@@ -1,0 +1,1 @@
+"""Device kernels: 256-bit limb arithmetic, elliptic curves, hashes, Merkle."""

@@ -1,0 +1,96 @@
+"""Golden-value tests for 256-bit limb arithmetic vs Python ints."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from fisco_bcos_tpu.ops import bigint as bi
+
+SECP_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+SM2_P = 0xFFFFFFFEFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF00000000FFFFFFFFFFFFFFFF
+
+rng = random.Random(1234)
+
+
+def rand256(below=1 << 256):
+    return rng.randrange(below)
+
+
+def test_roundtrip():
+    for _ in range(20):
+        x = rand256()
+        assert bi.from_limbs(bi.to_limbs(x)) == x
+
+
+def test_add_sub_carry():
+    xs = [rand256() for _ in range(64)] + [0, 1, (1 << 256) - 1]
+    ys = [rand256() for _ in range(64)] + [(1 << 256) - 1, (1 << 256) - 1, 1]
+    a = jnp.asarray(np.stack([bi.to_limbs(x) for x in xs]))
+    b = jnp.asarray(np.stack([bi.to_limbs(y) for y in ys]))
+    s, c = bi.add(a, b)
+    d, brw = bi.sub(a, b)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert bi.from_limbs(s[i]) == (x + y) % (1 << 256)
+        assert int(c[i]) == (x + y) >> 256
+        assert bi.from_limbs(d[i]) == (x - y) % (1 << 256)
+        assert int(brw[i]) == (1 if x < y else 0)
+    assert bool(bi.geq(a, b)[0]) == (xs[0] >= ys[0])
+
+
+def test_mod_ring_ops():
+    for p in (SECP_P, SECP_N, SM2_P):
+        m = bi.Mod(p)
+        xs = [rand256(p) for _ in range(32)] + [0, 1, p - 1]
+        ys = [rand256(p) for _ in range(32)] + [p - 1, p - 1, p - 1]
+        a = jnp.asarray(np.stack([bi.to_limbs(x) for x in xs]))
+        b = jnp.asarray(np.stack([bi.to_limbs(y) for y in ys]))
+        s = m.add(a, b)
+        d = m.sub(a, b)
+        n = m.neg(a)
+        h = m.half(a)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert bi.from_limbs(s[i]) == (x + y) % p, (i, hex(p))
+            assert bi.from_limbs(d[i]) == (x - y) % p
+            assert bi.from_limbs(n[i]) == (-x) % p
+            assert bi.from_limbs(h[i]) == (x * pow(2, -1, p)) % p
+
+
+def test_mont_mul():
+    for p in (SECP_P, SECP_N, SM2_P):
+        m = bi.Mod(p)
+        xs = [rand256(p) for _ in range(32)] + [0, 1, p - 1]
+        ys = [rand256(p) for _ in range(32)] + [p - 1, 1, p - 1]
+        a = jnp.asarray(np.stack([bi.to_limbs(x) for x in xs]))
+        b = jnp.asarray(np.stack([bi.to_limbs(y) for y in ys]))
+        am = m.to_mont(a)
+        bm = m.to_mont(b)
+        prod = m.from_mont(m.mul(am, bm))
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert bi.from_limbs(prod[i]) == (x * y) % p, (i, hex(p))
+        # round-trip
+        back = m.from_mont(am)
+        for i, x in enumerate(xs):
+            assert bi.from_limbs(back[i]) == x
+
+
+def test_pow_inv():
+    for p in (SECP_P, SECP_N):
+        m = bi.Mod(p)
+        xs = [rand256(p - 1) + 1 for _ in range(8)]
+        a = m.to_mont(jnp.asarray(np.stack([bi.to_limbs(x) for x in xs])))
+        inv = m.from_mont(m.inv(a))
+        cube = m.from_mont(m.pow_const(m.to_mont(
+            jnp.asarray(np.stack([bi.to_limbs(x) for x in xs]))), 3))
+        for i, x in enumerate(xs):
+            assert bi.from_limbs(inv[i]) == pow(x, -1, p)
+            assert bi.from_limbs(cube[i]) == pow(x, 3, p)
+
+
+def test_window_digits():
+    x = rand256()
+    a = jnp.asarray(bi.to_limbs(x))
+    d = bi.window_digits(a, 4)
+    for i in range(64):
+        assert int(d[i]) == (x >> (4 * i)) & 0xF
