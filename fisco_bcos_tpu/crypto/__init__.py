@@ -1,0 +1,2 @@
+"""Crypto plane: batch-first CryptoSuite (the reference's pluggable seam,
+/root/reference/bcos-crypto/bcos-crypto/interfaces/crypto/CryptoSuite.h:33-69)."""
