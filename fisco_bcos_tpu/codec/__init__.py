@@ -1,0 +1,1 @@
+"""Codecs: deterministic wire format + Solidity-ABI codec (bcos-codec)."""

@@ -1,0 +1,324 @@
+"""Precompiled system contracts.
+
+Reference counterpart: /root/reference/bcos-executor/src/precompiled/ —
+~20 precompiled contracts at reserved addresses (Table/KVTable, SystemConfig,
+Consensus, BFS, Crypto, plus benchmark contracts like DagTransfer under
+precompiled/extension/). This module provides the same capability seam:
+a registry of reserved addresses -> handler objects operating on the state
+overlay. Call data uses the framework's wire codec (a Solidity-ABI codec can
+layer on top for EVM compatibility).
+
+Addresses mirror the reference's numbering scheme (Common.h precompiled
+address constants): 20-byte addresses with a small integer suffix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from ..codec.wire import Reader, Writer
+from ..ledger import ledger as ledger_mod
+from ..protocol import LogEntry, TransactionStatus
+from ..storage.state import StateStorage
+
+
+def addr(n: int) -> bytes:
+    return n.to_bytes(20, "big")
+
+
+SYS_CONFIG_ADDRESS = addr(0x1000)
+TABLE_ADDRESS = addr(0x1001)
+CONSENSUS_ADDRESS = addr(0x1003)
+KV_TABLE_ADDRESS = addr(0x1009)
+CRYPTO_ADDRESS = addr(0x100A)
+BFS_ADDRESS = addr(0x100E)
+BALANCE_ADDRESS = addr(0x1011)
+DAG_TRANSFER_ADDRESS = addr(0x100C)  # parallel-transfer benchmark contract
+
+
+class PrecompileError(Exception):
+    def __init__(self, msg: str, status: TransactionStatus = TransactionStatus.PRECOMPILED_ERROR):
+        super().__init__(msg)
+        self.status = status
+
+
+@dataclasses.dataclass
+class CallContext:
+    state: StateStorage
+    block_number: int
+    timestamp: int
+    sender: bytes
+    to: bytes
+    input: bytes
+    gas_limit: int
+    suite: object = None
+    logs: list = dataclasses.field(default_factory=list)
+    # critical fields this call touches, for DAG conflict analysis
+    # (dag/CriticalFields.h:45 semantics): list of opaque keys
+    criticals: list = dataclasses.field(default_factory=list)
+
+
+class Precompile:
+    """Base: dispatch on a method name string, wire-codec args."""
+
+    name = "precompile"
+
+    def methods(self) -> dict[str, Callable[[CallContext, Reader, Writer], None]]:
+        raise NotImplementedError
+
+    def call(self, ctx: CallContext) -> bytes:
+        r = Reader(ctx.input)
+        try:
+            method = r.text()
+        except Exception as exc:
+            raise PrecompileError(f"{self.name}: bad call data") from exc
+        fn = self.methods().get(method)
+        if fn is None:
+            raise PrecompileError(f"{self.name}: unknown method {method!r}")
+        w = Writer()
+        fn(ctx, r, w)
+        return w.bytes()
+
+    # critical-field helper: declare the state key this call conflicts on
+    @staticmethod
+    def touch(ctx: CallContext, *keys: bytes) -> None:
+        ctx.criticals.extend(keys)
+
+
+def encode_call(method: str, build: Callable[[Writer], None] | None = None) -> bytes:
+    w = Writer()
+    w.text(method)
+    if build:
+        build(w)
+    return w.bytes()
+
+
+# ---------------------------------------------------------------------------
+# Balance / transfer (the executable core of the E2E slice + DagTransfer
+# benchmark semantics: precompiled/extension/DagTransferPrecompiled.cpp)
+# ---------------------------------------------------------------------------
+
+T_BALANCE = "c_balance"
+
+
+class BalancePrecompile(Precompile):
+    name = "balance"
+
+    def methods(self):
+        return {
+            "register": self._register,
+            "transfer": self._transfer,
+            "balanceOf": self._balance_of,
+        }
+
+    @staticmethod
+    def _get(ctx: CallContext, account: bytes) -> int:
+        v = ctx.state.get(T_BALANCE, account)
+        return int.from_bytes(v, "big") if v else 0
+
+    @staticmethod
+    def _set(ctx: CallContext, account: bytes, amount: int) -> None:
+        ctx.state.set(T_BALANCE, account, amount.to_bytes(16, "big"))
+
+    def _register(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        account = r.blob()
+        amount = r.u64()
+        self.touch(ctx, T_BALANCE.encode() + account)
+        if ctx.state.get(T_BALANCE, account) is not None:
+            raise PrecompileError("account exists")
+        self._set(ctx, account, amount)
+        w.u32(0)
+
+    def _transfer(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        src, dst, amount = r.blob(), r.blob(), r.u64()
+        self.touch(ctx, T_BALANCE.encode() + src, T_BALANCE.encode() + dst)
+        sb = self._get(ctx, src)
+        if sb < amount:
+            raise PrecompileError("insufficient balance",
+                                  TransactionStatus.REVERT)
+        self._set(ctx, src, sb - amount)
+        self._set(ctx, dst, self._get(ctx, dst) + amount)
+        ctx.logs.append(LogEntry(address=ctx.to, topics=[b"transfer"],
+                                 data=src + dst + amount.to_bytes(8, "big")))
+        w.u32(0)
+
+    def _balance_of(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        account = r.blob()
+        w.u64(self._get(ctx, account))
+
+
+# ---------------------------------------------------------------------------
+# KV table (precompiled/KVTablePrecompiled.cpp semantics)
+# ---------------------------------------------------------------------------
+
+T_USER_PREFIX = "u_"  # user tables namespaced like the reference's u_ prefix
+
+
+class KVTablePrecompile(Precompile):
+    name = "kv_table"
+
+    def methods(self):
+        return {
+            "createTable": self._create,
+            "set": self._set,
+            "get": self._get,
+        }
+
+    def _create(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = T_USER_PREFIX + r.text()
+        self.touch(ctx, table.encode())
+        meta_key = b"\x00__meta__"
+        if ctx.state.get(table, meta_key) is not None:
+            raise PrecompileError("table exists")
+        ctx.state.set(table, meta_key, b"kv")
+        w.u32(0)
+
+    def _set(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = T_USER_PREFIX + r.text()
+        key, value = r.blob(), r.blob()
+        self.touch(ctx, table.encode() + b"/" + key)
+        if ctx.state.get(table, b"\x00__meta__") is None:
+            raise PrecompileError("no such table")
+        ctx.state.set(table, key, value)
+        w.u32(0)
+
+    def _get(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = T_USER_PREFIX + r.text()
+        key = r.blob()
+        v = ctx.state.get(table, key)
+        w.u8(1 if v is not None else 0)
+        w.blob(v or b"")
+
+
+# ---------------------------------------------------------------------------
+# System config (precompiled/SystemConfigPrecompiled.cpp: setValueByKey with
+# next-block enablement, governed keys only)
+# ---------------------------------------------------------------------------
+
+_GOVERNED_KEYS = {
+    ledger_mod.SYSTEM_KEY_TX_COUNT_LIMIT,
+    ledger_mod.SYSTEM_KEY_LEADER_PERIOD,
+    ledger_mod.SYSTEM_KEY_GAS_LIMIT,
+}
+
+
+class SystemConfigPrecompile(Precompile):
+    name = "sys_config"
+
+    def methods(self):
+        return {"setValueByKey": self._set, "getValueByKey": self._get}
+
+    def _set(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key, value = r.text(), r.text()
+        if key not in _GOVERNED_KEYS:
+            raise PrecompileError(f"unknown system key {key}")
+        try:
+            iv = int(value)
+        except ValueError:
+            raise PrecompileError("system config value must be integer")
+        if key == ledger_mod.SYSTEM_KEY_TX_COUNT_LIMIT and iv < 1:
+            raise PrecompileError("tx_count_limit must be >= 1")
+        self.touch(ctx, b"s_config/" + key.encode())
+        wv = Writer()
+        wv.text(value).i64(ctx.block_number + 1)  # enables next block
+        ctx.state.set(ledger_mod.SYS_CONFIG, key.encode(), wv.bytes())
+        w.u32(0)
+
+    def _get(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key = r.text()
+        v = ctx.state.get(ledger_mod.SYS_CONFIG, key.encode())
+        if v is None:
+            w.text("")
+            w.i64(-1)
+            return
+        rr = Reader(v)
+        w.text(rr.text())
+        w.i64(rr.i64())
+
+
+# ---------------------------------------------------------------------------
+# Consensus-node management (precompiled/ConsensusPrecompiled.cpp: addSealer/
+# addObserver/remove/setWeight, effective next block)
+# ---------------------------------------------------------------------------
+
+class ConsensusPrecompile(Precompile):
+    name = "consensus"
+
+    def methods(self):
+        return {
+            "addSealer": self._add_sealer,
+            "addObserver": self._add_observer,
+            "remove": self._remove,
+            "setWeight": self._set_weight,
+        }
+
+    @staticmethod
+    def _write(ctx: CallContext, node_id: bytes, node_type: str, weight: int) -> None:
+        w = Writer()
+        w.text(node_type).u64(weight).i64(ctx.block_number + 1)
+        ctx.state.set(ledger_mod.SYS_CONSENSUS, node_id, w.bytes())
+
+    def _add_sealer(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        node_id, weight = r.blob(), r.u64()
+        if weight < 1:
+            raise PrecompileError("sealer weight must be >= 1")
+        self.touch(ctx, b"s_consensus/" + node_id)
+        self._write(ctx, node_id, "consensus_sealer", weight)
+        w.u32(0)
+
+    def _add_observer(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        node_id = r.blob()
+        self.touch(ctx, b"s_consensus/" + node_id)
+        self._write(ctx, node_id, "consensus_observer", 0)
+        w.u32(0)
+
+    def _remove(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        node_id = r.blob()
+        self.touch(ctx, b"s_consensus/" + node_id)
+        if ctx.state.get(ledger_mod.SYS_CONSENSUS, node_id) is None:
+            raise PrecompileError("node not found")
+        ctx.state.remove(ledger_mod.SYS_CONSENSUS, node_id)
+        w.u32(0)
+
+    def _set_weight(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        node_id, weight = r.blob(), r.u64()
+        v = ctx.state.get(ledger_mod.SYS_CONSENSUS, node_id)
+        if v is None:
+            raise PrecompileError("node not found")
+        rr = Reader(v)
+        node_type = rr.text()
+        self.touch(ctx, b"s_consensus/" + node_id)
+        self._write(ctx, node_id, node_type, weight)
+        w.u32(0)
+
+
+# ---------------------------------------------------------------------------
+# Crypto precompile (precompiled/CryptoPrecompiled.cpp: keccak/sm3/verify)
+# ---------------------------------------------------------------------------
+
+class CryptoPrecompile(Precompile):
+    name = "crypto"
+
+    def methods(self):
+        return {"hash": self._hash, "verify": self._verify}
+
+    def _hash(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        data = r.blob()
+        w.blob(ctx.suite.hash(data))
+
+    def _verify(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        digest, sig, pub = r.blob(), r.blob(), r.blob()
+        ok = ctx.suite.verify(pub, digest, sig)
+        w.u8(1 if ok else 0)
+
+
+PRECOMPILED_REGISTRY: dict[bytes, Precompile] = {
+    BALANCE_ADDRESS: BalancePrecompile(),
+    DAG_TRANSFER_ADDRESS: BalancePrecompile(),  # same semantics, bench alias
+    KV_TABLE_ADDRESS: KVTablePrecompile(),
+    TABLE_ADDRESS: KVTablePrecompile(),
+    SYS_CONFIG_ADDRESS: SystemConfigPrecompile(),
+    CONSENSUS_ADDRESS: ConsensusPrecompile(),
+    CRYPTO_ADDRESS: CryptoPrecompile(),
+}

@@ -1,0 +1,132 @@
+"""Node — the dependency-injection composition root.
+
+Reference counterpart: /root/reference/libinitializer/Initializer.cpp (:69
+initAirNode, :125 init — ordering front -> storage -> ledger -> executor ->
+scheduler -> txpool -> consensus -> start) and ProtocolInitializer.cpp:62-123
+(CryptoSuite selection by chain.sm_crypto — the seam where the TPU suite
+drops in).
+
+Round-1 shapes:
+  * solo mode (consensus="solo"): single node, auto-seal-execute-commit —
+    SURVEY §7 step 5's end-to-end slice. Every layer and both TPU kernel
+    families (recover at submit, Merkle at execute) are exercised.
+  * pbft mode arrives with the consensus package: same Node, a PBFTEngine
+    bound between sealer and scheduler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from ..crypto.suite import CryptoSuite, make_suite
+from ..executor.executor import TransactionExecutor
+from ..ledger.ledger import ConsensusNode, Ledger
+from ..protocol import Block
+from ..scheduler.scheduler import Scheduler
+from ..sealer.sealer import Sealer
+from ..storage.memory import MemoryStorage
+from ..storage.wal import WalStorage
+from ..txpool.txpool import TxPool
+from ..utils.log import LOG, badge
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    """Subset of the reference's config.ini surface (NodeConfig.cpp)."""
+
+    chain_id: str = "chain0"
+    group_id: str = "group0"
+    sm_crypto: bool = False
+    storage_path: Optional[str] = None  # None = in-memory
+    tx_count_limit: int = 1000
+    txpool_limit: int = 15000
+    block_limit_range: int = 600
+    min_seal_time: float = 0.05
+    consensus: str = "solo"  # solo | pbft
+    crypto_backend: str = "auto"  # device | host | auto
+    device_min_batch: int = 64
+
+
+class Node:
+    def __init__(self, config: NodeConfig | None = None,
+                 keypair=None, suite: CryptoSuite | None = None):
+        self.config = config or NodeConfig()
+        cfg = self.config
+        self.suite = suite or make_suite(cfg.sm_crypto,
+                                         backend=cfg.crypto_backend,
+                                         device_min_batch=cfg.device_min_batch)
+        self.keypair = keypair or self.suite.generate_keypair()
+        self.storage = (WalStorage(cfg.storage_path) if cfg.storage_path
+                        else MemoryStorage())
+        self.ledger = Ledger(self.storage, self.suite)
+        self.txpool = TxPool(self.suite, self.ledger, cfg.chain_id,
+                             cfg.group_id, cfg.txpool_limit,
+                             cfg.block_limit_range)
+        self.executor = TransactionExecutor(self.suite)
+        self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
+                                   self.suite, self.txpool)
+        self.sealer = Sealer(self.txpool, self.suite, self._on_proposal,
+                             cfg.tx_count_limit, cfg.min_seal_time)
+        self._commit_lock = threading.Lock()
+        self.consensus = None  # bound by PBFT wiring
+        self._started = False
+
+    # -- genesis -----------------------------------------------------------
+    def build_genesis(self, sealers: Optional[list[ConsensusNode]] = None) -> None:
+        sealers = sealers or [ConsensusNode(self.keypair.pub_bytes)]
+        self.ledger.build_genesis(sealers,
+                                  tx_count_limit=self.config.tx_count_limit)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        if self.ledger.current_number() < 0:
+            self.build_genesis()
+        self._started = True
+        if self.config.consensus == "solo":
+            self.sealer.set_should_seal(True, self.ledger.current_number() + 1)
+            self.sealer.start()
+        elif self.consensus is not None:
+            self.consensus.start()
+            self.sealer.start()
+        LOG.info(badge("NODE", "started",
+                       number=self.ledger.current_number(),
+                       mode=self.config.consensus))
+
+    def stop(self) -> None:
+        self.sealer.stop()
+        if self.consensus is not None:
+            self.consensus.stop()
+        self._started = False
+
+    # -- solo-consensus proposal path --------------------------------------
+    def _on_proposal(self, block: Block) -> bool:
+        if self.config.consensus != "solo":
+            return self.consensus.submit_proposal(block)
+        with self._commit_lock:
+            cfg = self.ledger.ledger_config()
+            block.header.sealer_list = [n.node_id for n in cfg.consensus_nodes]
+            result = self.scheduler.execute_block(block)
+            if result is None:
+                return False
+            # solo: self-sign the header as its own commit seal
+            seal = self.suite.sign(self.keypair,
+                                   result.header.hash(self.suite))
+            result.header.signature_list = [(0, seal)]
+            ok = self.scheduler.commit_block(result.header)
+            if ok:
+                self.sealer.set_should_seal(
+                    True, self.ledger.current_number() + 1,
+                    max_txs=cfg.block_tx_count_limit)
+            return ok
+
+    # -- client surface (pre-RPC, in-process) ------------------------------
+    def send_transaction(self, tx) -> "object":
+        return self.txpool.submit(tx)
+
+    def call(self, tx):
+        return self.scheduler.call(tx)

@@ -288,11 +288,17 @@ class Mod:
             [(e >> (window * i)) & ((1 << window) - 1) for i in range(nd)][::-1],
             dtype=np.int32,
         )
-        # table[k] = a^k (Montgomery form), k in [0, 2^window)
-        tbl = [self.one_mont(a.shape[:-1]), a]
-        for _ in range(2, 1 << window):
-            tbl.append(self.mul(tbl[-1], a))
-        table = jnp.stack(tbl, axis=0)  # [2^w, ..., NLIMBS]
+
+        # table[k] = a^k (Montgomery form), k in [0, 2^window); built with a
+        # scan so the multiply body is compiled once, not 2^w times
+        def tbl_step(prev, _):
+            nxt = self.mul(prev, a)
+            return nxt, nxt
+
+        _, rest = jax.lax.scan(tbl_step, a, None, length=(1 << window) - 2)
+        table = jnp.concatenate(
+            [self.one_mont(a.shape[:-1])[None], a[None], rest], axis=0
+        )  # [2^w, ..., NLIMBS]
 
         def body(acc, dig):
             for _ in range(window):

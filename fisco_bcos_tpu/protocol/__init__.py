@@ -1,0 +1,31 @@
+"""Protocol data model: Transaction / Receipt / BlockHeader / Block.
+
+Reference counterpart: the abstract data interfaces in
+/root/reference/bcos-framework/bcos-framework/protocol/{Transaction,
+TransactionReceipt,BlockHeader,Block}.h and their Tars-backed implementations
+in bcos-tars-protocol/bcos-tars-protocol/protocol/*Impl.*.
+"""
+
+from .types import (
+    Block,
+    BlockHeader,
+    LogEntry,
+    ParentInfo,
+    Receipt,
+    Transaction,
+    TransactionStatus,
+    batch_hash,
+    batch_recover_senders,
+)
+
+__all__ = [
+    "Block",
+    "BlockHeader",
+    "LogEntry",
+    "ParentInfo",
+    "Receipt",
+    "Transaction",
+    "TransactionStatus",
+    "batch_hash",
+    "batch_recover_senders",
+]

@@ -1,0 +1,144 @@
+"""Scheduler — drives a block through execute -> roots -> 2PC commit.
+
+Reference counterpart: /root/reference/bcos-scheduler/src/SchedulerImpl.cpp
+(:125 executeBlock, :370 commitBlock) and BlockExecutive.cpp (:52 prepare,
+:380 asyncExecute, :1124 txsRoot/receiptsRoot, :1265 batchBlockCommit 2PC).
+
+The execute phase fills the proposal's txs from the txpool
+(BlockExecutive.cpp:324 asyncFillBlock), runs the executor (DAG waves), then
+computes the three roots — txs/receipts via the TPU Merkle kernel, state root
+over the changeset — and returns the finalised header for consensus
+checkpointing. `commit` stages ledger writes + execution state into one
+changeset and drives prepare/commit on the transactional storage.
+
+Blocks execute strictly in order (block N+1 waits for N's header hash); the
+pipeline overlap happens a level up, in consensus (PBFT pipelines proposals,
+PBFTConfig waterlines) — matching the reference's design where the scheduler
+serialises execution per block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional, Sequence
+
+from ..executor.executor import TransactionExecutor
+from ..ledger.ledger import Ledger
+from ..protocol import Block, BlockHeader, ParentInfo, Receipt, Transaction
+from ..storage.interface import TransactionalStorage
+from ..storage.state import StateStorage
+from ..utils.log import LOG, badge, metric
+
+
+@dataclasses.dataclass
+class ExecutionResult:
+    header: BlockHeader
+    receipts: list[Receipt]
+    state: StateStorage  # holds the block's execution changeset
+
+
+class Scheduler:
+    def __init__(self, storage: TransactionalStorage, ledger: Ledger,
+                 executor: TransactionExecutor, suite, txpool=None):
+        self.storage = storage
+        self.ledger = ledger
+        self.executor = executor
+        self.suite = suite
+        self.txpool = txpool
+        self._lock = threading.RLock()
+        # cache: block hash -> ExecutionResult awaiting commit
+        self._executed: dict[bytes, ExecutionResult] = {}
+
+    # -- execute (SchedulerImpl::executeBlock) -----------------------------
+    def execute_block(self, block: Block, sealer_list: Sequence[bytes] | None = None
+                      ) -> Optional[ExecutionResult]:
+        """Execute a proposal; returns the finalised header (with roots) or
+        None if the block cannot be executed (bad parent / missing txs)."""
+        t0 = time.monotonic()
+        with self._lock:
+            header = block.header
+            current = self.ledger.current_number()
+            if header.number != current + 1:
+                LOG.warning(badge("SCHED", "execute-out-of-order",
+                                  number=header.number, current=current))
+                return None
+            parent = self.ledger.header_by_number(current)
+            parent_hash = parent.hash(self.suite) if parent else b"\x00" * 32
+
+            txs = block.transactions
+            if not txs and block.tx_hashes:
+                if self.txpool is None:
+                    return None
+                txs = self.txpool.fill_block(block.tx_hashes)
+                if txs is None:
+                    LOG.warning(badge("SCHED", "missing-txs", number=header.number))
+                    return None
+                block.transactions = txs
+
+            state = StateStorage(self.storage)
+            receipts = self.executor.execute_block_dag(
+                txs, state, header.number, header.timestamp)
+
+            # finalise header: parent info + roots
+            header.parent_info = [ParentInfo(current, parent_hash)]
+            header.txs_root = block.calculate_txs_root(self.suite)
+            block.receipts = receipts
+            header.receipts_root = block.calculate_receipts_root(self.suite)
+            self.ledger.prewrite_block(block, state)
+            header.state_root = self.executor.state_root(state.changeset())
+            header.gas_used = sum(r.gas_used for r in receipts)
+            header.invalidate()
+            if sealer_list is not None:
+                header.sealer_list = list(sealer_list)
+            result = ExecutionResult(header, receipts, state)
+            self._executed[header.hash(self.suite)] = result
+            metric("scheduler.execute", number=header.number, n_tx=len(txs),
+                   ms=int((time.monotonic() - t0) * 1000))
+            return result
+
+    # -- commit (SchedulerImpl::commitBlock; 2PC) --------------------------
+    def commit_block(self, header: BlockHeader) -> bool:
+        """Commit a previously-executed block (by header hash identity)."""
+        t0 = time.monotonic()
+        with self._lock:
+            hh = header.hash(self.suite)
+            result = self._executed.pop(hh, None)
+            if result is None:
+                LOG.error(badge("SCHED", "commit-unknown-block",
+                                number=header.number))
+                return False
+            # persist the final header (with any commit seals collected)
+            result.header.signature_list = header.signature_list
+            st = result.state
+            from ..ledger.ledger import T_HASH2NUM, T_HEADER, _be8
+            st.set(T_HEADER, _be8(header.number), result.header.encode())
+            st.set(T_HASH2NUM, hh, _be8(header.number))
+            changes = st.changeset()
+            try:
+                self.storage.prepare(header.number, changes)
+                self.storage.commit(header.number)
+            except Exception:
+                LOG.exception(badge("SCHED", "commit-2pc-failed",
+                                    number=header.number))
+                self.storage.rollback(header.number)
+                return False
+            # drop any other stale executed results for this height
+            for h in [h for h, r in self._executed.items()
+                      if r.header.number <= header.number]:
+                self._executed.pop(h, None)
+        if self.txpool is not None:
+            tx_hashes = self.ledger.tx_hashes_by_number(header.number)
+            nonces = self.ledger.nonces_by_number(header.number)
+            self.txpool.on_block_committed(header.number, tx_hashes, nonces)
+        metric("scheduler.commit", number=header.number,
+               ms=int((time.monotonic() - t0) * 1000))
+        return True
+
+    # -- read-only call (SchedulerImpl::call) ------------------------------
+    def call(self, tx: Transaction) -> Receipt:
+        state = StateStorage(self.storage)
+        n = self.ledger.current_number()
+        return self.executor.execute_transaction(
+            tx, state, n, int(time.time() * 1000))

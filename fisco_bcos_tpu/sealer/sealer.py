@@ -1,0 +1,86 @@
+"""Sealer — a worker loop that packages txs into proposals on the leader.
+
+Reference counterpart: /root/reference/bcos-sealer/bcos-sealer/Sealer.cpp
+(:94 executeWorker -> :116 submitProposal) + SealingManager.cpp (:232
+fetchTransactions via txpool asyncSealTxs). The sealer only runs when this
+node expects to lead (consensus tells it via `set_should_seal`); proposals
+carry tx-hash metadata (not full txs) like the reference's metadata-only
+sealing (MemoryStorage.cpp:570 batchFetchTxs).
+
+min_seal_time: like the reference's min_seal_time config, the sealer waits
+up to that long to fill a block before proposing a partial one; an empty
+pool proposes nothing (consensus generates empty blocks on timeout if
+configured, not the sealer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..protocol import Block, BlockHeader
+from ..txpool.txpool import TxPool
+from ..utils.log import LOG, badge, metric
+from ..utils.worker import Worker
+
+
+class Sealer(Worker):
+    def __init__(self, txpool: TxPool, suite,
+                 submit_proposal: Callable[[Block], bool],
+                 max_txs_per_block: int = 1000,
+                 min_seal_time: float = 0.5):
+        super().__init__("sealer", idle_wait=0.05)
+        self.txpool = txpool
+        self.suite = suite
+        self.submit_proposal = submit_proposal
+        self.max_txs_per_block = max_txs_per_block
+        self.min_seal_time = min_seal_time
+        self._should_seal = False
+        self._next_number = 0
+        self._first_pending_at: Optional[float] = None
+        self._lock = threading.Lock()
+        txpool.register_unseal_notifier(self.wakeup)
+
+    # consensus drives these
+    def set_should_seal(self, should: bool, next_number: int,
+                        max_txs: Optional[int] = None) -> None:
+        with self._lock:
+            self._should_seal = should
+            self._next_number = next_number
+            if max_txs is not None:
+                self.max_txs_per_block = max_txs
+        self.wakeup()
+
+    def execute_worker(self) -> None:
+        with self._lock:
+            should = self._should_seal
+            number = self._next_number
+            limit = self.max_txs_per_block
+        if not should:
+            return
+        pending = self.txpool.pending_count()
+        if pending == 0:
+            self._first_pending_at = None
+            return
+        now = time.monotonic()
+        if self._first_pending_at is None:
+            self._first_pending_at = now
+        if pending < limit and now - self._first_pending_at < self.min_seal_time:
+            return  # wait to fill the block
+        txs, hashes = self.txpool.seal(limit)
+        if not txs:
+            return
+        self._first_pending_at = None
+        header = BlockHeader(number=number,
+                             timestamp=int(time.time() * 1000))
+        block = Block(header=header, transactions=list(txs),
+                      tx_hashes=list(hashes))
+        with self._lock:
+            self._should_seal = False  # one proposal per grant
+        if not self.submit_proposal(block):
+            self.txpool.unseal(hashes)
+            with self._lock:
+                self._should_seal = True
+        else:
+            metric("sealer.proposal", number=number, n_tx=len(txs))

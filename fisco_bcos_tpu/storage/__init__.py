@@ -1,0 +1,22 @@
+"""Storage stack: transactional KV with 2PC + state overlays.
+
+Reference counterpart: TransactionalStorageInterface with asyncPrepare/
+asyncCommit/asyncRollback (/root/reference/bcos-framework/bcos-framework/
+storage/StorageInterface.h:126-141), RocksDBStorage (bcos-storage/
+bcos-storage/RocksDBStorage.h:64-68) and the StateStorage/KeyPageStorage
+overlays (bcos-table/src/).
+"""
+
+from .interface import Entry, StorageInterface, TransactionalStorage
+from .memory import MemoryStorage
+from .state import StateStorage
+from .wal import WalStorage
+
+__all__ = [
+    "Entry",
+    "StorageInterface",
+    "TransactionalStorage",
+    "MemoryStorage",
+    "StateStorage",
+    "WalStorage",
+]

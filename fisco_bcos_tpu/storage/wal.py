@@ -1,0 +1,207 @@
+"""Durable storage: write-ahead log + in-memory index + snapshot compaction.
+
+Fills the RocksDBStorage slot (/root/reference/bcos-storage/bcos-storage/
+RocksDBStorage.h:64-68) for single-node deployments: the 2PC `prepare`
+stages a changeset, `commit` appends one atomic, checksummed WAL record and
+fsyncs — crash recovery replays the log over the last snapshot, and prepared-
+but-uncommitted blocks vanish, exactly the semantics the scheduler's
+batchBlockCommit relies on (BlockExecutive.cpp:1265). Periodic compaction
+writes a full snapshot and truncates the log.
+
+(A C++ LSM engine can slot in behind the same TransactionalStorage contract
+for Pro/Max-scale state; the WAL format below is deliberately trivial so the
+native engine can share it.)
+
+Record format (all little-endian):
+  [u32 crc32 of payload][u64 payload_len][payload]
+  payload = u64 block_number, u32 nitems,
+            nitems * (u8 deleted, u16 table_len, table, u32 key_len, key,
+                      u32 val_len, val)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Iterator, Optional
+
+from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
+
+_HDR = struct.Struct("<IQ")
+
+
+class WalStorage(TransactionalStorage):
+    SNAPSHOT = "snapshot.bin"
+    LOG = "wal.log"
+
+    def __init__(self, path: str, compact_every: int = 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._tables: dict[str, dict[bytes, bytes]] = {}
+        self._prepared: dict[int, ChangeSet] = {}
+        self._lock = threading.RLock()
+        self._commits_since_compact = 0
+        self.compact_every = compact_every
+        self._recover()
+        self._log = open(os.path.join(path, self.LOG), "ab")
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self) -> None:
+        snap = os.path.join(self.path, self.SNAPSHOT)
+        if os.path.exists(snap):
+            with open(snap, "rb") as f:
+                data = f.read()
+            if len(data) >= 4:
+                crc = struct.unpack("<I", data[:4])[0]
+                body = data[4:]
+                if zlib.crc32(body) == crc:
+                    self._load_snapshot(body)
+        logp = os.path.join(self.path, self.LOG)
+        if os.path.exists(logp):
+            with open(logp, "rb") as f:
+                raw = f.read()
+            off = 0
+            while off + _HDR.size <= len(raw):
+                crc, ln = _HDR.unpack_from(raw, off)
+                if off + _HDR.size + ln > len(raw):
+                    break  # torn tail record: drop
+                payload = raw[off + _HDR.size : off + _HDR.size + ln]
+                if zlib.crc32(payload) != crc:
+                    break
+                self._apply_payload(payload)
+                off += _HDR.size + ln
+
+    def _load_snapshot(self, body: bytes) -> None:
+        off = 0
+        (ntab,) = struct.unpack_from("<I", body, off)
+        off += 4
+        for _ in range(ntab):
+            (tl,) = struct.unpack_from("<H", body, off)
+            off += 2
+            table = body[off : off + tl].decode()
+            off += tl
+            (nrow,) = struct.unpack_from("<I", body, off)
+            off += 4
+            rows = {}
+            for _ in range(nrow):
+                kl, vl = struct.unpack_from("<II", body, off)
+                off += 8
+                k = body[off : off + kl]
+                off += kl
+                v = body[off : off + vl]
+                off += vl
+                rows[k] = v
+            self._tables[table] = rows
+
+    def _apply_payload(self, payload: bytes) -> None:
+        off = 8  # skip block number
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        for _ in range(n):
+            deleted = payload[off]
+            off += 1
+            (tl,) = struct.unpack_from("<H", payload, off)
+            off += 2
+            table = payload[off : off + tl].decode()
+            off += tl
+            (kl,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            key = payload[off : off + kl]
+            off += kl
+            (vl,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            val = payload[off : off + vl]
+            off += vl
+            if deleted:
+                self._tables.get(table, {}).pop(key, None)
+            else:
+                self._tables.setdefault(table, {})[key] = val
+
+    # -- reads/writes (non-transactional direct ops, genesis bootstrap) ----
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._tables.get(table, {}).get(key)
+
+    def set(self, table: str, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._append_record(0, {(table, key): Entry(value)})
+            self._tables.setdefault(table, {})[key] = value
+
+    def remove(self, table: str, key: bytes) -> None:
+        with self._lock:
+            self._append_record(0, {(table, key): Entry(b"", EntryStatus.DELETED)})
+            self._tables.get(table, {}).pop(key, None)
+
+    def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
+        with self._lock:
+            ks = sorted(k for k in self._tables.get(table, {})
+                        if k.startswith(prefix))
+        return iter(ks)
+
+    # -- 2PC ---------------------------------------------------------------
+    def prepare(self, block_number: int, changes: ChangeSet) -> None:
+        with self._lock:
+            self._prepared[block_number] = dict(changes)
+
+    def commit(self, block_number: int) -> None:
+        with self._lock:
+            cs = self._prepared.pop(block_number)
+            self._append_record(block_number, cs)
+            for (table, key), entry in cs.items():
+                if entry.deleted:
+                    self._tables.get(table, {}).pop(key, None)
+                else:
+                    self._tables.setdefault(table, {})[key] = entry.value
+            self._commits_since_compact += 1
+            if self._commits_since_compact >= self.compact_every:
+                self.compact()
+
+    def rollback(self, block_number: int) -> None:
+        with self._lock:
+            self._prepared.pop(block_number, None)
+
+    # -- log/snapshot mechanics -------------------------------------------
+    def _append_record(self, block_number: int, cs: ChangeSet) -> None:
+        parts = [struct.pack("<QI", block_number, len(cs))]
+        for (table, key), e in cs.items():
+            tb = table.encode()
+            parts.append(struct.pack("<BH", 1 if e.deleted else 0, len(tb)))
+            parts.append(tb)
+            parts.append(struct.pack("<I", len(key)))
+            parts.append(key)
+            parts.append(struct.pack("<I", len(e.value)))
+            parts.append(e.value)
+        payload = b"".join(parts)
+        self._log.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
+        self._log.flush()
+        os.fsync(self._log.fileno())
+
+    def compact(self) -> None:
+        """Write a snapshot and truncate the WAL (atomic rename)."""
+        with self._lock:
+            parts = [struct.pack("<I", len(self._tables))]
+            for table, rows in self._tables.items():
+                tb = table.encode()
+                parts.append(struct.pack("<H", len(tb)))
+                parts.append(tb)
+                parts.append(struct.pack("<I", len(rows)))
+                for k, v in rows.items():
+                    parts.append(struct.pack("<II", len(k), len(v)))
+                    parts.append(k)
+                    parts.append(v)
+            body = b"".join(parts)
+            tmp = os.path.join(self.path, self.SNAPSHOT + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(struct.pack("<I", zlib.crc32(body)) + body)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.path, self.SNAPSHOT))
+            self._log.close()
+            self._log = open(os.path.join(self.path, self.LOG), "wb")
+            self._commits_since_compact = 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._log.close()

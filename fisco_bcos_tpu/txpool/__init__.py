@@ -1,0 +1,5 @@
+"""Transaction pool: pending store + batch validator (bcos-txpool)."""
+
+from .txpool import TxPool, TxSubmitResult
+
+__all__ = ["TxPool", "TxSubmitResult"]

@@ -1,0 +1,250 @@
+"""TxPool — pending-transaction store with TPU batch validation.
+
+Reference counterpart: /root/reference/bcos-txpool/bcos-txpool/ —
+MemoryStorage (txpool/storage/MemoryStorage.cpp:66 submitTransaction, :223
+verifyAndSubmitTransaction, :570 batchFetchTxs, :919 batchVerifyProposal) and
+TxValidator (txpool/validator/TxValidator.cpp:27-68: nonce/chainId/groupId/
+blockLimit checks then the per-tx signature recover at :56).
+
+Design difference (the point of this framework): validation is *batch-first*.
+`submit_batch` runs the cheap host checks per tx, then pushes every
+still-unverified signature through ONE TPU recover call
+(protocol.batch_recover_senders) instead of the reference's
+tbb::parallel_for over scalar verifies (TransactionSync.cpp:516-537).
+The single-tx `submit` is the degenerate case. Duplicate-nonce tracking
+follows the reference's TxPoolNonceChecker: nonces of the last `block_limit`
+committed blocks are a rolling filter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..ledger.ledger import Ledger
+from ..protocol import Block, Transaction, TransactionStatus, batch_hash, \
+    batch_recover_senders
+from ..utils.log import LOG, badge, metric
+
+DEFAULT_POOL_LIMIT = 15000  # txpool.limit default (NodeConfig.cpp:473-493)
+
+
+@dataclasses.dataclass
+class TxSubmitResult:
+    tx_hash: bytes
+    status: TransactionStatus
+    sender: Optional[bytes] = None
+
+
+class TxPool:
+    def __init__(self, suite, ledger: Ledger, chain_id: str = "chain0",
+                 group_id: str = "group0", pool_limit: int = DEFAULT_POOL_LIMIT,
+                 block_limit_range: int = 600):
+        self.suite = suite
+        self.ledger = ledger
+        self.chain_id = chain_id
+        self.group_id = group_id
+        self.pool_limit = pool_limit
+        self.block_limit_range = block_limit_range
+        self._lock = threading.RLock()
+        self._pending: "OrderedDict[bytes, Transaction]" = OrderedDict()
+        self._sealed: set[bytes] = set()
+        # rolling nonce filter: block number -> set of nonces
+        self._nonces_by_block: dict[int, set[str]] = {}
+        self._known_nonces: set[str] = set()
+        self._on_ready: list[Callable[[], None]] = []
+        # receipt futures: tx hash -> Event set at commit (RPC waits on it)
+        self._waiters: dict[bytes, threading.Event] = {}
+
+    # -- notifications -----------------------------------------------------
+    def register_unseal_notifier(self, fn: Callable[[], None]) -> None:
+        self._on_ready.append(fn)
+
+    def _notify_ready(self) -> None:
+        for fn in self._on_ready:
+            fn()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, tx: Transaction) -> TxSubmitResult:
+        return self.submit_batch([tx])[0]
+
+    def submit_batch(self, txs: Sequence[Transaction]) -> list[TxSubmitResult]:
+        """Host checks + one TPU batch recover for the survivors."""
+        t0 = time.monotonic()
+        hashes = batch_hash(txs, self.suite)
+        results: list[Optional[TxSubmitResult]] = [None] * len(txs)
+        need_verify: list[int] = []
+        with self._lock:
+            current = self.ledger.current_number()
+            seen_batch: set[bytes] = set()
+            for i, (tx, h) in enumerate(zip(txs, hashes)):
+                st = self._precheck(tx, h, current)
+                if st is None and h in seen_batch:
+                    st = TransactionStatus.ALREADY_IN_TXPOOL
+                if st is not None:
+                    results[i] = TxSubmitResult(h, st)
+                else:
+                    seen_batch.add(h)
+                    need_verify.append(i)
+        if need_verify:
+            sub = [txs[i] for i in need_verify]
+            _, ok = batch_recover_senders(sub, self.suite)
+            with self._lock:
+                for j, i in enumerate(need_verify):
+                    tx, h = txs[i], hashes[i]
+                    if not ok[j]:
+                        results[i] = TxSubmitResult(h, TransactionStatus.INVALID_SIGNATURE)
+                        continue
+                    if len(self._pending) >= self.pool_limit:
+                        results[i] = TxSubmitResult(h, TransactionStatus.TXPOOL_FULL)
+                        continue
+                    self._pending[h] = tx
+                    if tx.nonce:
+                        self._known_nonces.add(tx.nonce)
+                    results[i] = TxSubmitResult(h, TransactionStatus.OK,
+                                                tx.sender(self.suite))
+        metric("txpool.submit_batch", n=len(txs),
+               ok=sum(1 for r in results if r.status == TransactionStatus.OK),
+               ms=int((time.monotonic() - t0) * 1000))
+        if need_verify:
+            self._notify_ready()
+        return [r for r in results]
+
+    def _precheck(self, tx: Transaction, h: bytes,
+                  current: int) -> Optional[TransactionStatus]:
+        """Cheap host-side validation (TxValidator.cpp:33-51 semantics)."""
+        if h in self._pending or h in self._sealed:
+            return TransactionStatus.ALREADY_IN_TXPOOL
+        if self.ledger.receipt(h) is not None:
+            return TransactionStatus.ALREADY_KNOWN
+        if tx.chain_id != self.chain_id:
+            return TransactionStatus.INVALID_CHAINID
+        if tx.group_id != self.group_id:
+            return TransactionStatus.INVALID_GROUPID
+        if tx.block_limit <= current or \
+                tx.block_limit > current + self.block_limit_range:
+            return TransactionStatus.BLOCK_LIMIT_CHECK_FAIL
+        if tx.nonce and tx.nonce in self._known_nonces:
+            return TransactionStatus.NONCE_CHECK_FAIL
+        return None
+
+    # -- sealing (MemoryStorage.cpp:570 batchFetchTxs) ---------------------
+    def seal(self, max_txs: int) -> tuple[list[Transaction], list[bytes]]:
+        """Fetch up to max_txs unsealed txs, marking them sealed. Re-checks
+        block_limit against the current height (a tx can expire while queued;
+        the reference re-validates at seal time) and drops expired ones."""
+        with self._lock:
+            current = self.ledger.current_number()
+            out, hashes, expired = [], [], []
+            for h, tx in self._pending.items():
+                if h in self._sealed:
+                    continue
+                if tx.block_limit <= current:
+                    expired.append(h)
+                    continue
+                out.append(tx)
+                hashes.append(h)
+                if len(out) >= max_txs:
+                    break
+            self._sealed.update(hashes)
+            for h in expired:
+                self._pending.pop(h, None)
+        return out, hashes
+
+    def unseal(self, hashes: Sequence[bytes]) -> None:
+        """Return sealed txs to the pool (failed proposal / view change)."""
+        with self._lock:
+            for h in hashes:
+                self._sealed.discard(h)
+        self._notify_ready()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending) - len(self._sealed)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"pending": len(self._pending), "sealed": len(self._sealed)}
+
+    # -- proposal verification (TxPool.cpp:160 asyncVerifyBlock) -----------
+    def fill_block(self, tx_hashes: Sequence[bytes]) -> Optional[list[Transaction]]:
+        """hashes -> txs from the pool (BlockExecutive::prepare's
+        asyncFillBlock). None if any is missing."""
+        with self._lock:
+            out = []
+            for h in tx_hashes:
+                tx = self._pending.get(h)
+                if tx is None:
+                    return None
+                out.append(tx)
+            return out
+
+    def verify_proposal(self, block: Block) -> bool:
+        """Verify a proposal: every tx known (already validated at submit) or,
+        if the proposal carries full txs, batch-verify the unknown ones
+        (MemoryStorage.cpp:919 batchVerifyProposal)."""
+        hashes = block.tx_hashes or [t.hash(self.suite) for t in block.transactions]
+        with self._lock:
+            missing = [h for h in hashes if h not in self._pending]
+        if not missing:
+            return True
+        if not block.transactions:
+            return False
+        by_hash = {t.hash(self.suite): t for t in block.transactions}
+        todo = [by_hash[h] for h in missing if h in by_hash]
+        if len(todo) != len(missing):
+            return False
+        _, ok = batch_recover_senders(todo, self.suite)
+        if not bool(np.all(ok)):
+            return False
+        # import the newly-verified txs so commit can prune them
+        with self._lock:
+            current = self.ledger.current_number()
+            for tx in todo:
+                h = tx.hash(self.suite)
+                if self._precheck(tx, h, current) is None:
+                    self._pending[h] = tx
+                    self._sealed.add(h)
+                    if tx.nonce:
+                        self._known_nonces.add(tx.nonce)
+        return True
+
+    # -- commit notification (prune + nonce window) ------------------------
+    def on_block_committed(self, number: int, tx_hashes: Sequence[bytes],
+                           nonces: Sequence[str]) -> None:
+        with self._lock:
+            for h in tx_hashes:
+                self._pending.pop(h, None)
+                self._sealed.discard(h)
+            ns = set(n for n in nonces if n)
+            self._nonces_by_block[number] = ns
+            self._known_nonces.update(ns)
+            expired = number - self.block_limit_range
+            for bn in [b for b in self._nonces_by_block if b <= expired]:
+                self._known_nonces -= self._nonces_by_block.pop(bn)
+            events = [self._waiters.pop(h) for h in tx_hashes
+                      if h in self._waiters]
+        for ev in events:
+            ev.set()
+        self._notify_ready()
+
+    # -- RPC receipt waiting ----------------------------------------------
+    def wait_for_receipt(self, tx_hash: bytes, timeout: float = 30.0):
+        """Block until the tx is committed; -> Receipt or None on timeout."""
+        rc = self.ledger.receipt(tx_hash)
+        if rc is not None:
+            return rc
+        with self._lock:
+            ev = self._waiters.setdefault(tx_hash, threading.Event())
+        # commit may have landed between the first read and registration
+        if self.ledger.receipt(tx_hash) is not None:
+            ev.set()
+        ev.wait(timeout)
+        with self._lock:
+            self._waiters.pop(tx_hash, None)
+        return self.ledger.receipt(tx_hash)

@@ -1,0 +1,6 @@
+"""Base utilities (bcos-utilities counterpart): logging, workers, timers."""
+
+from .log import LOG, init_log, metric
+from .worker import Worker
+
+__all__ = ["LOG", "init_log", "metric", "Worker"]

@@ -1,0 +1,41 @@
+"""Structured key-value logging + METRIC channel.
+
+Counterpart of the reference's Boost.Log setup with LOG_BADGE/LOG_KV macros
+and the machine-readable METRIC channel (/root/reference/bcos-utilities/
+bcos-utilities/BoostLog.h, TxPool.cpp:206 metric lines). Python logging with
+a key=value formatter; `metric()` emits one flat line per event for offline
+scraping (tools/log_extract.sh analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+LOG = logging.getLogger("bcos-tpu")
+_METRIC = logging.getLogger("bcos-tpu.metric")
+
+
+def kv(**kw) -> str:
+    return ",".join(f"{k}={v}" for k, v in kw.items())
+
+
+def badge(*names: str, **kw) -> str:
+    head = "".join(f"[{n}]" for n in names)
+    return head + (": " + kv(**kw) if kw else "")
+
+
+def metric(event: str, **kw) -> None:
+    """METRIC channel: one machine-readable line per event."""
+    _METRIC.info("METRIC|%s|%d|%s", event, time.time_ns() // 1_000_000, kv(**kw))
+
+
+def init_log(level: int = logging.INFO, stream=None) -> None:
+    h = logging.StreamHandler(stream or sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname).1s %(name)s %(message)s"))
+    root = logging.getLogger("bcos-tpu")
+    root.handlers[:] = [h]
+    root.setLevel(level)
+    root.propagate = False

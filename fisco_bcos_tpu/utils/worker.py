@@ -1,0 +1,57 @@
+"""Worker — a stoppable loop thread with a wake-up queue.
+
+Counterpart of the reference's Worker base (/root/reference/bcos-utilities/
+bcos-utilities/Worker.h) that drives the sealer/consensus/sync loops
+(Sealer.cpp:94, PBFTEngine.cpp:40, BlockSync.cpp:183): a single thread spins
+`execute_worker()` whenever signalled, guaranteeing single-writer semantics
+for the module it drives.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+
+class Worker:
+    def __init__(self, name: str, idle_wait: float = 0.02):
+        self.name = name
+        self.idle_wait = idle_wait
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # override or assign
+    def execute_worker(self) -> None:  # pragma: no cover - overridden
+        pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.idle_wait)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            try:
+                self.execute_worker()
+            except Exception:  # worker loops must not die silently
+                from .log import LOG
+                LOG.exception("worker %s iteration failed", self.name)
+
+    def wakeup(self) -> None:
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,245 @@
+"""End-to-end slice (SURVEY §7 step 5): submit -> txpool validate -> seal ->
+execute (precompiles, DAG) -> Merkle roots -> 2PC commit -> receipts/proofs.
+
+Host crypto backend keeps this fast; kernel golden tests cover the device
+paths separately. Mirrors the reference's module tests with fakes
+(bcos-framework testutils/faker) driving real txpool/sealer/scheduler."""
+
+import time
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.executor.executor import TransactionExecutor
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.ledger.ledger import Ledger
+from fisco_bcos_tpu.ops import merkle as merkle_mod
+from fisco_bcos_tpu.protocol import Transaction, TransactionStatus
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+
+
+def make_tx(suite, kp, to, payload, nonce, block_limit=100):
+    return Transaction(to=to, input=payload, nonce=nonce,
+                       block_limit=block_limit).sign(suite, kp)
+
+
+@pytest.fixture()
+def node():
+    n = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0))
+    n.start()
+    yield n
+    n.stop()
+
+
+def wait_until(pred, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_solo_chain_transfer_flow(node):
+    suite = node.suite
+    kp = suite.generate_keypair(b"alice")
+    reg = make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                  pc.encode_call("register",
+                                 lambda w: w.blob(b"alice").u64(1000)),
+                  nonce="r1")
+    res = node.send_transaction(reg)
+    assert res.status == TransactionStatus.OK
+    rc = node.txpool.wait_for_receipt(res.tx_hash, timeout=10)
+    assert rc is not None and rc.status == 0
+
+    reg2 = make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                   pc.encode_call("register",
+                                  lambda w: w.blob(b"bob").u64(10)),
+                   nonce="r2")
+    xfer = make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                   pc.encode_call("transfer",
+                                  lambda w: w.blob(b"alice").blob(b"bob").u64(250)),
+                   nonce="x1")
+    r2 = node.txpool.submit_batch([reg2, xfer])
+    assert all(r.status == TransactionStatus.OK for r in r2)
+    rc2 = node.txpool.wait_for_receipt(r2[1].tx_hash, timeout=10)
+    assert rc2 is not None
+
+    # read balance via call
+    q = Transaction(to=pc.BALANCE_ADDRESS,
+                    input=pc.encode_call("balanceOf", lambda w: w.blob(b"bob")))
+    out = node.call(q)
+    assert out.status == 0
+    from fisco_bcos_tpu.codec.wire import Reader
+    assert Reader(out.output).u64() == 260
+
+    # chain advanced; block structure checks
+    n = node.ledger.current_number()
+    assert n >= 1
+    hdr = node.ledger.header_by_number(1)
+    assert hdr is not None
+    assert hdr.parent_info[0].number == 0
+    # hash->number index must hold the FINAL header hash (post state-root)
+    assert node.ledger.number_by_hash(hdr.hash(suite)) == 1
+    blk = node.ledger.block_by_number(1)
+    assert blk.header.txs_root == blk.calculate_txs_root(suite)
+    # commit seal present and valid (solo signs its own header)
+    assert hdr.signature_list
+    idx, sig = hdr.signature_list[0]
+    assert suite.verify(node.keypair.pub_bytes, hdr.hash(suite), sig)
+
+
+def test_receipt_and_tx_merkle_proofs(node):
+    suite = node.suite
+    kp = suite.generate_keypair(b"proofacct")
+    txs = [make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                   pc.encode_call("register",
+                                  lambda w, i=i: w.blob(f"acct{i}".encode()).u64(5)),
+                   nonce=f"p{i}") for i in range(6)]
+    results = node.txpool.submit_batch(txs)
+    assert all(r.status == TransactionStatus.OK for r in results)
+    assert node.txpool.wait_for_receipt(results[-1].tx_hash, 10) is not None
+
+    th = results[2].tx_hash
+    proof, root = node.ledger.tx_proof(th)
+    leaf = th
+    assert merkle_mod.verify_merkle_proof(leaf, proof, root, suite.hash_name)
+
+    rproof, rroot = node.ledger.receipt_proof(th)
+    rc = node.ledger.receipt(th)
+    assert merkle_mod.verify_merkle_proof(rc.hash(suite), rproof, rroot,
+                                          suite.hash_name)
+
+
+def test_txpool_rejections(node):
+    suite = node.suite
+    kp = suite.generate_keypair(b"rej")
+    good = make_tx(suite, kp, pc.BALANCE_ADDRESS, b"", nonce="g1")
+    dup = Transaction.decode(good.encode())
+    r1 = node.txpool.submit_batch([good, dup])
+    assert r1[0].status == TransactionStatus.OK
+    assert r1[1].status == TransactionStatus.ALREADY_IN_TXPOOL
+
+    wrong_chain = Transaction(chain_id="other", nonce="c1", block_limit=100,
+                              to=pc.BALANCE_ADDRESS).sign(suite, kp)
+    assert node.txpool.submit(wrong_chain).status == TransactionStatus.INVALID_CHAINID
+
+    expired = Transaction(nonce="e1", block_limit=0,
+                          to=pc.BALANCE_ADDRESS).sign(suite, kp)
+    assert node.txpool.submit(expired).status == TransactionStatus.BLOCK_LIMIT_CHECK_FAIL
+
+    bad_sig = make_tx(suite, kp, pc.BALANCE_ADDRESS, b"", nonce="b1")
+    sig = bytearray(bad_sig.signature)
+    sig[40] ^= 0x55
+    bad_sig.signature = bytes(sig)
+    bad_sig._sender = None
+    st = node.txpool.submit(bad_sig).status
+    assert st in (TransactionStatus.INVALID_SIGNATURE, TransactionStatus.OK)
+    if st == TransactionStatus.OK:
+        # recovered a different key: sender must not equal the real signer
+        assert bad_sig.sender(suite) != kp.address
+
+    nonce_reuse = make_tx(suite, kp, pc.BALANCE_ADDRESS, b"x", nonce="g1")
+    assert node.txpool.submit(nonce_reuse).status == TransactionStatus.NONCE_CHECK_FAIL
+
+
+def test_executor_revert_isolation():
+    suite = make_suite(backend="host")
+    storage = MemoryStorage()
+    ex = TransactionExecutor(suite)
+    state = StateStorage(storage)
+    kp = suite.generate_keypair(b"iso")
+    ok_tx = make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                    pc.encode_call("register", lambda w: w.blob(b"a").u64(100)),
+                    nonce="1")
+    # transfer more than balance -> REVERT, but must not undo ok_tx's write
+    bad_tx = make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                     pc.encode_call("transfer",
+                                    lambda w: w.blob(b"a").blob(b"b").u64(999)),
+                     nonce="2")
+    rcs = ex.execute_block_serial([ok_tx, bad_tx], state, 1, 0)
+    assert rcs[0].status == 0
+    assert rcs[1].status == int(TransactionStatus.REVERT)
+    assert state.get(pc.T_BALANCE, b"a") is not None
+    # the failed tx's writes are rolled back
+    assert state.get(pc.T_BALANCE, b"b") is None
+
+
+def test_dag_waves_match_serial():
+    suite = make_suite(backend="host")
+    ex = TransactionExecutor(suite)
+    kp = suite.generate_keypair(b"dag")
+
+    def xfer(src, dst, amt, nonce):
+        return make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                       pc.encode_call("transfer",
+                                      lambda w: w.blob(src).blob(dst).u64(amt)),
+                       nonce=nonce)
+
+    def reg(name, amt, nonce):
+        return make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                       pc.encode_call("register",
+                                      lambda w: w.blob(name).u64(amt)),
+                       nonce=nonce)
+
+    txs = [reg(b"a", 100, "1"), reg(b"b", 100, "2"), reg(b"c", 100, "3"),
+           reg(b"d", 100, "4"),
+           xfer(b"a", b"b", 10, "5"),   # conflicts with a,b
+           xfer(b"c", b"d", 20, "6"),   # independent of 5 -> same wave
+           xfer(b"b", b"c", 5, "7")]    # conflicts with both
+
+    st_serial = StateStorage(MemoryStorage())
+    rs = ex.execute_block_serial(txs, st_serial, 1, 0)
+    st_dag = StateStorage(MemoryStorage())
+    rd = ex.execute_block_dag(txs, st_dag, 1, 0)
+    assert [r.status for r in rs] == [r.status for r in rd]
+    assert st_serial.changeset().keys() == st_dag.changeset().keys()
+    for k in st_serial.changeset():
+        assert st_serial.changeset()[k].value == st_dag.changeset()[k].value
+    waves = ex.plan_dag(txs)
+    # the two independent transfers share a wave
+    w5 = next(i for i, w in enumerate(waves) if 4 in w)
+    w6 = next(i for i, w in enumerate(waves) if 5 in w)
+    assert w5 == w6
+
+
+def test_system_config_governance(node):
+    suite = node.suite
+    kp = suite.generate_keypair(b"gov")
+    tx = make_tx(suite, kp, pc.SYS_CONFIG_ADDRESS,
+                 pc.encode_call("setValueByKey",
+                                lambda w: w.text("tx_count_limit").text("500")),
+                 nonce="cfg1")
+    r = node.send_transaction(tx)
+    assert r.status == TransactionStatus.OK
+    assert node.txpool.wait_for_receipt(r.tx_hash, 10) is not None
+    v = node.ledger.system_config("tx_count_limit")
+    assert v[0] == "500"
+    cfg = node.ledger.ledger_config()
+    assert cfg.block_tx_count_limit == 500
+
+
+def test_wal_backed_node_restart(tmp_path):
+    p = str(tmp_path / "chaindb")
+    cfg = NodeConfig(crypto_backend="host", storage_path=p, min_seal_time=0.0)
+    n1 = Node(cfg)
+    n1.start()
+    suite = n1.suite
+    kp = suite.generate_keypair(b"persist")
+    tx = make_tx(suite, kp, pc.BALANCE_ADDRESS,
+                 pc.encode_call("register", lambda w: w.blob(b"p").u64(42)),
+                 nonce="w1")
+    r = n1.send_transaction(tx)
+    assert n1.txpool.wait_for_receipt(r.tx_hash, 10) is not None
+    committed = n1.ledger.current_number()
+    n1.stop()
+    n1.storage.close()
+
+    n2 = Node(NodeConfig(crypto_backend="host", storage_path=p))
+    assert n2.ledger.current_number() == committed
+    assert n2.ledger.receipt(r.tx_hash) is not None
+    hdr = n2.ledger.header_by_number(committed)
+    assert hdr is not None
+    n2.storage.close()

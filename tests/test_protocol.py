@@ -1,0 +1,137 @@
+"""Protocol object + wire codec tests (reference: bcos-framework protocol
+data model round-trips; TransactionImpl lazy hash/sender semantics)."""
+
+import numpy as np
+import pytest
+
+from fisco_bcos_tpu.codec.wire import Reader, Writer
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.protocol import (
+    Block,
+    BlockHeader,
+    LogEntry,
+    ParentInfo,
+    Receipt,
+    Transaction,
+    batch_hash,
+    batch_recover_senders,
+)
+
+
+@pytest.fixture(scope="module")
+def suite():
+    return make_suite(backend="host")
+
+
+@pytest.fixture(scope="module")
+def sm_suite():
+    return make_suite(sm_crypto=True, backend="host")
+
+
+def test_wire_roundtrip():
+    w = Writer()
+    w.u8(7).u16(513).u32(1 << 30).i64(-5).u64(1 << 50).u256(1 << 200)
+    w.blob(b"hello").text("world").seq([1, 2, 3], lambda ww, x: ww.u32(x))
+    r = Reader(w.bytes())
+    assert r.u8() == 7
+    assert r.u16() == 513
+    assert r.u32() == 1 << 30
+    assert r.i64() == -5
+    assert r.u64() == 1 << 50
+    assert r.u256() == 1 << 200
+    assert r.blob() == b"hello"
+    assert r.text() == "world"
+    assert r.seq(lambda rr: rr.u32()) == [1, 2, 3]
+    assert r.done()
+
+
+def test_wire_truncation_raises():
+    w = Writer()
+    w.blob(b"abc")
+    data = w.bytes()[:-1]
+    with pytest.raises(ValueError):
+        Reader(data).blob()
+
+
+def test_transaction_roundtrip_and_identity(suite):
+    kp = suite.generate_keypair(b"acct")
+    tx = Transaction(chain_id="chain0", group_id="group0", block_limit=100,
+                     nonce="n-1", to=b"\x01" * 20, input=b"payload",
+                     abi="abi").sign(suite, kp)
+    enc = tx.encode()
+    tx2 = Transaction.decode(enc)
+    assert tx2.nonce == "n-1"
+    assert tx2.to == b"\x01" * 20
+    assert tx2.signature == tx.signature
+    # identity: same unsigned bytes -> same hash; sender recovers to signer
+    assert tx2.hash(suite) == tx.hash(suite)
+    assert tx2.sender(suite) == kp.address
+
+
+def test_transaction_tampered_sig_rejected(suite):
+    kp = suite.generate_keypair(b"acct2")
+    tx = Transaction(nonce="n", block_limit=5).sign(suite, kp)
+    bad = bytearray(tx.signature)
+    bad[1] ^= 0xFF
+    tx2 = Transaction.decode(tx.encode())
+    tx2.signature = bytes(bad)
+    assert tx2.sender(suite) is None or tx2.sender(suite) != kp.address
+
+
+def test_batch_recover(suite):
+    kps = [suite.generate_keypair(bytes([i])) for i in range(4)]
+    txs = [Transaction(nonce=f"n{i}", block_limit=9).sign(suite, kp)
+           for i, kp in enumerate(kps)]
+    txs[2].signature = txs[1].signature  # wrong sig for tx2's hash
+    for t in txs:
+        t._sender = None
+    senders, ok = batch_recover_senders(txs, suite)
+    assert list(ok[:2]) == [True, True]
+    assert senders[0] == kps[0].address
+    assert senders[1] == kps[1].address
+    # recovered-but-wrong or invalid: either way not kps[2]
+    assert senders[2] != kps[2].address
+    assert ok[3] and senders[3] == kps[3].address
+
+
+def test_receipt_and_header_roundtrip(suite):
+    rc = Receipt(gas_used=21000, status=0, output=b"\x01",
+                 logs=[LogEntry(b"\x02" * 20, [b"t1", b"t2"], b"d")],
+                 block_number=7)
+    rc2 = Receipt.decode(rc.encode())
+    assert rc2.gas_used == 21000
+    assert rc2.logs[0].topics == [b"t1", b"t2"]
+    assert rc2.hash(suite) == rc.hash(suite)
+
+    h = BlockHeader(number=9, parent_info=[ParentInfo(8, b"\xaa" * 32)],
+                    txs_root=b"\x01" * 32, sealer=2,
+                    sealer_list=[b"pk1", b"pk2"],
+                    consensus_weights=[1, 2],
+                    signature_list=[(0, b"sig0"), (1, b"sig1")])
+    h2 = BlockHeader.decode(h.encode())
+    assert h2.number == 9
+    assert h2.parent_info[0].hash == b"\xaa" * 32
+    assert h2.signature_list == [(0, b"sig0"), (1, b"sig1")]
+    # hash covers core only — commit seals don't change identity
+    assert h2.hash(suite) == h.hash(suite)
+    h2.signature_list = []
+    assert BlockHeader.decode(h2.encode()).hash(suite) == h.hash(suite)
+
+
+def test_block_roots_match_merkle(suite):
+    kp = suite.generate_keypair(b"rootacct")
+    txs = [Transaction(nonce=f"n{i}", block_limit=3).sign(suite, kp)
+           for i in range(5)]
+    blk = Block(transactions=txs)
+    root = blk.calculate_txs_root(suite)
+    assert root == suite.merkle_root([t.hash(suite) for t in txs])
+    blk2 = Block.decode(blk.encode())
+    assert blk2.calculate_txs_root(suite) == root
+
+
+def test_sm_suite_transaction(sm_suite):
+    kp = sm_suite.generate_keypair(b"smacct")
+    tx = Transaction(nonce="sm-n", block_limit=4).sign(sm_suite, kp)
+    tx2 = Transaction.decode(tx.encode())
+    assert tx2.sender(sm_suite) == kp.address
+    assert len(tx.signature) == 128  # r|s|pub per SignatureDataWithPub
