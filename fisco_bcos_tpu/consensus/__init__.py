@@ -1,0 +1,3 @@
+from .pbft.engine import PBFTEngine
+
+__all__ = ["PBFTEngine"]

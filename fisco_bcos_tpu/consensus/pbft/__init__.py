@@ -1,0 +1,4 @@
+from .engine import PBFTEngine
+from .messages import PacketType, PBFTMessage
+
+__all__ = ["PBFTEngine", "PacketType", "PBFTMessage"]

@@ -1,0 +1,535 @@
+"""PBFT consensus engine: 3-phase agreement + checkpoint seals + view change.
+
+Reference counterpart: /root/reference/bcos-pbft/bcos-pbft/pbft/engine/
+PBFTEngine.cpp — message ingress at :471 onReceivePBFTMessage feeding a
+single-threaded worker (:40, :555 executeWorker), phase handlers
+(:784 handlePrePrepareMsg, :962 handlePrepareMsg, :980 handleCommitMsg),
+per-message signature checking (:732 checkSignature), proposal verification
+through the txpool (TxPool.cpp:160 asyncVerifyBlock), quorum/commit logic in
+pbft/cache/PBFTCacheProcessor.h:95-140, and timeout-driven view changes
+(PBFTTimer.h, view-change cache PBFTCacheProcessor.h:97-118).
+
+Same single-worker thread model (determinism, no locks in the hot state),
+two batch-first differences:
+  * the worker drains its whole inbox each wake and verifies ALL pending
+    packet signatures in ONE `suite.verify_batch` call — under a prepare/
+    commit flood from N-1 peers that is the TPU replacing the reference's
+    per-message scalar verify;
+  * checkpoint seals (commit seals over the *executed* header hash) are
+    batch-verified at quorum time, the same call shape BlockValidator.cpp:141
+    checkSignatureList uses for synced blocks.
+
+Phases (FISCO-BCOS 3.x style — execution happens after consensus on the
+proposal, then a checkpoint round collects commit seals over the executed
+header):
+  PRE_PREPARE(block) -> PREPARE(h) -> COMMIT(h) -> execute ->
+  CHECKPOINT(executed_h, seal) -> 2f+1 seals -> commit to ledger.
+
+View change: on timer expiry broadcast VIEW_CHANGE carrying the prepared
+proposal (if any); the new leader assembles 2f+1 into NEW_VIEW, re-proposes
+the carried prepared proposal or grants its sealer. f+1 higher views trigger
+fast view-change join (PBFTCacheProcessor's getViewChangeWeight shortcut).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...net.front import FrontService
+from ...net.moduleid import ModuleID
+from ...protocol import Block, BlockHeader
+from ...utils.log import LOG, badge, metric
+from ...utils.worker import Worker
+from .messages import (
+    PacketType,
+    PBFTMessage,
+    make_packet,
+    pack_messages,
+    unpack_messages,
+)
+
+
+class _ProposalCache:
+    """Per-height consensus state (PBFTCacheProcessor's PBFTCache)."""
+
+    __slots__ = ("proposal", "proposal_hash", "prepares", "commits",
+                 "checkpoints", "prepared", "committed_phase", "executed",
+                 "executed_hash", "preprepare_msg")
+
+    def __init__(self):
+        self.proposal: Optional[Block] = None
+        self.proposal_hash: bytes = b""
+        self.preprepare_msg: Optional[PBFTMessage] = None
+        self.prepares: dict[int, PBFTMessage] = {}
+        self.commits: dict[int, PBFTMessage] = {}
+        self.checkpoints: dict[int, bytes] = {}  # idx -> seal over executed_h
+        self.prepared = False
+        self.committed_phase = False
+        self.executed = False
+        self.executed_hash: bytes = b""
+
+
+class PBFTEngine(Worker):
+    def __init__(self, suite, keypair, front: FrontService, txpool, sealer,
+                 scheduler, ledger, leader_period: int = 1,
+                 view_timeout: float = 3.0, txsync=None,
+                 full_proposals: bool = False):
+        super().__init__("pbft", idle_wait=0.02)
+        self.suite = suite
+        self.keypair = keypair
+        self.front = front
+        self.txpool = txpool
+        self.sealer = sealer
+        self.scheduler = scheduler
+        self.ledger = ledger
+        self.txsync = txsync
+        # False (default, reference-faithful): pre-prepares carry tx-hash
+        # metadata only (MemoryStorage.cpp:570 metadata sealing); replicas
+        # fill from the pool and fetch stragglers from the leader
+        # (TxPool.cpp:160 fetch-missing). True: ship full txs in-band.
+        self.full_proposals = full_proposals
+        self.leader_period = max(1, leader_period)
+        self.base_timeout = view_timeout
+
+        cfg = ledger.ledger_config()
+        self.nodes: list[bytes] = sorted(n.node_id for n in cfg.consensus_nodes)
+        self.index = self.nodes.index(keypair.pub_bytes)
+        self.n = len(self.nodes)
+        self.f = (self.n - 1) // 3
+        self.quorum = 2 * self.f + 1
+
+        self.view = 0
+        self.to_view = 0  # > view while a view change is in flight
+        self._caches: dict[int, _ProposalCache] = {}
+        self._viewchanges: dict[int, dict[int, PBFTMessage]] = {}
+        self._inbox: "queue.Queue[tuple[str, object]]" = queue.Queue()
+        self._deadline = 0.0
+        self._timeout = view_timeout
+        self._committed_waiters: list = []
+
+        front.register_module(ModuleID.PBFT, self._on_network)
+
+    # -- identity ----------------------------------------------------------
+    def leader_for(self, number: int, view: int) -> int:
+        return (number // self.leader_period + view) % self.n
+
+    def is_leader(self, number: Optional[int] = None) -> bool:
+        if number is None:
+            number = self.ledger.current_number() + 1
+        return self.leader_for(number, self.view) == self.index
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._reset_timer()
+        super().start()
+        self._grant_sealer()
+
+    def _grant_sealer(self) -> None:
+        nxt = self.ledger.current_number() + 1
+        lead = self.leader_for(nxt, self.view) == self.index
+        cfg = self.ledger.ledger_config()
+        self.sealer.set_should_seal(lead, nxt,
+                                    max_txs=cfg.block_tx_count_limit)
+
+    # -- ingress -----------------------------------------------------------
+    def submit_proposal(self, block: Block) -> bool:
+        """Sealer hands a proposal over (Sealer.cpp:116 submitProposal)."""
+        if not self.is_leader(block.header.number):
+            return False
+        self._inbox.put(("proposal", block))
+        self.wakeup()
+        return True
+
+    def _on_network(self, src: bytes, payload: bytes, respond) -> None:
+        try:
+            msg = PBFTMessage.decode(payload)
+        except Exception:
+            LOG.warning(badge("PBFT", "bad-packet", src=src[:8].hex()))
+            return
+        self._inbox.put(("msg", msg))
+        self.wakeup()
+
+    # -- worker loop (PBFTEngine.cpp:555 executeWorker) --------------------
+    def execute_worker(self) -> None:
+        local: list[Block] = []
+        msgs: list[PBFTMessage] = []
+        while True:
+            try:
+                kind, item = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "proposal":
+                local.append(item)  # type: ignore[arg-type]
+            else:
+                msgs.append(item)  # type: ignore[arg-type]
+        for msg in self._batch_checked(msgs):
+            self._dispatch(msg)
+        for block in local:
+            self._broadcast_preprepare(block)
+        if time.monotonic() > self._deadline:
+            self._on_timeout()
+
+    def _batch_checked(self, msgs: list[PBFTMessage]) -> list[PBFTMessage]:
+        """ONE verify_batch call over every drained packet signature
+        (replaces the reference's per-message checkSignature at :732)."""
+        valid_idx = [m for m in msgs
+                     if 0 <= m.from_idx < self.n and m.from_idx != self.index]
+        if not valid_idx:
+            return []
+        digests = [m.hash(self.suite) for m in valid_idx]
+        sigs = [m.signature for m in valid_idx]
+        pubs = [self.nodes[m.from_idx] for m in valid_idx]
+        ok = self.suite.verify_batch(digests, sigs, pubs)
+        out = []
+        for m, good in zip(valid_idx, np.asarray(ok)):
+            if good:
+                out.append(m)
+            else:
+                LOG.warning(badge("PBFT", "bad-signature", frm=m.from_idx,
+                                  type=m.packet_type))
+        return out
+
+    # accept window for not-yet-actionable packets; anything beyond is
+    # dropped so a Byzantine peer cannot grow the caches without bound
+    NUMBER_WINDOW = 64
+    VIEW_WINDOW = 256
+
+    def _dispatch(self, msg: PBFTMessage) -> None:
+        expected = self.ledger.current_number() + 1
+        if not (expected <= msg.number <= expected + self.NUMBER_WINDOW):
+            return
+        if msg.view > self.view + self.VIEW_WINDOW:
+            return
+        t = msg.packet_type
+        if t == PacketType.PRE_PREPARE:
+            self._handle_preprepare(msg)
+        elif t == PacketType.PREPARE:
+            self._handle_prepare(msg)
+        elif t == PacketType.COMMIT:
+            self._handle_commit(msg)
+        elif t == PacketType.CHECKPOINT:
+            self._handle_checkpoint(msg)
+        elif t == PacketType.VIEW_CHANGE:
+            self._handle_viewchange(msg)
+        elif t == PacketType.NEW_VIEW:
+            self._handle_newview(msg)
+
+    # -- send helpers ------------------------------------------------------
+    def _signed(self, packet: PBFTMessage) -> PBFTMessage:
+        return packet.sign(self.suite, self.keypair)
+
+    def _broadcast(self, packet: PBFTMessage) -> None:
+        self.front.broadcast(ModuleID.PBFT, self._signed(packet).encode())
+
+    def _cache(self, number: int) -> _ProposalCache:
+        return self._caches.setdefault(number, _ProposalCache())
+
+    # -- leader: pre-prepare ----------------------------------------------
+    def _broadcast_preprepare(self, block: Block,
+                              carried: bool = False) -> None:
+        number = block.header.number
+        if number != self.ledger.current_number() + 1:
+            self.txpool.unseal(block.tx_hashes)
+            self._grant_sealer()
+            return
+        header = block.header
+        header.sealer = self.index
+        header.sealer_list = list(self.nodes)
+        if not carried:
+            header.timestamp = max(header.timestamp, int(time.time() * 1000))
+        # bind the tx set into the proposal identity before any roots exist
+        header.txs_root = self.suite.merkle_root(
+            block.tx_hashes or [t.hash(self.suite) for t in block.transactions])
+        header.invalidate()
+        phash = header.hash(self.suite)
+
+        cache = self._cache(number)
+        cache.proposal = block
+        cache.proposal_hash = phash
+        wire_block = block
+        if not self.full_proposals and block.transactions:
+            # metadata-only broadcast; the full block stays in our cache
+            wire_block = Block(header=header,
+                               tx_hashes=list(
+                                   block.tx_hashes
+                                   or [t.hash(self.suite)
+                                       for t in block.transactions]))
+        msg = make_packet(PacketType.PRE_PREPARE, self.view, number,
+                          self.index, phash, wire_block.encode())
+        cache.preprepare_msg = self._signed(msg)
+        self.front.broadcast(ModuleID.PBFT, cache.preprepare_msg.encode())
+        # leader's own prepare vote
+        self._vote_prepare(number, phash)
+        metric("pbft.preprepare", number=number, view=self.view,
+               n_tx=len(block.tx_hashes or block.transactions))
+
+    # -- replica: phase handlers ------------------------------------------
+    def _handle_preprepare(self, msg: PBFTMessage) -> None:
+        expected = self.ledger.current_number() + 1
+        if (msg.view != self.view or msg.number != expected
+                or self.to_view > self.view):
+            return
+        if msg.from_idx != self.leader_for(msg.number, msg.view):
+            LOG.warning(badge("PBFT", "preprepare-not-leader",
+                              frm=msg.from_idx, number=msg.number))
+            return
+        try:
+            block = Block.decode(msg.payload)
+        except Exception:
+            return
+        header = block.header
+        if header.number != msg.number or \
+                header.hash(self.suite) != msg.proposal_hash:
+            return
+        cache = self._cache(msg.number)
+        if cache.proposal is not None and cache.proposal_hash != msg.proposal_hash:
+            return  # conflicting proposal from same leader: keep the first
+        # metadata-only proposal: fetch any txs the gossip hasn't delivered
+        # yet from the leader (TxPool.cpp:160 asyncVerifyBlock fetch path)
+        if not block.transactions and block.tx_hashes and self.txsync:
+            missing = self.txpool.missing_hashes(block.tx_hashes)
+            if missing:
+                self.txsync.fetch_missing(self.nodes[msg.from_idx], missing,
+                                          timeout=2.0)
+        # proposal tx verification — ONE TPU batch recover for unknown txs
+        if not self.txpool.verify_proposal(block):
+            LOG.warning(badge("PBFT", "proposal-verify-failed",
+                              number=msg.number))
+            return
+        cache.proposal = block
+        cache.proposal_hash = msg.proposal_hash
+        cache.preprepare_msg = msg
+        self._vote_prepare(msg.number, msg.proposal_hash)
+        self._try_advance(msg.number)
+
+    def _vote_prepare(self, number: int, phash: bytes) -> None:
+        cache = self._cache(number)
+        if self.index in cache.prepares:
+            return
+        vote = self._signed(make_packet(PacketType.PREPARE, self.view,
+                                        number, self.index, phash))
+        cache.prepares[self.index] = vote
+        self.front.broadcast(ModuleID.PBFT, vote.encode())
+        self._try_advance(number)
+
+    def _handle_prepare(self, msg: PBFTMessage) -> None:
+        if msg.view != self.view:
+            return
+        cache = self._cache(msg.number)
+        cache.prepares.setdefault(msg.from_idx, msg)
+        self._try_advance(msg.number)
+
+    def _handle_commit(self, msg: PBFTMessage) -> None:
+        if msg.view != self.view:
+            return
+        cache = self._cache(msg.number)
+        cache.commits.setdefault(msg.from_idx, msg)
+        self._try_advance(msg.number)
+
+    def _handle_checkpoint(self, msg: PBFTMessage) -> None:
+        cache = self._cache(msg.number)
+        cache.checkpoints.setdefault(msg.from_idx, msg.payload)
+        self._try_advance(msg.number)
+
+    # -- quorum state machine (PBFTCacheProcessor::checkAndCommit) ---------
+    def _try_advance(self, number: int) -> None:
+        cache = self._caches.get(number)
+        if cache is None or number != self.ledger.current_number() + 1:
+            return
+        if cache.proposal is None:
+            return
+        phash = cache.proposal_hash
+        prepares = sum(1 for m in cache.prepares.values()
+                       if m.proposal_hash == phash)
+        if not cache.prepared and prepares >= self.quorum:
+            cache.prepared = True
+            vote = self._signed(make_packet(PacketType.COMMIT, self.view,
+                                            number, self.index, phash))
+            cache.commits[self.index] = vote
+            self.front.broadcast(ModuleID.PBFT, vote.encode())
+        commits = sum(1 for m in cache.commits.values()
+                      if m.proposal_hash == phash)
+        if cache.prepared and not cache.executed and commits >= self.quorum:
+            self._execute_and_checkpoint(number, cache)
+        if cache.executed:
+            self._try_commit_ledger(number, cache)
+
+    def _execute_and_checkpoint(self, number: int,
+                                cache: _ProposalCache) -> None:
+        result = self.scheduler.execute_block(cache.proposal)
+        if result is None:
+            LOG.error(badge("PBFT", "execute-failed", number=number))
+            return
+        cache.executed = True
+        cache.executed_hash = result.header.hash(self.suite)
+        # the checkpoint seal IS the commit seal for signature_list
+        seal = self.suite.sign(self.keypair, cache.executed_hash)
+        cache.checkpoints[self.index] = seal
+        self._broadcast(make_packet(PacketType.CHECKPOINT, self.view, number,
+                                    self.index, cache.executed_hash, seal))
+        metric("pbft.executed", number=number,
+               ehash=cache.executed_hash[:8].hex())
+
+    def _try_commit_ledger(self, number: int, cache: _ProposalCache) -> None:
+        if len(cache.checkpoints) < self.quorum or cache.committed_phase:
+            return
+        # batch-verify every collected seal over the executed header hash in
+        # one call (BlockValidator.cpp:141 checkSignatureList shape)
+        idxs = sorted(cache.checkpoints)
+        seals = [cache.checkpoints[i] for i in idxs]
+        ok = np.asarray(self.suite.verify_batch(
+            [cache.executed_hash] * len(idxs), seals,
+            [self.nodes[i] for i in idxs]))
+        good = [(i, s) for i, s, g in zip(idxs, seals, ok) if g]
+        if len(good) < self.quorum:
+            for i, g in zip(idxs, ok):
+                if not g:
+                    cache.checkpoints.pop(i, None)
+            return
+        cache.committed_phase = True
+        header = cache.proposal.header
+        header.signature_list = good
+        if not self.scheduler.commit_block(header):
+            LOG.error(badge("PBFT", "ledger-commit-failed", number=number))
+            cache.committed_phase = False
+            return
+        for h in [h for h in self._caches if h <= number]:
+            self._caches.pop(h, None)
+        self._viewchanges = {v: d for v, d in self._viewchanges.items()
+                             if v > self.view}
+        self._timeout = self.base_timeout
+        self._reset_timer()
+        self._grant_sealer()
+        metric("pbft.committed", number=number, view=self.view)
+
+    # -- view change -------------------------------------------------------
+    def _reset_timer(self) -> None:
+        self._deadline = time.monotonic() + self._timeout
+
+    def _on_timeout(self) -> None:
+        # nothing to agree on -> idle quietly unless a round is in flight
+        in_flight = any(c.proposal is not None and not c.committed_phase
+                        for c in self._caches.values())
+        pending_vc = self.to_view > self.view
+        if not in_flight and not pending_vc and self.txpool.pending_count() == 0:
+            self._reset_timer()
+            return
+        self.to_view = max(self.to_view + 1, self.view + 1)
+        self._timeout = min(self._timeout * 2, 60.0)
+        self._reset_timer()
+        self._send_viewchange()
+
+    def _send_viewchange(self) -> None:
+        number = self.ledger.current_number() + 1
+        committed = self.ledger.header_by_number(number - 1)
+        chash = committed.hash(self.suite) if committed else b"\x00" * 32
+        payload = b""
+        cache = self._caches.get(number)
+        if cache is not None and cache.prepared and cache.preprepare_msg:
+            payload = cache.preprepare_msg.encode()
+        vc = make_packet(PacketType.VIEW_CHANGE, self.to_view, number,
+                         self.index, chash, payload)
+        signed = self._signed(vc)
+        self._viewchanges.setdefault(self.to_view, {})[self.index] = signed
+        self.front.broadcast(ModuleID.PBFT, signed.encode())
+        metric("pbft.viewchange", to_view=self.to_view, number=number)
+        self._check_newview(self.to_view)
+
+    def _handle_viewchange(self, msg: PBFTMessage) -> None:
+        if msg.view <= self.view:
+            return
+        self._viewchanges.setdefault(msg.view, {})[msg.from_idx] = msg
+        # fast view change: f+1 nodes already in a higher view -> join them
+        higher = {v for v, d in self._viewchanges.items() if v > self.view
+                  and len(d) >= self.f + 1}
+        if higher and self.to_view <= self.view:
+            self.to_view = min(higher)
+            self._send_viewchange()
+        self._check_newview(msg.view)
+
+    def _check_newview(self, v: int) -> None:
+        """If this node leads view v and holds 2f+1 VIEW_CHANGEs, switch."""
+        vcs = self._viewchanges.get(v, {})
+        number = self.ledger.current_number() + 1
+        if len(vcs) < self.quorum or self.leader_for(number, v) != self.index:
+            return
+        proof = pack_messages(list(vcs.values()))
+        self._broadcast(make_packet(PacketType.NEW_VIEW, v, number,
+                                    self.index, b"", proof))
+        self._enter_view(v)
+        # safety: re-propose the carried prepared proposal, if any
+        carried = self._pick_carried(vcs.values(), number)
+        if carried is not None:
+            self._broadcast_preprepare(carried, carried=True)
+        else:
+            self._grant_sealer()
+
+    def _pick_carried(self, vcs, number: int) -> Optional[Block]:
+        best: Optional[PBFTMessage] = None
+        for vc in vcs:
+            if not vc.payload:
+                continue
+            try:
+                pp = PBFTMessage.decode(vc.payload)
+            except Exception:
+                continue
+            if pp.number != number:
+                continue
+            if best is None or pp.view > best.view:
+                best = pp
+        if best is None:
+            return None
+        try:
+            return Block.decode(best.payload)
+        except Exception:
+            return None
+
+    def _handle_newview(self, msg: PBFTMessage) -> None:
+        if msg.view <= self.view:
+            return
+        if msg.from_idx != self.leader_for(msg.number, msg.view):
+            return
+        vcs = unpack_messages(msg.payload)
+        vcs = [m for m in vcs if m.packet_type == PacketType.VIEW_CHANGE
+               and m.view == msg.view and 0 <= m.from_idx < self.n]
+        uniq = {m.from_idx: m for m in vcs}
+        if len(uniq) < self.quorum:
+            return
+        ok = np.asarray(self.suite.verify_batch(
+            [m.hash(self.suite) for m in uniq.values()],
+            [m.signature for m in uniq.values()],
+            [self.nodes[m.from_idx] for m in uniq.values()]))
+        if int(ok.sum()) < self.quorum:
+            return
+        self._enter_view(msg.view)
+
+    def _enter_view(self, v: int) -> None:
+        # drop round state from the old view; txs go back to the pool
+        for number, cache in list(self._caches.items()):
+            if cache.proposal is not None and not cache.committed_phase:
+                self.txpool.unseal(cache.proposal.tx_hashes)
+            self._caches.pop(number, None)
+        self.view = v
+        self.to_view = v
+        self._timeout = self.base_timeout
+        self._reset_timer()
+        self._grant_sealer()
+        metric("pbft.newview", view=v)
+
+    # -- introspection (getConsensusStatus RPC) ----------------------------
+    def status(self) -> dict:
+        return {
+            "index": self.index,
+            "view": self.view,
+            "toView": self.to_view,
+            "leaderIndex": self.leader_for(
+                self.ledger.current_number() + 1, self.view),
+            "consensusNodeNum": self.n,
+            "maxFaultyQuorum": self.f,
+            "committedNumber": self.ledger.current_number(),
+        }

@@ -146,6 +146,17 @@ class TransactionExecutor:
                ms=int((time.monotonic() - t0) * 1000))
         return [r for r in receipts]
 
+    # -- contract metadata (getCode/getABI RPC; EVM deploy writes these) ---
+    T_CODE = "s_code"
+    T_ABI = "s_abi"
+
+    def get_code(self, address: bytes, storage) -> bytes:
+        return storage.get(self.T_CODE, address) or b""
+
+    def get_abi(self, address: bytes, storage) -> str:
+        raw = storage.get(self.T_ABI, address)
+        return raw.decode() if raw else ""
+
     # -- state root (device Merkle over changeset digests) -----------------
     def state_root(self, changes: ChangeSet) -> bytes:
         if not changes:

@@ -31,6 +31,11 @@ from ..storage.memory import MemoryStorage
 from ..storage.wal import WalStorage
 from ..txpool.txpool import TxPool
 from ..utils.log import LOG, badge
+from ..consensus.pbft.engine import PBFTEngine
+from ..net.front import FrontService
+from ..net.gateway import Gateway
+from ..net.txsync import TransactionSync
+from ..sync.sync import BlockSync
 
 
 @dataclasses.dataclass
@@ -48,11 +53,16 @@ class NodeConfig:
     consensus: str = "solo"  # solo | pbft
     crypto_backend: str = "auto"  # device | host | auto
     device_min_batch: int = 64
+    leader_period: int = 1  # consensus_leader_period (NodeConfig.cpp:568)
+    view_timeout: float = 3.0
+    rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
+    rpc_host: str = "127.0.0.1"
 
 
 class Node:
     def __init__(self, config: NodeConfig | None = None,
-                 keypair=None, suite: CryptoSuite | None = None):
+                 keypair=None, suite: CryptoSuite | None = None,
+                 gateway: Optional[Gateway] = None):
         self.config = config or NodeConfig()
         cfg = self.config
         self.suite = suite or make_suite(cfg.sm_crypto,
@@ -71,7 +81,20 @@ class Node:
         self.sealer = Sealer(self.txpool, self.suite, self._on_proposal,
                              cfg.tx_count_limit, cfg.min_seal_time)
         self._commit_lock = threading.Lock()
-        self.consensus = None  # bound by PBFT wiring
+        self.consensus = None  # bound by PBFT wiring in start()
+        self.front: Optional[FrontService] = None
+        self.txsync: Optional[TransactionSync] = None
+        self.blocksync: Optional[BlockSync] = None
+        if gateway is not None:
+            self.front = FrontService(self.keypair.pub_bytes, gateway)
+            self.txsync = TransactionSync(self.front, self.txpool, self.suite)
+            self.blocksync = BlockSync(self.front, self.ledger,
+                                       self.scheduler, self.suite)
+        self.rpc = None
+        if cfg.rpc_port is not None:
+            from ..rpc.server import JsonRpcImpl, JsonRpcServer
+            self.rpc = JsonRpcServer(JsonRpcImpl(self),
+                                     host=cfg.rpc_host, port=cfg.rpc_port)
         self._started = False
 
     # -- genesis -----------------------------------------------------------
@@ -90,17 +113,40 @@ class Node:
         if self.config.consensus == "solo":
             self.sealer.set_should_seal(True, self.ledger.current_number() + 1)
             self.sealer.start()
-        elif self.consensus is not None:
-            self.consensus.start()
-            self.sealer.start()
+        elif self.config.consensus == "pbft":
+            if self.front is None:
+                raise RuntimeError("pbft consensus requires a gateway")
+            sealers = {n.node_id
+                       for n in self.ledger.ledger_config().consensus_nodes}
+            if self.keypair.pub_bytes in sealers:
+                if self.consensus is None:
+                    self.consensus = PBFTEngine(
+                        self.suite, self.keypair, self.front, self.txpool,
+                        self.sealer, self.scheduler, self.ledger,
+                        leader_period=self.config.leader_period,
+                        view_timeout=self.config.view_timeout,
+                        txsync=self.txsync)
+                self.consensus.start()
+                self.sealer.start()
+            # observers (not in the sealer set) just follow via block sync
+            if self.blocksync is not None:
+                self.blocksync.start()
+        if self.rpc is not None:
+            self.rpc.start()
         LOG.info(badge("NODE", "started",
                        number=self.ledger.current_number(),
                        mode=self.config.consensus))
 
     def stop(self) -> None:
+        if self.rpc is not None:
+            self.rpc.stop()
         self.sealer.stop()
         if self.consensus is not None:
             self.consensus.stop()
+        if self.blocksync is not None:
+            self.blocksync.stop()
+        if self.front is not None:
+            self.front.stop()
         self._started = False
 
     # -- solo-consensus proposal path --------------------------------------

@@ -1,0 +1,139 @@
+"""Gateway — inter-node message transport.
+
+Reference counterpart: /root/reference/bcos-gateway/bcos-gateway/Gateway.cpp
+(:184 onReceiveP2PMessage) over bcos-boostssl TLS sessions, with the
+`FakeGateWay` in-process variant used by every multi-node test fixture
+(bcos-framework/bcos-framework/testutils/faker/FakeFrontService.h:39-102 —
+it delivers a message by directly invoking the destination node's registered
+module handler, keyed by ModuleID).
+
+`FakeGateway` here is that fixture pattern promoted to a first-class
+transport: nodes register their FrontService under their node ID; sends are
+delivered on a shared dispatch thread pool so ordering/async semantics match
+a socket transport (no re-entrant delivery into the sender's stack). It also
+supports dropping nodes (partition) and per-link filters for failure tests.
+The socket transport (`fisco_bcos_tpu.net.p2p`) speaks the same envelope.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..utils.log import LOG, badge
+
+
+class Gateway:
+    """Transport interface the FrontService binds to."""
+
+    def register_front(self, node_id: bytes, front) -> None:
+        raise NotImplementedError
+
+    def unregister_front(self, node_id: bytes) -> None:
+        raise NotImplementedError
+
+    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        raise NotImplementedError
+
+    def broadcast(self, src: bytes, data: bytes) -> None:
+        raise NotImplementedError
+
+    def peers(self, src: bytes) -> list[bytes]:
+        raise NotImplementedError
+
+
+class FakeGateway(Gateway):
+    """In-process transport with one ordered delivery queue per node.
+
+    Per-destination FIFO mirrors a TCP session's ordering; cross-node order
+    is unspecified, like the network. `partition(node)` simulates a crashed
+    or isolated node; `set_filter(fn)` can drop/inspect individual messages
+    (fn(src, dst, data) -> deliver?).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fronts: dict[bytes, "object"] = {}
+        self._queues: dict[bytes, queue.Queue] = {}
+        self._threads: dict[bytes, threading.Thread] = {}
+        self._partitioned: set[bytes] = set()
+        self._filter: Optional[Callable[[bytes, bytes, bytes], bool]] = None
+        self._stopped = False
+
+    # -- wiring ------------------------------------------------------------
+    def register_front(self, node_id: bytes, front) -> None:
+        with self._lock:
+            self._fronts[node_id] = front
+            if node_id not in self._queues:
+                q: queue.Queue = queue.Queue()
+                t = threading.Thread(target=self._deliver_loop,
+                                     args=(node_id, q),
+                                     name=f"gw-{node_id[:4].hex()}",
+                                     daemon=True)
+                self._queues[node_id] = q
+                self._threads[node_id] = t
+                t.start()
+
+    def unregister_front(self, node_id: bytes) -> None:
+        with self._lock:
+            self._fronts.pop(node_id, None)
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._lock:
+            for q in self._queues.values():
+                q.put(None)
+
+    # -- fault injection ---------------------------------------------------
+    def partition(self, node_id: bytes, isolated: bool = True) -> None:
+        with self._lock:
+            if isolated:
+                self._partitioned.add(node_id)
+            else:
+                self._partitioned.discard(node_id)
+
+    def set_filter(self, fn: Optional[Callable[[bytes, bytes, bytes], bool]]
+                   ) -> None:
+        self._filter = fn
+
+    # -- transport ---------------------------------------------------------
+    def peers(self, src: bytes) -> list[bytes]:
+        with self._lock:
+            return [n for n in self._fronts
+                    if n != src and n not in self._partitioned]
+
+    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        with self._lock:
+            if (src in self._partitioned or dst in self._partitioned
+                    or dst not in self._fronts):
+                return False
+            q = self._queues.get(dst)
+        flt = self._filter
+        if flt is not None and not flt(src, dst, data):
+            return False
+        if q is None:
+            return False
+        q.put((src, data))
+        return True
+
+    def broadcast(self, src: bytes, data: bytes) -> None:
+        for dst in self.peers(src):
+            self.send(src, dst, data)
+
+    def _deliver_loop(self, node_id: bytes, q: queue.Queue) -> None:
+        while not self._stopped:
+            item = q.get()
+            if item is None:
+                return
+            src, data = item
+            with self._lock:
+                front = self._fronts.get(node_id)
+                dead = node_id in self._partitioned
+            if front is None or dead:
+                continue
+            try:
+                front.on_network_message(src, data)
+            except Exception:
+                LOG.exception(badge("GATEWAY", "dispatch-failed",
+                                    dst=node_id[:8].hex()))

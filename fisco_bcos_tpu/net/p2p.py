@@ -1,0 +1,248 @@
+"""P2P socket gateway: TCP (optionally TLS) transport between real nodes.
+
+Reference counterpart: /root/reference/bcos-gateway/bcos-gateway/ —
+`Host`/`Session` ASIO loops (libnetwork/Host.cpp, Session.cpp),
+`Service` connection management with reconnect (libp2p/Service.cpp), and the
+length-prefixed `P2PMessageV2` wire format; TLS contexts from
+bcos-boostssl/context/ContextBuilder.cpp. This implementation keeps the same
+shape on Python threads + blocking sockets: one listener, one reader thread
+per session, a reconnect loop for configured peers, length-prefixed frames.
+
+Frames: u32 length | payload. The first frame each way is a handshake
+carrying the magic, protocol version, and the sender's node ID (pubkey);
+afterwards every frame is an opaque FrontService envelope delivered to
+`front.on_network_message(src, data)`.
+
+Pass an `ssl.SSLContext` pair (server_ctx/client_ctx) for TLS — the
+reference's cert-based node authentication maps onto standard TLS certs; the
+node ID inside the handshake must then match the session's authenticated
+identity (enforced by the caller's context verify settings).
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..utils.log import LOG, badge
+from .gateway import Gateway
+
+MAGIC = b"FBTP"
+VERSION = 1
+MAX_FRAME = 128 * 1024 * 1024
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (length,) = struct.unpack(">I", head)
+    if length > MAX_FRAME:
+        return None
+    return _recv_exact(sock, length)
+
+
+class P2PGateway(Gateway):
+    def __init__(self, node_id: bytes, host: str = "127.0.0.1",
+                 port: int = 0, peers: Optional[list[tuple[str, int]]] = None,
+                 server_ssl: Optional[ssl.SSLContext] = None,
+                 client_ssl: Optional[ssl.SSLContext] = None,
+                 reconnect_interval: float = 1.0):
+        self.node_id = node_id
+        self.configured_peers = list(peers or [])
+        self.server_ssl = server_ssl
+        self.client_ssl = client_ssl
+        self.reconnect_interval = reconnect_interval
+        self._front = None
+        self._sessions: dict[bytes, socket.socket] = {}
+        self._send_locks: dict[bytes, threading.Lock] = {}
+        self._peer_by_addr: dict[tuple[str, int], bytes] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._threads: list[threading.Thread] = []
+
+    # -- Gateway interface -------------------------------------------------
+    def register_front(self, node_id: bytes, front) -> None:
+        assert node_id == self.node_id
+        self._front = front
+        self._spawn(self._accept_loop, "p2p-accept")
+        self._spawn(self._connect_loop, "p2p-connect")
+
+    def unregister_front(self, node_id: bytes) -> None:
+        self.stop()
+
+    def peers(self, src: bytes = b"") -> list[bytes]:
+        with self._lock:
+            return list(self._sessions)
+
+    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        with self._lock:
+            sock = self._sessions.get(dst)
+            slock = self._send_locks.setdefault(dst, threading.Lock())
+        if sock is None:
+            return False
+        try:
+            with slock:  # sendall is not atomic across threads
+                _send_frame(sock, data)
+            return True
+        except OSError:
+            self._drop(dst)
+            return False
+
+    def broadcast(self, src: bytes, data: bytes) -> None:
+        for dst in self.peers():
+            self.send(src, dst, data)
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = list(self._sessions.values())
+            self._sessions.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def add_peer(self, host: str, port: int) -> None:
+        with self._lock:
+            if (host, port) not in self.configured_peers:
+                self.configured_peers.append((host, port))
+
+    # -- internals ---------------------------------------------------------
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _handshake(self, sock: socket.socket) -> Optional[bytes]:
+        hello = MAGIC + bytes([VERSION]) + self.node_id
+        _send_frame(sock, hello)
+        frame = _recv_frame(sock)
+        if frame is None or len(frame) < 5 or frame[:4] != MAGIC:
+            return None
+        if frame[4] != VERSION:
+            return None
+        return frame[5:]
+
+    def _install(self, peer_id: bytes, sock: socket.socket,
+                 outbound: bool) -> bool:
+        """One session per pair, deterministic direction: the smaller node id
+        dials, the larger accepts — no replacement livelock on simultaneous
+        connects (Service.cpp keeps one session per peer the same way)."""
+        if peer_id == self.node_id:
+            return False
+        if outbound != (self.node_id < peer_id):
+            return False  # wrong direction: the other side owns this link
+        with self._lock:
+            if peer_id in self._sessions:
+                return False  # duplicate dial; first session wins
+            self._sessions[peer_id] = sock
+        self._spawn(lambda: self._read_loop(peer_id, sock),
+                    f"p2p-read-{peer_id[:4].hex()}")
+        LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
+                       n=len(self._sessions)))
+        return True
+
+    def _drop(self, peer_id: bytes) -> None:
+        with self._lock:
+            sock = self._sessions.pop(peer_id, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            if self.server_ssl is not None:
+                try:
+                    sock = self.server_ssl.wrap_socket(sock, server_side=True)
+                except ssl.SSLError:
+                    continue
+            try:
+                peer_id = self._handshake(sock)
+            except OSError:
+                continue
+            if peer_id is None or not self._install(peer_id, sock,
+                                                    outbound=False):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _connect_loop(self) -> None:
+        while not self._stopped:
+            with self._lock:
+                targets = list(self.configured_peers)
+                connected = set(self._sessions)
+            for host, port in targets:
+                if self._stopped:
+                    return
+                with self._lock:
+                    known = self._peer_by_addr.get((host, port))
+                if known is not None and known in connected:
+                    continue  # already linked to this address's node
+                try:
+                    sock = socket.create_connection((host, port), timeout=3)
+                    if self.client_ssl is not None:
+                        sock = self.client_ssl.wrap_socket(
+                            sock, server_hostname=host)
+                    peer_id = self._handshake(sock)
+                    if peer_id is not None:
+                        with self._lock:
+                            self._peer_by_addr[(host, port)] = peer_id
+                    if (peer_id is None
+                            or not self._install(peer_id, sock,
+                                                 outbound=True)):
+                        sock.close()
+                except OSError:
+                    continue
+            time.sleep(self.reconnect_interval)
+
+    def _read_loop(self, peer_id: bytes, sock: socket.socket) -> None:
+        while not self._stopped:
+            try:
+                frame = _recv_frame(sock)
+            except OSError:
+                frame = None
+            if frame is None:
+                self._drop(peer_id)
+                return
+            front = self._front
+            if front is None:
+                continue
+            try:
+                front.on_network_message(peer_id, frame)
+            except Exception:
+                LOG.exception(badge("P2P", "dispatch-failed",
+                                    peer=peer_id[:8].hex()))

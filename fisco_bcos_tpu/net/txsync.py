@@ -1,0 +1,99 @@
+"""TransactionSync — tx gossip + missing-tx fetch for proposals.
+
+Reference counterpart: /root/reference/bcos-txpool/bcos-txpool/sync/
+TransactionSync.cpp — broadcast of newly submitted txs to peers, batch
+import of received packets (the **tbb::parallel_for over tx->verify** at
+:516-537 that the TPU batch-recover call replaces here: received batches go
+through `TxPool.submit_batch`, i.e. ONE device recover kernel per packet),
+and on-demand fetch of a proposal's missing txs (TxPool.cpp:160
+asyncVerifyBlock's fetch-missing path).
+
+Wire payloads (module TxsSync):
+  push:    seq<blob tx-encoding>                    (gossip batch)
+  request: seq<blob tx-hash>                        (fetch by hash)
+  response:seq<blob tx-encoding>                    (may be partial)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from ..codec.wire import Reader, Writer
+from ..protocol import Transaction
+from ..utils.log import LOG, badge, metric
+from .front import FrontService
+from .moduleid import ModuleID
+
+
+def _pack_txs(txs: Sequence[Transaction]) -> bytes:
+    return Writer().seq(list(txs), lambda w, t: w.blob(t.encode())).bytes()
+
+
+def _unpack_txs(data: bytes) -> list[Transaction]:
+    return Reader(data).seq(lambda r: Transaction.decode(r.blob()))
+
+
+class TransactionSync:
+    def __init__(self, front: FrontService, txpool, suite):
+        self.front = front
+        self.txpool = txpool
+        self.suite = suite
+        self._lock = threading.Lock()
+        self._known_by_peer: dict[bytes, set[bytes]] = {}
+        front.register_module(ModuleID.TxsSync, self._on_message)
+        txpool.register_broadcast_hook(self.broadcast_new)
+
+    # -- outgoing gossip ---------------------------------------------------
+    def broadcast_new(self, txs: Sequence[Transaction]) -> None:
+        """Forward locally-submitted txs to all peers (skip per-peer knowns)."""
+        if not txs:
+            return
+        payload_cache: dict[frozenset, bytes] = {}
+        for peer in self.front.peers():
+            with self._lock:
+                known = self._known_by_peer.setdefault(peer, set())
+                fresh = [t for t in txs if t.hash(self.suite) not in known]
+                known.update(t.hash(self.suite) for t in fresh)
+            if not fresh:
+                continue
+            key = frozenset(t.hash(self.suite) for t in fresh)
+            data = payload_cache.get(key)
+            if data is None:
+                data = payload_cache[key] = _pack_txs(fresh)
+            self.front.send(ModuleID.TxsSync, peer, data)
+
+    # -- missing-tx fetch (proposal verification) --------------------------
+    def fetch_missing(self, peer: bytes, hashes: Sequence[bytes],
+                      timeout: float = 5.0) -> bool:
+        """Request txs by hash from `peer` and import them. True if all
+        arrived and verified (one batch recover for the whole response)."""
+        req = Writer().seq(list(hashes), lambda w, h: w.blob(h)).bytes()
+        resp = self.front.request(ModuleID.TxsSync, peer, req, timeout)
+        if resp is None:
+            return False
+        txs = _unpack_txs(resp)
+        if len(txs) != len(hashes):
+            return False
+        results = self.txpool.submit_batch(txs, broadcast=False)
+        metric("txsync.fetch_missing", n=len(txs), peer=peer[:8].hex())
+        from ..protocol import TransactionStatus
+        okset = (TransactionStatus.OK, TransactionStatus.ALREADY_IN_TXPOOL,
+                 TransactionStatus.ALREADY_KNOWN)
+        return all(r.status in okset for r in results)
+
+    # -- incoming ----------------------------------------------------------
+    def _on_message(self, src: bytes, payload: bytes, respond) -> None:
+        if respond is not None:  # fetch request: serve from the pool
+            hashes = Reader(payload).seq(lambda r: r.blob())
+            txs = self.txpool.fill_block(hashes) or []
+            respond(_pack_txs(txs))
+            return
+        txs = _unpack_txs(payload)
+        if not txs:
+            return
+        with self._lock:
+            known = self._known_by_peer.setdefault(src, set())
+            known.update(t.hash(self.suite) for t in txs)
+        # one TPU batch-recover for the whole gossip packet
+        self.txpool.submit_batch(txs, broadcast=True)

@@ -1,0 +1,3 @@
+from .server import JsonRpcServer, JsonRpcImpl
+
+__all__ = ["JsonRpcServer", "JsonRpcImpl"]

@@ -136,6 +136,11 @@ class Scheduler:
                ms=int((time.monotonic() - t0) * 1000))
         return True
 
+    def drop_executed(self, header: BlockHeader) -> None:
+        """Discard a cached execution result (failed sync replay etc.)."""
+        with self._lock:
+            self._executed.pop(header.hash(self.suite), None)
+
     # -- read-only call (SchedulerImpl::call) ------------------------------
     def call(self, tx: Transaction) -> Receipt:
         state = StateStorage(self.storage)

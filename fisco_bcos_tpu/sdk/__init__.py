@@ -1,0 +1,3 @@
+from .client import SdkClient, TransactionBuilder
+
+__all__ = ["SdkClient", "TransactionBuilder"]

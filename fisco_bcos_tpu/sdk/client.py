@@ -1,0 +1,132 @@
+"""Client SDK: JSON-RPC client + transaction assembly/signing.
+
+Reference counterpart: /root/reference/bcos-sdk/bcos-cpp-sdk/ — `Sdk`
+(Sdk.h:34-49) bundling a jsonrpc client over the WS service with the tx
+builders under utilities/transaction/. Here the transport is plain HTTP
+against `fisco_bcos_tpu.rpc.JsonRpcServer`; `TransactionBuilder` mirrors the
+reference's TransactionBuilder::createSignedTransaction (sign-and-encode
+against a CryptoSuite keypair, auto nonce + blockLimit).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import secrets
+import urllib.request
+from typing import Any, Optional
+
+from ..crypto.suite import CryptoSuite
+from ..protocol import Transaction
+
+
+class RpcCallError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc error {code}: {message}")
+        self.code = code
+
+
+class SdkClient:
+    def __init__(self, url: str, group: str = "group0",
+                 node_name: str = ""):
+        self.url = url
+        self.group = group
+        self.node_name = node_name
+        self._seq = itertools.count(1)
+
+    # -- raw jsonrpc -------------------------------------------------------
+    def request(self, method: str, params: list) -> Any:
+        body = json.dumps({"jsonrpc": "2.0", "id": next(self._seq),
+                           "method": method, "params": params}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RpcCallError(out["error"]["code"], out["error"]["message"])
+        return out.get("result")
+
+    def _grouped(self, method: str, *params) -> Any:
+        return self.request(method, [self.group, self.node_name, *params])
+
+    # -- convenience API (JsonRpcInterface.cpp:16-71 surface) --------------
+    def send_transaction(self, tx: Transaction, require_proof: bool = False,
+                         wait: bool = True) -> dict:
+        return self._grouped("sendTransaction", "0x" + tx.encode().hex(),
+                             require_proof, wait)
+
+    def call(self, to: bytes, data: bytes) -> dict:
+        return self._grouped("call", "0x" + to.hex(), "0x" + data.hex())
+
+    def get_block_number(self) -> int:
+        return self._grouped("getBlockNumber")
+
+    def get_block_by_number(self, number: int, only_header: bool = False,
+                            only_tx_hash: bool = False) -> Optional[dict]:
+        return self._grouped("getBlockByNumber", number, only_header,
+                             only_tx_hash)
+
+    def get_block_by_hash(self, block_hash: str,
+                          only_header: bool = False) -> Optional[dict]:
+        return self._grouped("getBlockByHash", block_hash, only_header)
+
+    def get_transaction(self, tx_hash: str,
+                        require_proof: bool = False) -> Optional[dict]:
+        return self._grouped("getTransaction", tx_hash, require_proof)
+
+    def get_transaction_receipt(self, tx_hash: str,
+                                require_proof: bool = False) -> Optional[dict]:
+        return self._grouped("getTransactionReceipt", tx_hash, require_proof)
+
+    def get_sealer_list(self) -> list:
+        return self._grouped("getSealerList")
+
+    def get_sync_status(self) -> dict:
+        return self._grouped("getSyncStatus")
+
+    def get_consensus_status(self) -> dict:
+        return self._grouped("getConsensusStatus")
+
+    def get_system_config(self, key: str) -> dict:
+        return self._grouped("getSystemConfigByKey", key)
+
+    def get_total_transaction_count(self) -> dict:
+        return self._grouped("getTotalTransactionCount")
+
+    def get_pending_tx_size(self) -> int:
+        return self._grouped("getPendingTxSize")
+
+    def get_group_info(self) -> dict:
+        return self.request("getGroupInfo", [self.group])
+
+
+class TransactionBuilder:
+    """Sign-and-encode helper (reference TransactionBuilder semantics)."""
+
+    def __init__(self, suite: CryptoSuite, client: Optional[SdkClient] = None,
+                 chain_id: str = "chain0", group_id: str = "group0",
+                 block_limit_offset: int = 500):
+        self.suite = suite
+        self.client = client
+        self.chain_id = chain_id
+        self.group_id = group_id
+        self.block_limit_offset = block_limit_offset
+
+    def build(self, keypair, to: bytes, data: bytes, abi: str = "",
+              nonce: Optional[str] = None,
+              block_limit: Optional[int] = None) -> Transaction:
+        if block_limit is None:
+            current = self.client.get_block_number() if self.client else 0
+            block_limit = current + self.block_limit_offset
+        if nonce is None:
+            nonce = secrets.token_hex(16)
+        tx = Transaction(chain_id=self.chain_id, group_id=self.group_id,
+                         block_limit=block_limit, nonce=nonce, to=to,
+                         input=data, abi=abi)
+        return tx.sign(self.suite, keypair)
+
+    def send(self, keypair, to: bytes, data: bytes, **kw) -> dict:
+        assert self.client is not None, "builder needs a client to send"
+        return self.client.send_transaction(self.build(keypair, to, data,
+                                                       **kw))

@@ -1,0 +1,3 @@
+from .sync import BlockSync
+
+__all__ = ["BlockSync"]

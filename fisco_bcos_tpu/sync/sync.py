@@ -1,0 +1,178 @@
+"""BlockSync — download/broadcast state machine for lagging nodes.
+
+Reference counterpart: /root/reference/bcos-sync/bcos-sync/BlockSync.cpp
+(:183 executeWorker -> :194 maintainPeersStatus, :200
+maintainDownloadingQueue, :216 maintainBlockRequest) — peers gossip their
+latest number, a lagging node requests ranges, and every fetched block's
+commit seals are batch-verified before replay
+(bcos-pbft/bcos-pbft/pbft/engine/BlockValidator.cpp:141 checkSignatureList —
+here ONE `suite.verify_batch` call across all seals of all fetched blocks).
+
+Wire payloads (module BlockSync):
+  push:     status  = i64 number | blob latest_hash
+  request:  range   = i64 from | i64 to
+  response: blocks  = seq<blob block-encoding (full txs)>
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..codec.wire import Reader, Writer
+from ..net.front import FrontService
+from ..net.moduleid import ModuleID
+from ..protocol import Block, BlockHeader
+from ..utils.log import LOG, badge, metric
+from ..utils.worker import Worker
+
+MAX_BLOCKS_PER_REQUEST = 32
+
+
+class BlockSync(Worker):
+    def __init__(self, front: FrontService, ledger, scheduler, suite,
+                 status_interval: float = 1.0):
+        super().__init__("block-sync", idle_wait=0.1)
+        self.front = front
+        self.ledger = ledger
+        self.scheduler = scheduler
+        self.suite = suite
+        self.status_interval = status_interval
+        self._peers: dict[bytes, int] = {}  # peer -> latest number
+        self._lock = threading.Lock()
+        self._last_status = 0.0
+        self._inflight = False
+        front.register_module(ModuleID.BlockSync, self._on_message)
+
+    # -- worker ------------------------------------------------------------
+    def execute_worker(self) -> None:
+        now = time.monotonic()
+        if now - self._last_status >= self.status_interval:
+            self._last_status = now
+            self.broadcast_status()
+        self._maybe_download()
+
+    def broadcast_status(self) -> None:
+        n = self.ledger.current_number()
+        h = self.ledger.header_by_number(n)
+        payload = (Writer().i64(n)
+                   .blob(h.hash(self.suite) if h else b"").bytes())
+        self.front.broadcast(ModuleID.BlockSync, payload)
+
+    def _maybe_download(self) -> None:
+        if self._inflight:
+            return
+        current = self.ledger.current_number()
+        with self._lock:
+            ahead = [(p, n) for p, n in self._peers.items() if n > current]
+        if not ahead:
+            return
+        peer, peer_number = max(ahead, key=lambda x: x[1])
+        lo = current + 1
+        hi = min(peer_number, current + MAX_BLOCKS_PER_REQUEST)
+        self._inflight = True
+        try:
+            req = Writer().i64(lo).i64(hi).bytes()
+            resp = self.front.request(ModuleID.BlockSync, peer, req,
+                                      timeout=10.0)
+            if resp is None:
+                return
+            blocks = Reader(resp).seq(lambda r: Block.decode(r.blob()))
+            self._apply_blocks(blocks)
+        finally:
+            self._inflight = False
+            self.wakeup()
+
+    # -- verification + replay --------------------------------------------
+    def _verify_seals(self, header: BlockHeader) -> bool:
+        """Verify one block's commit seals against the LOCAL ledger's sealer
+        set (never the peer-supplied header.sealer_list — a malicious peer
+        could fabricate that), deduplicated by sealer index, quorum 2f+1.
+        All seals go through one batch verify (BlockValidator.cpp:141)."""
+        sealer_set = sorted(n.node_id for n in self.ledger.consensus_nodes()
+                            if n.node_type == "consensus_sealer")
+        if list(header.sealer_list) != sealer_set:
+            LOG.warning(badge("SYNC", "sealer-list-mismatch",
+                              number=header.number))
+            return False
+        hh = header.hash(self.suite)
+        by_idx: dict[int, bytes] = {}
+        for idx, seal in header.signature_list:
+            if 0 <= idx < len(sealer_set):
+                by_idx.setdefault(idx, seal)
+        n = len(sealer_set)
+        quorum = 2 * ((n - 1) // 3) + 1
+        if len(by_idx) < quorum:
+            return False
+        idxs = sorted(by_idx)
+        ok = np.asarray(self.suite.verify_batch(
+            [hh] * len(idxs), [by_idx[i] for i in idxs],
+            [sealer_set[i] for i in idxs]))
+        if int(ok.sum()) < quorum:
+            LOG.warning(badge("SYNC", "seal-quorum-failed",
+                              number=header.number))
+            return False
+        return True
+
+    def _apply_blocks(self, blocks: list[Block]) -> None:
+        blocks = [b for b in blocks
+                  if b.header.number > self.ledger.current_number()]
+        blocks.sort(key=lambda b: b.header.number)
+        for block in blocks:
+            # verify per block, AFTER the previous replay: the sealer set is
+            # ledger state and may change at any height
+            if not self._verify_seals(block.header):
+                return
+            synced = block.header
+            expect_hash = synced.hash(self.suite)
+            replay = Block(transactions=block.transactions)
+            replay.header.version = synced.version
+            replay.header.consensus_weights = list(synced.consensus_weights)
+            replay.header.number = synced.number
+            replay.header.timestamp = synced.timestamp
+            replay.header.sealer = synced.sealer
+            replay.header.sealer_list = list(synced.sealer_list)
+            replay.header.extra_data = synced.extra_data
+            result = self.scheduler.execute_block(replay)
+            if result is None:
+                return
+            if result.header.hash(self.suite) != expect_hash:
+                LOG.error(badge("SYNC", "replay-hash-mismatch",
+                                number=synced.number))
+                self.scheduler.drop_executed(result.header)
+                return
+            result.header.signature_list = synced.signature_list
+            if not self.scheduler.commit_block(result.header):
+                return
+            metric("sync.committed", number=synced.number)
+
+    # -- serving + status ingest ------------------------------------------
+    def _on_message(self, src: bytes, payload: bytes, respond) -> None:
+        if respond is not None:  # range request: serve blocks
+            r = Reader(payload)
+            lo, hi = r.i64(), r.i64()
+            hi = min(hi, lo + MAX_BLOCKS_PER_REQUEST - 1,
+                     self.ledger.current_number())
+            out = []
+            for n in range(lo, hi + 1):
+                b = self.ledger.block_by_number(n, with_txs=True)
+                if b is None:
+                    break
+                out.append(b)
+            respond(Writer().seq(out, lambda w, b: w.blob(b.encode())).bytes())
+            return
+        r = Reader(payload)
+        number = r.i64()
+        with self._lock:
+            self._peers[src] = number
+        if number > self.ledger.current_number():
+            self.wakeup()
+
+    def status(self) -> dict:
+        with self._lock:
+            peers = {p.hex()[:16]: n for p, n in self._peers.items()}
+        return {"blockNumber": self.ledger.current_number(),
+                "peers": peers}

@@ -60,10 +60,17 @@ class TxPool:
         self._on_ready: list[Callable[[], None]] = []
         # receipt futures: tx hash -> Event set at commit (RPC waits on it)
         self._waiters: dict[bytes, threading.Event] = {}
+        # TransactionSync gossip hook (TransactionSync.cpp broadcast path)
+        self._broadcast_hooks: list[Callable[[Sequence[Transaction]], None]] = []
 
     # -- notifications -----------------------------------------------------
     def register_unseal_notifier(self, fn: Callable[[], None]) -> None:
         self._on_ready.append(fn)
+
+    def register_broadcast_hook(
+            self, fn: Callable[[Sequence[Transaction]], None]) -> None:
+        """TransactionSync registers here to gossip newly accepted txs."""
+        self._broadcast_hooks.append(fn)
 
     def _notify_ready(self) -> None:
         for fn in self._on_ready:
@@ -73,7 +80,8 @@ class TxPool:
     def submit(self, tx: Transaction) -> TxSubmitResult:
         return self.submit_batch([tx])[0]
 
-    def submit_batch(self, txs: Sequence[Transaction]) -> list[TxSubmitResult]:
+    def submit_batch(self, txs: Sequence[Transaction],
+                     broadcast: bool = True) -> list[TxSubmitResult]:
         """Host checks + one TPU batch recover for the survivors."""
         t0 = time.monotonic()
         hashes = batch_hash(txs, self.suite)
@@ -113,6 +121,12 @@ class TxPool:
                ms=int((time.monotonic() - t0) * 1000))
         if need_verify:
             self._notify_ready()
+        if broadcast and self._broadcast_hooks:
+            accepted = [txs[i] for i, r in enumerate(results)
+                        if r.status == TransactionStatus.OK]
+            if accepted:
+                for fn in self._broadcast_hooks:
+                    fn(accepted)
         return [r for r in results]
 
     def _precheck(self, tx: Transaction, h: bytes,
@@ -183,6 +197,11 @@ class TxPool:
                     return None
                 out.append(tx)
             return out
+
+    def missing_hashes(self, hashes: Sequence[bytes]) -> list[bytes]:
+        """Subset of `hashes` not present in the pool (fetch-missing path)."""
+        with self._lock:
+            return [h for h in hashes if h not in self._pending]
 
     def verify_proposal(self, block: Block) -> bool:
         """Verify a proposal: every tx known (already validated at submit) or,

@@ -1,0 +1,89 @@
+"""JSON-RPC server + SDK client round trip against a solo node.
+
+Covers the reference's access-layer surface (bcos-rpc JsonRpcInterface.cpp
+method table; bcos-sdk Sdk/TransactionBuilder) end to end over real HTTP.
+"""
+
+import pytest
+
+from fisco_bcos_tpu.codec.wire import Reader
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.ops import merkle as merkle_mod
+from fisco_bcos_tpu.sdk.client import RpcCallError, SdkClient, TransactionBuilder
+
+
+@pytest.fixture()
+def rpc_node():
+    n = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                        rpc_port=0))
+    n.start()
+    client = SdkClient(f"http://{n.rpc.host}:{n.rpc.port}")
+    yield n, client
+    n.stop()
+
+
+def test_rpc_tx_lifecycle(rpc_node):
+    node, client = rpc_node
+    suite = node.suite
+    kp = suite.generate_keypair(b"rpcuser")
+    builder = TransactionBuilder(suite, client)
+
+    rc = builder.send(kp, pc.BALANCE_ADDRESS,
+                      pc.encode_call("register",
+                                     lambda w: w.blob(b"rpc").u64(77)))
+    assert rc["status"] == 0
+    tx_hash = rc["transactionHash"]
+
+    # queries
+    assert client.get_block_number() >= 1
+    got = client.get_transaction(tx_hash)
+    assert got["hash"] == tx_hash and got["from"]
+    # single-tx block: the proof is empty (leaf == root), and must verify
+    rcpt = client.get_transaction_receipt(tx_hash, require_proof=True)
+    assert rcpt["status"] == 0 and "receiptProof" in rcpt
+
+    blk = client.get_block_by_number(rc["blockNumber"])
+    assert blk["number"] == rc["blockNumber"]
+    assert blk["transactions"][0]["hash"] == tx_hash
+    assert client.get_block_by_hash(blk["hash"], only_header=True)[
+        "number"] == blk["number"]
+    assert client.request("getBlockHashByNumber",
+                          ["group0", "", blk["number"]]) == blk["hash"]
+
+    out = client.call(pc.BALANCE_ADDRESS,
+                      pc.encode_call("balanceOf", lambda w: w.blob(b"rpc")))
+    assert out["status"] == 0
+    assert Reader(bytes.fromhex(out["output"][2:])).u64() == 77
+
+    # tx merkle proof verifies against the block's txsRoot
+    got_proof = client.get_transaction(tx_hash, require_proof=True)
+    proof = [(list(map(lambda s: bytes.fromhex(s[2:]), lvl["siblings"])),
+              lvl["index"]) for lvl in got_proof["txProof"]]
+    assert merkle_mod.verify_merkle_proof(
+        bytes.fromhex(tx_hash[2:]), proof,
+        bytes.fromhex(got_proof["txsRoot"][2:]), suite.hash_name)
+
+
+def test_rpc_status_and_errors(rpc_node):
+    node, client = rpc_node
+    status = client.get_sync_status()
+    assert status["blockNumber"] == node.ledger.current_number()
+    counts = client.get_total_transaction_count()
+    assert counts["blockNumber"] == node.ledger.current_number()
+    sealers = client.get_sealer_list()
+    assert sealers and sealers[0]["nodeID"].startswith("0x")
+    cfg = client.get_system_config("tx_count_limit")
+    assert cfg["value"] == "1000"
+    info = client.get_group_info()
+    assert info["groupID"] == "group0" and info["genesisHash"].startswith("0x")
+    assert client.request("getGroupList", [])["groupList"] == ["group0"]
+    assert client.get_pending_tx_size() == 0
+
+    with pytest.raises(RpcCallError):
+        client.request("noSuchMethod", [])
+    with pytest.raises(RpcCallError):
+        client.request("getBlockNumber", ["wrong-group", ""])
+    # malformed tx hex -> internal error, not a crash
+    with pytest.raises(RpcCallError):
+        client.request("sendTransaction", ["group0", "", "0xdeadbeef", False])
