@@ -6,15 +6,19 @@ This is the north-star component: the reference's per-transaction hot path is
 dispatched to the WeDPR Rust FFI one signature at a time under a tbb loop
 (/root/reference/bcos-txpool/bcos-txpool/sync/TransactionSync.cpp:516-537,
  /root/reference/bcos-crypto/bcos-crypto/signature/secp256k1/
- Secp256k1Crypto.cpp:40,57,85). Here the batch IS the kernel: every function
-takes [B, NLIMBS] uint32 limb arrays and maps the whole batch onto TPU vector
-lanes; `jax.sharding` splits B across the device mesh for 64k-tx blocks.
+ Secp256k1Crypto.cpp:40,57,85). Here the batch IS the kernel: the public
+entry points take [B, NLIMBS] uint32 limb arrays, transpose to the
+lane-major [NLIMBS, B] layout (batch in the TPU's 128-wide lane axis — see
+ops.fp), and map the whole batch onto vector lanes; `jax.sharding` splits B
+across the device mesh for 64k-tx blocks.
 
 Algorithms
 ----------
-* Field/scalar arithmetic: Montgomery CIOS over 16x16-bit limbs (`bigint.Mod`).
-* Point arithmetic: Jacobian coordinates, *complete by selection* — every add
-  also computes the doubling and infinity cases and `jnp.where`-selects, so
+* Field arithmetic: `fp.SolinasField` fold reduction for secp256k1's
+  pseudo-Mersenne prime (plain domain); `fp.MontField` full-product REDC for
+  SM2's prime and both curve orders (Montgomery domain).
+* Point arithmetic: Jacobian coordinates, *complete by selection* — every
+  add also computes the doubling and infinity cases and selects, so
   adversarial inputs (forced collisions) cannot produce wrong results. TPU
   control flow must be branch-free anyway; completeness is free-ish.
 * Double-scalar mult u1*G + u2*Q: Shamir's trick with 4-bit windows over a
@@ -37,20 +41,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import bigint
-from .bigint import (
-    NLIMBS,
-    Mod,
-    eq,
-    geq,
-    is_zero,
-    to_limbs,
-    window_digits,
-)
+from . import bigint, fp
+from .fp import NLIMBS, eq, geq, is_zero, select
 from ..crypto import refimpl
 
 WINDOW = 4
-NDIGITS = bigint.BITS // WINDOW  # 64 digit positions
+NDIGITS = fp.BITS // WINDOW  # 64 digit positions
 TBL = 1 << WINDOW  # 16 window entries (index 0 = skip)
 
 __all__ = [
@@ -64,7 +60,7 @@ __all__ = [
 
 
 class Curve:
-    """Static curve context: field/scalar Mods + Montgomery constants + G table.
+    """Static curve context: field objects + curve constants + G table.
 
     Hashable by identity (module-level singletons) so it can be a jit static
     argument.
@@ -72,21 +68,23 @@ class Curve:
 
     def __init__(self, params: refimpl.CurveParams):
         self.params = params
-        self.fp = Mod(params.p, params.name + ".p")
-        self.fn = Mod(params.n, params.name + ".n")
+        if (1 << fp.BITS) - params.p < 1 << 34:
+            self.fp: fp._FieldBase = fp.SolinasField(params.p, params.name + ".p")
+        else:
+            self.fp = fp.MontField(params.p, params.name + ".p")
+        self.fn = fp.MontField(params.n, params.name + ".n")
         self.a_is_zero = params.a % params.p == 0
 
-        def mont(v: int) -> np.ndarray:
-            return to_limbs(v * self.fp.r_int % params.p)
-
-        self.a_m = mont(params.a % params.p)
-        self.b_m = mont(params.b % params.p)
-        # affine window table for G: entry k = k*G in Montgomery form, k>=1.
-        tbl = np.zeros((TBL, 2, NLIMBS), np.uint32)
+        self.a_rep = self.fp.encode_int(params.a)
+        self.b_rep = self.fp.encode_int(params.b)
+        # affine window table for G: entry k = k*G in field rep, k >= 1;
+        # flattened [TBL, 2*NLIMBS] for the constant-table lane select.
+        tbl = np.zeros((TBL, 2 * NLIMBS), np.uint32)
         P = None
         for k in range(1, TBL):
             P = refimpl.ec_add(params, P, (params.gx, params.gy))
-            tbl[k, 0], tbl[k, 1] = mont(P[0]), mont(P[1])
+            tbl[k, :NLIMBS] = self.fp.encode_int(P[0])
+            tbl[k, NLIMBS:] = self.fp.encode_int(P[1])
         self.g_table = tbl
 
     def __repr__(self):
@@ -98,86 +96,85 @@ SM2P256V1 = Curve(refimpl.SM2P256V1)
 
 
 # ---------------------------------------------------------------------------
-# Jacobian point arithmetic (points packed as [..., 3, NLIMBS], Montgomery)
+# Jacobian point arithmetic (points packed as [..., 3, NLIMBS, B], field rep)
 # ---------------------------------------------------------------------------
 
 def _pack(X, Y, Z):
-    return jnp.stack([X, Y, Z], axis=-2)
+    return jnp.stack([X, Y, Z], axis=-3)
 
 
 def _unpack(P):
-    return P[..., 0, :], P[..., 1, :], P[..., 2, :]
+    return P[..., 0, :, :], P[..., 1, :, :], P[..., 2, :, :]
 
 
 def _sel(cond, a, b):
-    """cond ? a : b over packed points."""
-    return jnp.where(cond[..., None, None], a, b)
+    """cond ? a : b over packed points (cond: [..., B])."""
+    return jnp.where(cond[..., None, None, :], a, b)
 
 
 def _inf_like(P):
     return jnp.zeros_like(P)
 
 
-def _mulk(fp, pairs):
-    """One stacked Montgomery multiply for k independent products.
+def _mulk(f, pairs):
+    """One stacked field multiply for k independent products.
 
-    Compile-time: each Mod.mul lowers to a fori_loop (an XLA while); XLA's
-    loop passes dominate compile on these kernels, so fusing k muls into one
-    loop over a stacked leading axis cuts compile ~k-fold. Runtime: wider
-    batches fill VPU lanes better. This phase-stacking is why the point
-    formulas below look staged."""
+    Stacking along a fresh leading axis turns k multiplies into one call —
+    k-fold fewer HLO nodes (compile time) and longer vectors at run time.
+    """
     a = jnp.stack([p[0] for p in pairs], axis=0)
     b = jnp.stack([p[1] for p in pairs], axis=0)
-    r = fp.mul(a, b)
+    r = f.mul(a, b)
     return [r[i] for i in range(len(pairs))]
 
 
 def jac_double(cv: Curve, P):
     """2P. Complete: Z=0 (infinity) propagates as Z3=0."""
-    fp = cv.fp
+    f = cv.fp
     X, Y, Z = _unpack(P)
-    two_y = fp.add(Y, Y)
+    two_y = f.add(Y, Y)
     if cv.a_is_zero:
-        XX, YY = _mulk(fp, [(X, X), (Y, Y)])
-        XYY, YYYY, Z3 = _mulk(fp, [(X, YY), (YY, YY), (two_y, Z)])
-        M = fp.add(fp.add(XX, XX), XX)  # 3*X^2
+        XX, YY = _mulk(f, [(X, X), (Y, Y)])
+        XYY, YYYY, Z3 = _mulk(f, [(X, YY), (YY, YY), (two_y, Z)])
+        M = f.add(f.add(XX, XX), XX)  # 3*X^2
     else:
-        XX, YY, ZZ = _mulk(fp, [(X, X), (Y, Y), (Z, Z)])
+        XX, YY, ZZ = _mulk(f, [(X, X), (Y, Y), (Z, Z)])
         XYY, YYYY, Z3, ZZZZ = _mulk(
-            fp, [(X, YY), (YY, YY), (two_y, Z), (ZZ, ZZ)])
-        aZ4 = fp.mul(jnp.broadcast_to(jnp.asarray(cv.a_m), ZZZZ.shape), ZZZZ)
-        M = fp.add(fp.add(fp.add(XX, XX), XX), aZ4)
-    S = fp.add(XYY, XYY)
-    S = fp.add(S, S)  # 4*X*Y^2
-    MM = fp.mul(M, M)
-    X3 = fp.sub(MM, fp.add(S, S))
-    y8 = fp.add(YYYY, YYYY)
-    y8 = fp.add(y8, y8)
-    y8 = fp.add(y8, y8)  # 8*Y^4
-    Y3 = fp.sub(fp.mul(M, fp.sub(S, X3)), y8)
+            f, [(X, YY), (YY, YY), (two_y, Z), (ZZ, ZZ)])
+        a_c = jnp.broadcast_to(fp._col(cv.a_rep), ZZZZ.shape)
+        aZ4 = f.mul(a_c, ZZZZ)
+        M = f.add(f.add(f.add(XX, XX), XX), aZ4)
+    S = f.add(XYY, XYY)
+    S = f.add(S, S)  # 4*X*Y^2
+    MM = f.mul(M, M)
+    X3 = f.sub(MM, f.add(S, S))
+    y8 = f.add(YYYY, YYYY)
+    y8 = f.add(y8, y8)
+    y8 = f.add(y8, y8)  # 8*Y^4
+    Y3 = f.sub(f.mul(M, f.sub(S, X3)), y8)
     return _pack(X3, Y3, Z3)
 
 
 def jac_add(cv: Curve, P, Q):
-    """P + Q, both Jacobian. Complete by selection (doubling/infinity cases)."""
-    fp = cv.fp
+    """P + Q, both Jacobian. Complete by selection (doubling/infinity)."""
+    f = cv.fp
     X1, Y1, Z1 = _unpack(P)
     X2, Y2, Z2 = _unpack(Q)
     p_inf = is_zero(Z1)
     q_inf = is_zero(Z2)
-    Z1Z1, Z2Z2 = _mulk(fp, [(Z1, Z1), (Z2, Z2)])
+    Z1Z1, Z2Z2 = _mulk(f, [(Z1, Z1), (Z2, Z2)])
     U1, U2, Y1Z2, Y2Z1 = _mulk(
-        fp, [(X1, Z2Z2), (X2, Z1Z1), (Y1, Z2), (Y2, Z1)])
-    S1, S2 = _mulk(fp, [(Y1Z2, Z2Z2), (Y2Z1, Z1Z1)])
-    H = fp.sub(U2, U1)
-    R = fp.sub(S2, S1)
+        f, [(X1, Z2Z2), (X2, Z1Z1), (Y1, Z2), (Y2, Z1)])
+    S1, S2 = _mulk(f, [(Y1Z2, Z2Z2), (Y2Z1, Z1Z1)])
+    H = f.sub(U2, U1)
+    R = f.sub(S2, S1)
     h0 = is_zero(H)
     r0 = is_zero(R)
-    HH, RR = _mulk(fp, [(H, H), (R, R)])
-    HHH, V, Z1Z2 = _mulk(fp, [(H, HH), (U1, HH), (Z1, Z2)])
-    X3 = fp.sub(fp.sub(RR, HHH), fp.add(V, V))
-    t1, t2, Z3 = _mulk(fp, [(R, fp.sub(V, X3)), (S1, HHH), (Z1Z2, H)])
-    Y3 = fp.sub(t1, t2)
+    HH, RR = _mulk(f, [(H, H), (R, R)])
+    HHH, V, Z1Z2 = _mulk(f, [(H, HH), (U1, HH), (Z1, Z2)])
+    X3 = f.sub(f.sub(RR, HHH), f.add(V, V))
+    t1, t2, Z3 = _mulk(f, [(R, f.sub(V, X3)), (S1, HHH), (Z1Z2, H)])
+    Y3 = f.sub(t1, t2)
     res = _pack(X3, Y3, Z3)
     res = _sel(h0 & r0, jac_double(cv, P), res)  # P == Q
     res = _sel(h0 & ~r0, _inf_like(res), res)  # P == -Q
@@ -187,26 +184,28 @@ def jac_add(cv: Curve, P, Q):
 
 
 def jac_add_affine(cv: Curve, P, qx, qy):
-    """P + (qx, qy) with the second operand affine (Z2 = 1): mixed addition."""
-    fp = cv.fp
+    """P + (qx, qy) with the second operand affine (Z2 = 1): mixed add."""
+    f = cv.fp
     X1, Y1, Z1 = _unpack(P)
     p_inf = is_zero(Z1)
-    Z1Z1 = fp.mul(Z1, Z1)
-    U2, qyZ1 = _mulk(fp, [(qx, Z1Z1), (qy, Z1)])
-    S2 = fp.mul(qyZ1, Z1Z1)
-    H = fp.sub(U2, X1)
-    R = fp.sub(S2, Y1)
+    Z1Z1 = f.mul(Z1, Z1)
+    U2, qyZ1 = _mulk(f, [(qx, Z1Z1), (qy, Z1)])
+    S2 = f.mul(qyZ1, Z1Z1)
+    H = f.sub(U2, X1)
+    R = f.sub(S2, Y1)
     h0 = is_zero(H)
     r0 = is_zero(R)
-    HH, RR = _mulk(fp, [(H, H), (R, R)])
-    HHH, V, Z3 = _mulk(fp, [(H, HH), (X1, HH), (Z1, H)])
-    X3 = fp.sub(fp.sub(RR, HHH), fp.add(V, V))
-    t1, t2 = _mulk(fp, [(R, fp.sub(V, X3)), (Y1, HHH)])
-    Y3 = fp.sub(t1, t2)
+    HH, RR = _mulk(f, [(H, H), (R, R)])
+    HHH, V, Z3 = _mulk(f, [(H, HH), (X1, HH), (Z1, H)])
+    X3 = f.sub(f.sub(RR, HHH), f.add(V, V))
+    t1, t2 = _mulk(f, [(R, f.sub(V, X3)), (Y1, HHH)])
+    Y3 = f.sub(t1, t2)
     res = _pack(X3, Y3, Z3)
     res = _sel(h0 & r0, jac_double(cv, P), res)
     res = _sel(h0 & ~r0, _inf_like(res), res)
-    lifted = _pack(qx, qy, cv.fp.one_mont(qx.shape[:-1]))
+    one = f.one_rep(qx.shape)
+    lifted = _pack(jnp.broadcast_to(qx, one.shape),
+                   jnp.broadcast_to(qy, one.shape), one)
     res = _sel(p_inf, lifted, res)
     return res
 
@@ -215,31 +214,34 @@ def jac_add_affine(cv: Curve, P, qx, qy):
 # windowed Shamir double-scalar multiplication
 # ---------------------------------------------------------------------------
 
-def _take_const(table, dig):
-    """table [TBL, k, L] constant; dig [...]. -> [..., k, L] via one-hot sum
-    (gathers lower poorly on TPU; a masked sum stays on the VPU)."""
-    oh = (dig[..., None] == jnp.arange(TBL, dtype=dig.dtype)).astype(jnp.uint32)
-    # [..., TBL] x [TBL, k, L] -> [..., k, L]
-    return jnp.tensordot(oh, table, axes=([-1], [0]))
+def _take_const(gt_flat: np.ndarray, dig):
+    """Constant table [TBL, 2L] x digits [B] -> (x [L, B], y [L, B]).
 
-
-def _take_batch(table, dig):
-    """table [TBL, ..., 3, L] per-element; dig [...]. -> [..., 3, L]."""
-    oh = (dig[None, ...] == jnp.arange(TBL, dtype=dig.dtype).reshape(
-        (TBL,) + (1,) * dig.ndim)).astype(jnp.uint32)
-    return jnp.sum(table * oh[..., None, None], axis=0)
-
-
-def shamir_mult(cv: Curve, k1, k2, qx_m, qy_m):
-    """k1*G + k2*Q -> packed Jacobian point (Montgomery form).
-
-    k1, k2: canonical scalar limbs [..., NLIMBS]; qx_m/qy_m: affine Q in
-    Montgomery field form. 64-step scan, 4-bit windows for both scalars.
+    One-hot weighted sum: gathers lower poorly on TPU; a small tensordot
+    (a [2L, TBL] x [TBL, B] matmul) stays on the fast path.
     """
-    batch_shape = k1.shape[:-1]
-    # per-element Q window table: tq[k] = k*Q (Jacobian), k in [0, 16),
+    oh = (dig[None, :] == jnp.arange(TBL, dtype=dig.dtype)[:, None]
+          ).astype(jnp.uint32)
+    ge = jnp.tensordot(jnp.asarray(gt_flat.T), oh, axes=[[1], [0]])  # [2L, B]
+    return ge[:NLIMBS], ge[NLIMBS:]
+
+
+def _take_batch(tq, dig):
+    """Per-element table [TBL, 3, L, B] x digits [B] -> [3, L, B]."""
+    oh = (dig[None, :] == jnp.arange(TBL, dtype=dig.dtype)[:, None]
+          ).astype(jnp.uint32)
+    return jnp.sum(tq * oh[:, None, None, :], axis=0)
+
+
+def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
+    """k1*G + k2*Q -> packed Jacobian point (field rep).
+
+    k1, k2: plain canonical scalar limbs [L, B]; qx_r/qy_r: affine Q in
+    field rep. 64-step scan, 4-bit windows for both scalars.
+    """
+    # per-element Q window table tq[k] = k*Q (Jacobian), k in [0, 16),
     # built with a scan so the add body compiles once
-    q1 = _pack(qx_m, qy_m, cv.fp.one_mont(batch_shape))
+    q1 = _pack(qx_r, qy_r, cv.fp.one_rep(qx_r.shape))
 
     def tbl_step(prev, _):
         nxt = jac_add(cv, prev, q1)
@@ -248,23 +250,22 @@ def shamir_mult(cv: Curve, k1, k2, qx_m, qy_m):
     _, rest = jax.lax.scan(tbl_step, q1, None, length=TBL - 2)
     tq = jnp.concatenate([_inf_like(q1)[None], q1[None], rest], axis=0)
 
-    d1 = jnp.moveaxis(window_digits(k1, WINDOW)[..., ::-1], -1, 0)  # [64, ...]
-    d2 = jnp.moveaxis(window_digits(k2, WINDOW)[..., ::-1], -1, 0)
-    gt = jnp.asarray(cv.g_table)
+    d1 = fp.window_digits(k1, WINDOW)[..., ::-1, :]  # [64, B] MSB-first
+    d2 = fp.window_digits(k2, WINDOW)[..., ::-1, :]
 
     def body(acc, digs):
         dg, dq = digs
         for _ in range(WINDOW):
             acc = jac_double(cv, acc)
-        ge = _take_const(gt, dg)
-        added_g = jac_add_affine(cv, acc, ge[..., 0, :], ge[..., 1, :])
+        gx_e, gy_e = _take_const(cv.g_table, dg)
+        added_g = jac_add_affine(cv, acc, gx_e, gy_e)
         acc = _sel(dg == 0, acc, added_g)
         qe = _take_batch(tq, dq)
         added_q = jac_add(cv, acc, qe)
         acc = _sel(dq == 0, acc, added_q)
         return acc, None
 
-    init = jnp.zeros(batch_shape + (3, NLIMBS), jnp.uint32)
+    init = jnp.zeros((3, NLIMBS) + k1.shape[-1:], jnp.uint32)
     acc, _ = jax.lax.scan(body, init, (d1, d2))
     return acc
 
@@ -273,59 +274,65 @@ def shamir_mult(cv: Curve, k1, k2, qx_m, qy_m):
 # verification / recovery kernels
 # ---------------------------------------------------------------------------
 
-def _scalar_checks(fn: Mod, r, s):
-    nl = jnp.asarray(fn.limbs)
+def _scalar_checks(fn, r, s):
+    nl = fp._col(fn.limbs)
     return (~is_zero(r)) & (~is_zero(s)) & (~geq(r, nl)) & (~geq(s, nl))
 
 
-def _on_curve(cv: Curve, xm, ym):
-    fp = cv.fp
-    rhs = fp.add(fp.mul(fp.sqr(xm), xm), jnp.asarray(cv.b_m))
+def _on_curve(cv: Curve, xr, yr):
+    f = cv.fp
+    rhs = f.add(f.mul(f.sqr(xr), xr),
+                jnp.broadcast_to(fp._col(cv.b_rep), xr.shape))
     if not cv.a_is_zero:
-        rhs = fp.add(rhs, fp.mul(jnp.asarray(cv.a_m), xm))
-    return eq(fp.sqr(ym), rhs)
+        rhs = f.add(rhs, f.mul(jnp.broadcast_to(fp._col(cv.a_rep), xr.shape), xr))
+    return eq(f.sqr(yr), rhs)
 
 
 def _x_matches_mod_n(cv: Curve, X, Z, rscalar):
     """Does the affine x of (X, :, Z) reduce to rscalar mod n?
 
-    Avoids a field inversion: x == r (mod n) iff X == cand * Z^2 in the field
-    for cand in {r, r + n} (the second only when r + n < p).
+    Avoids a field inversion: x == r (mod n) iff X == cand * Z^2 in the
+    field for cand in {r, r + n} (the second only when r + n < p).
     """
-    fp, fn = cv.fp, cv.fn
-    zz = fp.sqr(Z)
-    pl = jnp.asarray(fp.limbs)
-    m1 = eq(X, fp.mul(fp.to_mont(rscalar), zz))
-    rpn, carry = bigint.add(rscalar, jnp.asarray(fn.limbs))
-    lt_p = (carry == 0) & (~geq(rpn, pl))
-    cand2 = jnp.where(lt_p[..., None], rpn, jnp.zeros_like(rpn))
-    m2 = lt_p & eq(X, fp.mul(fp.to_mont(cand2), zz))
+    f, fn_ = cv.fp, cv.fn
+    zz = f.sqr(Z)
+    m1 = eq(X, f.mul(f.to_rep(rscalar), zz))
+    rpn, carry = fp.add_limbs(rscalar, fp._col(fn_.limbs))
+    lt_p = (carry == 0) & (~geq(rpn, fp._col(f.limbs)))
+    cand2 = select(lt_p, rpn, jnp.zeros_like(rpn))
+    m2 = lt_p & eq(X, f.mul(f.to_rep(cand2), zz))
     return m1 | m2
+
+
+def _tx(a):
+    """Public boundary: [B, NLIMBS] -> lane-major [NLIMBS, B]."""
+    assert a.ndim == 2 and a.shape[-1] == NLIMBS
+    return jnp.transpose(a)
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def ecdsa_verify_batch(cv: Curve, e, r, s, qx, qy):
-    """Batched ECDSA verify. All args [..., NLIMBS] uint32; -> bool[...].
+    """Batched ECDSA verify. All args [B, NLIMBS] uint32; -> bool[B].
 
     e: message digest as 256-bit integer (will be reduced mod n);
     r, s: signature scalars; qx, qy: affine public key (field canonical).
     """
-    fp, fn = cv.fp, cv.fn
-    ok = _scalar_checks(fn, r, s)
-    pl = jnp.asarray(fp.limbs)
+    e, r, s, qx, qy = map(_tx, (e, r, s, qx, qy))
+    f, fn_ = cv.fp, cv.fn
+    ok = _scalar_checks(fn_, r, s)
+    pl = fp._col(f.limbs)
     ok &= (~geq(qx, pl)) & (~geq(qy, pl))
-    qxm, qym = fp.to_mont(qx), fp.to_mont(qy)
-    ok &= _on_curve(cv, qxm, qym)
+    qxr, qyr = f.to_rep(qx), f.to_rep(qy)
+    ok &= _on_curve(cv, qxr, qyr)
     ok &= ~(is_zero(qx) & is_zero(qy))
 
-    e_red = fn.reduce_full(e)
-    w = fn.inv(fn.to_mont(s))
-    u1 = fn.from_mont(fn.mul(fn.to_mont(e_red), w))
-    u2 = fn.from_mont(fn.mul(fn.to_mont(r), w))
-    R = shamir_mult(cv, u1, u2, qxm, qym)
+    w = fn_.inv(fn_.to_rep(s))  # Mont(s^-1)
+    u1 = fn_.from_rep(fn_.mul(fn_.to_rep(e), w))
+    u2 = fn_.from_rep(fn_.mul(fn_.to_rep(r), w))
+    R = shamir_mult(cv, u1, u2, qxr, qyr)
     X, _, Z = _unpack(R)
     ok &= ~is_zero(Z)
-    ok &= _x_matches_mod_n(cv, X, Z, r)
+    ok &= _x_matches_mod_n(cv, X, Z, fn_.reduce_loose(r))
     return ok
 
 
@@ -334,69 +341,74 @@ def ecdsa_recover_batch(cv: Curve, e, r, s, v):
     """Batched public-key recovery (the reference's per-tx hot op,
     Transaction.h:79 -> wedpr_secp256k1_recover_public_key).
 
-    e, r, s: [..., NLIMBS]; v: [...] uint32 recovery id in [0, 4).
-    -> (qx, qy, ok): affine recovered key (canonical limbs) + validity mask.
+    e, r, s: [B, NLIMBS]; v: [B] uint32 recovery id in [0, 4).
+    -> (qx, qy, ok): affine recovered key (canonical limbs, [B, NLIMBS])
+    plus validity mask [B].
     """
-    fp, fn = cv.fp, cv.fn
-    ok = _scalar_checks(fn, r, s) & (v < 4)
-    pl = jnp.asarray(fp.limbs)
+    e, r, s = map(_tx, (e, r, s))
+    f, fn_ = cv.fp, cv.fn
+    ok = _scalar_checks(fn_, r, s) & (v < 4)
+    pl = fp._col(f.limbs)
 
     # x = r + (v >> 1) * n, must stay below p
-    hi = ((v >> 1) & 1).astype(jnp.uint32)
-    addend = jnp.where(hi[..., None] == 1, jnp.asarray(fn.limbs),
-                       jnp.zeros((NLIMBS,), jnp.uint32))
-    xr, carry = bigint.add(r, addend)
+    hi_bit = ((v >> 1) & 1) == 1
+    nbc = jnp.broadcast_to(fp._col(fn_.limbs), r.shape)
+    addend = select(hi_bit, nbc, jnp.zeros_like(r))
+    xr, carry = fp.add_limbs(r, addend)
     ok &= (carry == 0) & (~geq(xr, pl))
-    xr = jnp.where(ok[..., None], xr, jnp.zeros_like(xr))
+    xr = select(ok, xr, jnp.zeros_like(xr))
 
-    xm = fp.to_mont(xr)
-    ysq = fp.add(fp.mul(fp.sqr(xm), xm), jnp.asarray(cv.b_m))
+    xm = f.to_rep(xr)
+    ysq = f.add(f.mul(f.sqr(xm), xm),
+                jnp.broadcast_to(fp._col(cv.b_rep), xm.shape))
     if not cv.a_is_zero:
-        ysq = fp.add(ysq, fp.mul(jnp.asarray(cv.a_m), xm))
-    y = fp.pow_const(ysq, (cv.params.p + 1) // 4)  # sqrt (p = 3 mod 4)
-    ok &= eq(fp.sqr(y), ysq)
-    yc = fp.from_mont(y)
-    flip = (yc[..., 0] & 1) != (v & 1)
-    ym = jnp.where(flip[..., None], fp.neg(y), y)
+        ysq = f.add(ysq, f.mul(jnp.broadcast_to(fp._col(cv.a_rep), xm.shape), xm))
+    y = f.pow_const(ysq, (cv.params.p + 1) // 4)  # sqrt (p = 3 mod 4)
+    ok &= eq(f.sqr(y), ysq)
+    yc = f.from_rep(y)
+    flip = (yc[..., 0, :] & 1) != (v & 1)
+    ym = select(flip, f.neg(y), y)
 
-    rinv = fn.inv(fn.to_mont(r))
-    e_red = fn.reduce_full(e)
-    u1 = fn.from_mont(fn.mul(fn.neg(fn.to_mont(e_red)), rinv))  # -e/r
-    u2 = fn.from_mont(fn.mul(fn.to_mont(s), rinv))  # s/r
+    rinv = fn_.inv(fn_.to_rep(r))
+    u1 = fn_.from_rep(fn_.mul(fn_.neg(fn_.to_rep(e)), rinv))  # -e/r mod n
+    u2 = fn_.from_rep(fn_.mul(fn_.to_rep(s), rinv))  # s/r mod n
     Q = shamir_mult(cv, u1, u2, xm, ym)
     X, Y, Z = _unpack(Q)
     ok &= ~is_zero(Z)
 
-    zinv = fp.inv(Z)
-    zi2 = fp.sqr(zinv)
-    qx = fp.from_mont(fp.mul(X, zi2))
-    qy = fp.from_mont(fp.mul(Y, fp.mul(zi2, zinv)))
-    qx = jnp.where(ok[..., None], qx, jnp.zeros_like(qx))
-    qy = jnp.where(ok[..., None], qy, jnp.zeros_like(qy))
-    return qx, qy, ok
+    zinv = f.inv(Z)
+    zi2 = f.sqr(zinv)
+    qx = f.from_rep(f.mul(X, zi2))
+    qy = f.from_rep(f.mul(Y, f.mul(zi2, zinv)))
+    qx = select(ok, qx, jnp.zeros_like(qx))
+    qy = select(ok, qy, jnp.zeros_like(qy))
+    return jnp.transpose(qx), jnp.transpose(qy), ok
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def sm2_verify_batch(cv: Curve, e, r, s, qx, qy):
     """Batched SM2 verify (GB/T 32918): R' = e + x(s*G + (r+s)*Q) == r.
 
-    e is the SM3(Z_A || M) digest as a 256-bit integer.
+    e is the SM3(Z_A || M) digest as a 256-bit integer. All args
+    [B, NLIMBS]; -> bool[B].
     """
-    fp, fn = cv.fp, cv.fn
-    ok = _scalar_checks(fn, r, s)
-    pl = jnp.asarray(fp.limbs)
+    e, r, s, qx, qy = map(_tx, (e, r, s, qx, qy))
+    f, fn_ = cv.fp, cv.fn
+    ok = _scalar_checks(fn_, r, s)
+    pl = fp._col(f.limbs)
     ok &= (~geq(qx, pl)) & (~geq(qy, pl))
-    qxm, qym = fp.to_mont(qx), fp.to_mont(qy)
-    ok &= _on_curve(cv, qxm, qym)
+    qxr, qyr = f.to_rep(qx), f.to_rep(qy)
+    ok &= _on_curve(cv, qxr, qyr)
     ok &= ~(is_zero(qx) & is_zero(qy))
 
-    t = fn.add(fn.reduce_once(r), fn.reduce_once(s))
+    rc = fn_.reduce_loose(r)
+    t = fn_.add(rc, fn_.reduce_loose(s))
     ok &= ~is_zero(t)
-    P = shamir_mult(cv, s, t, qxm, qym)
+    P = shamir_mult(cv, fn_.reduce_loose(s), t, qxr, qyr)
     X, _, Z = _unpack(P)
     ok &= ~is_zero(Z)
-    e_red = fn.reduce_full(e)
-    c = fn.sub(r, e_red)  # candidate x1 mod n
+    e_red = fn_.reduce_loose(e)  # e < 2^256 < 2n: one conditional subtract
+    c = fn_.sub(rc, e_red)  # candidate x1 mod n
     ok &= _x_matches_mod_n(cv, X, Z, c)
     return ok
 
