@@ -1,0 +1,185 @@
+"""ctypes binding for the native bcoskv LSM engine (native/bcoskv).
+
+The reference's persistent layer is native C++ (RocksDB behind
+bcos-storage/bcos-storage/RocksDBStorage.h:64-68, TiKV behind
+TiKVStorage.h:50-105). This module binds our own C++ engine — WAL + SSTs +
+2PC, see native/bcoskv/bcoskv.cpp — through the same TransactionalStorage
+contract the rest of the node uses, so `NativeStorage` and the pure-Python
+`WalStorage` are interchangeable (StorageInitializer selects by config).
+
+The shared library is built on demand with `make -C native` (g++ only, no
+external deps); `available()` reports whether the binary could be produced
+so deployments without a toolchain fall back to WalStorage.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator, Optional
+
+from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libbcoskv.so")
+
+_lib = None
+_lib_err: Optional[str] = None
+_lib_lock = threading.Lock()
+
+_SEP = b"\x00"  # table/key separator inside composite engine keys
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_err
+    with _lib_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO_PATH):
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO_PATH)
+        except Exception as e:  # toolchain missing / build failure
+            _lib_err = str(e)
+            return None
+        lib.bcoskv_open.restype = ctypes.c_void_p
+        lib.bcoskv_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_uint64]
+        lib.bcoskv_close.argtypes = [ctypes.c_void_p]
+        lib.bcoskv_get.restype = ctypes.c_int
+        lib.bcoskv_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.bcoskv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+        lib.bcoskv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+        lib.bcoskv_scan.restype = ctypes.c_int
+        lib.bcoskv_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.bcoskv_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.bcoskv_prepare.restype = ctypes.c_int
+        lib.bcoskv_prepare.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_char_p, ctypes.c_uint64]
+        lib.bcoskv_commit.restype = ctypes.c_int
+        lib.bcoskv_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.bcoskv_rollback.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.bcoskv_flush.restype = ctypes.c_int
+        lib.bcoskv_flush.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native engine can be (or was) built and loaded."""
+    return _load() is not None
+
+
+class NativeStorage(TransactionalStorage):
+    """TransactionalStorage over the C++ bcoskv engine."""
+
+    def __init__(self, path: str, flush_bytes: int = 8 << 20,
+                 max_ssts: int = 8):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"bcoskv unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.bcoskv_open(path.encode(), flush_bytes, max_ssts)
+        if not self._h:
+            raise RuntimeError(f"bcoskv_open failed for {path}")
+        self._lock = threading.RLock()
+
+    # -- composite keys ----------------------------------------------------
+    @staticmethod
+    def _ck(table: str, key: bytes) -> bytes:
+        return table.encode() + _SEP + key
+
+    # -- reads/writes ------------------------------------------------------
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        ck = self._ck(table, key)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        with self._lock:
+            found = self._lib.bcoskv_get(self._h, ck, len(ck),
+                                         ctypes.byref(out), ctypes.byref(n))
+            if not found:
+                return None
+            data = ctypes.string_at(out, n.value)
+            self._lib.bcoskv_free(out)
+            return data
+
+    def set(self, table: str, key: bytes, value: bytes) -> None:
+        ck = self._ck(table, key)
+        with self._lock:
+            self._lib.bcoskv_put(self._h, ck, len(ck), value, len(value))
+
+    def remove(self, table: str, key: bytes) -> None:
+        ck = self._ck(table, key)
+        with self._lock:
+            self._lib.bcoskv_del(self._h, ck, len(ck))
+
+    def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
+        pre = self._ck(table, prefix)
+        cut = len(table.encode()) + 1
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        with self._lock:
+            self._lib.bcoskv_scan(self._h, pre, len(pre), ctypes.byref(out),
+                                  ctypes.byref(n))
+            packed = ctypes.string_at(out, n.value)
+            self._lib.bcoskv_free(out)
+        ks = []
+        (count,) = struct.unpack_from("<I", packed, 0)
+        off = 4
+        for _ in range(count):
+            (kl,) = struct.unpack_from("<I", packed, off)
+            off += 4
+            ks.append(packed[off + cut:off + kl])
+            off += kl
+            (vl,) = struct.unpack_from("<I", packed, off)
+            off += 4 + vl
+        return iter(ks)
+
+    # -- 2PC ---------------------------------------------------------------
+    def prepare(self, block_number: int, changes: ChangeSet) -> None:
+        parts = [struct.pack("<I", len(changes))]
+        for (table, key), e in changes.items():
+            ck = self._ck(table, key)
+            parts.append(struct.pack("<BI", 1 if e.deleted else 0, len(ck)))
+            parts.append(ck)
+            parts.append(struct.pack("<I", len(e.value)))
+            parts.append(e.value)
+        payload = b"".join(parts)
+        with self._lock:
+            if not self._lib.bcoskv_prepare(self._h, block_number, payload,
+                                            len(payload)):
+                raise RuntimeError("bcoskv_prepare rejected payload")
+
+    def commit(self, block_number: int) -> None:
+        with self._lock:
+            if not self._lib.bcoskv_commit(self._h, block_number):
+                raise KeyError(f"no prepared block {block_number}")
+
+    def rollback(self, block_number: int) -> None:
+        with self._lock:
+            self._lib.bcoskv_rollback(self._h, block_number)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._lib.bcoskv_flush(self._h)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.bcoskv_close(self._h)
+                self._h = None

@@ -1,0 +1,588 @@
+// bcoskv — embedded LSM-style KV storage engine with WAL + 2PC.
+//
+// Fills the native-storage slot of the framework (the reference links
+// RocksDB behind bcos-storage/bcos-storage/RocksDBStorage.h:64-68 and TiKV
+// behind TiKVStorage.h:50-105; both implement the TransactionalStorage 2PC
+// contract of bcos-framework/storage/StorageInterface.h:126-141).  This is
+// an independent, purpose-built engine — not a RocksDB wrapper — sized for
+// a consortium-chain node: block-batched writes, prefix scans for table
+// iteration, crash-safe commit via a checksummed write-ahead log.
+//
+// Design:
+//   * keys are opaque byte strings (the Python layer composes
+//     "table\0key"); values opaque bytes; deletes are tombstones.
+//   * memtable: std::map (ordered -> cheap prefix scans).
+//   * WAL: [crc32][u64 len][payload] records, fsync'd per commit; replayed
+//     over the SSTs at open; torn tails dropped.
+//   * SST: immutable sorted file, [magic][count] + (klen,key,del,vlen,val)*;
+//     an in-memory offset index is rebuilt at open (files are block-scale,
+//     rebuilding is one sequential read).
+//   * flush: memtable > threshold -> new SST, WAL truncated.  compaction:
+//     too many SSTs -> full merge (newest wins, tombstones dropped in the
+//     oldest level).
+//   * 2PC: prepare(block) stages a changeset in memory; commit(block)
+//     appends ONE atomic WAL record then applies to the memtable;
+//     rollback discards.  Recovery therefore never sees half a block.
+//
+// C ABI at the bottom; bound from Python via ctypes (storage/native.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace bcoskv {
+
+// ---------------------------------------------------------------------------
+// crc32 (public-domain polynomial table, reflected 0xEDB88320)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+static uint32_t crc32(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// little-endian IO helpers
+// ---------------------------------------------------------------------------
+
+static void put_u32(std::string& out, uint32_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 4);
+}
+static void put_u64(std::string& out, uint64_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 8);
+}
+static uint32_t get_u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+static uint64_t get_u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+struct Value {
+  bool deleted;
+  std::string data;
+};
+
+using MemTable = std::map<std::string, Value>;
+
+// ---------------------------------------------------------------------------
+// SSTable — immutable sorted run on disk
+// ---------------------------------------------------------------------------
+
+static constexpr uint32_t kSstMagic = 0x4B565353u;  // "SSVK"
+
+class SSTable {
+ public:
+  explicit SSTable(std::string path) : path_(std::move(path)) {}
+
+  bool load_index() {
+    FILE* f = fopen(path_.c_str(), "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    buf_.resize(static_cast<size_t>(sz));
+    if (sz > 0 && fread(buf_.data(), 1, static_cast<size_t>(sz), f) !=
+                      static_cast<size_t>(sz)) {
+      fclose(f);
+      return false;
+    }
+    fclose(f);
+    if (buf_.size() < 8 || get_u32(buf_.data()) != kSstMagic) return false;
+    uint32_t count = get_u32(buf_.data() + 4);
+    size_t off = 8;
+    index_.reserve(count);
+    for (uint32_t i = 0; i < count; i++) {
+      if (off + 4 > buf_.size()) return false;
+      uint32_t klen = get_u32(buf_.data() + off);
+      size_t koff = off + 4;
+      if (koff + klen + 5 > buf_.size()) return false;
+      uint32_t vlen = get_u32(buf_.data() + koff + klen + 1);
+      if (koff + klen + 5 + vlen > buf_.size()) return false;
+      index_.push_back(off);
+      off = koff + klen + 5 + vlen;
+    }
+    return true;
+  }
+
+  size_t size() const { return index_.size(); }
+
+  std::string_view key_at(size_t i) const {
+    size_t off = index_[i];
+    uint32_t klen = get_u32(buf_.data() + off);
+    return {reinterpret_cast<const char*>(buf_.data() + off + 4), klen};
+  }
+
+  // (deleted, value)
+  std::pair<bool, std::string_view> value_at(size_t i) const {
+    size_t off = index_[i];
+    uint32_t klen = get_u32(buf_.data() + off);
+    size_t p = off + 4 + klen;
+    bool del = buf_[p] != 0;
+    uint32_t vlen = get_u32(buf_.data() + p + 1);
+    return {del, {reinterpret_cast<const char*>(buf_.data() + p + 5), vlen}};
+  }
+
+  // smallest index with key >= target
+  size_t lower_bound(std::string_view target) const {
+    size_t lo = 0, hi = index_.size();
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (key_at(mid) < target) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+  }
+
+  std::optional<Value> get(std::string_view key) const {
+    size_t i = lower_bound(key);
+    if (i < index_.size() && key_at(i) == key) {
+      auto [del, v] = value_at(i);
+      return Value{del, std::string(v)};
+    }
+    return std::nullopt;
+  }
+
+  const std::string& path() const { return path_; }
+
+ private:
+  std::string path_;
+  std::vector<uint8_t> buf_;
+  std::vector<size_t> index_;
+};
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
+
+class Engine {
+ public:
+  Engine(std::string dir, size_t flush_bytes, size_t max_ssts)
+      : dir_(std::move(dir)), flush_bytes_(flush_bytes), max_ssts_(max_ssts) {}
+
+  bool open() {
+    std::lock_guard<std::mutex> g(mu_);
+    ::mkdir(dir_.c_str(), 0755);
+    if (!load_manifest()) return false;
+    if (!replay_wal()) return false;
+    wal_ = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    return wal_ >= 0;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (wal_ >= 0) ::close(wal_);
+    wal_ = -1;
+  }
+
+  bool get(std::string_view key, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = mem_.find(std::string(key));
+    if (it != mem_.end()) {
+      if (it->second.deleted) return false;
+      *out = it->second.data;
+      return true;
+    }
+    for (auto r = ssts_.rbegin(); r != ssts_.rend(); ++r) {
+      auto v = (*r)->get(key);
+      if (v) {
+        if (v->deleted) return false;
+        *out = std::move(v->data);
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void put(std::string_view key, std::string_view val, bool del) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string payload = encode_changeset(
+        0, {{std::string(key), Value{del, std::string(val)}}});
+    append_wal(payload);
+    apply(std::string(key), Value{del, std::string(val)});
+    maybe_flush();
+  }
+
+  // prefix scan over the merged view; collects (key, value) pairs
+  void scan(std::string_view prefix,
+            std::vector<std::pair<std::string, std::string>>* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    // merge: per-source cursor, smallest key wins; newer sources shadow
+    struct Cur { size_t src; size_t i; };  // src: 0..ssts-1 old..new, mem = N
+    std::map<std::string, std::pair<size_t, Value>> best;  // key -> (rank, v)
+    size_t nsst = ssts_.size();
+    for (size_t s = 0; s < nsst; s++) {
+      auto& t = *ssts_[s];
+      for (size_t i = t.lower_bound(prefix); i < t.size(); i++) {
+        auto k = t.key_at(i);
+        if (k.substr(0, prefix.size()) != prefix) break;
+        auto [del, v] = t.value_at(i);
+        auto& slot = best[std::string(k)];
+        if (slot.first <= s + 1) slot = {s + 1, Value{del, std::string(v)}};
+      }
+    }
+    for (auto it = mem_.lower_bound(std::string(prefix)); it != mem_.end();
+         ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      best[it->first] = {nsst + 1, it->second};
+    }
+    for (auto& [k, rv] : best)
+      if (!rv.second.deleted) out->emplace_back(k, rv.second.data);
+  }
+
+  // -- 2PC ------------------------------------------------------------------
+  void prepare(uint64_t block, MemTable changes) {
+    std::lock_guard<std::mutex> g(mu_);
+    prepared_[block] = std::move(changes);
+  }
+
+  bool commit(uint64_t block) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = prepared_.find(block);
+    if (it == prepared_.end()) return false;
+    append_wal(encode_changeset(block, it->second));
+    for (auto& [k, v] : it->second) apply(k, v);
+    prepared_.erase(it);
+    maybe_flush();
+    return true;
+  }
+
+  void rollback(uint64_t block) {
+    std::lock_guard<std::mutex> g(mu_);
+    prepared_.erase(block);
+  }
+
+  bool flush() {
+    std::lock_guard<std::mutex> g(mu_);
+    return flush_locked();
+  }
+
+ private:
+  std::string wal_path() const { return dir_ + "/wal.log"; }
+  std::string manifest_path() const { return dir_ + "/MANIFEST"; }
+  std::string sst_path(uint64_t seq) const {
+    char buf[32];
+    snprintf(buf, sizeof buf, "/%06llu.sst", (unsigned long long)seq);
+    return dir_ + buf;
+  }
+
+  void apply(std::string key, Value v) {
+    mem_bytes_ += key.size() + v.data.size() + 16;
+    mem_[std::move(key)] = std::move(v);
+  }
+
+  static std::string encode_changeset(uint64_t block, const MemTable& cs) {
+    std::string p;
+    put_u64(p, block);
+    put_u32(p, static_cast<uint32_t>(cs.size()));
+    for (auto& [k, v] : cs) {
+      p.push_back(v.deleted ? 1 : 0);
+      put_u32(p, static_cast<uint32_t>(k.size()));
+      p += k;
+      put_u32(p, static_cast<uint32_t>(v.data.size()));
+      p += v.data;
+    }
+    return p;
+  }
+
+  void append_wal(const std::string& payload) {
+    std::string rec;
+    put_u32(rec, crc32(reinterpret_cast<const uint8_t*>(payload.data()),
+                       payload.size()));
+    put_u64(rec, payload.size());
+    rec += payload;
+    ssize_t n = ::write(wal_, rec.data(), rec.size());
+    (void)n;
+    ::fsync(wal_);
+  }
+
+  bool replay_wal() {
+    FILE* f = fopen(wal_path().c_str(), "rb");
+    if (!f) return true;  // no WAL yet
+    std::vector<uint8_t> raw;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    raw.resize(static_cast<size_t>(sz));
+    if (sz > 0 && fread(raw.data(), 1, raw.size(), f) != raw.size()) {
+      fclose(f);
+      return false;
+    }
+    fclose(f);
+    size_t off = 0;
+    while (off + 12 <= raw.size()) {
+      uint32_t crc = get_u32(raw.data() + off);
+      uint64_t len = get_u64(raw.data() + off + 4);
+      if (off + 12 + len > raw.size()) break;  // torn tail
+      const uint8_t* p = raw.data() + off + 12;
+      if (crc32(p, len) != crc) break;
+      // payload: u64 block, u32 n, then entries
+      if (len >= 12) {
+        uint32_t n = get_u32(p + 8);
+        size_t q = 12;
+        for (uint32_t i = 0; i < n && q < len; i++) {
+          bool del = p[q] != 0;
+          q += 1;
+          uint32_t klen = get_u32(p + q);
+          q += 4;
+          std::string key(reinterpret_cast<const char*>(p + q), klen);
+          q += klen;
+          uint32_t vlen = get_u32(p + q);
+          q += 4;
+          std::string val(reinterpret_cast<const char*>(p + q), vlen);
+          q += vlen;
+          apply(std::move(key), Value{del, std::move(val)});
+        }
+      }
+      off += 12 + len;
+    }
+    return true;
+  }
+
+  bool load_manifest() {
+    FILE* f = fopen(manifest_path().c_str(), "rb");
+    if (!f) return true;
+    char line[64];
+    while (fgets(line, sizeof line, f)) {
+      uint64_t seq = strtoull(line, nullptr, 10);
+      auto sst = std::make_unique<SSTable>(sst_path(seq));
+      if (!sst->load_index()) {
+        fclose(f);
+        return false;
+      }
+      ssts_.push_back(std::move(sst));
+      next_seq_ = std::max(next_seq_, seq + 1);
+    }
+    fclose(f);
+    return true;
+  }
+
+  bool write_manifest(const std::vector<uint64_t>& seqs) {
+    std::string tmp = manifest_path() + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    for (uint64_t s : seqs) fprintf(f, "%llu\n", (unsigned long long)s);
+    fflush(f);
+    ::fsync(fileno(f));
+    fclose(f);
+    return ::rename(tmp.c_str(), manifest_path().c_str()) == 0;
+  }
+
+  void maybe_flush() {
+    if (mem_bytes_ >= flush_bytes_) flush_locked();
+  }
+
+  bool flush_locked() {
+    if (mem_.empty()) return true;
+    uint64_t seq = next_seq_++;
+    if (!write_sst(sst_path(seq), mem_)) return false;
+    auto sst = std::make_unique<SSTable>(sst_path(seq));
+    if (!sst->load_index()) return false;
+    ssts_.push_back(std::move(sst));
+    std::vector<uint64_t> seqs;
+    for (auto& s : ssts_) {
+      uint64_t v = strtoull(s->path().c_str() + dir_.size() + 1, nullptr, 10);
+      seqs.push_back(v);
+    }
+    if (!write_manifest(seqs)) return false;
+    mem_.clear();
+    mem_bytes_ = 0;
+    // truncate WAL: its contents are now durable in the SST
+    if (wal_ >= 0) ::close(wal_);
+    wal_ = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (ssts_.size() > max_ssts_) compact();
+    return true;
+  }
+
+  static bool write_sst(const std::string& path, const MemTable& rows) {
+    std::string out;
+    put_u32(out, kSstMagic);
+    put_u32(out, static_cast<uint32_t>(rows.size()));
+    for (auto& [k, v] : rows) {
+      put_u32(out, static_cast<uint32_t>(k.size()));
+      out += k;
+      out.push_back(v.deleted ? 1 : 0);
+      put_u32(out, static_cast<uint32_t>(v.data.size()));
+      out += v.data;
+    }
+    std::string tmp = path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    if (!out.empty() && fwrite(out.data(), 1, out.size(), f) != out.size()) {
+      fclose(f);
+      return false;
+    }
+    fflush(f);
+    ::fsync(fileno(f));
+    fclose(f);
+    return ::rename(tmp.c_str(), path.c_str()) == 0;
+  }
+
+  void compact() {
+    // full merge, newest wins; tombstones dropped (single-level result)
+    MemTable merged;
+    for (auto& sst : ssts_)  // oldest -> newest: later overwrite earlier
+      for (size_t i = 0; i < sst->size(); i++) {
+        auto [del, v] = sst->value_at(i);
+        merged[std::string(sst->key_at(i))] = Value{del, std::string(v)};
+      }
+    for (auto it = merged.begin(); it != merged.end();)
+      it = it->second.deleted ? merged.erase(it) : std::next(it);
+    uint64_t seq = next_seq_++;
+    if (!write_sst(sst_path(seq), merged)) return;
+    auto sst = std::make_unique<SSTable>(sst_path(seq));
+    if (!sst->load_index()) return;
+    std::vector<std::string> old_paths;
+    for (auto& s : ssts_) old_paths.push_back(s->path());
+    ssts_.clear();
+    ssts_.push_back(std::move(sst));
+    write_manifest({seq});
+    for (auto& p : old_paths) ::unlink(p.c_str());
+  }
+
+  std::string dir_;
+  size_t flush_bytes_;
+  size_t max_ssts_;
+  std::mutex mu_;
+  MemTable mem_;
+  size_t mem_bytes_ = 0;
+  std::vector<std::unique_ptr<SSTable>> ssts_;
+  std::map<uint64_t, MemTable> prepared_;
+  uint64_t next_seq_ = 1;
+  int wal_ = -1;
+};
+
+}  // namespace bcoskv
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes-friendly)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* bcoskv_open(const char* dir, uint64_t flush_bytes, uint64_t max_ssts) {
+  auto* e = new bcoskv::Engine(dir, flush_bytes ? flush_bytes : (8u << 20),
+                               max_ssts ? max_ssts : 8);
+  if (!e->open()) {
+    delete e;
+    return nullptr;
+  }
+  return e;
+}
+
+void bcoskv_close(void* h) {
+  auto* e = static_cast<bcoskv::Engine*>(h);
+  e->close();
+  delete e;
+}
+
+// returns 1 if found; *out/*out_len owned by engine until bcoskv_free
+int bcoskv_get(void* h, const uint8_t* key, uint64_t klen, uint8_t** out,
+               uint64_t* out_len) {
+  auto* e = static_cast<bcoskv::Engine*>(h);
+  std::string v;
+  if (!e->get({reinterpret_cast<const char*>(key), klen}, &v)) return 0;
+  auto* buf = static_cast<uint8_t*>(malloc(v.size()));
+  memcpy(buf, v.data(), v.size());
+  *out = buf;
+  *out_len = v.size();
+  return 1;
+}
+
+void bcoskv_put(void* h, const uint8_t* key, uint64_t klen, const uint8_t* val,
+                uint64_t vlen) {
+  static_cast<bcoskv::Engine*>(h)->put(
+      {reinterpret_cast<const char*>(key), klen},
+      {reinterpret_cast<const char*>(val), vlen}, false);
+}
+
+void bcoskv_del(void* h, const uint8_t* key, uint64_t klen) {
+  static_cast<bcoskv::Engine*>(h)->put(
+      {reinterpret_cast<const char*>(key), klen}, {}, true);
+}
+
+// scan: packed result buffer u32 count, then (u32 klen, key, u32 vlen, val)*
+int bcoskv_scan(void* h, const uint8_t* prefix, uint64_t plen, uint8_t** out,
+                uint64_t* out_len) {
+  auto* e = static_cast<bcoskv::Engine*>(h);
+  std::vector<std::pair<std::string, std::string>> rows;
+  e->scan({reinterpret_cast<const char*>(prefix), plen}, &rows);
+  std::string packed;
+  bcoskv::put_u32(packed, static_cast<uint32_t>(rows.size()));
+  for (auto& [k, v] : rows) {
+    bcoskv::put_u32(packed, static_cast<uint32_t>(k.size()));
+    packed += k;
+    bcoskv::put_u32(packed, static_cast<uint32_t>(v.size()));
+    packed += v;
+  }
+  auto* buf = static_cast<uint8_t*>(malloc(packed.size()));
+  memcpy(buf, packed.data(), packed.size());
+  *out = buf;
+  *out_len = packed.size();
+  return 1;
+}
+
+void bcoskv_free(uint8_t* p) { free(p); }
+
+// changeset payload: u32 n, then (u8 del, u32 klen, key, u32 vlen, val)*
+int bcoskv_prepare(void* h, uint64_t block, const uint8_t* payload,
+                   uint64_t len) {
+  bcoskv::MemTable cs;
+  if (len < 4) return 0;
+  uint32_t n;
+  memcpy(&n, payload, 4);
+  size_t q = 4;
+  for (uint32_t i = 0; i < n; i++) {
+    if (q + 5 > len) return 0;
+    bool del = payload[q] != 0;
+    q += 1;
+    uint32_t klen;
+    memcpy(&klen, payload + q, 4);
+    q += 4;
+    if (q + klen + 4 > len) return 0;
+    std::string key(reinterpret_cast<const char*>(payload + q), klen);
+    q += klen;
+    uint32_t vlen;
+    memcpy(&vlen, payload + q, 4);
+    q += 4;
+    if (q + vlen > len) return 0;
+    std::string val(reinterpret_cast<const char*>(payload + q), vlen);
+    q += vlen;
+    cs[std::move(key)] = bcoskv::Value{del, std::move(val)};
+  }
+  static_cast<bcoskv::Engine*>(h)->prepare(block, std::move(cs));
+  return 1;
+}
+
+int bcoskv_commit(void* h, uint64_t block) {
+  return static_cast<bcoskv::Engine*>(h)->commit(block) ? 1 : 0;
+}
+
+void bcoskv_rollback(void* h, uint64_t block) {
+  static_cast<bcoskv::Engine*>(h)->rollback(block);
+}
+
+int bcoskv_flush(void* h) {
+  return static_cast<bcoskv::Engine*>(h)->flush() ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+"""Native bcoskv engine vs pure-Python WalStorage: same 2PC contract.
+
+Mirrors the reference's storage tests (bcos-storage backends both implement
+StorageInterface.h:126-141; tests/perf/benchmark.cpp compares them). Both
+backends here run the same scenario suite, including a crash-recovery check
+(close without compaction -> reopen -> WAL replay).
+"""
+
+import pytest
+
+from fisco_bcos_tpu.storage.interface import Entry, EntryStatus
+from fisco_bcos_tpu.storage.wal import WalStorage
+from fisco_bcos_tpu.storage import native
+
+
+def _backends(tmp_path):
+    out = [("wal", lambda p: WalStorage(str(tmp_path / ("w" + p))))]
+    if native.available():
+        out.append(("native",
+                    lambda p: native.NativeStorage(str(tmp_path / ("n" + p)))))
+    return out
+
+
+@pytest.fixture(params=["wal", "native"])
+def storage_factory(request, tmp_path):
+    if request.param == "native" and not native.available():
+        pytest.skip("native toolchain unavailable")
+    if request.param == "wal":
+        return lambda p="x": WalStorage(str(tmp_path / ("w" + p)))
+    return lambda p="x": native.NativeStorage(str(tmp_path / ("n" + p)))
+
+
+def test_basic_kv(storage_factory):
+    st = storage_factory()
+    assert st.get("t", b"k") is None
+    st.set("t", b"k", b"v1")
+    st.set("t", b"k2", b"v2")
+    st.set("u", b"k", b"other-table")
+    assert st.get("t", b"k") == b"v1"
+    assert st.get("u", b"k") == b"other-table"
+    st.remove("t", b"k")
+    assert st.get("t", b"k") is None
+    assert st.get("t", b"k2") == b"v2"
+    st.close()
+
+
+def test_prefix_scan(storage_factory):
+    st = storage_factory()
+    for i in range(5):
+        st.set("t", b"a%d" % i, b"x")
+    st.set("t", b"b0", b"y")
+    st.remove("t", b"a3")
+    keys = list(st.keys("t", b"a"))
+    assert keys == [b"a0", b"a1", b"a2", b"a4"]
+    assert list(st.keys("t")) == [b"a0", b"a1", b"a2", b"a4", b"b0"]
+    st.close()
+
+
+def test_2pc_commit_rollback(storage_factory):
+    st = storage_factory()
+    st.set("t", b"base", b"0")
+    cs = {("t", b"k1"): Entry(b"v1"),
+          ("t", b"base"): Entry(b"", EntryStatus.DELETED)}
+    st.prepare(7, cs)
+    # nothing visible before commit
+    assert st.get("t", b"k1") is None
+    st.commit(7)
+    assert st.get("t", b"k1") == b"v1"
+    assert st.get("t", b"base") is None
+    st.prepare(8, {("t", b"k2"): Entry(b"v2")})
+    st.rollback(8)
+    with pytest.raises(Exception):
+        st.commit(8)
+    assert st.get("t", b"k2") is None
+    st.close()
+
+
+def test_crash_recovery(tmp_path, storage_factory):
+    st = storage_factory("crash")
+    st.set("t", b"a", b"1")
+    st.prepare(1, {("t", b"b"): Entry(b"2")})
+    st.commit(1)
+    st.prepare(2, {("t", b"c"): Entry(b"3")})  # prepared, never committed
+    st.close()  # crash: prepared block must vanish, committed must survive
+
+    st2 = storage_factory("crash")
+    assert st2.get("t", b"a") == b"1"
+    assert st2.get("t", b"b") == b"2"
+    assert st2.get("t", b"c") is None
+    st2.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_flush_and_sst_reads(tmp_path):
+    st = native.NativeStorage(str(tmp_path / "flush"), flush_bytes=1 << 10)
+    for i in range(200):  # > 1KiB total -> forces SST flushes
+        st.set("t", b"key%03d" % i, b"val%03d" % i)
+    st.remove("t", b"key100")
+    st.flush()
+    assert st.get("t", b"key007") == b"val007"
+    assert st.get("t", b"key100") is None
+    st.close()
+    # reopen reads from SSTs (WAL truncated by flush)
+    st2 = native.NativeStorage(str(tmp_path / "flush"))
+    assert st2.get("t", b"key199") == b"val199"
+    assert st2.get("t", b"key100") is None
+    assert len(list(st2.keys("t", b"key19"))) == 10
+    st2.close()
