@@ -37,13 +37,82 @@ class TransactionExecutor:
     def __init__(self, suite, registry: Optional[dict[bytes, Precompile]] = None):
         self.suite = suite
         self.registry = dict(PRECOMPILED_REGISTRY if registry is None else registry)
+        from .evm import EVM
+        self.evm = EVM(suite, registry=self.registry)
 
     # -- single transaction ------------------------------------------------
     def execute_transaction(self, tx: Transaction, state: StateStorage,
                             block_number: int, timestamp: int,
                             gas_limit: int = 3_000_000_000) -> Receipt:
-        sp = state.savepoint()
         sender = tx.sender(self.suite) or b""
+        sp = state.savepoint()
+        try:
+            if tx.to == b"":
+                rc = self._execute_create(tx, state, sender, block_number,
+                                          timestamp, gas_limit)
+            elif (tx.to not in self.registry
+                  and self.evm.get_code(state, tx.to)):
+                rc = self._execute_evm(tx, state, sender, block_number,
+                                       timestamp, gas_limit)
+            else:
+                rc = self._execute_precompile(tx, state, sender, block_number,
+                                              timestamp, gas_limit)
+            state.release(sp)
+            return rc
+        except Exception as exc:  # defensive: executor must not kill the node
+            state.rollback_to(sp)
+            rc = Receipt(block_number=block_number, gas_used=TX_GAS)
+            rc.status = int(TransactionStatus.EXECUTION_ABORTED)
+            rc.message = f"internal: {exc}"
+            return rc
+
+    def _env(self, sender: bytes, block_number: int, timestamp: int,
+             gas_limit: int):
+        from .evm import TxEnv
+        return TxEnv(origin=sender, gas_price=0, block_number=block_number,
+                     timestamp=timestamp, gas_limit=gas_limit)
+
+    def _execute_create(self, tx, state, sender, block_number, timestamp,
+                        gas_limit) -> Receipt:
+        """Contract deployment (empty `to`, input = EVM initcode)."""
+        env = self._env(sender, block_number, timestamp, gas_limit)
+        res = self.evm.create(state, env, sender, 0, tx.input, gas_limit)
+        rc = Receipt(block_number=block_number,
+                     gas_used=gas_limit - res.gas_left)
+        if res.success:
+            rc.contract_address = res.create_address
+            rc.logs = res.logs
+            if tx.abi:
+                state.set(self.T_ABI, res.create_address, tx.abi.encode())
+        else:
+            rc.status = int(TransactionStatus.REVERT if res.error == "revert"
+                            else TransactionStatus.EXECUTION_ABORTED)
+            rc.output = res.output
+            rc.message = res.error
+        return rc
+
+    def _execute_evm(self, tx, state, sender, block_number, timestamp,
+                     gas_limit) -> Receipt:
+        env = self._env(sender, block_number, timestamp, gas_limit)
+        res = self.evm.execute_message(state, env, sender, tx.to, 0,
+                                       tx.input, gas_limit)
+        rc = Receipt(block_number=block_number,
+                     gas_used=gas_limit - res.gas_left, output=res.output)
+        if res.success:
+            rc.logs = res.logs
+        else:
+            if res.error == "revert":
+                rc.status = int(TransactionStatus.REVERT)
+            elif res.error == "out of gas":
+                rc.status = int(TransactionStatus.OUT_OF_GAS)
+            else:
+                rc.status = int(TransactionStatus.EXECUTION_ABORTED)
+            rc.message = res.error
+        return rc
+
+    def _execute_precompile(self, tx, state, sender, block_number, timestamp,
+                            gas_limit) -> Receipt:
+        sp = state.savepoint()
         ctx = CallContext(state=state, block_number=block_number,
                           timestamp=timestamp, sender=sender, to=tx.to,
                           input=tx.input, gas_limit=gas_limit,
@@ -146,12 +215,14 @@ class TransactionExecutor:
                ms=int((time.monotonic() - t0) * 1000))
         return [r for r in receipts]
 
-    # -- contract metadata (getCode/getABI RPC; EVM deploy writes these) ---
-    T_CODE = "s_code"
+    # -- contract metadata (getCode/getABI RPC; EVM deploy writes these;
+    # table layout owned by evm.py — single definition) --------------------
+    from .evm import T_CODE
     T_ABI = "s_abi"
 
     def get_code(self, address: bytes, storage) -> bytes:
-        return storage.get(self.T_CODE, address) or b""
+        from .evm import EVM
+        return EVM.get_code(storage, address)
 
     def get_abi(self, address: bytes, storage) -> str:
         raw = storage.get(self.T_ABI, address)
