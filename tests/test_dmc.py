@@ -1,0 +1,187 @@
+"""Key locks + DMC sharded execution tests.
+
+Mirrors the reference's testKeyLocks.cpp / testDmcExecutor.cpp semantics:
+lock grant/queue, deadlock cycle detection with requester revert, and
+shard-parallel block execution whose results equal the serial schedule.
+"""
+
+import threading
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor.executor import TransactionExecutor
+from fisco_bcos_tpu.executor.precompiled import BALANCE_ADDRESS, KV_TABLE_ADDRESS
+from fisco_bcos_tpu.codec.wire import Writer
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.scheduler.dmc import DmcExecutor
+from fisco_bcos_tpu.scheduler.keylocks import DeadlockError, GraphKeyLocks
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+
+SUITE = make_suite(backend="host")
+
+
+# ---------------------------------------------------------------------------
+# GraphKeyLocks
+# ---------------------------------------------------------------------------
+
+def test_keylock_grant_and_reentrancy():
+    kl = GraphKeyLocks()
+    kl.acquire("t1", b"A", b"k")
+    kl.acquire("t1", b"A", b"k")  # re-entrant
+    assert kl.holder_of(b"A", b"k") == "t1"
+    assert not kl.try_acquire("t2", b"A", b"k")
+    kl.release_all("t1")
+    assert kl.try_acquire("t2", b"A", b"k")
+
+
+def test_keylock_deadlock_detection():
+    kl = GraphKeyLocks()
+    kl.acquire("t1", b"A", b"k")
+    kl.acquire("t2", b"B", b"k")
+    # t1 waits for B (held by t2) in a thread; then t2 requesting A closes
+    # the cycle and must be chosen as victim.
+    started = threading.Event()
+    got = []
+
+    def t1_wait():
+        started.set()
+        kl.acquire("t1", b"B", b"k", timeout=5)
+        got.append("t1-acquired")
+        kl.release_all("t1")
+
+    th = threading.Thread(target=t1_wait)
+    th.start()
+    started.wait()
+    import time
+    time.sleep(0.05)  # let t1 enter the wait
+    with pytest.raises(DeadlockError):
+        kl.acquire("t2", b"A", b"k", timeout=5)
+    kl.release_all("t2")  # victim reverts, releasing B
+    th.join(timeout=5)
+    assert got == ["t1-acquired"]
+
+
+def test_keylock_timeout():
+    kl = GraphKeyLocks()
+    kl.acquire("t1", b"A", b"k")
+    with pytest.raises(TimeoutError):
+        kl.acquire("t2", b"A", b"k", timeout=0.05)
+
+
+# ---------------------------------------------------------------------------
+# DMC block execution
+# ---------------------------------------------------------------------------
+
+def _transfer_tx(frm: bytes, to_acct: bytes, amount: int) -> Transaction:
+    w = Writer()
+    w.text("transfer").blob(frm).blob(to_acct).u64(amount)
+    tx = Transaction(to=BALANCE_ADDRESS, input=w.bytes())
+    tx._sender = b"\xaa" * 20
+    return tx
+
+
+def _register_tx(acct: bytes, amount: int) -> Transaction:
+    w = Writer()
+    w.text("register").blob(acct).u64(amount)
+    tx = Transaction(to=BALANCE_ADDRESS, input=w.bytes())
+    tx._sender = b"\xaa" * 20
+    return tx
+
+
+def _kv_create_tx(table: str) -> Transaction:
+    w = Writer()
+    w.text("createTable").text(table)
+    tx = Transaction(to=KV_TABLE_ADDRESS, input=w.bytes())
+    tx._sender = b"\xaa" * 20
+    return tx
+
+
+def _kv_set_tx(table: str, k: bytes, v: bytes) -> Transaction:
+    w = Writer()
+    w.text("set").text(table).blob(k).blob(v)
+    tx = Transaction(to=KV_TABLE_ADDRESS, input=w.bytes())
+    tx._sender = b"\xaa" * 20
+    return tx
+
+
+def test_dmc_matches_serial():
+    accounts = [b"acct%d" % i for i in range(4)]
+    txs = [_register_tx(a, 1000) for a in accounts]
+    txs.append(_kv_create_tx("kv"))
+    for i in range(12):
+        txs.append(_transfer_tx(accounts[i % 4], accounts[(i + 1) % 4],
+                                10 + i))
+    for i in range(6):
+        txs.append(_kv_set_tx("kv", b"key%d" % i, b"val%d" % i))
+
+    # serial reference
+    st_serial = StateStorage(MemoryStorage())
+    ex = TransactionExecutor(SUITE)
+    serial = [ex.execute_transaction(t, st_serial, 1, 1000) for t in txs]
+
+    st_dmc = StateStorage(MemoryStorage())
+    dmc = DmcExecutor(TransactionExecutor(SUITE), SUITE)
+    parallel = dmc.execute_block(txs, st_dmc, 1, 1000)
+
+    assert len(parallel) == len(serial)
+    for a, b in zip(parallel, serial):
+        assert (a.status, a.output) == (b.status, b.output)
+    # same final state
+    assert st_serial.changeset() == st_dmc.changeset()
+
+
+def test_dmc_single_shard_order():
+    txs = [_kv_create_tx("t")]
+    txs += [_kv_set_tx("t", b"k", b"v%d" % i) for i in range(5)]
+    st = StateStorage(MemoryStorage())
+    dmc = DmcExecutor(TransactionExecutor(SUITE), SUITE)
+    rcs = dmc.execute_block(txs, st, 1, 1000)
+    assert all(r.status == 0 for r in rcs)
+    # last write in block order wins: read back through the precompile
+    from fisco_bcos_tpu.codec.wire import Reader
+    ex = TransactionExecutor(SUITE)
+    w = Writer()
+    w.text("get").text("t").blob(b"k")
+    q = Transaction(to=KV_TABLE_ADDRESS, input=w.bytes())
+    q._sender = b"\xaa" * 20
+    rc = ex.execute_transaction(q, st, 1, 1000)
+    r = Reader(rc.output)
+    assert r.u8() == 1 and r.blob() == b"v4"
+
+
+def test_dmc_wave_plan_properties():
+    """Planner invariants: shard order kept, cross-shard key conflicts split
+    across waves, opaque txs are global barriers."""
+    accounts = [b"a", b"b", b"c"]
+    txs = [_register_tx(a, 100) for a in accounts]       # disjoint keys
+    txs.append(_transfer_tx(b"a", b"b", 1))              # conflicts with 0,1
+    evm_tx = Transaction(to=b"\x77" * 20, input=b"")      # opaque -> barrier
+    evm_tx._sender = b"\xaa" * 20
+    txs.append(evm_tx)
+    txs.append(_transfer_tx(b"b", b"c", 1))
+    dmc = DmcExecutor(TransactionExecutor(SUITE), SUITE)
+    waves = dmc.plan(txs)
+    pos = {i: w for w, wv in enumerate(waves) for i in wv}
+    # registers share a wave (same shard, serial) or honour order
+    assert pos[0] <= pos[1] <= pos[2]
+    assert pos[3] >= pos[2]          # transfer after the registers it reads
+    assert waves[pos[4]] == [4]      # barrier is alone
+    assert pos[5] > pos[4]           # post-barrier work comes later
+
+
+def test_dmc_deterministic_across_runs():
+    accounts = [b"x%d" % i for i in range(6)]
+    txs = [_register_tx(a, 500) for a in accounts]
+    for i in range(20):
+        txs.append(_transfer_tx(accounts[i % 6], accounts[(i + 2) % 6], i))
+    outs = []
+    for _ in range(3):
+        st = StateStorage(MemoryStorage())
+        dmc = DmcExecutor(TransactionExecutor(SUITE), SUITE, max_workers=4)
+        rcs = dmc.execute_block(txs, st, 1, 1000)
+        outs.append((tuple((r.status, r.output) for r in rcs),
+                     tuple(sorted((k, e.value) for (t, k), e in
+                                  st.changeset().items()))))
+    assert outs[0] == outs[1] == outs[2]
