@@ -85,11 +85,19 @@ class Node:
         self.front: Optional[FrontService] = None
         self.txsync: Optional[TransactionSync] = None
         self.blocksync: Optional[BlockSync] = None
+        self.amop = None
+        self.lightnode_server = None
         if gateway is not None:
             self.front = FrontService(self.keypair.pub_bytes, gateway)
             self.txsync = TransactionSync(self.front, self.txpool, self.suite)
             self.blocksync = BlockSync(self.front, self.ledger,
                                        self.scheduler, self.suite)
+            from ..net.amop import AMOPService
+            self.amop = AMOPService(self.front)
+            from ..lightnode import LightNodeServer
+            self.lightnode_server = LightNodeServer(self)
+        from ..rpc.eventsub import EventSub
+        self.eventsub = EventSub(self.ledger, self.scheduler)
         self.rpc = None
         if cfg.rpc_port is not None:
             from ..rpc.server import JsonRpcImpl, JsonRpcServer
@@ -147,6 +155,7 @@ class Node:
             self.blocksync.stop()
         if self.front is not None:
             self.front.stop()
+        self.scheduler.shutdown()
         self._started = False
 
     # -- solo-consensus proposal path --------------------------------------

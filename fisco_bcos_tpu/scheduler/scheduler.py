@@ -20,6 +20,7 @@ serialises execution per block.
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
 import time
 from typing import Optional, Sequence
@@ -50,6 +51,15 @@ class Scheduler:
         self._lock = threading.RLock()
         # cache: block hash -> ExecutionResult awaiting commit
         self._executed: dict[bytes, ExecutionResult] = {}
+        # commit observers: callback(block_number) after a durable commit
+        # (the reference's block-number notification fan-out,
+        # Initializer.cpp:393-416). Observers run on a notifier thread so a
+        # slow subscriber cannot stall the consensus commit path.
+        self.on_commit: list = []
+        self._notify_q: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._notifier = threading.Thread(target=self._notify_loop,
+                                          daemon=True, name="sched-notify")
+        self._notifier.start()
 
     # -- execute (SchedulerImpl::executeBlock) -----------------------------
     def execute_block(self, block: Block, sealer_list: Sequence[bytes] | None = None
@@ -132,9 +142,26 @@ class Scheduler:
             tx_hashes = self.ledger.tx_hashes_by_number(header.number)
             nonces = self.ledger.nonces_by_number(header.number)
             self.txpool.on_block_committed(header.number, tx_hashes, nonces)
+        self._notify_q.put(header.number)
         metric("scheduler.commit", number=header.number,
                ms=int((time.monotonic() - t0) * 1000))
         return True
+
+    def shutdown(self) -> None:
+        """Stop the notifier thread (node shutdown)."""
+        self._notify_q.put(None)
+
+    def _notify_loop(self) -> None:
+        while True:
+            number = self._notify_q.get()
+            if number is None:
+                return
+            for cb in list(self.on_commit):
+                try:
+                    cb(number)
+                except Exception:
+                    LOG.exception(badge("SCHED", "commit-observer-failed",
+                                        number=number))
 
     def drop_executed(self, header: BlockHeader) -> None:
         """Discard a cached execution result (failed sync replay etc.)."""

@@ -1,0 +1,119 @@
+"""AMOP pub/sub + event subscription tests (multi-node over FakeGateway)."""
+
+import threading
+import time
+
+from fisco_bcos_tpu.net.amop import AMOPService
+from fisco_bcos_tpu.net.front import FrontService
+from fisco_bcos_tpu.net.gateway import FakeGateway
+from fisco_bcos_tpu.rpc.eventsub import EventFilter, EventSub
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.executor import precompiled as pc
+
+
+def _amop_net(n):
+    gw = FakeGateway()
+    fronts = [FrontService(bytes([i + 1]) * 32, gw) for i in range(n)]
+    services = [AMOPService(f) for f in fronts]
+    time.sleep(0.1)  # let announcements drain
+    return gw, fronts, services
+
+
+def test_amop_announce_and_publish():
+    gw, fronts, svcs = _amop_net(3)
+    got = []
+
+    def handler(topic, data, src):
+        got.append((topic, data))
+        return b"reply:" + data
+
+    svcs[1].subscribe("weather", handler)
+    deadline = time.time() + 5
+    while not svcs[0].peer_subscribers("weather") and time.time() < deadline:
+        time.sleep(0.02)
+    assert svcs[0].peer_subscribers("weather") == [fronts[1].node_id]
+
+    resp = svcs[0].publish("weather", b"sunny?")
+    assert resp == b"reply:sunny?"
+    assert got == [("weather", b"sunny?")]
+
+    svcs[1].unsubscribe("weather")
+    deadline = time.time() + 5
+    while svcs[0].peer_subscribers("weather") and time.time() < deadline:
+        time.sleep(0.02)
+    assert svcs[0].publish("weather", b"again", timeout=0.5) is None
+    gw.stop()
+
+
+def test_amop_broadcast():
+    gw, fronts, svcs = _amop_net(3)
+    hits = []
+    ev = threading.Event()
+
+    def mk(i):
+        def h(topic, data, src):
+            hits.append((i, data))
+            if len(hits) >= 2:
+                ev.set()
+            return None
+        return h
+
+    svcs[1].subscribe("news", mk(1))
+    svcs[2].subscribe("news", mk(2))
+    deadline = time.time() + 5
+    while len(svcs[0].peer_subscribers("news")) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    n = svcs[0].broadcast("news", b"flash")
+    assert n == 2
+    assert ev.wait(5)
+    assert sorted(hits) == [(1, b"flash"), (2, b"flash")]
+    gw.stop()
+
+
+def test_eventsub_live_and_historical(tmp_path):
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0))
+    node.start()
+    kp = node.suite.generate_keypair(b"evt-user")
+
+    def send(nonce, inp):
+        tx = Transaction(to=pc.BALANCE_ADDRESS, input=inp, nonce=nonce,
+                         block_limit=node.ledger.current_number() + 100
+                         ).sign(node.suite, kp)
+        r = node.send_transaction(tx)
+        rc = node.txpool.wait_for_receipt(r.tx_hash, 15)
+        assert rc is not None and rc.status == 0, (rc and rc.message)
+        return r.tx_hash
+
+    send("n1", pc.encode_call("register", lambda w: w.blob(b"a").u64(100)))
+    send("n2", pc.encode_call("register", lambda w: w.blob(b"b").u64(0)))
+    # transfer emits a LogEntry with topic b"transfer"
+    send("n3", pc.encode_call("transfer",
+                              lambda w: w.blob(b"a").blob(b"b").u64(7)))
+
+    # historical subscription sees the past transfer
+    seen = []
+    flt = EventFilter(from_block=0, addresses={pc.BALANCE_ADDRESS},
+                      topics=[{b"transfer"}])
+    node.eventsub.subscribe(flt, lambda n, h, i, log: seen.append(log.data))
+    assert len(seen) == 1
+
+    # live: a new transfer is pushed on commit
+    send("n4", pc.encode_call("transfer",
+                              lambda w: w.blob(b"a").blob(b"b").u64(5)))
+    deadline = time.time() + 10
+    while len(seen) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(seen) == 2
+
+    # bounded range auto-completes and unsubscribes
+    done = []
+    fid = node.eventsub.subscribe(
+        EventFilter(from_block=0, to_block=node.ledger.current_number(),
+                    topics=[{b"transfer"}]),
+        lambda n, h, i, log: done.append(n))
+    assert len(done) == 2
+    assert fid not in node.eventsub.active()
+
+    node.stop()
