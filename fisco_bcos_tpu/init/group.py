@@ -1,0 +1,135 @@
+"""Multi-group composition: independent chains sharing one transport + RPC.
+
+Reference counterpart: the multi-group model of
+/root/reference/bcos-framework/bcos-framework/multigroup/ (GroupInfo /
+ChainNodeInfo), bcos-rpc/bcos-rpc/groupmgr/GroupManager.cpp (RPC-side group
+registry + per-group service routing) and the gateway's group multiplexing
+(bcos-gateway GatewayNodeManager.cpp). Each group is an independent chain —
+its own ledger, txpool, consensus set — over the shared gateway
+(net.gateway.GroupGateway namespacing) and a single JSON-RPC endpoint that
+routes by the `group` parameter.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..net.gateway import Gateway, GroupGateway
+from ..rpc.server import (JSONRPC_INVALID_PARAMS, JsonRpcError, JsonRpcImpl,
+                          JsonRpcServer)
+from ..utils.log import LOG, badge
+from .node import Node, NodeConfig
+
+
+class GroupManager:
+    """Hosts one Node per group on a shared gateway."""
+
+    def __init__(self, shared_gateway: Optional[Gateway] = None,
+                 chain_id: str = "chain0"):
+        self.chain_id = chain_id
+        self.shared_gateway = shared_gateway
+        self._nodes: dict[str, Node] = {}
+        self._lock = threading.Lock()
+
+    def add_group(self, config: NodeConfig, keypair=None, suite=None) -> Node:
+        if config.chain_id != self.chain_id:
+            raise ValueError(f"chain mismatch: {config.chain_id}")
+        with self._lock:
+            if config.group_id in self._nodes:
+                raise ValueError(f"group exists: {config.group_id}")
+            gw = (GroupGateway(self.shared_gateway, config.group_id)
+                  if self.shared_gateway is not None else None)
+            node = Node(config, keypair=keypair, suite=suite, gateway=gw)
+            self._nodes[config.group_id] = node
+            LOG.info(badge("GROUPMGR", "group-added", group=config.group_id))
+            return node
+
+    def remove_group(self, group_id: str) -> bool:
+        with self._lock:
+            node = self._nodes.pop(group_id, None)
+        if node is None:
+            return False
+        node.stop()
+        return True
+
+    def node(self, group_id: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(group_id)
+
+    def groups(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def start(self) -> None:
+        with self._lock:
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            n.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            n.stop()
+
+
+class GroupedJsonRpc:
+    """One RPC surface over many groups: routes by the `group` param.
+
+    The reference's RPC holds a GroupManager and resolves (group, node) to
+    the right service client (bcos-rpc/groupmgr/GroupManager.cpp); here it
+    resolves to the in-process per-group JsonRpcImpl.
+    """
+
+    def __init__(self, mgr: GroupManager):
+        self.mgr = mgr
+        self._impls: dict[str, JsonRpcImpl] = {}
+
+    def _impl(self, group: str) -> JsonRpcImpl:
+        impl = self._impls.get(group)
+        node = self.mgr.node(group)
+        if node is None:
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS,
+                               f"unknown group {group}")
+        if impl is None or impl.node is not node:
+            impl = JsonRpcImpl(node)
+            self._impls[group] = impl
+        return impl
+
+    def handle(self, request: dict) -> dict:
+        method = request.get("method", "")
+        params = request.get("params", [])
+        if method == "getGroupList":
+            return {"jsonrpc": "2.0", "id": request.get("id"),
+                    "result": {"groupList": self.mgr.groups()}}
+        if method == "getGroupInfoList":
+            # registry-wide method: aggregate per-group info locally
+            infos = []
+            for g in self.mgr.groups():
+                resp = self._impl(g).handle(
+                    {"jsonrpc": "2.0", "id": 0, "method": "getGroupInfo",
+                     "params": [g]})
+                if "result" in resp:
+                    infos.append(resp["result"])
+            return {"jsonrpc": "2.0", "id": request.get("id"),
+                    "result": infos}
+        group = None
+        if isinstance(params, list) and params:
+            group = params[0]
+        elif isinstance(params, dict):
+            group = params.get("group")
+        if not isinstance(group, str):
+            return {"jsonrpc": "2.0", "id": request.get("id"),
+                    "error": {"code": JSONRPC_INVALID_PARAMS,
+                              "message": "missing group parameter"}}
+        try:
+            return self._impl(group).handle(request)
+        except JsonRpcError as exc:
+            return {"jsonrpc": "2.0", "id": request.get("id"),
+                    "error": {"code": exc.code, "message": exc.message}}
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> JsonRpcServer:
+        srv = JsonRpcServer(self, host=host, port=port)
+        srv.start()
+        return srv

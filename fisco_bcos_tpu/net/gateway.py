@@ -43,6 +43,54 @@ class Gateway:
         raise NotImplementedError
 
 
+class GroupGateway(Gateway):
+    """Namespaces one group's traffic onto a shared transport.
+
+    The reference multiplexes every P2P payload by (groupID, moduleID) over
+    shared TLS sessions (bcos-gateway/bcos-gateway/gateway/
+    GatewayNodeManager.cpp groupID->nodeID registry). Here the same effect:
+    each group's nodes register under `group_id || node_id` on the shared
+    gateway, so multiple groups coexist on one transport without seeing
+    each other's messages.
+    """
+
+    def __init__(self, shared: Gateway, group_id: str):
+        self.shared = shared
+        self.prefix = group_id.encode() + b"\x00"
+
+    def _w(self, node_id: bytes) -> bytes:
+        return self.prefix + node_id
+
+    def register_front(self, node_id: bytes, front) -> None:
+        self.shared.register_front(self._w(node_id), _Unwrap(front, len(self.prefix)))
+
+    def unregister_front(self, node_id: bytes) -> None:
+        self.shared.unregister_front(self._w(node_id))
+
+    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        return self.shared.send(self._w(src), self._w(dst), data)
+
+    def broadcast(self, src: bytes, data: bytes) -> None:
+        # only to same-group peers (shared.broadcast would cross groups)
+        for dst in self.peers(src):
+            self.send(src, dst, data)
+
+    def peers(self, src: bytes) -> list[bytes]:
+        return [p[len(self.prefix):] for p in self.shared.peers(self._w(src))
+                if p.startswith(self.prefix)]
+
+
+class _Unwrap:
+    """Strips the group prefix off inbound source ids before the front."""
+
+    def __init__(self, front, cut: int):
+        self.front = front
+        self.cut = cut
+
+    def on_network_message(self, src: bytes, data: bytes) -> None:
+        self.front.on_network_message(src[self.cut:], data)
+
+
 class FakeGateway(Gateway):
     """In-process transport with one ordered delivery queue per node.
 

@@ -1,0 +1,97 @@
+"""Multi-group: independent chains on a shared gateway + routed RPC."""
+
+import json
+import time
+import urllib.request
+
+from fisco_bcos_tpu.init.group import GroupManager, GroupedJsonRpc
+from fisco_bcos_tpu.init.node import NodeConfig
+from fisco_bcos_tpu.net.gateway import FakeGateway, GroupGateway
+from fisco_bcos_tpu.net.front import FrontService
+from fisco_bcos_tpu.net.moduleid import ModuleID
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.executor import precompiled as pc
+
+
+def test_group_gateway_isolation():
+    shared = FakeGateway()
+    g1 = GroupGateway(shared, "g1")
+    g2 = GroupGateway(shared, "g2")
+    got = {"g1": [], "g2": []}
+
+    def front(tag):
+        class F:
+            def on_network_message(self, src, data):
+                got[tag].append((src, data))
+        return F()
+
+    g1.register_front(b"A" * 32, front("g1"))
+    g1.register_front(b"B" * 32, front("g1"))
+    g2.register_front(b"A" * 32, front("g2"))  # same node id, other group
+    time.sleep(0.05)
+    assert g1.peers(b"A" * 32) == [b"B" * 32]
+    assert g2.peers(b"A" * 32) == []  # no cross-group peers
+    g1.broadcast(b"A" * 32, b"hello-g1")
+    deadline = time.time() + 5
+    while not got["g1"] and time.time() < deadline:
+        time.sleep(0.01)
+    assert got["g1"] == [(b"A" * 32, b"hello-g1")]
+    assert got["g2"] == []
+    shared.stop()
+
+
+def test_two_groups_independent_chains_and_rpc():
+    mgr = GroupManager()
+    n1 = mgr.add_group(NodeConfig(group_id="group0", crypto_backend="host",
+                                  min_seal_time=0.0))
+    n2 = mgr.add_group(NodeConfig(group_id="group1", crypto_backend="host",
+                                  min_seal_time=0.0))
+    mgr.start()
+    try:
+        kp = n1.suite.generate_keypair(b"mg-user")
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register", lambda w: w.blob(b"a").u64(42)),
+                         nonce="n1", group_id="group0",
+                         block_limit=n1.ledger.current_number() + 100
+                         ).sign(n1.suite, kp)
+        r = n1.send_transaction(tx)
+        rc = n1.txpool.wait_for_receipt(r.tx_hash, 15)
+        assert rc is not None and rc.status == 0
+        deadline = time.time() + 5
+        while n1.ledger.current_number() < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert n1.ledger.current_number() >= 1
+        assert n2.ledger.current_number() == 0  # other group untouched
+
+        rpc = GroupedJsonRpc(mgr)
+        resp = rpc.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "getGroupList", "params": []})
+        assert resp["result"]["groupList"] == ["group0", "group1"]
+        resp = rpc.handle({"jsonrpc": "2.0", "id": 2,
+                           "method": "getBlockNumber", "params": ["group0"]})
+        assert resp["result"] >= 1
+        resp = rpc.handle({"jsonrpc": "2.0", "id": 3,
+                           "method": "getBlockNumber", "params": ["group1"]})
+        assert resp["result"] == 0
+        resp = rpc.handle({"jsonrpc": "2.0", "id": 4,
+                           "method": "getBlockNumber", "params": ["nope"]})
+        assert "error" in resp
+
+        # served over HTTP too
+        srv = rpc.serve(port=0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/",
+                data=json.dumps({"jsonrpc": "2.0", "id": 9,
+                                 "method": "getBlockNumber",
+                                 "params": ["group0"]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as f:
+                body = json.load(f)
+            assert body["result"] >= 1
+        finally:
+            srv.stop()
+    finally:
+        mgr.stop()
+        n1.storage.close() if hasattr(n1.storage, "close") else None
