@@ -1,0 +1,1 @@
+from .lightnode import LightNodeClient, LightNodeServer  # noqa: F401

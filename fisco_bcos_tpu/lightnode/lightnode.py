@@ -1,0 +1,271 @@
+"""Light node — header/proof-verifying client + full-node serving side.
+
+Reference counterpart: /root/reference/lightnode/ (concept-based client node:
+fisco-bcos-lightnode/main.cpp, client/P2PClientImpl.h, rpc/LightNodeRPC.h)
+with the server side hooked by libinitializer/LightNodeInitializer.cpp; the
+dedicated ModuleIDs 4000-4006 (bcos-framework protocol/Protocol.h:80-87).
+
+The light client holds no state database. It learns the chain head from
+peers, verifies block headers by their commit-seal quorum (2f+1 of the
+configured consensus set over the header hash — the same check
+BlockValidator.cpp:141 does on synced blocks, batched through the
+CryptoSuite), verifies transactions/receipts against the header's Merkle
+roots (width-16 canonical tree, ops.merkle), and forwards writes
+(sendTransaction) and reads (call) to a full node.
+
+Wire formats use the framework codec; every exchange is a front
+request/response on its ModuleID.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..codec.wire import Reader, Writer
+from ..net.front import FrontService
+from ..net.moduleid import ModuleID
+from ..ops import merkle
+from ..protocol import Block, BlockHeader, Receipt, Transaction
+from ..utils.log import LOG, badge
+
+
+class LightNodeServer:
+    """Registers the lightnode-serving handlers on a full node's front."""
+
+    def __init__(self, node):
+        self.node = node
+        front: FrontService = node.front
+        front.register_module(ModuleID.LIGHTNODE_GET_STATUS, self._status)
+        front.register_module(ModuleID.LIGHTNODE_GET_BLOCK, self._block)
+        front.register_module(ModuleID.LIGHTNODE_GET_TRANSACTIONS, self._txs)
+        front.register_module(ModuleID.LIGHTNODE_GET_RECEIPTS, self._receipts)
+        front.register_module(ModuleID.LIGHTNODE_SEND_TRANSACTION, self._send)
+        front.register_module(ModuleID.LIGHTNODE_CALL, self._call)
+        front.register_module(ModuleID.LIGHTNODE_GET_ABI, self._abi)
+
+    def _status(self, src, payload, respond):
+        if respond is None:
+            return
+        n = self.node.ledger.current_number()
+        header = self.node.ledger.header_by_number(n)
+        w = Writer()
+        w.i64(n).blob(header.encode() if header else b"")
+        respond(w.bytes())
+
+    def _block(self, src, payload, respond):
+        if respond is None:
+            return
+        r = Reader(payload)
+        number, with_txs = r.i64(), r.u8()
+        blk = self.node.ledger.block_by_number(number, with_txs=bool(with_txs))
+        w = Writer()
+        w.blob(blk.encode() if blk else b"")
+        respond(w.bytes())
+
+    def _txs(self, src, payload, respond):
+        if respond is None:
+            return
+        r = Reader(payload)
+        hashes = r.seq(lambda rr: rr.blob())
+        w = Writer()
+
+        def one(ww: Writer, h: bytes) -> None:
+            tx = self.node.ledger.transaction(h)
+            rc = self.node.ledger.receipt(h)
+            if tx is None or rc is None:
+                ww.u8(0)
+                return
+            proof, root = self.node.ledger.tx_proof(h)
+            ww.u8(1).i64(rc.block_number).blob(tx.encode())
+            _encode_proof(ww, proof, root)
+
+        w.seq(hashes, one)
+        respond(w.bytes())
+
+    def _receipts(self, src, payload, respond):
+        if respond is None:
+            return
+        r = Reader(payload)
+        hashes = r.seq(lambda rr: rr.blob())
+        w = Writer()
+
+        def one(ww: Writer, h: bytes) -> None:
+            rc = self.node.ledger.receipt(h)
+            if rc is None:
+                ww.u8(0)
+                return
+            proof, root = self.node.ledger.receipt_proof(h)
+            ww.u8(1).i64(rc.block_number).blob(rc.encode())
+            _encode_proof(ww, proof, root)
+
+        w.seq(hashes, one)
+        respond(w.bytes())
+
+    def _send(self, src, payload, respond):
+        tx = Transaction.decode(payload)
+        res = self.node.send_transaction(tx)
+        if respond is not None:
+            w = Writer()
+            w.u32(int(res.status)).blob(res.tx_hash)
+            respond(w.bytes())
+
+    def _call(self, src, payload, respond):
+        if respond is None:
+            return
+        tx = Transaction.decode(payload)
+        rc = self.node.scheduler.call(tx)
+        w = Writer()
+        w.u32(rc.status).blob(rc.output)
+        respond(w.bytes())
+
+    def _abi(self, src, payload, respond):
+        if respond is None:
+            return
+        w = Writer()
+        w.text(self.node.executor.get_abi(payload, self.node.storage))
+        respond(w.bytes())
+
+
+def _encode_proof(w: Writer, proof, root: bytes) -> None:
+    w.blob(root)
+    w.seq(proof, lambda ww, lvl: (
+        ww.u8(lvl[1]), ww.seq(lvl[0], lambda w3, s: w3.blob(s))))
+
+
+def _decode_proof(r: Reader):
+    root = r.blob()
+    proof = []
+    for _ in range(r.u32()):
+        pos = r.u8()
+        sibs = r.seq(lambda rr: rr.blob())
+        proof.append((sibs, pos))
+    return proof, root
+
+
+class LightNodeClient:
+    """Stateless verifying client over the P2P front."""
+
+    def __init__(self, front: FrontService, suite,
+                 consensus_nodes: Sequence[bytes]):
+        self.front = front
+        self.suite = suite
+        self.sealers = sorted(consensus_nodes)
+        f = (len(self.sealers) - 1) // 3
+        self.quorum = 2 * f + 1
+        self._lock = threading.Lock()
+
+    # -- plumbing ----------------------------------------------------------
+    def _ask(self, module: int, payload: bytes,
+             timeout: float = 5.0) -> Optional[bytes]:
+        for peer in sorted(self.front.peers()):
+            resp = self.front.request(module, peer, payload, timeout=timeout)
+            if resp is not None:
+                return resp
+        return None
+
+    # -- header verification ----------------------------------------------
+    def verify_header(self, header: BlockHeader) -> bool:
+        """2f+1 valid commit seals from the configured consensus set."""
+        hh = header.hash(self.suite)
+        sigs, pubs = [], []
+        for idx, seal in header.signature_list:
+            if 0 <= idx < len(self.sealers):
+                sigs.append(seal)
+                pubs.append(self.sealers[idx])
+        if len(sigs) < self.quorum:
+            return False
+        ok = np.asarray(self.suite.verify_batch([hh] * len(sigs), sigs, pubs))
+        return int(ok.sum()) >= self.quorum
+
+    # -- API ---------------------------------------------------------------
+    def status(self) -> Optional[int]:
+        resp = self._ask(ModuleID.LIGHTNODE_GET_STATUS, b"")
+        if resp is None:
+            return None
+        return Reader(resp).i64()
+
+    def header(self, number: int, verify: bool = True
+               ) -> Optional[BlockHeader]:
+        w = Writer()
+        w.i64(number).u8(0)
+        resp = self._ask(ModuleID.LIGHTNODE_GET_BLOCK, w.bytes())
+        if resp is None:
+            return None
+        raw = Reader(resp).blob()
+        if not raw:
+            return None
+        header = Block.decode(raw).header
+        if verify and not self.verify_header(header):
+            LOG.warning(badge("LIGHT", "header-verify-failed", number=number))
+            return None
+        return header
+
+    def transaction(self, tx_hash: bytes, verify: bool = True
+                    ) -> Optional[Transaction]:
+        w = Writer()
+        w.seq([tx_hash], lambda ww, h: ww.blob(h))
+        resp = self._ask(ModuleID.LIGHTNODE_GET_TRANSACTIONS, w.bytes())
+        if resp is None:
+            return None
+        r = Reader(resp)
+        if r.u32() != 1 or r.u8() != 1:
+            return None
+        number = r.i64()
+        tx = Transaction.decode(r.blob())
+        proof, root = _decode_proof(r)
+        if verify:
+            # anchor the proof root to a quorum-verified header — a peer-
+            # supplied root alone proves nothing
+            header = self.header(number)
+            if header is None or root != header.txs_root:
+                return None
+            leaf = tx.hash(self.suite)
+            if tx_hash != leaf or not merkle.verify_merkle_proof(
+                    leaf, proof, root, self.suite.hash_name):
+                return None
+        return tx
+
+    def receipt(self, tx_hash: bytes, verify: bool = True
+                ) -> Optional[Receipt]:
+        w = Writer()
+        w.seq([tx_hash], lambda ww, h: ww.blob(h))
+        resp = self._ask(ModuleID.LIGHTNODE_GET_RECEIPTS, w.bytes())
+        if resp is None:
+            return None
+        r = Reader(resp)
+        if r.u32() != 1 or r.u8() != 1:
+            return None
+        number = r.i64()
+        rc = Receipt.decode(r.blob())
+        proof, root = _decode_proof(r)
+        if verify:
+            header = self.header(number)
+            if header is None or root != header.receipts_root:
+                return None
+            leaf = rc.hash(self.suite)
+            if not merkle.verify_merkle_proof(leaf, proof, root,
+                                              self.suite.hash_name):
+                return None
+        return rc
+
+    def send_transaction(self, tx: Transaction):
+        resp = self._ask(ModuleID.LIGHTNODE_SEND_TRANSACTION, tx.encode(),
+                         timeout=30.0)
+        if resp is None:
+            return None
+        r = Reader(resp)
+        return r.u32(), r.blob()  # (status, tx_hash)
+
+    def call(self, tx: Transaction):
+        resp = self._ask(ModuleID.LIGHTNODE_CALL, tx.encode())
+        if resp is None:
+            return None
+        r = Reader(resp)
+        return r.u32(), r.blob()  # (status, output)
+
+    def get_abi(self, address: bytes) -> Optional[str]:
+        resp = self._ask(ModuleID.LIGHTNODE_GET_ABI, address)
+        return Reader(resp).text() if resp is not None else None
