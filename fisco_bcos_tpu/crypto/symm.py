@@ -1,0 +1,215 @@
+"""Symmetric ciphers: SM4 (GB/T 32907) and AES-128, with CTR mode + HMAC.
+
+Reference counterpart: /root/reference/bcos-crypto/bcos-crypto/encrypt/
+(AESCrypto / SM4Crypto via OpenSSL EVP) used by bcos-security's disk
+encryption (DataEncryption.h:35-55). Pure from-spec implementations (the
+image has no OpenSSL binding); these run host-side on low-volume data — node
+key files and storage values — not in any hot path.
+
+`seal`/`open_sealed` provide the authenticated envelope the security layer
+uses: random IV, CTR keystream, HMAC-SHA256 tag over IV||ciphertext
+(encrypt-then-MAC).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+# ---------------------------------------------------------------------------
+# SM4
+# ---------------------------------------------------------------------------
+
+def _sm4_build_sbox() -> bytes:
+    """SM4 S-box from its algebraic definition: affine -> inversion in
+    GF(2^8)/(x^8+x^7+x^6+x^5+x^4+x^2+1) -> same affine, with the circulant
+    matrix row 0xA7 and constant 0xD3 (checked by the standard test vector).
+    """
+
+    def gf_mul(a: int, b: int) -> int:
+        r = 0
+        for i in range(8):
+            if (b >> i) & 1:
+                r ^= a << i
+        for i in range(15, 7, -1):
+            if (r >> i) & 1:
+                r ^= 0x1F5 << (i - 8)
+        return r & 0xFF
+
+    inv = [0] * 256
+    for a in range(1, 256):
+        if inv[a]:
+            continue
+        for x in range(1, 256):
+            if gf_mul(a, x) == 1:
+                inv[a], inv[x] = x, a
+                break
+
+    def affine(x: int) -> int:
+        y = 0
+        for i in range(8):
+            bit = 0
+            for j in range(8):
+                if (0xA7 >> ((j - i) % 8)) & 1 and (x >> j) & 1:
+                    bit ^= 1
+            y |= bit << i
+        return y ^ 0xD3
+
+    return bytes(affine(inv[affine(x)]) for x in range(256))
+
+
+_SM4_SBOX = _sm4_build_sbox()
+_FK = (0xA3B1BAC6, 0x56AA3350, 0x677D9197, 0xB27022DC)
+_CK = tuple(
+    sum(((4 * i + j) * 7 % 256) << (24 - 8 * j) for j in range(4))
+    for i in range(32))
+_M32 = 0xFFFFFFFF
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & _M32
+
+
+def _sm4_tau(a: int) -> int:
+    return (_SM4_SBOX[(a >> 24) & 0xFF] << 24 | _SM4_SBOX[(a >> 16) & 0xFF] << 16
+            | _SM4_SBOX[(a >> 8) & 0xFF] << 8 | _SM4_SBOX[a & 0xFF])
+
+
+def _sm4_t(a: int) -> int:
+    b = _sm4_tau(a)
+    return b ^ _rotl(b, 2) ^ _rotl(b, 10) ^ _rotl(b, 18) ^ _rotl(b, 24)
+
+
+def _sm4_t_key(a: int) -> int:
+    b = _sm4_tau(a)
+    return b ^ _rotl(b, 13) ^ _rotl(b, 23)
+
+
+def sm4_key_schedule(key: bytes) -> list[int]:
+    assert len(key) == 16
+    mk = [int.from_bytes(key[4 * i:4 * i + 4], "big") for i in range(4)]
+    k = [mk[i] ^ _FK[i] for i in range(4)]
+    rks = []
+    for i in range(32):
+        k.append(k[i] ^ _sm4_t_key(k[i + 1] ^ k[i + 2] ^ k[i + 3] ^ _CK[i]))
+        rks.append(k[-1])
+    return rks
+
+
+def sm4_encrypt_block(rks: list[int], block: bytes) -> bytes:
+    x = [int.from_bytes(block[4 * i:4 * i + 4], "big") for i in range(4)]
+    for i in range(32):
+        x.append(x[i] ^ _sm4_t(x[i + 1] ^ x[i + 2] ^ x[i + 3] ^ rks[i]))
+    return b"".join(v.to_bytes(4, "big") for v in x[35:31:-1])
+
+
+# ---------------------------------------------------------------------------
+# AES-128
+# ---------------------------------------------------------------------------
+
+def _aes_build_sbox() -> bytes:
+    p, q, sbox = 1, 1, bytearray(256)
+    while True:
+        p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+        q ^= q << 1
+        q ^= q << 2
+        q ^= q << 4
+        q &= 0xFF
+        if q & 0x80:
+            q ^= 0x09
+        x = q ^ _rotl8(q, 1) ^ _rotl8(q, 2) ^ _rotl8(q, 3) ^ _rotl8(q, 4)
+        sbox[p] = x ^ 0x63
+        if p == 1:
+            break
+    sbox[0] = 0x63
+    return bytes(sbox)
+
+
+def _rotl8(x: int, n: int) -> int:
+    return ((x << n) | (x >> (8 - n))) & 0xFF
+
+
+_AES_SBOX = _aes_build_sbox()
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36]
+
+
+def aes128_key_schedule(key: bytes) -> list[bytes]:
+    assert len(key) == 16
+    words = [key[4 * i:4 * i + 4] for i in range(4)]
+    for i in range(4, 44):
+        t = words[i - 1]
+        if i % 4 == 0:
+            t = bytes(_AES_SBOX[b] for b in t[1:] + t[:1])
+            t = bytes([t[0] ^ _RCON[i // 4 - 1]]) + t[1:]
+        words.append(bytes(a ^ b for a, b in zip(words[i - 4], t)))
+    return [b"".join(words[4 * r:4 * r + 4]) for r in range(11)]
+
+
+def _xtime(a: int) -> int:
+    return ((a << 1) ^ 0x1B) & 0xFF if a & 0x80 else a << 1
+
+
+def aes128_encrypt_block(round_keys: list[bytes], block: bytes) -> bytes:
+    s = bytearray(a ^ b for a, b in zip(block, round_keys[0]))
+    for rnd in range(1, 11):
+        s = bytearray(_AES_SBOX[b] for b in s)  # SubBytes
+        # ShiftRows (state is column-major: byte r + 4c)
+        s = bytearray(s[(i + 4 * (i % 4)) % 16] for i in range(16))
+        if rnd < 10:  # MixColumns
+            out = bytearray(16)
+            for c in range(4):
+                col = s[4 * c:4 * c + 4]
+                for r in range(4):
+                    out[4 * c + r] = (_xtime(col[r]) ^ _xtime(col[(r + 1) % 4])
+                                      ^ col[(r + 1) % 4] ^ col[(r + 2) % 4]
+                                      ^ col[(r + 3) % 4])
+            s = out
+        s = bytearray(a ^ b for a, b in zip(s, round_keys[rnd]))
+    return bytes(s)
+
+
+# ---------------------------------------------------------------------------
+# CTR mode + authenticated envelope
+# ---------------------------------------------------------------------------
+
+class BlockCipher:
+    def __init__(self, algorithm: str, key: bytes):
+        self.algorithm = algorithm
+        key = hashlib.sha256(key).digest()[:16] if len(key) != 16 else key
+        self.key = key
+        if algorithm == "sm4":
+            self._rks = sm4_key_schedule(key)
+            self._enc = lambda b: sm4_encrypt_block(self._rks, b)
+        elif algorithm == "aes":
+            self._rks = aes128_key_schedule(key)
+            self._enc = lambda b: aes128_encrypt_block(self._rks, b)
+        else:
+            raise ValueError(f"unknown cipher {algorithm!r}")
+
+    def ctr(self, iv: bytes, data: bytes) -> bytes:
+        assert len(iv) == 16
+        out = bytearray()
+        counter = int.from_bytes(iv, "big")
+        for off in range(0, len(data), 16):
+            ks = self._enc(counter.to_bytes(16, "big"))
+            chunk = data[off:off + 16]
+            out += bytes(a ^ b for a, b in zip(chunk, ks))
+            counter = (counter + 1) % (1 << 128)
+        return bytes(out)
+
+    # -- authenticated envelope (encrypt-then-MAC) -------------------------
+    def seal(self, plaintext: bytes) -> bytes:
+        iv = os.urandom(16)
+        ct = self.ctr(iv, plaintext)
+        tag = hmac.new(self.key, iv + ct, hashlib.sha256).digest()
+        return iv + ct + tag
+
+    def open_sealed(self, blob: bytes) -> bytes:
+        if len(blob) < 48:
+            raise ValueError("sealed blob too short")
+        iv, ct, tag = blob[:16], blob[16:-32], blob[-32:]
+        want = hmac.new(self.key, iv + ct, hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("authentication failed")
+        return self.ctr(iv, ct)

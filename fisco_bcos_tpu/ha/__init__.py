@@ -1,0 +1,1 @@
+from .election import FileLeaseElection, LeaderElection  # noqa: F401

@@ -1,0 +1,199 @@
+"""Leader election for HA deployments (campaign / lease / keep-alive).
+
+Reference counterpart: /root/reference/bcos-leader-election/src/
+LeaderElection.h:30-92 — etcd lease-based master election for Max-mode HA:
+`campaignLeader` writes the leader key under a lease, a KeepAlive thread
+renews it, losing the lease (or watching it vanish) triggers onSeized /
+re-campaign (WatcherConfig.cpp). The interface here is the same
+(campaign / keep-alive / watch / callbacks); the bundled backend coordinates
+through a shared filesystem lease file instead of etcd — the natural
+single-dependency-free analogue for this framework (an etcd/raft backend can
+implement the same interface for cross-machine deployments).
+
+Lease file format (atomic replace): "holder_id\\nexpiry_unix_float\\nfence".
+`fence` is a monotonically increasing token: a new leader bumps it, so
+downstream consumers can reject stale writes from a deposed leader (the
+classic fencing-token pattern replacing etcd's revision numbers).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.log import LOG, badge
+
+
+class LeaderElection:
+    """Interface: LeaderElection.h's campaign/keepalive/callback surface."""
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    def is_leader(self) -> bool:
+        raise NotImplementedError
+
+    def leader(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def fence_token(self) -> int:
+        raise NotImplementedError
+
+    def on_elected(self, cb: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def on_seized(self, cb: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+
+class FileLeaseElection(LeaderElection):
+    def __init__(self, lease_path: str, member_id: str,
+                 lease_ttl: float = 3.0, heartbeat: float = 1.0):
+        self.path = lease_path
+        self.member_id = member_id
+        self.ttl = lease_ttl
+        self.heartbeat = heartbeat
+        self._elected_cbs: list[Callable[[], None]] = []
+        self._seized_cbs: list[Callable[[], None]] = []
+        self._leader = False
+        self._fence = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- callbacks ----------------------------------------------------------
+    def on_elected(self, cb: Callable[[], None]) -> None:
+        self._elected_cbs.append(cb)
+
+    def on_seized(self, cb: Callable[[], None]) -> None:
+        self._seized_cbs.append(cb)
+
+    # -- lease file ---------------------------------------------------------
+    def _read(self) -> tuple[Optional[str], float, int]:
+        try:
+            with open(self.path, "r") as f:
+                holder, expiry, fence = f.read().split("\n")[:3]
+            return holder, float(expiry), int(fence)
+        except (OSError, ValueError):
+            return None, 0.0, 0
+
+    def _write(self, fence: int) -> bool:
+        tmp = f"{self.path}.{self.member_id}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(f"{self.member_id}\n{time.time() + self.ttl}\n{fence}")
+            os.replace(tmp, self.path)
+            return True
+        except OSError:
+            return False
+
+    # -- campaign loop ------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            holder, expiry, fence = self._read()
+            now = time.time()
+            if self._leader:
+                if holder == self.member_id:
+                    self._write(self._fence)  # renew
+                else:
+                    self._demote()  # someone took the lease
+            else:
+                if not holder or expiry < now:
+                    self._campaign()
+            self._stop.wait(self.heartbeat)
+        # clean release on stop: expire the lease immediately but KEEP the
+        # fence token (it must be monotone across leadership changes)
+        if self._leader:
+            holder, _, fence = self._read()
+            if holder == self.member_id:
+                tmp = f"{self.path}.{self.member_id}.tmp"
+                try:
+                    with open(tmp, "w") as f:
+                        f.write(f"\n0\n{fence}")
+                    os.replace(tmp, self.path)
+                except OSError:
+                    pass
+            self._demote(quiet=True)
+
+    def _campaign(self) -> None:
+        """Campaign under an O_EXCL mutex so two candidates cannot both
+        read-modify-write the lease (and end up sharing a fence token).
+        A crashed campaigner's stale mutex is broken after one TTL."""
+        mutex = self.path + ".campaign"
+        try:
+            fd = os.open(mutex, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                if time.time() - os.path.getmtime(mutex) > self.ttl:
+                    os.unlink(mutex)  # stale: holder died mid-campaign
+            except OSError:
+                pass
+            return  # retry next heartbeat
+        except OSError:
+            return
+        try:
+            os.close(fd)
+            holder, expiry, fence = self._read()
+            if holder and expiry >= time.time():
+                return  # lost the race before the mutex
+            if self._write(fence + 1):
+                self._promote(fence + 1)
+        finally:
+            try:
+                os.unlink(mutex)
+            except OSError:
+                pass
+
+    def _promote(self, fence: int) -> None:
+        with self._lock:
+            self._leader = True
+            self._fence = fence
+        LOG.info(badge("ELECTION", "elected", member=self.member_id,
+                       fence=fence))
+        for cb in self._elected_cbs:
+            try:
+                cb()
+            except Exception:
+                LOG.exception(badge("ELECTION", "elected-cb-failed"))
+
+    def _demote(self, quiet: bool = False) -> None:
+        with self._lock:
+            was = self._leader
+            self._leader = False
+        if was and not quiet:
+            LOG.warning(badge("ELECTION", "seized", member=self.member_id))
+            for cb in self._seized_cbs:
+                try:
+                    cb()
+                except Exception:
+                    LOG.exception(badge("ELECTION", "seized-cb-failed"))
+
+    # -- API ----------------------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"election-{self.member_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.ttl + 1)
+            self._thread = None
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._leader
+
+    def leader(self) -> Optional[str]:
+        holder, expiry, _ = self._read()
+        return holder if holder and expiry >= time.time() else None
+
+    def fence_token(self) -> int:
+        with self._lock:
+            return self._fence

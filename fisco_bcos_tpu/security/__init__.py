@@ -1,0 +1,1 @@
+from .dataencrypt import DataEncryption, EncryptedStorage, KeyCenter  # noqa: F401
