@@ -1,0 +1,1 @@
+from .config import ChainConfig, load_node, save_node_config  # noqa: F401

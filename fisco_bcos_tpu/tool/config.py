@@ -1,0 +1,178 @@
+"""Node configuration files: config.ini + genesis + node key.
+
+Reference counterpart: /root/reference/bcos-tool/bcos-tool/NodeConfig.cpp —
+the INI surface (sections `chain.*` :517-535, `consensus.*` :568,
+`txpool.*` :473-493, `storage.*` :618-620, `rpc`/`p2p`/`cert` :355-459,
+`storage_security.*` :579-606) plus the genesis file defining the immutable
+chain parameters and initial consensus node list; and LedgerConfigFetcher
+(pull on-chain config at boot). The same three tiers exist here:
+
+  1. config.ini  — per-node runtime knobs (this module -> NodeConfig);
+  2. genesis     — chain-wide constants + initial sealers (validated
+                   against the ledger once built);
+  3. on-chain system config — mutable via the SystemConfig precompile,
+     read from the ledger each block (ledger.system_config).
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import os
+from typing import Optional
+
+from ..init.node import Node, NodeConfig
+from ..ledger.ledger import ConsensusNode
+from ..security import DataEncryption, KeyCenter
+
+
+@dataclasses.dataclass
+class ChainConfig:
+    """Parsed genesis: immutable chain constants + initial consensus set."""
+
+    chain_id: str = "chain0"
+    group_id: str = "group0"
+    sm_crypto: bool = False
+    consensus_type: str = "pbft"
+    block_tx_count_limit: int = 1000
+    leader_period: int = 1
+    sealers: list[bytes] = dataclasses.field(default_factory=list)
+
+    def to_ini(self) -> str:
+        cp = configparser.ConfigParser()
+        cp["chain"] = {"chain_id": self.chain_id, "group_id": self.group_id,
+                       "sm_crypto": str(self.sm_crypto).lower()}
+        cp["consensus"] = {
+            "consensus_type": self.consensus_type,
+            "block_tx_count_limit": str(self.block_tx_count_limit),
+            "leader_period": str(self.leader_period),
+        }
+        lines = []
+        for i, pk in enumerate(self.sealers):
+            lines.append(f"node.{i}={pk.hex()}:1")
+        import io
+        buf = io.StringIO()
+        cp.write(buf)
+        return buf.getvalue() + "[consensus_node_list]\n" + "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_ini(cls, text: str) -> "ChainConfig":
+        cp = configparser.ConfigParser(strict=False)
+        cp.read_string(text)
+        sealers = []
+        if cp.has_section("consensus_node_list"):
+            for key in sorted(cp["consensus_node_list"],
+                              key=lambda k: int(k.split(".")[-1])):
+                val = cp["consensus_node_list"][key]
+                sealers.append(bytes.fromhex(val.split(":")[0]))
+        return cls(
+            chain_id=cp.get("chain", "chain_id", fallback="chain0"),
+            group_id=cp.get("chain", "group_id", fallback="group0"),
+            sm_crypto=cp.getboolean("chain", "sm_crypto", fallback=False),
+            consensus_type=cp.get("consensus", "consensus_type",
+                                  fallback="pbft"),
+            block_tx_count_limit=cp.getint("consensus",
+                                           "block_tx_count_limit",
+                                           fallback=1000),
+            leader_period=cp.getint("consensus", "leader_period", fallback=1),
+            sealers=sealers,
+        )
+
+
+def node_config_to_ini(cfg: NodeConfig) -> str:
+    cp = configparser.ConfigParser()
+    cp["chain"] = {"chain_id": cfg.chain_id, "group_id": cfg.group_id,
+                   "sm_crypto": str(cfg.sm_crypto).lower()}
+    cp["txpool"] = {"limit": str(cfg.txpool_limit),
+                    "block_limit_range": str(cfg.block_limit_range)}
+    cp["consensus"] = {"type": cfg.consensus,
+                       "min_seal_time": str(cfg.min_seal_time),
+                       "view_timeout": str(cfg.view_timeout),
+                       "leader_period": str(cfg.leader_period)}
+    cp["storage"] = {"type": "wal" if cfg.storage_path else "memory",
+                     "path": cfg.storage_path or ""}
+    cp["rpc"] = {"listen_ip": cfg.rpc_host,
+                 "listen_port": "" if cfg.rpc_port is None else str(cfg.rpc_port)}
+    cp["executor"] = {}
+    cp["crypto"] = {"backend": cfg.crypto_backend,
+                    "device_min_batch": str(cfg.device_min_batch)}
+    import io
+    buf = io.StringIO()
+    cp.write(buf)
+    return buf.getvalue()
+
+
+def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
+    cp = configparser.ConfigParser(strict=False)
+    cp.read_string(text)
+    path = cp.get("storage", "path", fallback="") or None
+    if path and base_dir and not os.path.isabs(path):
+        path = os.path.join(base_dir, path)
+    port_s = cp.get("rpc", "listen_port", fallback="")
+    return NodeConfig(
+        chain_id=cp.get("chain", "chain_id", fallback="chain0"),
+        group_id=cp.get("chain", "group_id", fallback="group0"),
+        sm_crypto=cp.getboolean("chain", "sm_crypto", fallback=False),
+        storage_path=path,
+        txpool_limit=cp.getint("txpool", "limit", fallback=15000),
+        block_limit_range=cp.getint("txpool", "block_limit_range",
+                                    fallback=600),
+        consensus=cp.get("consensus", "type", fallback="solo"),
+        min_seal_time=cp.getfloat("consensus", "min_seal_time",
+                                  fallback=0.05),
+        view_timeout=cp.getfloat("consensus", "view_timeout", fallback=3.0),
+        leader_period=cp.getint("consensus", "leader_period", fallback=1),
+        crypto_backend=cp.get("crypto", "backend", fallback="auto"),
+        device_min_batch=cp.getint("crypto", "device_min_batch", fallback=64),
+        rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
+        rpc_port=int(port_s) if port_s else None,
+    )
+
+
+def save_node_config(node_dir: str, cfg: NodeConfig, chain: ChainConfig,
+                     secret: int,
+                     storage_passphrase: Optional[bytes] = None) -> None:
+    """Write a node directory: config.ini, genesis, node.key[.enc]."""
+    os.makedirs(node_dir, exist_ok=True)
+    with open(os.path.join(node_dir, "config.ini"), "w") as f:
+        f.write(node_config_to_ini(cfg))
+    with open(os.path.join(node_dir, "genesis"), "w") as f:
+        f.write(chain.to_ini())
+    key_bytes = secret.to_bytes(32, "big")
+    if storage_passphrase:
+        enc = DataEncryption(KeyCenter(storage_passphrase))
+        with open(os.path.join(node_dir, "node.key.enc"), "wb") as f:
+            f.write(enc.encrypt(key_bytes))
+    else:
+        with open(os.path.join(node_dir, "node.key"), "wb") as f:
+            f.write(key_bytes)
+
+
+def load_node(node_dir: str, gateway=None,
+              storage_passphrase: Optional[bytes] = None) -> Node:
+    """Boot a Node from a config directory (genesis applied on first start,
+    validated against the existing ledger otherwise)."""
+    with open(os.path.join(node_dir, "config.ini")) as f:
+        cfg = node_config_from_ini(f.read(), base_dir=node_dir)
+    with open(os.path.join(node_dir, "genesis")) as f:
+        chain = ChainConfig.from_ini(f.read())
+    enc_path = os.path.join(node_dir, "node.key.enc")
+    if os.path.exists(enc_path):
+        if not storage_passphrase:
+            raise ValueError("node key is encrypted; passphrase required")
+        enc = DataEncryption(KeyCenter(storage_passphrase))
+        key_bytes = enc.decrypt_file(enc_path)
+    else:
+        with open(os.path.join(node_dir, "node.key"), "rb") as f:
+            key_bytes = f.read()
+    from ..crypto.suite import make_suite
+    suite = make_suite(cfg.sm_crypto, backend=cfg.crypto_backend,
+                       device_min_batch=cfg.device_min_batch)
+    kp = suite.keypair_from_secret(int.from_bytes(key_bytes, "big"))
+    cfg.tx_count_limit = chain.block_tx_count_limit
+    cfg.leader_period = chain.leader_period
+    node = Node(cfg, keypair=kp, suite=suite, gateway=gateway)
+    if node.ledger.current_number() < 0:
+        node.build_genesis([ConsensusNode(pk) for pk in chain.sealers]
+                           or None)
+    return node
