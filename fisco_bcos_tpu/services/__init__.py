@@ -1,0 +1,3 @@
+from .rpc import ServiceClient, ServiceServer  # noqa: F401
+from .storage_service import RemoteStorage, StorageServer  # noqa: F401
+from .executor_service import ExecutorServer, RemoteExecutor  # noqa: F401

@@ -1,0 +1,166 @@
+"""Service RPC — the Pro/Max microservice transport.
+
+Reference counterpart: Tars RPC between module services
+(/root/reference/bcos-tars-protocol/ — 26 .tars IDL files + generated
+servant proxies in client/, wrapped per-module under
+fisco-bcos-tars-service/*Service/). The framework equivalent is a small
+length-prefixed request/response protocol over TCP using the deterministic
+wire codec: frame = u32 length | u64 seq | u8 kind | text method | blob
+payload. Servers register method handlers; clients get synchronous proxies
+with timeouts. No IDL compiler — method payloads are wire-codec structs
+owned by each service module (storage_service, executor_service).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional
+
+from ..codec.wire import Reader, Writer
+from ..net.p2p import MAX_FRAME, _recv_exact
+from ..utils.log import LOG, badge
+
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_ERROR = 2
+
+Handler = Callable[[Reader, Writer], None]
+
+
+def _send_frame(sock: socket.socket, seq: int, kind: int, method: str,
+                payload: bytes) -> None:
+    w = Writer()
+    w.u64(seq).u8(kind).text(method).blob(payload)
+    body = w.bytes()
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">I", hdr)
+    if n > MAX_FRAME:  # same cap as the P2P transport: reject, don't OOM
+        return None
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    r = Reader(body)
+    return r.u64(), r.u8(), r.text(), r.blob()
+
+
+class ServiceServer:
+    """Threaded TCP server dispatching named methods."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self._methods: dict[str, Handler] = {}
+        outer = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    frame = _recv_frame(self.request)
+                    if frame is None:
+                        return
+                    seq, kind, method, payload = frame
+                    if kind != KIND_REQUEST:
+                        continue
+                    fn = outer._methods.get(method)
+                    w = Writer()
+                    try:
+                        if fn is None:
+                            raise KeyError(f"unknown method {method!r}")
+                        fn(Reader(payload), w)
+                        _send_frame(self.request, seq, KIND_RESPONSE, method,
+                                    w.bytes())
+                    except Exception as exc:  # noqa: BLE001 — RPC boundary
+                        LOG.exception(badge("SVC", "handler-failed",
+                                            service=outer.name, method=method))
+                        ew = Writer()
+                        ew.text(f"{type(exc).__name__}: {exc}")
+                        _send_frame(self.request, seq, KIND_ERROR, method,
+                                    ew.bytes())
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Srv((host, port), _H)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, method: str, fn: Handler) -> None:
+        self._methods[method] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"svc-{self.name}")
+        self._thread.start()
+        LOG.info(badge("SVC", "started", service=self.name, port=self.port))
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class ServiceRemoteError(RuntimeError):
+    pass
+
+
+class ServiceClient:
+    """Synchronous client with one pooled connection (thread-safe)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.addr = (host, port)
+        self.timeout = timeout
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.settimeout(self.timeout)
+            self._sock = s
+        return self._sock
+
+    def call(self, method: str, build: Optional[Callable[[Writer], None]]
+             = None) -> Reader:
+        w = Writer()
+        if build:
+            build(w)
+        with self._lock:
+            for attempt in (0, 1):  # one reconnect on a broken connection
+                try:
+                    sock = self._connect()
+                    seq = next(self._seq)
+                    _send_frame(sock, seq, KIND_REQUEST, method, w.bytes())
+                    while True:
+                        frame = _recv_frame(sock)
+                        if frame is None:
+                            raise ConnectionError("service closed connection")
+                        rseq, kind, _, payload = frame
+                        if rseq != seq:
+                            continue  # stale response from a prior timeout
+                        if kind == KIND_ERROR:
+                            raise ServiceRemoteError(Reader(payload).text())
+                        return Reader(payload)
+                except (ConnectionError, OSError):
+                    self.close()
+                    if attempt:
+                        raise
+        raise ConnectionError("unreachable")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

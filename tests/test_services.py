@@ -1,0 +1,115 @@
+"""Pro/Max service split: storage + executor services over real sockets."""
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.codec.wire import Writer
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.services import (ExecutorServer, RemoteExecutor,
+                                     RemoteStorage, StorageServer)
+from fisco_bcos_tpu.services.rpc import (ServiceClient, ServiceRemoteError,
+                                         ServiceServer)
+from fisco_bcos_tpu.storage.interface import Entry
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.wal import WalStorage
+
+SUITE = make_suite(backend="host")
+
+
+def test_service_rpc_roundtrip_and_errors():
+    srv = ServiceServer("echo")
+    srv.register("echo", lambda r, w: w.blob(r.blob()))
+
+    def boom(r, w):
+        raise ValueError("kaput")
+
+    srv.register("boom", boom)
+    srv.start()
+    try:
+        cli = ServiceClient("127.0.0.1", srv.port)
+        assert cli.call("echo", lambda w: w.blob(b"hi")).blob() == b"hi"
+        with pytest.raises(ServiceRemoteError, match="kaput"):
+            cli.call("boom")
+        with pytest.raises(ServiceRemoteError, match="unknown method"):
+            cli.call("nope")
+        # the connection survives handler errors
+        assert cli.call("echo", lambda w: w.blob(b"x")).blob() == b"x"
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_remote_storage_contract(tmp_path):
+    srv = StorageServer(WalStorage(str(tmp_path / "db")))
+    srv.start()
+    try:
+        st = RemoteStorage("127.0.0.1", srv.port)
+        st.set("t", b"k", b"v")
+        assert st.get("t", b"k") == b"v"
+        assert st.get("t", b"missing") is None
+        st.set("t", b"k2", b"v2")
+        assert list(st.keys("t")) == [b"k", b"k2"]
+        assert st.get_batch("t", [b"k", b"zz", b"k2"]) == [b"v", None, b"v2"]
+        st.prepare(3, {("t", b"k3"): Entry(b"v3")})
+        assert st.get("t", b"k3") is None
+        st.commit(3)
+        assert st.get("t", b"k3") == b"v3"
+        st.prepare(4, {("t", b"k4"): Entry(b"v4")})
+        st.rollback(4)
+        assert st.get("t", b"k4") is None
+        st.close()
+    finally:
+        srv.stop()
+        srv.backend.close()
+
+
+def test_remote_executor_block_execution(tmp_path):
+    # Max shape: executor process reads state through the storage service
+    storage_srv = StorageServer(WalStorage(str(tmp_path / "db")))
+    storage_srv.start()
+    exec_storage = RemoteStorage("127.0.0.1", storage_srv.port)
+    exec_srv = ExecutorServer(SUITE, exec_storage)
+    exec_srv.start()
+    try:
+        ex = RemoteExecutor("127.0.0.1", exec_srv.port)
+        assert ex.status() >= 0
+
+        def tx(method, build, nonce):
+            w = Writer()
+            w.text(method)
+            build(w)
+            t = Transaction(to=pc.BALANCE_ADDRESS, input=w.bytes(),
+                            nonce=nonce)
+            t._sender = b"\xaa" * 20
+            return t
+
+        txs = [tx("register", lambda w: w.blob(b"a").u64(100), "n1"),
+               tx("register", lambda w: w.blob(b"b").u64(0), "n2"),
+               tx("transfer",
+                  lambda w: w.blob(b"a").blob(b"b").u64(30), "n3")]
+        receipts, changes = ex.execute_block(txs, 1, 1000)
+        assert [rc.status for rc in receipts] == [0, 0, 0]
+        assert changes  # the scheduler-side changeset came back
+
+        # scheduler-side 2PC against the same storage service
+        sched_storage = RemoteStorage("127.0.0.1", storage_srv.port)
+        sched_storage.prepare(1, changes)
+        sched_storage.commit(1)
+        from fisco_bcos_tpu.executor.precompiled import T_BALANCE
+        assert int.from_bytes(
+            sched_storage.get(T_BALANCE, b"b"), "big") == 30
+
+        ex.bump_term()
+        receipts2, _ = ex.execute_block(
+            [tx("balanceOf", lambda w: w.blob(b"b"), "n4")], 2, 2000)
+        assert receipts2[0].status == 0
+        from fisco_bcos_tpu.codec.wire import Reader
+        assert Reader(receipts2[0].output).u64() == 30
+        ex.close()
+        sched_storage.close()
+    finally:
+        exec_srv.stop()
+        storage_srv.stop()
+        exec_storage.close()
+        storage_srv.backend.close()
