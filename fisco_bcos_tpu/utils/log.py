@@ -27,8 +27,26 @@ def badge(*names: str, **kw) -> str:
 
 
 def metric(event: str, **kw) -> None:
-    """METRIC channel: one machine-readable line per event."""
+    """METRIC channel: one machine-readable line per event, mirrored into
+    the in-process registry (counters + latency histograms) served by the
+    Prometheus endpoint (utils.metrics.MetricsServer)."""
     _METRIC.info("METRIC|%s|%d|%s", event, time.time_ns() // 1_000_000, kv(**kw))
+    from . import metrics as _m  # local import: metrics never imports log
+
+    name = event.replace(".", "_")
+    _m.REGISTRY.inc(f"bcos_{name}_total")
+    if "ms" in kw:
+        try:
+            _m.REGISTRY.observe(f"bcos_{name}_seconds", float(kw["ms"]) / 1e3)
+        except (TypeError, ValueError):
+            pass
+    for gauge_key in ("n", "n_tx", "number"):
+        if gauge_key in kw:
+            try:
+                _m.REGISTRY.set_gauge(f"bcos_{name}_{gauge_key}",
+                                      float(kw[gauge_key]))
+            except (TypeError, ValueError):
+                pass
 
 
 def init_log(level: int = logging.INFO, stream=None) -> None:

@@ -1,0 +1,181 @@
+"""In-process metrics registry + Prometheus text exporter.
+
+Counterpart of the reference's observability stack: the METRIC log channel
+(bcos-utilities BoostLog.h + e.g. TxPool.cpp:206) scraped into the
+Prometheus/Grafana bundle shipped under
+/root/reference/tools/BcosBuilder/docker/host/linux/monitor/ with
+tools/template/Dashboard.json. Instead of log scraping, the framework keeps
+counters/gauges/histograms in-process and exposes them in the Prometheus
+text format over HTTP (`MetricsServer`), so the same Grafana dashboards can
+point straight at a node. `utils.log.metric()` keeps emitting the flat
+METRIC lines; this registry is the queryable aggregate view (also served by
+the RPC `getMetrics` method).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets):
+        self.buckets = list(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.total += v
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class MetricsRegistry:
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hists: dict[tuple[str, tuple], _Histogram] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[dict]) -> tuple[str, tuple]:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[dict] = None) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[dict] = None) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = _Histogram(self.DEFAULT_BUCKETS)
+            h.observe(value)
+
+    def timer(self, name: str, labels: Optional[dict] = None):
+        reg = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                reg.observe(name, time.perf_counter() - self.t0, labels)
+                return False
+
+        return _T()
+
+    # -- export ------------------------------------------------------------
+    @staticmethod
+    def _fmt_labels(labels: tuple) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+    def prometheus_text(self) -> str:
+        lines = []
+        typed: set[str] = set()  # one TYPE line per metric NAME, not series
+
+        def type_line(name: str, kind: str) -> None:
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} {kind}")
+
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                type_line(name, "counter")
+                lines.append(f"{name}{self._fmt_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                type_line(name, "gauge")
+                lines.append(f"{name}{self._fmt_labels(labels)} {v}")
+            for (name, labels), h in sorted(self._hists.items()):
+                type_line(name, "histogram")
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    lab = dict(labels)
+                    lab["le"] = repr(b)
+                    lines.append(
+                        f"{name}_bucket{self._fmt_labels(tuple(sorted(lab.items())))} {cum}")
+                cum += h.counts[-1]
+                lab = dict(labels)
+                lab["le"] = "+Inf"
+                lines.append(
+                    f"{name}_bucket{self._fmt_labels(tuple(sorted(lab.items())))} {cum}")
+                lines.append(f"{name}_sum{self._fmt_labels(labels)} {h.total}")
+                lines.append(f"{name}_count{self._fmt_labels(labels)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {f"{n}{dict(l) or ''}": v
+                             for (n, l), v in self._counters.items()},
+                "gauges": {f"{n}{dict(l) or ''}": v
+                           for (n, l), v in self._gauges.items()},
+                "histograms": {
+                    f"{n}{dict(l) or ''}": {"count": h.count, "sum": h.total}
+                    for (n, l), h in self._hists.items()},
+            }
+
+
+REGISTRY = MetricsRegistry()  # process-wide default
+
+
+class MetricsServer:
+    """Prometheus scrape endpoint: GET /metrics."""
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY,
+                 host: str = "127.0.0.1", port: int = 0):
+        reg = registry
+
+        class _H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = reg.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _H)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="metrics")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

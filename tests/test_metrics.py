@@ -1,0 +1,59 @@
+"""Metrics registry + Prometheus endpoint tests."""
+
+import urllib.request
+
+from fisco_bcos_tpu.utils.metrics import MetricsRegistry, MetricsServer
+from fisco_bcos_tpu.utils.log import metric
+
+
+def test_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.inc("reqs_total")
+    reg.inc("reqs_total", 2)
+    reg.set_gauge("height", 42, {"group": "g0"})
+    reg.observe("latency_seconds", 0.004)
+    reg.observe("latency_seconds", 0.2)
+    with reg.timer("timed_seconds"):
+        pass
+    snap = reg.snapshot()
+    assert snap["counters"]["reqs_total"] == 3
+    assert snap["gauges"]["height{'group': 'g0'}"] == 42
+    assert snap["histograms"]["latency_seconds"]["count"] == 2
+    text = reg.prometheus_text()
+    assert "# TYPE reqs_total counter" in text
+    assert 'height{group="g0"} 42' in text
+    assert "latency_seconds_count 2" in text
+    assert 'le="+Inf"' in text
+
+
+def test_metric_feeds_default_registry():
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.snapshot()["counters"].get("bcos_test_evt_total", 0)
+    metric("test.evt", ms=12, n=5)
+    snap = REGISTRY.snapshot()
+    assert snap["counters"]["bcos_test_evt_total"] == before + 1
+    assert snap["gauges"]["bcos_test_evt_n"] == 5
+    assert snap["histograms"]["bcos_test_evt_seconds"]["count"] >= 1
+
+
+def test_metrics_http_endpoint():
+    reg = MetricsRegistry()
+    reg.inc("up")
+    srv = MetricsServer(reg, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as f:
+            body = f.read().decode()
+        assert "up 1.0" in body
+    finally:
+        srv.stop()
+
+
+def test_prometheus_single_type_line_per_name():
+    reg = MetricsRegistry()
+    reg.inc("rpc_total", labels={"method": "a"})
+    reg.inc("rpc_total", labels={"method": "b"})
+    text = reg.prometheus_text()
+    assert text.count("# TYPE rpc_total counter") == 1
+    assert 'rpc_total{method="a"}' in text and 'rpc_total{method="b"}' in text
