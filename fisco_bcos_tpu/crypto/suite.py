@@ -139,7 +139,9 @@ class CryptoSuite:
         return self._host_hash(pub_bytes)[12:]
 
     # -- signing (host, single) --------------------------------------------
-    def sign(self, kp: KeyPair, digest: bytes) -> bytes:
+    def sign(self, kp, digest: bytes) -> bytes:
+        if hasattr(kp, "sign_digest"):  # HSM-backed: secret stays inside
+            return kp.sign_digest(digest)
         if self.kind == "ecdsa":
             r, s, v = refimpl.ecdsa_sign(self.params, kp.secret, digest)
             return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])

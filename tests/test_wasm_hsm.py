@@ -1,0 +1,117 @@
+"""WASM gas-metering pass + HSM provider seam tests."""
+
+import struct
+
+import pytest
+
+from fisco_bcos_tpu.crypto.hsm import HsmKeyPair, SoftHsmProvider
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor.wasm import (GasMeteredModule, WasmEngine,
+                                          WasmUnavailable, is_wasm)
+
+
+def _leb(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tiny_module() -> bytes:
+    """Minimal valid-enough module with one function body:
+    i32.const 1; i32.const 2; i32.add; call 0; end"""
+    body_code = b"\x41\x01\x41\x02\x6a\x10\x00\x0b"
+    body = _leb(0) + body_code  # 0 local decls
+    code_section = _leb(1) + _leb(len(body)) + body
+    sec = bytes([10]) + _leb(len(code_section)) + code_section
+    return b"\x00asm\x01\x00\x00\x00" + sec
+
+
+def test_gas_metering_plan():
+    mod = _tiny_module()
+    assert is_wasm(mod)
+    m = GasMeteredModule(mod)
+    assert m.blocks, "no metering blocks found"
+    # const+const+add (3) then call (5) + end block accounting
+    assert m.static_cost() >= 8
+
+
+def test_wasm_gated_without_backend():
+    eng = WasmEngine()
+    WasmEngine.set_backend(None)
+    assert not WasmEngine.available()
+    with pytest.raises(WasmUnavailable):
+        eng.execute(_tiny_module(), "main", b"", 100000)
+
+
+def test_wasm_backend_seam():
+    calls = []
+
+    def backend(code, func, args, gas, module):
+        calls.append((func, args, module.static_cost()))
+        return b"\x2a", gas - module.static_cost()
+
+    WasmEngine.set_backend(backend)
+    try:
+        out, gas_left = WasmEngine().execute(_tiny_module(), "main",
+                                             b"\x04", 1000)
+        assert out == b"\x2a" and gas_left < 1000
+        assert calls and calls[0][0] == "main"
+    finally:
+        WasmEngine.set_backend(None)
+
+
+def test_soft_hsm_sign_verify(tmp_path):
+    prov = SoftHsmProvider(str(tmp_path / "keystore"), b"pin1234")
+    pub = prov.generate_key(1)
+    assert len(pub) == 64
+    suite = make_suite(sm_crypto=True, backend="host")
+    digest = suite.hash(b"hsm message")
+    sig = prov.sign(1, digest)
+    assert prov.verify(1, digest, sig)
+    # the suite verifies HSM-produced signatures identically
+    assert suite.verify(pub, digest, sig)
+
+    kp = HsmKeyPair(prov, 1, suite)
+    assert kp.secret is None
+    assert kp.pub_bytes == pub
+    sig2 = kp.sign_digest(digest)
+    assert suite.verify(pub, digest, sig2)
+
+    # keystore survives reopen with the right pin, rejects a wrong one
+    prov2 = SoftHsmProvider(str(tmp_path / "keystore"), b"pin1234")
+    assert prov2.public_key(1) == pub
+    with pytest.raises(ValueError):
+        SoftHsmProvider(str(tmp_path / "keystore"), b"wrong")
+
+
+def test_wasm_malformed_module_rejected():
+    with pytest.raises(ValueError, match="malformed"):
+        GasMeteredModule(b"\x00asm\x01\x00\x00\x00" + bytes([10])
+                         + b"\x05\x01\x03\x00\x41")
+
+
+def test_wasm_blocktype_and_br_table_immediates():
+    # block 0x40; br_table [0] 0; end — immediates must not be read as ops
+    body_code = b"\x02\x40\x41\x00\x0e\x01\x00\x00\x0b\x0b"
+    body = _leb(0) + body_code
+    code_section = _leb(1) + _leb(len(body)) + body
+    sec = bytes([10]) + _leb(len(code_section)) + code_section
+    mod = b"\x00asm\x01\x00\x00\x00" + sec
+    m = GasMeteredModule(mod)
+    # ops: block, i32.const, br_table, end, end = 5 default-cost ops
+    assert m.static_cost() == 5
+
+
+def test_hsm_sign_through_suite(tmp_path):
+    prov = SoftHsmProvider(str(tmp_path / "ks2"), b"pin")
+    prov.generate_key(7)
+    suite = make_suite(sm_crypto=True, backend="host")
+    kp = HsmKeyPair(prov, 7, suite)
+    digest = suite.hash(b"via-suite")
+    sig = suite.sign(kp, digest)  # must dispatch to the provider
+    assert suite.verify(kp.pub_bytes, digest, sig)
