@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Merkle-root benchmark — counterpart of the reference's
+benchmark/merkleBench.cpp:16-60 (old tbb-parallel root vs width-16 Merkle,
+`-c count` leaves, reports ms). Here: device kernel vs host oracle.
+
+Usage: python benchmark/merkle_bench.py [-c 10000] [--alg keccak256|sm3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--count", type=int, default=10_000)
+    ap.add_argument("--alg", default="keccak256", choices=["keccak256", "sm3"])
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--host", action="store_true", help="also time host path")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from fisco_bcos_tpu.ops import merkle
+
+    rng = np.random.default_rng(5)
+    leaves = rng.integers(0, 256, size=(args.count, 32), dtype=np.uint8)
+
+    root = merkle.merkle_root(leaves, args.alg)
+    np.asarray(root)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        root = merkle.merkle_root(leaves, args.alg)
+    np.asarray(root)
+    dev_ms = (time.perf_counter() - t0) / args.iters * 1000
+
+    out = {"metric": f"merkle_root_{args.alg}_{args.count}",
+           "value": round(dev_ms, 2), "unit": "ms"}
+    if args.host:
+        hl = [bytes(r) for r in leaves]
+        t0 = time.perf_counter()
+        host_root = merkle.merkle_levels_host(hl, args.alg)[-1][0]
+        out["host_ms"] = round((time.perf_counter() - t0) * 1000, 2)
+        assert host_root == bytes(np.asarray(root)), "device/host root mismatch"
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Storage benchmark — counterpart of the reference's
+tests/perf/benchmark.cpp:26-43 (StateStorage vs KeyPageStorage read/write
+throughput over a configurable dataset). Adds the native C++ engine.
+
+Usage: python benchmark/storage_bench.py [-n 20000] [--value-size 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_backend(name, factory, n, vsize):
+    st = factory()
+    val = b"v" * vsize
+    keys = [b"key%08d" % i for i in range(n)]
+    t0 = time.perf_counter()
+    for k in keys:
+        st.set("t", k, val)
+    w = n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for k in keys:
+        st.get("t", k)
+    r = n / (time.perf_counter() - t0)
+    if hasattr(st, "close"):
+        st.close()
+    return {"backend": name, "writes_per_sec": round(w), "reads_per_sec": round(r)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=20_000)
+    ap.add_argument("--value-size", type=int, default=64)
+    args = ap.parse_args()
+
+    from fisco_bcos_tpu.storage.keypage import KeyPageStorage
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+    from fisco_bcos_tpu.storage.state import StateStorage
+    from fisco_bcos_tpu.storage.wal import WalStorage
+    from fisco_bcos_tpu.storage import native
+
+    tmp = tempfile.mkdtemp(prefix="bcos-bench-")
+    results = [
+        bench_backend("state_over_memory",
+                      lambda: StateStorage(MemoryStorage()),
+                      args.n, args.value_size),
+        bench_backend("wal", lambda: WalStorage(os.path.join(tmp, "wal")),
+                      args.n, args.value_size),
+        bench_backend("keypage_over_wal",
+                      lambda: KeyPageStorage(
+                          WalStorage(os.path.join(tmp, "kp"))),
+                      args.n, args.value_size),
+    ]
+    if native.available():
+        results.append(bench_backend(
+            "native_bcoskv",
+            lambda: native.NativeStorage(os.path.join(tmp, "native")),
+            args.n, args.value_size))
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({"metric": f"storage_rw_{args.n}", "results": results}))
+
+
+if __name__ == "__main__":
+    main()
