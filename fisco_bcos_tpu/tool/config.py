@@ -88,7 +88,8 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     cp["consensus"] = {"type": cfg.consensus,
                        "min_seal_time": str(cfg.min_seal_time),
                        "view_timeout": str(cfg.view_timeout),
-                       "leader_period": str(cfg.leader_period)}
+                       "leader_period": str(cfg.leader_period),
+                       "tx_count_limit": str(cfg.tx_count_limit)}
     cp["storage"] = {"type": "wal" if cfg.storage_path else "memory",
                      "path": cfg.storage_path or ""}
     cp["rpc"] = {"listen_ip": cfg.rpc_host,
@@ -122,6 +123,8 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
                                   fallback=0.05),
         view_timeout=cp.getfloat("consensus", "view_timeout", fallback=3.0),
         leader_period=cp.getint("consensus", "leader_period", fallback=1),
+        tx_count_limit=cp.getint("consensus", "tx_count_limit",
+                                 fallback=1000),
         crypto_backend=cp.get("crypto", "backend", fallback="auto"),
         device_min_batch=cp.getint("crypto", "device_min_batch", fallback=64),
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
@@ -175,4 +178,12 @@ def load_node(node_dir: str, gateway=None,
     if node.ledger.current_number() < 0:
         node.build_genesis([ConsensusNode(pk) for pk in chain.sealers]
                            or None)
+    elif chain.sealers:
+        # restart: the genesis file must agree with the built chain
+        existing = {n.node_id
+                    for n in node.ledger.ledger_config().consensus_nodes}
+        if existing != set(chain.sealers):
+            raise ValueError(
+                "genesis consensus_node_list does not match the existing "
+                "ledger's consensus set — refusing to boot")
     return node

@@ -91,3 +91,20 @@ def test_encrypted_node_key(tmp_path):
                      storage_passphrase=b"hunter2")
     assert node.ledger.current_number() == 0
     node.storage.close()
+
+
+def test_genesis_mismatch_rejected_on_restart(tmp_path):
+    out = str(tmp_path / "gchain")
+    build_chain(out, 1, consensus="solo", crypto_backend="host")
+    node = load_node(os.path.join(out, "node0"))
+    node.build_genesis() if node.ledger.current_number() < 0 else None
+    node.storage.close()
+    # tamper with the genesis sealer list
+    import re
+    gpath = os.path.join(out, "node0", "genesis")
+    text = open(gpath).read()
+    text = re.sub(r"node\.0=[0-9a-f]+", "node.0=" + "ab" * 64, text)
+    open(gpath, "w").write(text)
+    import pytest
+    with pytest.raises(ValueError, match="consensus set"):
+        load_node(os.path.join(out, "node0"))
