@@ -34,3 +34,38 @@ Subpackage map (reference analogue in parentheses):
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+
+def _setup_compilation_cache() -> None:
+    """Enable JAX's persistent compilation cache for every consumer.
+
+    The EC kernels are large HLO graphs; without a disk cache every node
+    start, test run, bench, and dryrun re-pays XLA compilation. Configured
+    here (package import) so all entry points share one cache. Override the
+    location with FBTPU_JAX_CACHE_DIR; disable with FBTPU_JAX_CACHE_DIR=off.
+    """
+    d = _os.environ.get("FBTPU_JAX_CACHE_DIR")
+    if d == "off":
+        return
+    try:
+        import jax
+
+        if d is None:
+            d = _os.path.join(
+                _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+                ".jax_cache",
+            )
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+        except Exception:
+            pass  # option renamed/absent in other jax versions
+    except Exception:
+        pass  # cache is an optimization; never block import on it
+
+
+_setup_compilation_cache()

@@ -91,48 +91,81 @@ def _pad(x, lo, hi):
 # raw multi-limb primitives (all shapes [..., L, B], batch minor-most)
 # ---------------------------------------------------------------------------
 
+def _diag_sum(p, width: int):
+    """Anti-diagonal reduction: p[..., R, J, B] -> cols[..., width, B] with
+    cols[k] = sum_i p[i, k - i] (out-of-range j treated as zero).
+
+    Implemented branch-free via the pad-and-reshape shear: padding each row
+    to width+1 and re-viewing the flat buffer at stride `width` shifts row i
+    right by i, so one axis reduction produces every column. ~5 HLO ops
+    total — this replaces an unrolled per-limb pad/add chain, which is what
+    made XLA compiles of the EC kernels pathological.
+    """
+    R, J = p.shape[-3], p.shape[-2]
+    assert J <= width + 1 and R <= width
+    spec = [(0, 0)] * (p.ndim - 2) + [(0, width + 1 - J), (0, 0)]
+    flat = jnp.pad(p, spec).reshape(p.shape[:-3] + (R * (width + 1), p.shape[-1]))
+    sheared = flat[..., : R * width, :].reshape(
+        p.shape[:-3] + (R, width, p.shape[-1]))
+    return jnp.sum(sheared, axis=-3)
+
+
 def mul_wide(a, b):
     """Full 512-bit product as 32 redundant columns, each < 2^21.
 
-    a, b: uint32[..., 16, B] with exact 16-bit limbs. One broadcast multiply
-    per limb of a; products split 16/16 and accumulated per output column.
+    a, b: uint32[..., 16, B] with exact 16-bit limbs. One [16, 16, B] outer
+    product, split 16/16 per partial product, reduced along anti-diagonals.
     """
-    bs = jnp.broadcast_shapes(a.shape, b.shape)
-    acc = jnp.zeros(bs[:-2] + (2 * NLIMBS, bs[-1]), jnp.uint32)
-    for i in range(NLIMBS):
-        p = a[..., i:i + 1, :] * b  # [..., 16, B], each < 2^32
-        acc = acc + _pad(p & MASK, i, NLIMBS - i)
-        acc = acc + _pad(p >> LIMB_BITS, i + 1, NLIMBS - i - 1)
-    return acc
+    p = a[..., :, None, :] * b[..., None, :, :]  # [..., 16, 16, B] < 2^32
+    lo = _diag_sum(p & MASK, 2 * NLIMBS)
+    hi = _diag_sum(_pad(p >> LIMB_BITS, 1, 0), 2 * NLIMBS)  # offset +1 col
+    return lo + hi
 
 
 def mul_low(a, b):
     """Low 16 columns of the product (mod 2^256), redundant (< 2^21)."""
-    bs = jnp.broadcast_shapes(a.shape, b.shape)
-    acc = jnp.zeros(bs[:-2] + (NLIMBS, bs[-1]), jnp.uint32)
-    bfull = jnp.broadcast_to(b, bs)
-    for i in range(NLIMBS):
-        p = a[..., i:i + 1, :] * bfull[..., :NLIMBS - i, :]
-        acc = acc + _pad(p & MASK, i, 0)
-        if i + 1 < NLIMBS:
-            acc = acc + _pad((p >> LIMB_BITS)[..., :NLIMBS - i - 1, :], i + 1, 0)
-    return acc
+    return mul_wide(a, b)[..., :NLIMBS, :]
+
+
+def _shift_up(x, k: int):
+    """Along the limb axis (-2): out[i] = x[i - k], zero-fill below."""
+    return _pad(x, k, 0)[..., : x.shape[-2], :]
 
 
 def carry_prop(cols, nout: int):
-    """Sequential carry sweep: redundant columns -> exact 16-bit limbs.
+    """Redundant columns -> exact 16-bit limbs, in log depth.
 
-    cols: uint32[..., ncols, B], every column < 2^31 (so column + carry
-    stays in uint32). Returns (limbs [..., nout, B], carry_out [..., B]).
+    cols: uint32[..., ncols, B] with ncols <= nout, every column < 2^31.
+    Returns (limbs [..., nout, B], carry_out [..., B]) where carry_out is
+    the value overflowing limb nout-1 (fits uint32).
+
+    Two vectorized collapse passes bring every column to <= 2^16, then a
+    Kogge-Stone carry-lookahead (prefix over the generate/propagate
+    semigroup) resolves the remaining single-bit ripple exactly in
+    ceil(log2(m)) steps — no 32-long sequential dependency chain and no
+    stack-of-slices, which together dominated both compile time and the
+    critical path of the previous per-limb sweep.
     """
     ncols = cols.shape[-2]
-    c = jnp.zeros(cols.shape[:-2] + (cols.shape[-1],), jnp.uint32)
-    outs = []
-    for k in range(nout):
-        v = c if k >= ncols else cols[..., k, :] + c
-        outs.append(v & MASK)
-        c = v >> LIMB_BITS
-    return jnp.stack(outs, axis=-2), c
+    assert ncols <= nout, (ncols, nout)
+    m = nout + 2  # headroom: total value < 2^(16*nout + 16) for ncols<=nout
+    cols = _pad(cols, 0, m - ncols)
+    # collapse: < 2^31 -> < 2^17 -> <= 2^16
+    w = (cols & MASK) + _shift_up(cols >> LIMB_BITS, 1)
+    w = (w & MASK) + _shift_up(w >> LIMB_BITS, 1)
+    # carry-lookahead over values <= 2^16
+    r = w & MASK
+    G = w >> LIMB_BITS  # generate, in {0, 1}
+    P = (r == MASK).astype(jnp.uint32)  # propagate
+    k = 1
+    while k < m:
+        G = G | (P & _shift_up(G, k))
+        P = P & _shift_up(P, k)
+        k *= 2
+    cin = _shift_up(G, 1)
+    limbs = (r + cin) & MASK
+    carry = limbs[..., nout, :] | (limbs[..., nout + 1, :] << LIMB_BITS)
+    return limbs[..., :nout, :], carry
 
 
 def add_limbs(a, b):

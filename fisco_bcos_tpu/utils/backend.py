@@ -1,0 +1,64 @@
+"""Accelerator-backend probing and CPU-pinned environments.
+
+This container force-registers an experimental accelerator plugin at
+interpreter startup (sitecustomize) and overrides ``jax_platforms`` via
+``jax.config.update``, so ``jax.devices()`` can hang indefinitely or raise
+(libtpu client/terminal skew) in EVERY process regardless of the
+JAX_PLATFORMS env var. Driver-facing entry points (bench.py,
+__graft_entry__.py) must therefore:
+
+  * probe the default backend in a BOUNDED subprocess before touching jax
+    in-process, and
+  * fall back to a subprocess env that pins CPU and disables the plugin
+    (its sitecustomize gates registration on PALLAS_AXON_POOL_IPS).
+
+Centralised here so the plugin-gating knowledge lives in one place.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+PROBE_TIMEOUT = float(os.environ.get("FBTPU_PROBE_TIMEOUT", "120"))
+
+
+def probe_default_backend(timeout: float | None = None,
+                          cwd: str | None = None) -> tuple[bool, str, int]:
+    """-> (healthy, platform_or_diag, n_devices); bounded subprocess."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print('PROBE', d[0].platform, len(d))"],
+            cwd=cwd, timeout=timeout or PROBE_TIMEOUT,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    except subprocess.TimeoutExpired:
+        return False, "probe-timeout", 0
+    except Exception as exc:  # noqa: BLE001 — diagnostic path
+        return False, f"probe-error:{type(exc).__name__}", 0
+    if r.returncode == 0:
+        for line in r.stdout.splitlines():
+            if line.startswith("PROBE "):
+                _, plat, cnt = line.split()
+                return True, plat, int(cnt)
+    tail = (r.stdout or "")[-300:]
+    return False, f"rc={r.returncode}:{tail!r}", 0
+
+
+def cpu_pinned_env(n_devices: int | None = None,
+                   extra_path: str | None = None) -> dict:
+    """Env for a subprocess pinned to the CPU platform with the accelerator
+    plugin disabled; optionally with an n-device virtual CPU mesh."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    if n_devices is not None:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    if extra_path:
+        env["PYTHONPATH"] = extra_path + os.pathsep + env.get("PYTHONPATH", "")
+    return env

@@ -3,13 +3,30 @@
 Multi-chip hardware is not available in CI; all sharding tests run on a
 virtual 8-device CPU platform (the driver separately dry-runs the multichip
 path via __graft_entry__.dryrun_multichip).
+
+Ordering matters on two axes:
+
+* ``XLA_FLAGS`` must be in the environment before the first backend
+  initialization (the CPU client reads it at creation).
+* The container's sitecustomize registers an experimental accelerator
+  plugin at interpreter startup and force-overrides ``jax_platforms`` via
+  ``jax.config.update`` — so an env-var ``JAX_PLATFORMS`` set here is a
+  no-op, and initializing that plugin hangs the whole process when its
+  device tunnel is unhealthy. The only reliable in-process pin is another
+  ``jax.config.update`` AFTER import (last write wins, and no backend is
+  initialized yet when conftest runs).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: skip plugin entirely
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
