@@ -72,9 +72,13 @@ def _measure_cpu_baseline() -> tuple[float, int, str]:
     try:
         if cores == 1:
             return n / _openssl_verify_loop(n), 1, "measured-openssl"
+        import multiprocessing
         from concurrent.futures import ProcessPoolExecutor
 
-        with ProcessPoolExecutor(cores) as ex:
+        # spawn (not fork): forking after the XLA client exists can deadlock
+        ctx = multiprocessing.get_context("spawn")
+
+        with ProcessPoolExecutor(cores, mp_context=ctx) as ex:
             list(ex.map(_openssl_verify_loop, [50] * cores))  # warm pool
             t0 = time.perf_counter()
             list(ex.map(_openssl_verify_loop, [n] * cores))
@@ -103,6 +107,10 @@ def main() -> None:
             _cpu_reexec()
 
     try:
+        # measure the CPU divisor FIRST (before any device work contends
+        # for cores or the XLA client spawns threads)
+        cpu_base, cores, src = _measure_cpu_baseline()
+
         import jax
 
         from fisco_bcos_tpu.crypto import refimpl
@@ -142,7 +150,6 @@ def main() -> None:
         dt_r, rec = timed(ec.ecdsa_recover_batch, ec.SECP256K1, e, r, s, v)
         assert bool(np.asarray(rec[2]).all()), "recover kernel rejected sigs"
 
-        cpu_base, cores, src = _measure_cpu_baseline()
         value = batch / dt_v
         recover = batch / dt_r
         print(json.dumps({

@@ -52,14 +52,22 @@ from .messages import (
     pack_messages,
     unpack_messages,
 )
+from .storage import (
+    PBFTLog,
+    TAG_BLOCK,
+    TAG_COMMIT,
+    TAG_PREPARE,
+    TAG_PREPREPARE,
+)
 
 
 class _ProposalCache:
     """Per-height consensus state (PBFTCacheProcessor's PBFTCache)."""
 
     __slots__ = ("proposal", "proposal_hash", "prepares", "commits",
-                 "checkpoints", "prepared", "committed_phase", "executed",
-                 "executed_hash", "preprepare_msg")
+                 "checkpoints", "checkpoint_msgs", "prepared",
+                 "committed_phase", "executed", "executed_hash",
+                 "preprepare_msg")
 
     def __init__(self):
         self.proposal: Optional[Block] = None
@@ -68,6 +76,7 @@ class _ProposalCache:
         self.prepares: dict[int, PBFTMessage] = {}
         self.commits: dict[int, PBFTMessage] = {}
         self.checkpoints: dict[int, bytes] = {}  # idx -> seal over executed_h
+        self.checkpoint_msgs: dict[int, PBFTMessage] = {}  # for recover resp
         self.prepared = False
         self.committed_phase = False
         self.executed = False
@@ -78,7 +87,7 @@ class PBFTEngine(Worker):
     def __init__(self, suite, keypair, front: FrontService, txpool, sealer,
                  scheduler, ledger, leader_period: int = 1,
                  view_timeout: float = 3.0, txsync=None,
-                 full_proposals: bool = False):
+                 full_proposals: bool = False, persist: bool = True):
         super().__init__("pbft", idle_wait=0.02)
         self.suite = suite
         self.keypair = keypair
@@ -103,6 +112,11 @@ class PBFTEngine(Worker):
         self.f = (self.n - 1) // 3
         self.quorum = 2 * self.f + 1
 
+        # durable consensus log (LedgerStorage.cpp analogue); replayed in
+        # start() so an in-flight round survives a crash/restart
+        self.log: Optional[PBFTLog] = (
+            PBFTLog(ledger.storage) if persist else None)
+
         self.view = 0
         self.to_view = 0  # > view while a view change is in flight
         self._caches: dict[int, _ProposalCache] = {}
@@ -125,9 +139,72 @@ class PBFTEngine(Worker):
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        self._replay_log()
         self._reset_timer()
         super().start()
         self._grant_sealer()
+
+    # -- crash recovery (PBFTEngine::initState analogue) -------------------
+    def _replay_log(self) -> None:
+        """Restore in-flight round state persisted by a previous run and
+        nudge the cluster so the round can finish without a view change."""
+        if self.log is None:
+            return
+        v = self.log.load_view()
+        if v > self.view:
+            self.view = self.to_view = v
+        number = self.ledger.current_number() + 1
+        self.log.prune(number - 1)  # drop anything already committed
+        rec = self.log.load_height(number)
+        if TAG_PREPREPARE not in rec or TAG_BLOCK not in rec:
+            return
+        try:
+            pp = PBFTMessage.decode(rec[TAG_PREPREPARE])
+            block = Block.decode(rec[TAG_BLOCK])
+        except Exception:
+            LOG.warning(badge("PBFT", "replay-decode-failed", number=number))
+            return
+        if pp.view != self.view:
+            # stale record from before a view change (the log is cleared on
+            # view entry, but a crash can land between the two writes) — a
+            # carried proposal re-enters the new view with a new hash, so
+            # resurrecting this one could block the legitimate proposal
+            self.log.clear_heights()
+            return
+        cache = self._cache(number)
+        cache.proposal = block
+        cache.proposal_hash = pp.proposal_hash
+        cache.preprepare_msg = pp
+        # re-import the proposal's txs into the (empty, post-restart) pool so
+        # fills, proposal re-verification and commit pruning keep working
+        self.txpool.verify_proposal(block)
+        replayed = []
+        for tag, store in ((TAG_PREPARE, cache.prepares),
+                           (TAG_COMMIT, cache.commits)):
+            if tag not in rec:
+                continue
+            try:
+                vote = PBFTMessage.decode(rec[tag])
+            except Exception:
+                continue
+            if (vote.view != pp.view
+                    or vote.proposal_hash != pp.proposal_hash):
+                continue  # vote for a different round of this height
+            store[self.index] = vote
+            replayed.append(vote)
+            if tag == TAG_COMMIT:
+                cache.prepared = True
+        # rebroadcast our packets (receivers deduplicate) + ask peers for
+        # their cached round state
+        if pp.from_idx == self.index:
+            self.front.broadcast(ModuleID.PBFT, pp.encode())
+        for vote in replayed:
+            self.front.broadcast(ModuleID.PBFT, vote.encode())
+        req = self._signed(make_packet(PacketType.RECOVER_REQ, self.view,
+                                       number, self.index))
+        self.front.broadcast(ModuleID.PBFT, req.encode())
+        metric("pbft.replayed", number=number, view=self.view,
+               votes=len(replayed))
 
     def _grant_sealer(self) -> None:
         nxt = self.ledger.current_number() + 1
@@ -218,6 +295,39 @@ class PBFTEngine(Worker):
             self._handle_viewchange(msg)
         elif t == PacketType.NEW_VIEW:
             self._handle_newview(msg)
+        elif t == PacketType.RECOVER_REQ:
+            self._handle_recover_req(msg)
+        elif t == PacketType.RECOVER_RESP:
+            self._handle_recover_resp(msg)
+
+    # -- round-state recovery ----------------------------------------------
+    def _handle_recover_req(self, msg: PBFTMessage) -> None:
+        """A restarted peer asks for our cached packets at a height."""
+        cache = self._caches.get(msg.number)
+        if cache is None:
+            return
+        out: list[PBFTMessage] = []
+        if cache.preprepare_msg is not None:
+            out.append(cache.preprepare_msg)
+        out.extend(cache.prepares.values())
+        out.extend(cache.commits.values())
+        out.extend(cache.checkpoint_msgs.values())
+        if not out:
+            return
+        resp = self._signed(make_packet(PacketType.RECOVER_RESP, self.view,
+                                        msg.number, self.index, b"",
+                                        pack_messages(out)))
+        self.front.send(ModuleID.PBFT, self.nodes[msg.from_idx],
+                        resp.encode())
+
+    def _handle_recover_resp(self, msg: PBFTMessage) -> None:
+        try:
+            inner = unpack_messages(msg.payload)
+        except Exception:
+            return
+        # re-enqueue so each inner packet passes normal signature checking
+        for m in inner[: 4 * self.n + 1]:
+            self._inbox.put(("msg", m))
 
     # -- send helpers ------------------------------------------------------
     def _signed(self, packet: PBFTMessage) -> PBFTMessage:
@@ -262,6 +372,7 @@ class PBFTEngine(Worker):
         msg = make_packet(PacketType.PRE_PREPARE, self.view, number,
                           self.index, phash, wire_block.encode())
         cache.preprepare_msg = self._signed(msg)
+        self._persist_proposal(number, cache)
         self.front.broadcast(ModuleID.PBFT, cache.preprepare_msg.encode())
         # leader's own prepare vote
         self._vote_prepare(number, phash)
@@ -287,8 +398,11 @@ class PBFTEngine(Worker):
                 header.hash(self.suite) != msg.proposal_hash:
             return
         cache = self._cache(msg.number)
-        if cache.proposal is not None and cache.proposal_hash != msg.proposal_hash:
-            return  # conflicting proposal from same leader: keep the first
+        if cache.proposal is not None:
+            if cache.proposal_hash != msg.proposal_hash:
+                return  # conflicting proposal from same leader: keep first
+            self._try_advance(msg.number)  # duplicate (e.g. recover replay)
+            return
         # metadata-only proposal: fetch any txs the gossip hasn't delivered
         # yet from the leader (TxPool.cpp:160 asyncVerifyBlock fetch path)
         if not block.transactions and block.tx_hashes and self.txsync:
@@ -304,8 +418,25 @@ class PBFTEngine(Worker):
         cache.proposal = block
         cache.proposal_hash = msg.proposal_hash
         cache.preprepare_msg = msg
+        self._persist_proposal(msg.number, cache)
         self._vote_prepare(msg.number, msg.proposal_hash)
         self._try_advance(msg.number)
+
+    def _persist_proposal(self, number: int, cache: _ProposalCache) -> None:
+        """Write the accepted pre-prepare + a FULL block (txs materialised
+        from the pool — after a restart the pool is empty, so the persisted
+        block must be executable standalone)."""
+        if self.log is None or cache.preprepare_msg is None:
+            return
+        block = cache.proposal
+        if block is not None and not block.transactions and block.tx_hashes:
+            txs = self.txpool.fill_block(block.tx_hashes)
+            if txs is not None:
+                block = Block(header=block.header,
+                              transactions=txs,
+                              tx_hashes=list(block.tx_hashes))
+        self.log.save_proposal(number, cache.preprepare_msg.encode(),
+                               block.encode() if block is not None else b"")
 
     def _vote_prepare(self, number: int, phash: bytes) -> None:
         cache = self._cache(number)
@@ -314,6 +445,8 @@ class PBFTEngine(Worker):
         vote = self._signed(make_packet(PacketType.PREPARE, self.view,
                                         number, self.index, phash))
         cache.prepares[self.index] = vote
+        if self.log is not None:
+            self.log.save_packet(number, TAG_PREPARE, vote.encode())
         self.front.broadcast(ModuleID.PBFT, vote.encode())
         self._try_advance(number)
 
@@ -334,6 +467,7 @@ class PBFTEngine(Worker):
     def _handle_checkpoint(self, msg: PBFTMessage) -> None:
         cache = self._cache(msg.number)
         cache.checkpoints.setdefault(msg.from_idx, msg.payload)
+        cache.checkpoint_msgs.setdefault(msg.from_idx, msg)
         self._try_advance(msg.number)
 
     # -- quorum state machine (PBFTCacheProcessor::checkAndCommit) ---------
@@ -351,6 +485,8 @@ class PBFTEngine(Worker):
             vote = self._signed(make_packet(PacketType.COMMIT, self.view,
                                             number, self.index, phash))
             cache.commits[self.index] = vote
+            if self.log is not None:
+                self.log.save_packet(number, TAG_COMMIT, vote.encode())
             self.front.broadcast(ModuleID.PBFT, vote.encode())
         commits = sum(1 for m in cache.commits.values()
                       if m.proposal_hash == phash)
@@ -370,8 +506,11 @@ class PBFTEngine(Worker):
         # the checkpoint seal IS the commit seal for signature_list
         seal = self.suite.sign(self.keypair, cache.executed_hash)
         cache.checkpoints[self.index] = seal
-        self._broadcast(make_packet(PacketType.CHECKPOINT, self.view, number,
-                                    self.index, cache.executed_hash, seal))
+        ck = self._signed(make_packet(PacketType.CHECKPOINT, self.view,
+                                      number, self.index,
+                                      cache.executed_hash, seal))
+        cache.checkpoint_msgs[self.index] = ck
+        self.front.broadcast(ModuleID.PBFT, ck.encode())
         metric("pbft.executed", number=number,
                ehash=cache.executed_hash[:8].hex())
 
@@ -400,6 +539,8 @@ class PBFTEngine(Worker):
             return
         for h in [h for h in self._caches if h <= number]:
             self._caches.pop(h, None)
+        if self.log is not None:
+            self.log.prune(number)
         self._viewchanges = {v: d for v, d in self._viewchanges.items()
                              if v > self.view}
         self._timeout = self.base_timeout
@@ -516,6 +657,12 @@ class PBFTEngine(Worker):
             self._caches.pop(number, None)
         self.view = v
         self.to_view = v
+        if self.log is not None:
+            # every cached round was just discarded; a carried proposal
+            # re-enters this view as a NEW pre-prepare (new hash), so stale
+            # height records must not survive into a future replay
+            self.log.save_view(v)
+            self.log.clear_heights()
         self._timeout = self.base_timeout
         self._reset_timer()
         self._grant_sealer()

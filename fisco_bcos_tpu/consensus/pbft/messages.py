@@ -35,6 +35,11 @@ class PacketType(enum.IntEnum):
     VIEW_CHANGE = 3
     NEW_VIEW = 4
     CHECKPOINT = 5
+    # round-state recovery after a crash/restart (the reference's
+    # RecoverRequest/RecoverResponse consensus-status exchange): REQ asks
+    # peers for their cached packets at a height; RESP carries them packed.
+    RECOVER_REQ = 6
+    RECOVER_RESP = 7
 
 
 @dataclasses.dataclass

@@ -65,6 +65,10 @@ class StorageInterface(abc.ABC):
         for k, v in items:
             self.set(table, k, v)
 
+    def remove_batch(self, table: str, ks: Iterable[bytes]) -> None:
+        for k in ks:
+            self.remove(table, k)
+
 
 class TransactionalStorage(StorageInterface):
     """Two-phase commit: stage a changeset per block, then commit/rollback.

@@ -134,6 +134,29 @@ class WalStorage(TransactionalStorage):
             self._append_record(0, {(table, key): Entry(b"", EntryStatus.DELETED)})
             self._tables.get(table, {}).pop(key, None)
 
+    # batched direct writes: ONE WAL record + ONE fsync per call (the PBFT
+    # consensus log writes several keys per phase on the hot worker thread)
+    def set_batch(self, table: str, items) -> None:
+        items = list(items)
+        if not items:
+            return
+        with self._lock:
+            self._append_record(0, {(table, k): Entry(v) for k, v in items})
+            rows = self._tables.setdefault(table, {})
+            for k, v in items:
+                rows[k] = v
+
+    def remove_batch(self, table: str, ks) -> None:
+        ks = list(ks)
+        if not ks:
+            return
+        with self._lock:
+            self._append_record(0, {(table, k): Entry(b"", EntryStatus.DELETED)
+                                    for k in ks})
+            rows = self._tables.get(table, {})
+            for k in ks:
+                rows.pop(k, None)
+
     def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
         with self._lock:
             ks = sorted(k for k in self._tables.get(table, {})

@@ -178,3 +178,81 @@ def test_tx_gossip_reaches_all_pools():
             [n.txpool.status() for n in nodes]
     finally:
         stop_cluster(gateway, nodes)
+
+
+def test_crash_restart_replays_consensus_log(tmp_path):
+    """Kill a quorum-breaking set of nodes mid-round (after prepare+commit
+    quorum, before checkpoint exchange), restart them on the same storage,
+    and the round must finish WITHOUT a view change — the persisted
+    consensus log (engine.py _replay_log / storage.py PBFTLog; reference
+    bcos-pbft LedgerStorage.cpp + PBFTEngine::initState) carries it."""
+    from fisco_bcos_tpu.codec.wire import Reader
+    from fisco_bcos_tpu.consensus.pbft.messages import PacketType, PBFTMessage
+    from fisco_bcos_tpu.net.moduleid import ModuleID
+
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    keypairs = [suite.generate_keypair(bytes([i + 1]) * 16) for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+
+    def mk_node(i):
+        return Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                               min_seal_time=0.0, view_timeout=60.0,
+                               storage_path=str(tmp_path / f"n{i}")),
+                    keypair=keypairs[i], gateway=gateway)
+
+    nodes = [mk_node(i) for i in range(4)]
+    for n in nodes:
+        n.build_genesis(sealers)
+
+    # drop every CHECKPOINT packet so the round stalls after commit quorum
+    def drop_checkpoints(src, dst, data):
+        r = Reader(data)
+        module, _, _ = r.u16(), r.u8(), r.u64()
+        if module != int(ModuleID.PBFT):
+            return True
+        try:
+            msg = PBFTMessage.decode(r.blob())
+        except Exception:
+            return True
+        return msg.packet_type != int(PacketType.CHECKPOINT)
+
+    gateway.set_filter(drop_checkpoints)
+    try:
+        for n in nodes:
+            n.start()
+
+        kp = suite.generate_keypair(b"restart-user")
+        res = nodes[0].send_transaction(make_tx(suite, kp, nonce="rr1"))
+        assert res.status == TransactionStatus.OK
+
+        # every node reaches the executed state (commit quorum passed) but
+        # the chain cannot advance: checkpoints are being dropped
+        assert wait_until(lambda: all(
+            any(c.executed for c in n.consensus._caches.values())
+            for n in nodes)), "round did not reach the executed state"
+        assert all(n.ledger.current_number() == 0 for n in nodes)
+
+        # crash two nodes (quorum = 3: the survivors cannot finish alone)
+        for i in (2, 3):
+            nodes[i].stop()
+            nodes[i].storage.close()
+        gateway.set_filter(None)
+        time.sleep(0.3)
+        assert all(nodes[i].ledger.current_number() == 0 for i in (0, 1))
+
+        # restart on the same storage: the replayed log finishes the round
+        for i in (2, 3):
+            nodes[i] = mk_node(i)
+            nodes[i].start()
+
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes)), \
+            [n.ledger.current_number() for n in nodes]
+        assert all(n.consensus.view == 0 for n in nodes), \
+            "round must complete via log replay, not a view change"
+        headers = [n.ledger.header_by_number(1) for n in nodes]
+        hashes = {h.hash(suite) for h in headers}
+        assert len(hashes) == 1
+    finally:
+        stop_cluster(gateway, nodes)
