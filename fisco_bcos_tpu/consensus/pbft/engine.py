@@ -171,13 +171,17 @@ class PBFTEngine(Worker):
             # resurrecting this one could block the legitimate proposal
             self.log.clear_heights()
             return
+        # re-import the proposal's txs into the (empty, post-restart) pool so
+        # fills, proposal re-verification and commit pruning keep working; a
+        # proposal that cannot be materialised is unexecutable — drop it
+        if not self.txpool.verify_proposal(block):
+            LOG.warning(badge("PBFT", "replay-unverifiable", number=number))
+            self.log.clear_heights()
+            return
         cache = self._cache(number)
         cache.proposal = block
         cache.proposal_hash = pp.proposal_hash
         cache.preprepare_msg = pp
-        # re-import the proposal's txs into the (empty, post-restart) pool so
-        # fills, proposal re-verification and commit pruning keep working
-        self.txpool.verify_proposal(block)
         replayed = []
         for tag, store in ((TAG_PREPARE, cache.prepares),
                            (TAG_COMMIT, cache.commits)):
@@ -200,6 +204,10 @@ class PBFTEngine(Worker):
             self.front.broadcast(ModuleID.PBFT, pp.encode())
         for vote in replayed:
             self.front.broadcast(ModuleID.PBFT, vote.encode())
+        if self.index not in cache.prepares:
+            # crashed between persisting the proposal and the prepare vote —
+            # the node provably never voted, so cast it now
+            self._vote_prepare(number, pp.proposal_hash)
         req = self._signed(make_packet(PacketType.RECOVER_REQ, self.view,
                                        number, self.index))
         self.front.broadcast(ModuleID.PBFT, req.encode())
@@ -429,14 +437,21 @@ class PBFTEngine(Worker):
         if self.log is None or cache.preprepare_msg is None:
             return
         block = cache.proposal
-        if block is not None and not block.transactions and block.tx_hashes:
+        if block is None:
+            return
+        if not block.transactions and block.tx_hashes:
             txs = self.txpool.fill_block(block.tx_hashes)
-            if txs is not None:
-                block = Block(header=block.header,
-                              transactions=txs,
-                              tx_hashes=list(block.tx_hashes))
+            if txs is None:
+                # cannot materialise the txs (e.g. a carried metadata-only
+                # proposal with gossip still in flight): persisting a
+                # non-executable block would wedge replay — skip instead
+                LOG.warning(badge("PBFT", "persist-unfillable",
+                                  number=number))
+                return
+            block = Block(header=block.header, transactions=txs,
+                          tx_hashes=list(block.tx_hashes))
         self.log.save_proposal(number, cache.preprepare_msg.encode(),
-                               block.encode() if block is not None else b"")
+                               block.encode())
 
     def _vote_prepare(self, number: int, phash: bytes) -> None:
         cache = self._cache(number)

@@ -29,8 +29,71 @@ from .precompiled import (
     Precompile,
     PrecompileError,
 )
+from .wasm import WasmEngine, is_wasm
 
 TX_GAS = 21_000  # flat per-tx gas for precompile calls (EVM meters its own)
+WASM_GAS_LIMIT = 2_000_000  # per-call interpreter budget (instruction units)
+
+
+class WasmHostContext:
+    """Contract I/O bridge the interpreter's env imports resolve against
+    (the reference's BCOS host interface for liquid contracts: input,
+    output, storage, caller, revert, events)."""
+
+    TABLE = "s_wasm"
+
+    def __init__(self, state, suite, address: bytes, sender: bytes,
+                 input_data: bytes):
+        self.state = state
+        self.suite = suite
+        self.address = address
+        self.sender = sender
+        self.input = input_data
+        self.output = b""
+        self.logs: list[bytes] = []
+        self.inst = None
+
+    def bind(self, inst, args: bytes) -> None:
+        self.inst = inst
+        self.input = args
+
+    def _key(self, k: bytes) -> bytes:
+        return self.address + b"/" + k
+
+    def funcs(self) -> dict:
+        from .wasm_interp import WasmRevertError
+
+        def revert(inst, ptr, ln):
+            raise WasmRevertError(inst.mem_read(ptr, ln))
+
+        def storage_read(inst, kptr, klen, vptr, vcap):
+            v = self.state.get(self.TABLE,
+                               self._key(inst.mem_read(kptr, klen)))
+            if v is None:
+                return -1
+            inst.mem_write(vptr, v[:vcap])
+            return len(v)
+
+        def storage_write(inst, kptr, klen, vptr, vlen):
+            self.state.set(self.TABLE, self._key(inst.mem_read(kptr, klen)),
+                           inst.mem_read(vptr, vlen))
+
+        return {
+            "input_size": lambda inst: len(self.input),
+            "input_copy": lambda inst, ptr: inst.mem_write(ptr, self.input),
+            "caller_copy": lambda inst, ptr: inst.mem_write(
+                ptr, self.sender[:20].ljust(20, b"\x00")),
+            "set_output": lambda inst, ptr, ln: self._set_output(
+                inst.mem_read(ptr, ln)),
+            "storage_read": storage_read,
+            "storage_write": storage_write,
+            "revert": revert,
+            "log_event": lambda inst, ptr, ln: self.logs.append(
+                inst.mem_read(ptr, ln)),
+        }
+
+    def _set_output(self, data: bytes) -> None:
+        self.output = data
 
 
 class TransactionExecutor:
@@ -47,11 +110,19 @@ class TransactionExecutor:
         sender = tx.sender(self.suite) or b""
         sp = state.savepoint()
         try:
+            code = (b"" if tx.to == b"" or tx.to in self.registry
+                    else self.evm.get_code(state, tx.to))
             if tx.to == b"":
-                rc = self._execute_create(tx, state, sender, block_number,
-                                          timestamp, gas_limit)
-            elif (tx.to not in self.registry
-                  and self.evm.get_code(state, tx.to)):
+                if is_wasm(tx.input):
+                    rc = self._execute_wasm_create(tx, state, sender,
+                                                   block_number)
+                else:
+                    rc = self._execute_create(tx, state, sender, block_number,
+                                              timestamp, gas_limit)
+            elif code and is_wasm(code):
+                rc = self._execute_wasm_call(tx, state, sender, block_number,
+                                             code)
+            elif code:
                 rc = self._execute_evm(tx, state, sender, block_number,
                                        timestamp, gas_limit)
             else:
@@ -108,6 +179,99 @@ class TransactionExecutor:
             else:
                 rc.status = int(TransactionStatus.EXECUTION_ABORTED)
             rc.message = res.error
+        return rc
+
+    # -- WASM ("liquid") contracts -----------------------------------------
+    def _execute_wasm_create(self, tx, state, sender, block_number
+                             ) -> Receipt:
+        """Deploy: tx.input is the module bytes; run exported `deploy` if
+        present (the liquid constructor)."""
+        from .wasm_interp import (
+            Instance,
+            Module,
+            WasmOutOfGas,
+            WasmRevertError,
+            WasmTrap,
+        )
+
+        addr = self.suite.hash(sender + tx.nonce.encode() + b"\x00wasm")[12:]
+        rc = Receipt(block_number=block_number, gas_used=TX_GAS)
+        sp = state.savepoint()
+        try:
+            if not WasmEngine.available():
+                raise PrecompileError(
+                    "wasm execution disabled (WITH_WASM=OFF analogue)",
+                    TransactionStatus.EXECUTION_ABORTED)
+            m = Module(tx.input)  # one parse: validates structure
+            state.set(self.T_CODE, addr, tx.input)
+            host = WasmHostContext(state, self.suite, addr, sender, b"")
+            inst = Instance(m, host.funcs(), WASM_GAS_LIMIT)
+            host.bind(inst, b"")
+            if "deploy" in m.exports:  # the liquid constructor
+                inst.invoke("deploy", [])
+            rc.gas_used += WASM_GAS_LIMIT - inst.gas
+            rc.contract_address = addr
+            rc.logs = [(addr, [], blob) for blob in host.logs]
+            if tx.abi:
+                state.set(self.T_ABI, addr, tx.abi.encode())
+            state.release(sp)
+        except PrecompileError as exc:
+            state.rollback_to(sp)
+            rc.status = int(exc.status)
+            rc.message = str(exc)
+        except WasmOutOfGas:
+            state.rollback_to(sp)
+            rc.status = int(TransactionStatus.OUT_OF_GAS)
+            rc.gas_used += WASM_GAS_LIMIT
+            rc.message = "wasm deploy out of gas"
+        except WasmRevertError as exc:
+            state.rollback_to(sp)
+            rc.status = int(TransactionStatus.REVERT)
+            rc.output = exc.data
+            rc.gas_used += WASM_GAS_LIMIT - getattr(exc, "gas_left", 0)
+            rc.message = "wasm deploy reverted"
+        except (WasmTrap, ValueError) as exc:
+            state.rollback_to(sp)
+            rc.status = int(TransactionStatus.EXECUTION_ABORTED)
+            rc.gas_used += WASM_GAS_LIMIT - getattr(exc, "gas_left", 0)
+            rc.message = str(exc)
+        return rc
+
+    def _execute_wasm_call(self, tx, state, sender, block_number, code
+                           ) -> Receipt:
+        """Call: tx.input = SCALE(method-name string) ++ raw arg bytes."""
+        from ..codec import scale
+        from .wasm_interp import WasmOutOfGas, WasmRevertError, WasmTrap
+
+        rc = Receipt(block_number=block_number, gas_used=TX_GAS)
+        sp = state.savepoint()
+        try:
+            d = scale.Decoder(tx.input)
+            func = d.string()
+            args = d._take(d.remaining())
+            host = WasmHostContext(state, self.suite, tx.to, sender, args)
+            out, gas_left = WasmEngine().execute(code, func, args,
+                                                 WASM_GAS_LIMIT, host=host)
+            rc.output = out
+            rc.gas_used += WASM_GAS_LIMIT - gas_left
+            rc.logs = [(tx.to, [], blob) for blob in host.logs]
+            state.release(sp)
+        except WasmOutOfGas:
+            state.rollback_to(sp)
+            rc.status = int(TransactionStatus.OUT_OF_GAS)
+            rc.gas_used += WASM_GAS_LIMIT
+            rc.message = "wasm out of gas"
+        except WasmRevertError as exc:
+            state.rollback_to(sp)
+            rc.status = int(TransactionStatus.REVERT)
+            rc.output = exc.data
+            rc.gas_used += WASM_GAS_LIMIT - getattr(exc, "gas_left", 0)
+            rc.message = "wasm revert"
+        except (WasmTrap, ValueError, scale.ScaleError) as exc:
+            state.rollback_to(sp)
+            rc.status = int(TransactionStatus.EXECUTION_ABORTED)
+            rc.gas_used += WASM_GAS_LIMIT - getattr(exc, "gas_left", 0)
+            rc.message = f"wasm trap: {exc}"
         return rc
 
     def _execute_precompile(self, tx, state, sender, block_number, timestamp,

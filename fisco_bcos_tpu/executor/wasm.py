@@ -9,11 +9,12 @@ toolchain, bcos-codec/scale/).
 This module is the same seam: a `WasmEngine` interface the executor
 dispatches to for WASM-attribute transactions, parameter marshalling via
 the framework's SCALE codec, and `GasMeteredModule` — the gas-injection
-pass over a parsed module's instruction stream. The bundled engine handles
-validation/metering bookkeeping; actual bytecode execution requires a
-runtime backend (`set_backend`): none is bundled in this build, so
-execution raises `WasmUnavailable` with a clear gate message, exactly like
-a reference build compiled without WITH_WASM.
+pass over a parsed module's instruction stream. Execution runs on a
+pluggable backend (`set_backend`); the default is the in-tree structured
+stack-machine interpreter (`wasm_interp`), which charges the metering
+plan's per-opcode costs as it runs. Setting the backend to None gates
+execution off (`WasmUnavailable`), like a reference build compiled without
+WITH_WASM.
 """
 
 from __future__ import annotations
@@ -28,9 +29,9 @@ WASM_MAGIC = b"\x00asm"
 class WasmUnavailable(RuntimeError):
     def __init__(self):
         super().__init__(
-            "WASM execution requires a runtime backend (build gated like "
-            "the reference's WITH_WASM=OFF); register one via "
-            "WasmEngine.set_backend")
+            "WASM execution disabled (backend set to None — the "
+            "reference's WITH_WASM=OFF); restore one via "
+            "WasmEngine.set_backend / use_interpreter")
 
 
 def is_wasm(code: bytes) -> bool:
@@ -162,8 +163,31 @@ class GasMeteredModule:
         return sum(c for _, c in self.blocks)
 
 
-# backend: callable(code, func, args_scale, gas) -> (output_scale, gas_left)
-_BACKEND: Optional[Callable] = None
+def _bundled_backend(code: bytes, func: str, args: bytes, gas: int,
+                     module=None, host=None) -> tuple[bytes, int]:
+    """Default runtime: the in-tree interpreter (wasm_interp). `host` is a
+    WasmHostContext-like object exposing funcs() (env imports) and
+    bind(instance, args) / output for contract I/O. Failure exceptions get
+    a `gas_left` attribute so receipts can charge the gas actually burned."""
+    from .wasm_interp import Instance, Module, WasmTrap, WasmRevertError
+
+    inst = Instance(Module(code), (host.funcs() if host else {}), gas)
+    if host is not None:
+        host.bind(inst, args)
+    try:
+        results = inst.invoke(func, [])
+    except (WasmTrap, WasmRevertError) as exc:
+        exc.gas_left = inst.gas
+        raise
+    if host is not None:
+        out = host.output
+    else:
+        out = b"".join(int(r).to_bytes(8, "little") for r in results)
+    return out, inst.gas
+
+
+# backend: callable(code, func, args, gas, module[, host]) -> (out, gas_left)
+_BACKEND: Optional[Callable] = _bundled_backend
 
 
 class WasmEngine:
@@ -175,17 +199,28 @@ class WasmEngine:
         _BACKEND = backend
 
     @staticmethod
+    def use_interpreter() -> None:
+        """Restore the default in-tree interpreter backend."""
+        global _BACKEND
+        _BACKEND = _bundled_backend
+
+    @staticmethod
     def available() -> bool:
         return _BACKEND is not None
 
-    def execute(self, code: bytes, func: str, args: bytes, gas: int
-                ) -> tuple[bytes, int]:
+    def execute(self, code: bytes, func: str, args: bytes, gas: int,
+                host=None) -> tuple[bytes, int]:
         """args/return are SCALE-encoded (codec.scale), as the reference's
         liquid contracts expect."""
-        module = GasMeteredModule(code)  # validates + builds the gas plan
         if _BACKEND is None:
             raise WasmUnavailable()
-        return _BACKEND(code, func, args, gas, module)
+        # the bundled interpreter validates in Module() and meters itself;
+        # only external backends consume the injection-style gas plan
+        module = (None if _BACKEND is _bundled_backend
+                  else GasMeteredModule(code))
+        if host is None:
+            return _BACKEND(code, func, args, gas, module)
+        return _BACKEND(code, func, args, gas, module, host=host)
 
     @staticmethod
     def encode_args(builder) -> bytes:

@@ -1,0 +1,361 @@
+"""WASM interpreter tests: VM semantics + executor-level contract flow.
+
+Modules are hand-assembled (no toolchain in the image); the `_Asm` helper
+builds the binary sections. Covers: arithmetic/control flow/memory/tables,
+deterministic traps, per-instruction gas with out-of-gas revert, and the
+deploy + call + storage + revert contract path through TransactionExecutor
+(reference: bcos-executor WASM path with GasInjector metering,
+/root/reference/bcos-executor/src/vm/gas_meter/GasInjector.cpp).
+"""
+
+import pytest
+
+from fisco_bcos_tpu.executor.wasm import WasmEngine, is_wasm
+from fisco_bcos_tpu.executor.wasm_interp import (
+    Instance,
+    Module,
+    WasmOutOfGas,
+    WasmTrap,
+)
+
+I32, I64 = 0x7F, 0x7E
+
+
+def leb(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def sleb(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        done = (v == 0 and not b & 0x40) or (v == -1 and b & 0x40)
+        out += bytes([b | (0 if done else 0x80)])
+        if done:
+            return out
+
+
+class _Asm:
+    """Minimal wasm module builder."""
+
+    def __init__(self):
+        self.types: list[tuple[list[int], list[int]]] = []
+        self.imports: list[tuple[str, str, int]] = []
+        self.funcs: list[tuple[int, list[int], bytes]] = []  # (type, locals, body)
+        self.mem_pages = 0
+        self.exports: list[tuple[str, int, int]] = []
+        self.datas: list[tuple[int, bytes]] = []
+        self.table_elems: list[int] | None = None
+
+    def typ(self, params, results) -> int:
+        key = (list(params), list(results))
+        for i, t in enumerate(self.types):
+            if t == key:
+                return i
+        self.types.append(key)
+        return len(self.types) - 1
+
+    def imp(self, name, params, results) -> int:
+        self.imports.append(("env", name, self.typ(params, results)))
+        return len(self.imports) - 1
+
+    def func(self, params, results, body, locals_=()) -> int:
+        self.funcs.append((self.typ(params, results), list(locals_), body))
+        return len(self.imports) + len(self.funcs) - 1
+
+    def build(self) -> bytes:
+        def vec(items):
+            return leb(len(items)) + b"".join(items)
+
+        def section(sid, payload):
+            return bytes([sid]) + leb(len(payload)) + payload
+
+        out = b"\x00asm\x01\x00\x00\x00"
+        out += section(1, vec([
+            b"\x60" + vec([bytes([p]) for p in ps])
+            + vec([bytes([r]) for r in rs]) for ps, rs in self.types]))
+        if self.imports:
+            out += section(2, vec([
+                leb(len(m)) + m.encode() + leb(len(n)) + n.encode()
+                + b"\x00" + leb(t) for m, n, t in self.imports]))
+        out += section(3, vec([leb(t) for t, _, _ in self.funcs]))
+        if self.table_elems is not None:
+            out += section(4, vec([b"\x70\x00" + leb(len(self.table_elems))]))
+        if self.mem_pages:
+            out += section(5, vec([b"\x00" + leb(self.mem_pages)]))
+        if self.exports:
+            out += section(7, vec([
+                leb(len(n)) + n.encode() + bytes([k]) + leb(i)
+                for n, k, i in self.exports]))
+        if self.table_elems is not None:
+            out += section(9, vec([
+                b"\x00\x41\x00\x0b" + vec([leb(f) for f in self.table_elems])
+            ]))
+        bodies = []
+        for _, locals_, body in self.funcs:
+            ldecl = vec([leb(1) + bytes([t]) for t in locals_])
+            b = ldecl + body
+            bodies.append(leb(len(b)) + b)
+        out += section(10, vec(bodies))
+        if self.datas:
+            out += section(11, vec([
+                b"\x00\x41" + sleb(off) + b"\x0b" + leb(len(blob)) + blob
+                for off, blob in self.datas]))
+        return out
+
+
+def c32(v):
+    return b"\x41" + sleb(v)
+
+
+def c64(v):
+    return b"\x42" + sleb(v)
+
+
+# ---------------------------------------------------------------------------
+# pure VM semantics
+# ---------------------------------------------------------------------------
+
+def test_arithmetic_and_calls():
+    a = _Asm()
+    add = a.func([I32, I32], [I32],
+                 b"\x20\x00\x20\x01\x6a\x0b")  # local0 + local1
+    a.func([I32], [I32],  # double(x) = add(x, x)
+           b"\x20\x00\x20\x00\x10" + leb(add) + b"\x0b")
+    a.exports = [("add", 0, 0), ("double", 0, 1)]
+    inst = Instance(Module(a.build()), gas=10_000)
+    assert inst.invoke("add", [5, 7]) == [12]
+    assert inst.invoke("add", [0xFFFFFFFF, 1]) == [0]  # i32 wraps
+    assert inst.invoke("double", [21]) == [42]
+
+
+def test_control_flow_loop_sum():
+    # sum(n) = n + (n-1) + ... + 1 via block/loop/br_if/br
+    body = (b"\x02\x40"  # block
+            b"\x03\x40"  # loop
+            b"\x20\x00\x45\x0d\x01"  # local0 == 0 -> br_if 1 (exit block)
+            b"\x20\x01\x20\x00\x6a\x21\x01"  # acc += n
+            b"\x20\x00" + c32(1) + b"\x6b\x21\x00"  # n -= 1
+            b"\x0c\x00"  # br 0 (continue loop)
+            b"\x0b\x0b"
+            b"\x20\x01\x0b")  # return acc
+    a = _Asm()
+    a.func([I32], [I32], body, locals_=[I32])
+    a.exports = [("sum", 0, 0)]
+    inst = Instance(Module(a.build()), gas=100_000)
+    assert inst.invoke("sum", [10]) == [55]
+    assert inst.invoke("sum", [0]) == [0]
+
+
+def test_if_else_and_select():
+    # max(a,b) via if/else with result type i32
+    body = (b"\x20\x00\x20\x01\x4a"  # a > b (signed)
+            b"\x04\x7f"  # if (result i32)
+            b"\x20\x00\x05\x20\x01\x0b\x0b")
+    a = _Asm()
+    a.func([I32, I32], [I32], body)
+    a.exports = [("max", 0, 0)]
+    inst = Instance(Module(a.build()), gas=10_000)
+    assert inst.invoke("max", [3, 9]) == [9]
+    assert inst.invoke("max", [9, 3]) == [9]
+    assert inst.invoke("max", [0xFFFFFFFF, 1]) == [1]  # -1 < 1 signed
+
+
+def test_br_table_dispatch():
+    # switch(i): 0->10, 1->20, default->99
+    body = (b"\x02\x40\x02\x40\x02\x40"
+            b"\x20\x00\x0e\x02\x00\x01\x02"  # br_table [0 1] 2
+            b"\x0b" + c32(10) + b"\x0f"  # case 0: return 10
+            b"\x0b" + c32(20) + b"\x0f"  # case 1: return 20
+            b"\x0b" + c32(99) + b"\x0f"  # default
+            + c32(0) + b"\x0b")
+    a = _Asm()
+    a.func([I32], [I32], body)
+    a.exports = [("switch", 0, 0)]
+    inst = Instance(Module(a.build()), gas=10_000)
+    assert inst.invoke("switch", [0]) == [10]
+    assert inst.invoke("switch", [1]) == [20]
+    assert inst.invoke("switch", [7]) == [99]
+
+
+def test_memory_and_i64():
+    # store i64 at [8], load it back doubled
+    body = (c32(8) + c64(0x1122334455667788) + b"\x37\x03\x00"
+            + c32(8) + b"\x29\x03\x00" + c32(8) + b"\x29\x03\x00"
+            + b"\x7c\x0b")
+    a = _Asm()
+    a.mem_pages = 1
+    a.func([], [I64], body)
+    a.exports = [("run", 0, 0)]
+    inst = Instance(Module(a.build()), gas=10_000)
+    assert inst.invoke("run") == [(2 * 0x1122334455667788) & ((1 << 64) - 1)]
+
+
+def test_call_indirect_through_table():
+    a = _Asm()
+    f10 = a.func([], [I32], c32(10) + b"\x0b")
+    f20 = a.func([], [I32], c32(20) + b"\x0b")
+    t = a.typ([], [I32])
+    a.func([I32], [I32],
+           b"\x20\x00\x11" + leb(t) + b"\x00\x0b")  # call_indirect
+    a.table_elems = [f10, f20]
+    a.exports = [("pick", 0, 2)]
+    inst = Instance(Module(a.build()), gas=10_000)
+    assert inst.invoke("pick", [0]) == [10]
+    assert inst.invoke("pick", [1]) == [20]
+    with pytest.raises(WasmTrap):
+        inst.invoke("pick", [5])
+
+
+def test_deterministic_traps():
+    a = _Asm()
+    a.func([I32], [I32], b"\x20\x00" + c32(0) + b"\x6d\x0b")  # x / 0 signed
+    a.func([], [], b"\x00\x0b")  # unreachable
+    a.mem_pages = 1
+    a.func([], [I32], c32(0x20000) + b"\x28\x02\x00\x0b")  # OOB load
+    a.exports = [("div", 0, 0), ("boom", 0, 1), ("oob", 0, 2)]
+    inst = Instance(Module(a.build()), gas=10_000)
+    with pytest.raises(WasmTrap, match="divide by zero"):
+        inst.invoke("div", [1])
+    with pytest.raises(WasmTrap, match="unreachable"):
+        inst.invoke("boom")
+    with pytest.raises(WasmTrap, match="out of bounds"):
+        inst.invoke("oob")
+
+
+def test_out_of_gas_stops_infinite_loop():
+    a = _Asm()
+    a.func([], [], b"\x03\x40\x0c\x00\x0b\x0b")  # loop { br 0 }
+    a.exports = [("spin", 0, 0)]
+    inst = Instance(Module(a.build()), gas=5_000)
+    with pytest.raises(WasmOutOfGas):
+        inst.invoke("spin")
+    assert inst.gas == 0
+
+
+def test_gas_charges_match_metering_costs():
+    # 3 default-cost ops + function-call cost structure is deterministic
+    a = _Asm()
+    a.func([], [I32], c32(1) + c32(2) + b"\x6a\x0b")
+    a.exports = [("f", 0, 0)]
+    inst = Instance(Module(a.build()), gas=1_000)
+    inst.invoke("f")
+    assert inst.gas == 1_000 - 4  # const, const, add, end
+
+
+# ---------------------------------------------------------------------------
+# executor-level contract flow
+# ---------------------------------------------------------------------------
+
+def _counter_contract() -> bytes:
+    """Liquid-style counter: `add` reads an 8-byte LE amount from call args,
+    adds it to storage["c"], writes back and returns the new value;
+    `spin` burns gas forever; `fail` reverts with data."""
+    a = _Asm()
+    sread = a.imp("storage_read", [I32, I32, I32, I32], [I32])
+    swrite = a.imp("storage_write", [I32, I32, I32, I32], [])
+    a.imp("input_size", [], [I32])
+    icopy = a.imp("input_copy", [I32], [])
+    soutput = a.imp("set_output", [I32, I32], [])
+    hrevert = a.imp("revert", [I32, I32], [])
+
+    add_body = (
+        c32(16) + b"\x10" + leb(icopy)  # input_copy(16)
+        + c32(0) + c32(1) + c32(32) + c32(8) + b"\x10" + leb(sread)
+        + c32(-1) + b"\x46"  # == -1 ?
+        + b"\x04\x40" + c32(32) + c64(0) + b"\x37\x03\x00" + b"\x0b"
+        + c32(32)  # store target addr
+        + c32(32) + b"\x29\x03\x00"  # load current
+        + c32(16) + b"\x29\x03\x00"  # load amount
+        + b"\x7c" + b"\x37\x03\x00"  # add, store
+        + c32(0) + c32(1) + c32(32) + c32(8) + b"\x10" + leb(swrite)
+        + c32(32) + c32(8) + b"\x10" + leb(soutput)
+        + b"\x0b")
+    a.func([], [], add_body)
+    a.func([], [], b"\x03\x40\x0c\x00\x0b\x0b")  # spin
+    a.func([], [], c32(0) + c32(1) + b"\x10" + leb(hrevert) + b"\x0b")  # fail
+    a.func([], [], b"\x0b")  # deploy (no-op constructor)
+    base = len(a.imports)
+    a.mem_pages = 1
+    a.datas = [(0, b"c")]
+    a.exports = [("add", 0, base), ("spin", 0, base + 1),
+                 ("fail", 0, base + 2), ("deploy", 0, base + 3)]
+    return a.build()
+
+
+def test_wasm_contract_deploy_call_oog_revert():
+    from fisco_bcos_tpu.codec import scale
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor.executor import TransactionExecutor
+    from fisco_bcos_tpu.protocol import Transaction, TransactionStatus
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+    from fisco_bcos_tpu.storage.state import StateStorage
+
+    WasmEngine.use_interpreter()
+    suite = make_suite(backend="host")
+    kp = suite.generate_keypair(b"wasm-user")
+    ex = TransactionExecutor(suite)
+    state = StateStorage(MemoryStorage())
+    code = _counter_contract()
+    assert is_wasm(code)
+
+    deploy = Transaction(to=b"", input=code, nonce="w1",
+                         block_limit=100).sign(suite, kp)
+    rc = ex.execute_transaction(deploy, state, 1, 0)
+    assert rc.status == 0, rc.message
+    addr = rc.contract_address
+    assert addr and len(addr) == 20
+
+    def call(func, args=b"", nonce="w2"):
+        inp = scale.Encoder().string(func).raw(args).bytes()
+        tx = Transaction(to=addr, input=inp, nonce=nonce,
+                         block_limit=100).sign(suite, kp)
+        return ex.execute_transaction(tx, state, 1, 0)
+
+    rc = call("add", (5).to_bytes(8, "little"), "w2")
+    assert rc.status == 0, rc.message
+    assert int.from_bytes(rc.output, "little") == 5
+    rc = call("add", (37).to_bytes(8, "little"), "w3")
+    assert rc.status == 0
+    assert int.from_bytes(rc.output, "little") == 42  # persisted state
+
+    rc = call("spin", b"", "w4")
+    assert rc.status == int(TransactionStatus.OUT_OF_GAS)
+
+    # the failed call must not have clobbered state
+    rc = call("add", (0).to_bytes(8, "little"), "w5")
+    assert int.from_bytes(rc.output, "little") == 42
+
+    rc = call("fail", b"", "w6")
+    assert rc.status == int(TransactionStatus.REVERT)
+    assert rc.output == b"c"  # revert data = memory[0:1] (the key byte)
+
+
+def test_wasm_deploy_gated_when_backend_disabled():
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor.executor import TransactionExecutor
+    from fisco_bcos_tpu.protocol import Transaction, TransactionStatus
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+    from fisco_bcos_tpu.storage.state import StateStorage
+
+    suite = make_suite(backend="host")
+    kp = suite.generate_keypair(b"gate-user")
+    ex = TransactionExecutor(suite)
+    state = StateStorage(MemoryStorage())
+    WasmEngine.set_backend(None)
+    try:
+        tx = Transaction(to=b"", input=_counter_contract(), nonce="g1",
+                         block_limit=100).sign(suite, kp)
+        rc = ex.execute_transaction(tx, state, 1, 0)
+        assert rc.status == int(TransactionStatus.EXECUTION_ABORTED)
+        assert not rc.contract_address
+    finally:
+        WasmEngine.use_interpreter()
