@@ -28,6 +28,12 @@ from .precompiled import (
     CallContext,
     Precompile,
     PrecompileError,
+    account_status,
+    ACCOUNT_NORMAL,
+    check_deploy_auth,
+    check_method_auth,
+    contract_available,
+    record_contract_admin,
 )
 from .wasm import WasmEngine, is_wasm
 
@@ -112,6 +118,10 @@ class TransactionExecutor:
         try:
             code = (b"" if tx.to == b"" or tx.to in self.registry
                     else self.evm.get_code(state, tx.to))
+            rc = self._auth_gate(tx, state, sender, block_number, code)
+            if rc is not None:
+                state.release(sp)
+                return rc
             if tx.to == b"":
                 if is_wasm(tx.input):
                     rc = self._execute_wasm_create(tx, state, sender,
@@ -137,6 +147,47 @@ class TransactionExecutor:
             rc.message = f"internal: {exc}"
             return rc
 
+    def _auth_gate(self, tx, state, sender: bytes,
+                   block_number: int, code: bytes) -> Optional[Receipt]:
+        """Deterministic, state-driven auth checks before any execution
+        (the reference's auth-check path in TransactionExecutive): frozen/
+        abolished sender accounts, the chain deploy ACL, per-contract
+        freeze, and per-method ACLs. Returns a denial receipt or None."""
+        def deny(status, msg):
+            rc = Receipt(block_number=block_number, gas_used=TX_GAS)
+            rc.status = int(status)
+            rc.message = msg
+            return rc
+
+        if account_status(state, sender) != ACCOUNT_NORMAL:
+            return deny(TransactionStatus.ACCOUNT_FROZEN,
+                        "sender account frozen/abolished")
+        if tx.to == b"":
+            if not check_deploy_auth(state, sender):
+                return deny(TransactionStatus.PERMISSION_DENIED,
+                            "deploy denied by chain ACL")
+            return None
+        if tx.to in self.registry:
+            return None  # system precompiles gate themselves
+        if not contract_available(state, tx.to):
+            return deny(TransactionStatus.CONTRACT_FROZEN, "contract frozen")
+        # method selector: EVM = first 4 input bytes; WASM = H(method)[:4]
+        # (wasm call data is SCALE method-name + args, so a raw input prefix
+        # would never match an ACL keyed by method hash)
+        if code and is_wasm(code):
+            from ..codec import scale
+            try:
+                selector = self.suite.hash(
+                    scale.Decoder(tx.input).string().encode())[:4]
+            except Exception:
+                selector = b""  # malformed call data traps in execution
+        else:
+            selector = tx.input[:4]
+        if not check_method_auth(state, tx.to, selector, sender):
+            return deny(TransactionStatus.PERMISSION_DENIED,
+                        "method call denied by contract ACL")
+        return None
+
     def _env(self, sender: bytes, block_number: int, timestamp: int,
              gas_limit: int):
         from .evm import TxEnv
@@ -153,6 +204,7 @@ class TransactionExecutor:
         if res.success:
             rc.contract_address = res.create_address
             rc.logs = res.logs
+            record_contract_admin(state, res.create_address, sender)
             if tx.abi:
                 state.set(self.T_ABI, res.create_address, tx.abi.encode())
         else:
@@ -204,6 +256,7 @@ class TransactionExecutor:
                     TransactionStatus.EXECUTION_ABORTED)
             m = Module(tx.input)  # one parse: validates structure
             state.set(self.T_CODE, addr, tx.input)
+            record_contract_admin(state, addr, sender)
             host = WasmHostContext(state, self.suite, addr, sender, b"")
             inst = Instance(m, host.funcs(), WASM_GAS_LIMIT)
             host.bind(inst, b"")

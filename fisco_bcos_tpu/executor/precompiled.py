@@ -29,12 +29,17 @@ def addr(n: int) -> bytes:
 
 SYS_CONFIG_ADDRESS = addr(0x1000)
 TABLE_ADDRESS = addr(0x1001)
+TABLE_MANAGER_ADDRESS = addr(0x1002)
 CONSENSUS_ADDRESS = addr(0x1003)
 KV_TABLE_ADDRESS = addr(0x1009)
 CRYPTO_ADDRESS = addr(0x100A)
-BFS_ADDRESS = addr(0x100E)
-BALANCE_ADDRESS = addr(0x1011)
 DAG_TRANSFER_ADDRESS = addr(0x100C)  # parallel-transfer benchmark contract
+BFS_ADDRESS = addr(0x100E)
+CAST_ADDRESS = addr(0x100F)
+BALANCE_ADDRESS = addr(0x1011)
+AUTH_MANAGER_ADDRESS = addr(0x10001)  # committee/auth plane (extension/)
+CONTRACT_AUTH_ADDRESS = addr(0x1005)
+ACCOUNT_MANAGER_ADDRESS = addr(0x10003)
 
 
 class PrecompileError(Exception):
@@ -313,12 +318,738 @@ class CryptoPrecompile(Precompile):
         w.u8(1 if ok else 0)
 
 
+# ---------------------------------------------------------------------------
+# BFS — the on-chain filesystem (precompiled/BFSPrecompiled.cpp: list/mkdir/
+# touch/link/readlink over the /apps /tables /sys tree)
+# ---------------------------------------------------------------------------
+
+T_BFS = "s_bfs"
+_BFS_ROOTS = (b"/", b"/apps", b"/tables", b"/sys", b"/usr")
+
+
+class BFSPrecompile(Precompile):
+    name = "bfs"
+
+    def methods(self):
+        return {
+            "mkdir": self._mkdir,
+            "list": self._list,
+            "touch": self._touch,
+            "link": self._link,
+            "readlink": self._readlink,
+        }
+
+    @staticmethod
+    def _norm(path: str) -> bytes:
+        if not path.startswith("/") or "//" in path or path != path.strip():
+            raise PrecompileError(f"invalid bfs path {path!r}")
+        p = path.rstrip("/") or "/"
+        return p.encode()
+
+    @staticmethod
+    def _entry(kind: str, ext: bytes = b"") -> bytes:
+        return Writer().text(kind).blob(ext).bytes()
+
+    def _get_entry(self, ctx, key: bytes):
+        if key in _BFS_ROOTS:
+            return "dir", b""
+        v = ctx.state.get(T_BFS, key)
+        if v is None:
+            return None
+        r = Reader(v)
+        return r.text(), r.blob()
+
+    def _require_parent_dir(self, ctx, key: bytes) -> None:
+        parent = key.rsplit(b"/", 1)[0] or b"/"
+        ent = self._get_entry(ctx, parent)
+        if ent is None or ent[0] != "dir":
+            raise PrecompileError(f"parent not a directory: "
+                                  f"{parent.decode()!r}")
+
+    def _mkdir(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key = self._norm(r.text())
+        self.touch(ctx, b"bfs" + key)
+        # recursive like the reference's makeDirs
+        parts = key.split(b"/")[1:]
+        cur = b""
+        for part in parts:
+            cur += b"/" + part
+            ent = self._get_entry(ctx, cur)
+            if ent is None:
+                ctx.state.set(T_BFS, cur, self._entry("dir"))
+            elif ent[0] != "dir":
+                raise PrecompileError(f"not a directory: {cur.decode()!r}")
+        w.u32(0)
+
+    def _list(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key = self._norm(r.text())
+        ent = self._get_entry(ctx, key)
+        if ent is None:
+            raise PrecompileError("no such path")
+        if ent[0] != "dir":  # a file lists itself
+            w.u32(1)
+            w.text(key.rsplit(b"/", 1)[1].decode()).text(ent[0])
+            return
+        prefix = (key if key != b"/" else b"") + b"/"
+        children = []
+        seen = set()
+        for k in ctx.state.keys(T_BFS, prefix):
+            rest = k[len(prefix):]
+            if not rest or b"/" in rest:
+                continue
+            if rest not in seen:
+                seen.add(rest)
+                children.append((rest, self._get_entry(ctx, k)[0]))
+        if key == b"/":
+            for root in _BFS_ROOTS[1:]:
+                nm = root[1:]
+                if nm not in seen:
+                    children.append((nm, "dir"))
+        w.u32(len(children))
+        for nm, kind in sorted(children):
+            w.text(nm.decode()).text(kind)
+
+    def _touch(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key = self._norm(r.text())
+        kind = r.text() or "contract"
+        self.touch(ctx, b"bfs" + key)
+        if self._get_entry(ctx, key) is not None:
+            raise PrecompileError("path exists")
+        self._require_parent_dir(ctx, key)
+        ctx.state.set(T_BFS, key, self._entry(kind))
+        w.u32(0)
+
+    def _link(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        """link(name, version, contract_address, abi) -> /apps/name/version"""
+        name, version = r.text(), r.text()
+        address, abi = r.blob(), r.blob()
+        key = self._norm(f"/apps/{name}/{version}")
+        self.touch(ctx, b"bfs" + key)
+        parent = key.rsplit(b"/", 1)[0]
+        cur = b""
+        for part in parent.split(b"/")[1:]:
+            cur += b"/" + part
+            if self._get_entry(ctx, cur) is None:
+                ctx.state.set(T_BFS, cur, self._entry("dir"))
+        ctx.state.set(T_BFS, key,
+                      self._entry("link", Writer().blob(address).blob(abi)
+                                  .bytes()))
+        w.u32(0)
+
+    def _readlink(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key = self._norm(r.text())
+        ent = self._get_entry(ctx, key)
+        if ent is None or ent[0] != "link":
+            raise PrecompileError("not a link")
+        rr = Reader(ent[1])
+        w.blob(rr.blob())  # contract address
+
+
+# ---------------------------------------------------------------------------
+# TableManager + structured Table (TableManagerPrecompiled.cpp +
+# TablePrecompiled.cpp: schema'd tables, key column + value columns, row ops
+# and bounded condition scans)
+# ---------------------------------------------------------------------------
+
+_SCHEMA_KEY = b"\x00__schema__"
+# condition comparators (TablePrecompiled.cpp Condition ops)
+_COND_OPS = {0: "eq", 1: "ne", 2: "gt", 3: "ge", 4: "lt", 5: "le"}
+
+
+def _cond_match(conds: list[tuple[int, str]], key: str) -> bool:
+    """Evaluate (op, value)[] conditions over the key column."""
+    for op, val in conds:
+        name = _COND_OPS.get(op)
+        if name is None:
+            raise PrecompileError(f"bad condition op {op}")
+        if not ((name == "eq" and key == val)
+                or (name == "ne" and key != val)
+                or (name == "gt" and key > val)
+                or (name == "ge" and key >= val)
+                or (name == "lt" and key < val)
+                or (name == "le" and key <= val)):
+            return False
+    return True
+
+
+class TableManagerPrecompile(Precompile):
+    name = "table_manager"
+
+    def methods(self):
+        return {
+            "createTable": self._create,
+            "createKVTable": self._create_kv,
+            "appendColumns": self._append,
+            "desc": self._desc,
+            "openTable": self._open,
+        }
+
+    @staticmethod
+    def _table(name: str) -> str:
+        return T_USER_PREFIX + name.strip("/")
+
+    def _create(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        """createTable(path, key_col, value_cols[])"""
+        table = self._table(r.text())
+        key_col = r.text()
+        cols = r.seq(lambda rr: rr.text())
+        self.touch(ctx, table.encode())
+        if ctx.state.get(table, _SCHEMA_KEY) is not None or \
+                ctx.state.get(table, b"\x00__meta__") is not None:
+            raise PrecompileError("table exists")
+        if not key_col or len(set(cols)) != len(cols):
+            raise PrecompileError("bad schema")
+        ctx.state.set(table, _SCHEMA_KEY,
+                      Writer().text(key_col).seq(
+                          cols, lambda ww, c: ww.text(c)).bytes())
+        w.u32(0)
+
+    def _create_kv(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        _key_col, _val_col = r.text(), r.text()
+        self.touch(ctx, table.encode())
+        if ctx.state.get(table, b"\x00__meta__") is not None or \
+                ctx.state.get(table, _SCHEMA_KEY) is not None:
+            raise PrecompileError("table exists")
+        ctx.state.set(table, b"\x00__meta__", b"kv")
+        w.u32(0)
+
+    def _schema(self, ctx, table: str) -> tuple[str, list[str]]:
+        v = ctx.state.get(table, _SCHEMA_KEY)
+        if v is None:
+            raise PrecompileError("no such table")
+        r = Reader(v)
+        return r.text(), r.seq(lambda rr: rr.text())
+
+    def _append(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        new_cols = r.seq(lambda rr: rr.text())
+        key_col, cols = self._schema(ctx, table)
+        if set(new_cols) & set(cols):
+            raise PrecompileError("column exists")
+        self.touch(ctx, table.encode())
+        cols = cols + new_cols
+        ctx.state.set(table, _SCHEMA_KEY,
+                      Writer().text(key_col).seq(
+                          cols, lambda ww, c: ww.text(c)).bytes())
+        w.u32(0)
+
+    def _desc(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        key_col, cols = self._schema(ctx, self._table(r.text()))
+        w.text(key_col)
+        w.seq(cols, lambda ww, c: ww.text(c))
+
+    def _open(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        exists = (ctx.state.get(table, _SCHEMA_KEY) is not None
+                  or ctx.state.get(table, b"\x00__meta__") is not None)
+        w.u8(1 if exists else 0)
+
+
+class TablePrecompile(TableManagerPrecompile):
+    """Row operations on schema'd tables (TablePrecompiled.cpp). Routed via
+    an explicit table-name argument instead of per-table proxy addresses."""
+
+    name = "table"
+
+    def methods(self):
+        return {
+            "insert": self._insert,
+            "select": self._select,
+            "selectByCondition": self._select_cond,
+            "count": self._count,
+            "update": self._update,
+            "remove": self._remove,
+        }
+
+    def _row_key(self, key: str) -> bytes:
+        return b"\x01" + key.encode()
+
+    def _insert(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        key = r.text()
+        values = r.seq(lambda rr: rr.text())
+        _kc, cols = self._schema(ctx, table)
+        if len(values) != len(cols):
+            raise PrecompileError("column count mismatch")
+        rk = self._row_key(key)
+        self.touch(ctx, table.encode() + rk)
+        if ctx.state.get(table, rk) is not None:
+            raise PrecompileError("row exists")
+        ctx.state.set(table, rk,
+                      Writer().seq(values, lambda ww, v: ww.text(v)).bytes())
+        w.u32(1)  # affected rows
+
+    def _read_row(self, ctx, table, key: str):
+        v = ctx.state.get(table, self._row_key(key))
+        if v is None:
+            return None
+        return Reader(v).seq(lambda rr: rr.text())
+
+    def _select(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        row = self._read_row(ctx, table, r.text())
+        if row is None:
+            w.u8(0)
+            return
+        w.u8(1)
+        w.seq(row, lambda ww, v: ww.text(v))
+
+    def _iter_cond(self, ctx, r: Reader):
+        """Parse (op, value)[] over the KEY column + (offset, count) limit;
+        yield (key, row) matches in key order — bounded scan."""
+        table = self._table(r.text())
+        conds = r.seq(lambda rr: (rr.u8(), rr.text()))
+        offset, count = r.u32(), r.u32()
+        if count > 500:  # the reference's USER_TABLE_MAX_LIMIT_COUNT
+            raise PrecompileError("limit count > 500")
+        self._schema(ctx, table)  # must exist
+        out = []
+        skipped = 0
+        if count == 0:
+            return out
+        for k in ctx.state.keys(table, b"\x01"):
+            key = k[1:].decode()
+            if not _cond_match(conds, key):
+                continue
+            if skipped < offset:
+                skipped += 1
+                continue
+            out.append((key, Reader(ctx.state.get(table, k))
+                        .seq(lambda rr: rr.text())))
+            if len(out) >= count:
+                break
+        return out
+
+    def _select_cond(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        rows = self._iter_cond(ctx, r)
+        w.u32(len(rows))
+        for key, row in rows:
+            w.text(key)
+            w.seq(row, lambda ww, v: ww.text(v))
+
+    def _count(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        conds = r.seq(lambda rr: (rr.u8(), rr.text()))
+        self._schema(ctx, table)
+        n = sum(1 for k in ctx.state.keys(table, b"\x01")
+                if _cond_match(conds, k[1:].decode()))
+        w.u32(n)
+
+    def _update(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        key = r.text()
+        updates = r.seq(lambda rr: (rr.text(), rr.text()))
+        _kc, cols = self._schema(ctx, table)
+        row = self._read_row(ctx, table, key)
+        if row is None:
+            w.u32(0)
+            return
+        idx = {c: i for i, c in enumerate(cols)}
+        for col, val in updates:
+            if col not in idx:
+                raise PrecompileError(f"no column {col!r}")
+            row[idx[col]] = val
+        rk = self._row_key(key)
+        self.touch(ctx, table.encode() + rk)
+        ctx.state.set(table, rk,
+                      Writer().seq(row, lambda ww, v: ww.text(v)).bytes())
+        w.u32(1)
+
+    def _remove(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        table = self._table(r.text())
+        key = r.text()
+        self._schema(ctx, table)
+        rk = self._row_key(key)
+        self.touch(ctx, table.encode() + rk)
+        if ctx.state.get(table, rk) is None:
+            w.u32(0)
+            return
+        ctx.state.remove(table, rk)
+        w.u32(1)
+
+
+# ---------------------------------------------------------------------------
+# Auth plane (extension/AuthManagerPrecompiled.cpp + ContractAuthMgr
+# Precompiled.cpp): per-contract admin, method ACLs, contract freeze, and
+# chain-wide deploy ACL. All state-driven, so enforcement is deterministic
+# across nodes with no config flag.
+# ---------------------------------------------------------------------------
+
+T_AUTH = "c_auth"
+AUTH_WHITE = 1
+AUTH_BLACK = 2
+_K_DEPLOY_TYPE = b"\x00deploy_type"
+
+
+def _auth_admin_key(address: bytes) -> bytes:
+    return b"adm/" + address
+
+
+def _auth_method_key(address: bytes, selector: bytes) -> bytes:
+    return b"mth/" + address + b"/" + selector[:4]
+
+
+def _auth_status_key(address: bytes) -> bytes:
+    return b"sts/" + address
+
+
+def _deploy_acl_key(account: bytes) -> bytes:
+    return b"dpl/" + account
+
+
+def check_method_auth(state, address: bytes, selector: bytes,
+                      account: bytes) -> bool:
+    """Enforcement hook the executor calls before contract calls."""
+    admin = state.get(T_AUTH, _auth_admin_key(address))
+    if admin == account:
+        return True
+    v = state.get(T_AUTH, _auth_method_key(address, selector))
+    if v is None:
+        return True
+    r = Reader(v)
+    auth_type = r.u8()
+    acl = set(r.seq(lambda rr: rr.blob()))
+    if auth_type == AUTH_WHITE:
+        return account in acl
+    if auth_type == AUTH_BLACK:
+        return account not in acl
+    return True
+
+
+def contract_available(state, address: bytes) -> bool:
+    v = state.get(T_AUTH, _auth_status_key(address))
+    return v is None or v == b"\x00"
+
+
+def check_deploy_auth(state, account: bytes) -> bool:
+    t = state.get(T_AUTH, _K_DEPLOY_TYPE)
+    if t is None or t == b"\x00":
+        return True
+    listed = state.get(T_AUTH, _deploy_acl_key(account)) is not None
+    return listed if t == bytes([AUTH_WHITE]) else not listed
+
+
+def record_contract_admin(state, address: bytes, admin: bytes) -> None:
+    state.set(T_AUTH, _auth_admin_key(address), admin)
+
+
+class ContractAuthPrecompile(Precompile):
+    """Per-contract auth management; admin-only mutations."""
+
+    name = "contract_auth"
+
+    def methods(self):
+        return {
+            "getAdmin": self._get_admin,
+            "resetAdmin": self._reset_admin,
+            "setMethodAuthType": self._set_type,
+            "openMethodAuth": self._open,
+            "closeMethodAuth": self._close,
+            "checkMethodAuth": self._check,
+            "setContractStatus": self._set_status,
+            "contractAvailable": self._available,
+        }
+
+    def _require_admin(self, ctx: CallContext, address: bytes) -> None:
+        admin = ctx.state.get(T_AUTH, _auth_admin_key(address))
+        if admin is None:
+            raise PrecompileError("contract has no admin record")
+        if admin != ctx.sender:
+            raise PrecompileError("sender is not the contract admin",
+                                  TransactionStatus.PERMISSION_DENIED)
+
+    def _get_admin(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        w.blob(ctx.state.get(T_AUTH, _auth_admin_key(r.blob())) or b"")
+
+    def _reset_admin(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        address, new_admin = r.blob(), r.blob()
+        self._require_admin(ctx, address)
+        self.touch(ctx, b"auth/" + address)
+        ctx.state.set(T_AUTH, _auth_admin_key(address), new_admin)
+        w.u32(0)
+
+    def _acl(self, ctx, address, selector) -> tuple[int, list[bytes]]:
+        v = ctx.state.get(T_AUTH, _auth_method_key(address, selector))
+        if v is None:
+            return 0, []
+        r = Reader(v)
+        return r.u8(), r.seq(lambda rr: rr.blob())
+
+    def _write_acl(self, ctx, address, selector, auth_type, acl) -> None:
+        ctx.state.set(T_AUTH, _auth_method_key(address, selector),
+                      Writer().u8(auth_type).seq(
+                          acl, lambda ww, a: ww.blob(a)).bytes())
+
+    def _set_type(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        address, selector, auth_type = r.blob(), r.blob(), r.u8()
+        if auth_type not in (AUTH_WHITE, AUTH_BLACK):
+            raise PrecompileError("auth type must be 1 (white) or 2 (black)")
+        self._require_admin(ctx, address)
+        self.touch(ctx, b"auth/" + address)
+        self._write_acl(ctx, address, selector, auth_type, [])
+        w.u32(0)
+
+    def _open(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        """whitelist: add account; blacklist: remove account."""
+        address, selector, account = r.blob(), r.blob(), r.blob()
+        self._require_admin(ctx, address)
+        auth_type, acl = self._acl(ctx, address, selector)
+        if auth_type == 0:
+            raise PrecompileError("set auth type first")
+        self.touch(ctx, b"auth/" + address)
+        if auth_type == AUTH_WHITE and account not in acl:
+            acl.append(account)
+        elif auth_type == AUTH_BLACK and account in acl:
+            acl.remove(account)
+        self._write_acl(ctx, address, selector, auth_type, acl)
+        w.u32(0)
+
+    def _close(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        address, selector, account = r.blob(), r.blob(), r.blob()
+        self._require_admin(ctx, address)
+        auth_type, acl = self._acl(ctx, address, selector)
+        if auth_type == 0:
+            raise PrecompileError("set auth type first")
+        self.touch(ctx, b"auth/" + address)
+        if auth_type == AUTH_WHITE and account in acl:
+            acl.remove(account)
+        elif auth_type == AUTH_BLACK and account not in acl:
+            acl.append(account)
+        self._write_acl(ctx, address, selector, auth_type, acl)
+        w.u32(0)
+
+    def _check(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        address, selector, account = r.blob(), r.blob(), r.blob()
+        w.u8(1 if check_method_auth(ctx.state, address, selector, account)
+             else 0)
+
+    def _set_status(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        address, frozen = r.blob(), r.u8()
+        self._require_admin(ctx, address)
+        self.touch(ctx, b"auth/" + address)
+        ctx.state.set(T_AUTH, _auth_status_key(address), bytes([frozen]))
+        w.u32(0)
+
+    def _available(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        w.u8(1 if contract_available(ctx.state, r.blob()) else 0)
+
+
+class AuthManagerPrecompile(ContractAuthPrecompile):
+    """Chain-wide deploy ACL on top of the contract-auth surface.
+
+    The reference routes these through a governance committee contract; the
+    committee seam here is 'the governors table': accounts in it may change
+    the deploy policy. Bootstrap: the FIRST setDeployAuthType caller becomes
+    a governor (mirrors committee initialisation at genesis deploy)."""
+
+    name = "auth_manager"
+    _K_GOV = b"gov/"
+
+    def methods(self):
+        m = dict(super().methods())
+        m.update({
+            "deployType": self._deploy_type,
+            "setDeployAuthType": self._set_deploy_type,
+            "openDeployAuth": self._open_deploy,
+            "closeDeployAuth": self._close_deploy,
+            "hasDeployAuth": self._has_deploy,
+            "addGovernor": self._add_governor,
+        })
+        return m
+
+    def _is_governor(self, ctx) -> bool:
+        return ctx.state.get(T_AUTH, self._K_GOV + ctx.sender) is not None
+
+    def _any_governor(self, ctx) -> bool:
+        return next(iter(ctx.state.keys(T_AUTH, self._K_GOV)), None) is not None
+
+    def _require_governor(self, ctx) -> None:
+        if self._any_governor(ctx) and not self._is_governor(ctx):
+            raise PrecompileError("sender is not a governor",
+                                  TransactionStatus.PERMISSION_DENIED)
+
+    def _bootstrap_governor(self, ctx) -> None:
+        if not self._any_governor(ctx):
+            ctx.state.set(T_AUTH, self._K_GOV + ctx.sender, b"\x01")
+
+    def _add_governor(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        account = r.blob()
+        self._require_governor(ctx)
+        self._bootstrap_governor(ctx)
+        self.touch(ctx, b"auth/gov")
+        ctx.state.set(T_AUTH, self._K_GOV + account, b"\x01")
+        w.u32(0)
+
+    def _deploy_type(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        v = ctx.state.get(T_AUTH, _K_DEPLOY_TYPE)
+        w.u8(v[0] if v else 0)
+
+    def _set_deploy_type(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        t = r.u8()
+        if t not in (0, AUTH_WHITE, AUTH_BLACK):
+            raise PrecompileError("deploy type must be 0/1/2")
+        self._require_governor(ctx)
+        self._bootstrap_governor(ctx)
+        self.touch(ctx, b"auth/deploy")
+        ctx.state.set(T_AUTH, _K_DEPLOY_TYPE, bytes([t]))
+        w.u32(0)
+
+    def _deploy_policy(self, ctx) -> int:
+        v = ctx.state.get(T_AUTH, _K_DEPLOY_TYPE)
+        return v[0] if v else 0
+
+    def _open_deploy(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        """GRANT deploy rights: whitelist -> list; blacklist -> unlist."""
+        account = r.blob()
+        self._require_governor(ctx)
+        self.touch(ctx, b"auth/deploy")
+        if self._deploy_policy(ctx) == AUTH_BLACK:
+            ctx.state.remove(T_AUTH, _deploy_acl_key(account))
+        else:
+            ctx.state.set(T_AUTH, _deploy_acl_key(account), b"\x01")
+        w.u32(0)
+
+    def _close_deploy(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        """REVOKE deploy rights: whitelist -> unlist; blacklist -> list."""
+        account = r.blob()
+        self._require_governor(ctx)
+        self.touch(ctx, b"auth/deploy")
+        if self._deploy_policy(ctx) == AUTH_BLACK:
+            ctx.state.set(T_AUTH, _deploy_acl_key(account), b"\x01")
+        else:
+            ctx.state.remove(T_AUTH, _deploy_acl_key(account))
+        w.u32(0)
+
+    def _has_deploy(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        w.u8(1 if check_deploy_auth(ctx.state, r.blob()) else 0)
+
+
+# ---------------------------------------------------------------------------
+# Account manager (extension/AccountManagerPrecompiled.cpp +
+# AccountPrecompiled.cpp: freeze/unfreeze/abolish externally-owned accounts)
+# ---------------------------------------------------------------------------
+
+T_ACCOUNT = "c_account"
+ACCOUNT_NORMAL, ACCOUNT_FROZEN, ACCOUNT_ABOLISHED = 0, 1, 2
+
+
+def account_status(state, account: bytes) -> int:
+    v = state.get(T_ACCOUNT, account)
+    return v[0] if v else ACCOUNT_NORMAL
+
+
+class AccountManagerPrecompile(Precompile):
+    name = "account_manager"
+
+    def methods(self):
+        return {
+            "setAccountStatus": self._set,
+            "getAccountStatus": self._get,
+        }
+
+    def _set(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        account, status = r.blob(), r.u8()
+        if status not in (ACCOUNT_NORMAL, ACCOUNT_FROZEN, ACCOUNT_ABOLISHED):
+            raise PrecompileError("bad account status")
+        # governor-gated via the auth plane when governors exist
+        gov_prefix = AuthManagerPrecompile._K_GOV
+        has_gov = next(iter(ctx.state.keys(T_AUTH, gov_prefix)),
+                       None) is not None
+        if has_gov and ctx.state.get(T_AUTH,
+                                     gov_prefix + ctx.sender) is None:
+            raise PrecompileError("sender is not a governor",
+                                  TransactionStatus.PERMISSION_DENIED)
+        if account_status(ctx.state, account) == ACCOUNT_ABOLISHED:
+            raise PrecompileError("account abolished")
+        self.touch(ctx, b"acct/" + account)
+        ctx.state.set(T_ACCOUNT, account, bytes([status]))
+        w.u32(0)
+
+    def _get(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        w.u8(account_status(ctx.state, r.blob()))
+
+
+# ---------------------------------------------------------------------------
+# Cast helpers (CastPrecompiled.cpp: string <-> number/address conversions
+# for Solidity contracts without string parsing)
+# ---------------------------------------------------------------------------
+
+class CastPrecompile(Precompile):
+    name = "cast"
+
+    def methods(self):
+        return {
+            "stringToS256": self._s2i256,
+            "stringToS64": self._s2i,
+            "stringToU256": self._s2u,
+            "stringToAddr": self._s2a,
+            "s256ToString": self._i256s,
+            "s64ToString": self._i2s,
+            "u256ToString": self._u2s,
+            "addrToString": self._a2s,
+        }
+
+    @staticmethod
+    def _parse_int(s: str) -> int:
+        try:
+            return int(s, 16) if s.lower().startswith("0x") else int(s)
+        except ValueError:
+            raise PrecompileError(f"not a number: {s!r}")
+
+    def _s2i(self, ctx, r: Reader, w: Writer) -> None:
+        v = self._parse_int(r.text())
+        if not -(1 << 63) <= v < 1 << 63:
+            raise PrecompileError("out of s64 range")
+        w.i64(v)
+
+    def _s2i256(self, ctx, r: Reader, w: Writer) -> None:
+        v = self._parse_int(r.text())
+        if not -(1 << 255) <= v < 1 << 255:
+            raise PrecompileError("out of s256 range")
+        w.blob(v.to_bytes(32, "big", signed=True))
+
+    def _i256s(self, ctx, r: Reader, w: Writer) -> None:
+        w.text(str(int.from_bytes(r.blob(), "big", signed=True)))
+
+    def _s2u(self, ctx, r: Reader, w: Writer) -> None:
+        v = self._parse_int(r.text())
+        if v < 0:
+            raise PrecompileError("negative for unsigned cast")
+        w.blob(v.to_bytes(32, "big"))
+
+    def _s2a(self, ctx, r: Reader, w: Writer) -> None:
+        s = r.text().removeprefix("0x")
+        try:
+            raw = bytes.fromhex(s)
+        except ValueError:
+            raise PrecompileError("bad address hex")
+        if len(raw) != 20:
+            raise PrecompileError("address must be 20 bytes")
+        w.blob(raw)
+
+    def _i2s(self, ctx, r: Reader, w: Writer) -> None:
+        w.text(str(r.i64()))
+
+    def _u2s(self, ctx, r: Reader, w: Writer) -> None:
+        w.text(str(int.from_bytes(r.blob(), "big")))
+
+    def _a2s(self, ctx, r: Reader, w: Writer) -> None:
+        w.text("0x" + r.blob().hex())
+
+
 PRECOMPILED_REGISTRY: dict[bytes, Precompile] = {
     BALANCE_ADDRESS: BalancePrecompile(),
     DAG_TRANSFER_ADDRESS: BalancePrecompile(),  # same semantics, bench alias
     KV_TABLE_ADDRESS: KVTablePrecompile(),
-    TABLE_ADDRESS: KVTablePrecompile(),
+    TABLE_ADDRESS: TablePrecompile(),
+    TABLE_MANAGER_ADDRESS: TableManagerPrecompile(),
     SYS_CONFIG_ADDRESS: SystemConfigPrecompile(),
     CONSENSUS_ADDRESS: ConsensusPrecompile(),
     CRYPTO_ADDRESS: CryptoPrecompile(),
+    BFS_ADDRESS: BFSPrecompile(),
+    CAST_ADDRESS: CastPrecompile(),
+    AUTH_MANAGER_ADDRESS: AuthManagerPrecompile(),
+    CONTRACT_AUTH_ADDRESS: ContractAuthPrecompile(),
+    ACCOUNT_MANAGER_ADDRESS: AccountManagerPrecompile(),
 }

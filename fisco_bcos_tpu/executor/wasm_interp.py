@@ -30,6 +30,7 @@ from .wasm import GasMeteredModule, is_wasm
 
 PAGE = 65536
 MAX_PAGES = 256  # 16 MiB cap per instance
+MAX_TABLE_ELEMS = 65536
 MAX_CALL_DEPTH = 128
 
 COST_DEFAULT = GasMeteredModule.COST_DEFAULT
@@ -141,6 +142,8 @@ class Module:
                         mn, p = _leb_u(data, p)
                         if flag & 1:
                             _, p = _leb_u(data, p)
+                        if mn > MAX_TABLE_ELEMS:
+                            raise ValueError("table min exceeds cap")
                         self.tables.append([None] * mn)
                 elif sec == 5:
                     n, p = _leb_u(data, off)
@@ -326,6 +329,13 @@ class Instance:
             if fn is None:
                 raise WasmTrap(f"unresolved import {mod}.{name}")
             self.host.append(fn)
+        # declared minimums are attacker-controlled module bytes: cap them
+        # BEFORE allocating, or one deploy tx could OOM the node
+        if module.mem_min > MAX_PAGES:
+            raise WasmTrap(f"memory min {module.mem_min} pages exceeds the "
+                           f"{MAX_PAGES}-page cap")
+        if any(len(t) > MAX_TABLE_ELEMS for t in module.tables):
+            raise WasmTrap("table size exceeds cap")
         self.memory = bytearray(module.mem_min * PAGE)
         self.globals = [g[2] for g in module.globals]
         self.tables = [list(t) for t in module.tables]

@@ -49,6 +49,9 @@ class TransactionStatus(enum.IntEnum):
     PRECOMPILED_ERROR = 15
     EXECUTION_ABORTED = 17
     CALL_ADDRESS_ERROR = 16
+    PERMISSION_DENIED = 18
+    CONTRACT_FROZEN = 21
+    ACCOUNT_FROZEN = 22
     NONCE_CHECK_FAIL = 10000
     BLOCK_LIMIT_CHECK_FAIL = 10001
     TXPOOL_FULL = 10003

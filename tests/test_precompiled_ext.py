@@ -1,0 +1,286 @@
+"""Extended system precompiles: BFS, TableManager/Table, auth plane,
+account manager, cast — plus executor-level enforcement (deploy ACL,
+method ACLs, frozen contracts/accounts).
+
+Reference semantics: /root/reference/bcos-executor/src/precompiled/
+(BFSPrecompiled.cpp, TableManagerPrecompiled.cpp, TablePrecompiled.cpp,
+CastPrecompiled.cpp) and extension/ (AuthManagerPrecompiled.cpp,
+ContractAuthMgrPrecompiled.cpp, AccountManagerPrecompiled.cpp).
+"""
+
+import pytest
+
+from fisco_bcos_tpu.codec.wire import Reader
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.executor.executor import TransactionExecutor
+from fisco_bcos_tpu.protocol import Transaction, TransactionStatus
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+
+
+@pytest.fixture()
+def env():
+    suite = make_suite(backend="host")
+    ex = TransactionExecutor(suite)
+    state = StateStorage(MemoryStorage())
+    kp = suite.generate_keypair(b"pre-admin")
+    return suite, ex, state, kp
+
+
+_N = iter(range(100000))
+
+
+def run(env, to, method, build=None, kp=None, status=0):
+    suite, ex, state, kp0 = env
+    tx = Transaction(to=to, input=pc.encode_call(method, build),
+                     nonce=f"px{next(_N)}", block_limit=100
+                     ).sign(suite, kp or kp0)
+    rc = ex.execute_transaction(tx, state, 1, 0)
+    assert rc.status == int(status), (method, rc.status, rc.message)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# BFS
+# ---------------------------------------------------------------------------
+
+def test_bfs_mkdir_touch_list_link(env):
+    rc = run(env, pc.BFS_ADDRESS, "mkdir", lambda w: w.text("/apps/dex/v1"))
+    run(env, pc.BFS_ADDRESS, "touch",
+        lambda w: w.text("/apps/dex/v1/readme").text("file"))
+    rc = run(env, pc.BFS_ADDRESS, "list", lambda w: w.text("/apps/dex/v1"))
+    r = Reader(rc.output)
+    n = r.u32()
+    assert n == 1 and r.text() == "readme" and r.text() == "file"
+    # link + readlink round trip
+    addr20 = b"\x42" * 20
+    run(env, pc.BFS_ADDRESS, "link",
+        lambda w: w.text("dex").text("2.0").blob(addr20).blob(b"[]"))
+    rc = run(env, pc.BFS_ADDRESS, "readlink",
+             lambda w: w.text("/apps/dex/2.0"))
+    assert Reader(rc.output).blob() == addr20
+    # root listing includes the standard dirs + created ones
+    rc = run(env, pc.BFS_ADDRESS, "list", lambda w: w.text("/"))
+    names = []
+    r = Reader(rc.output)
+    for _ in range(r.u32()):
+        names.append(r.text())
+        r.text()
+    assert {"apps", "tables", "sys", "usr"} <= set(names)
+
+
+def test_bfs_rejects_bad_paths(env):
+    run(env, pc.BFS_ADDRESS, "mkdir", lambda w: w.text("relative/x"),
+        status=TransactionStatus.PRECOMPILED_ERROR)
+    run(env, pc.BFS_ADDRESS, "touch",
+        lambda w: w.text("/nonexistent/dir/file").text("file"),
+        status=TransactionStatus.PRECOMPILED_ERROR)
+
+
+# ---------------------------------------------------------------------------
+# TableManager / Table
+# ---------------------------------------------------------------------------
+
+def _mk_table(env, name="t_test"):
+    run(env, pc.TABLE_MANAGER_ADDRESS, "createTable",
+        lambda w: (w.text(name).text("id")
+                   .seq(["name", "score"], lambda ww, c: ww.text(c))))
+
+
+def test_table_schema_and_rows(env):
+    _mk_table(env)
+    rc = run(env, pc.TABLE_MANAGER_ADDRESS, "desc",
+             lambda w: w.text("t_test"))
+    r = Reader(rc.output)
+    assert r.text() == "id"
+    assert r.seq(lambda rr: rr.text()) == ["name", "score"]
+
+    run(env, pc.TABLE_ADDRESS, "insert",
+        lambda w: w.text("t_test").text("k1")
+        .seq(["alice", "90"], lambda ww, v: ww.text(v)))
+    rc = run(env, pc.TABLE_ADDRESS, "select",
+             lambda w: w.text("t_test").text("k1"))
+    r = Reader(rc.output)
+    assert r.u8() == 1 and r.seq(lambda rr: rr.text()) == ["alice", "90"]
+
+    run(env, pc.TABLE_ADDRESS, "update",
+        lambda w: w.text("t_test").text("k1")
+        .seq([("score", "95")], lambda ww, u: ww.text(u[0]).text(u[1])))
+    rc = run(env, pc.TABLE_ADDRESS, "select",
+             lambda w: w.text("t_test").text("k1"))
+    r = Reader(rc.output)
+    r.u8()
+    assert r.seq(lambda rr: rr.text()) == ["alice", "95"]
+
+    rc = run(env, pc.TABLE_ADDRESS, "remove",
+             lambda w: w.text("t_test").text("k1"))
+    assert Reader(rc.output).u32() == 1
+    rc = run(env, pc.TABLE_ADDRESS, "select",
+             lambda w: w.text("t_test").text("k1"))
+    assert Reader(rc.output).u8() == 0
+
+
+def test_table_condition_scan_and_count(env):
+    _mk_table(env)
+    for i in range(10):
+        run(env, pc.TABLE_ADDRESS, "insert",
+            lambda w, i=i: w.text("t_test").text(f"k{i}")
+            .seq([f"u{i}", str(i)], lambda ww, v: ww.text(v)))
+    # select k3 < key <= k7, limit (offset 1, count 2)
+    rc = run(env, pc.TABLE_ADDRESS, "selectByCondition",
+             lambda w: w.text("t_test")
+             .seq([(2, "k3"), (5, "k7")],
+                  lambda ww, c: ww.u8(c[0]).text(c[1]))
+             .u32(1).u32(2))
+    r = Reader(rc.output)
+    assert r.u32() == 2
+    assert r.text() == "k5"  # k4 skipped by offset
+    r.seq(lambda rr: rr.text())
+    assert r.text() == "k6"
+    rc = run(env, pc.TABLE_ADDRESS, "count",
+             lambda w: w.text("t_test")
+             .seq([(3, "k5")], lambda ww, c: ww.u8(c[0]).text(c[1])))
+    assert Reader(rc.output).u32() == 5  # k5..k9
+
+
+def test_table_append_columns(env):
+    _mk_table(env)
+    run(env, pc.TABLE_MANAGER_ADDRESS, "appendColumns",
+        lambda w: w.text("t_test").seq(["rank"], lambda ww, c: ww.text(c)))
+    rc = run(env, pc.TABLE_MANAGER_ADDRESS, "desc",
+             lambda w: w.text("t_test"))
+    r = Reader(rc.output)
+    r.text()
+    assert r.seq(lambda rr: rr.text()) == ["name", "score", "rank"]
+
+
+# ---------------------------------------------------------------------------
+# auth plane: deploy ACL governance round trip + method ACL + freezes
+# ---------------------------------------------------------------------------
+
+EVM_COUNTER = bytes.fromhex(  # PUSH1 0 PUSH1 0 RETURN (deploys empty code)
+    "60006000f3")
+
+
+def test_deploy_auth_deny_allow_roundtrip(env):
+    suite, ex, state, gov = env
+    outsider = suite.generate_keypair(b"outsider-kp")
+
+    # governor bootstraps and switches the chain to whitelist deploys
+    run(env, pc.AUTH_MANAGER_ADDRESS, "setDeployAuthType",
+        lambda w: w.u8(pc.AUTH_WHITE))
+    # outsider cannot change policy now
+    run(env, pc.AUTH_MANAGER_ADDRESS, "setDeployAuthType", lambda w: w.u8(0),
+        kp=outsider, status=TransactionStatus.PERMISSION_DENIED)
+
+    deploy = Transaction(to=b"", input=EVM_COUNTER, nonce="d1",
+                         block_limit=100).sign(suite, outsider)
+    rc = ex.execute_transaction(deploy, state, 1, 0)
+    assert rc.status == int(TransactionStatus.PERMISSION_DENIED)
+
+    # governor whitelists the outsider -> deploy succeeds
+    run(env, pc.AUTH_MANAGER_ADDRESS, "openDeployAuth",
+        lambda w: w.blob(outsider.address))
+    rc2 = run(env, pc.AUTH_MANAGER_ADDRESS, "hasDeployAuth",
+              lambda w: w.blob(outsider.address))
+    assert Reader(rc2.output).u8() == 1
+    deploy2 = Transaction(to=b"", input=EVM_COUNTER, nonce="d2",
+                          block_limit=100).sign(suite, outsider)
+    rc = ex.execute_transaction(deploy2, state, 1, 0)
+    assert rc.status == 0, rc.message
+
+    # close it again -> denied again
+    run(env, pc.AUTH_MANAGER_ADDRESS, "closeDeployAuth",
+        lambda w: w.blob(outsider.address))
+    deploy3 = Transaction(to=b"", input=EVM_COUNTER, nonce="d3",
+                          block_limit=100).sign(suite, outsider)
+    rc = ex.execute_transaction(deploy3, state, 1, 0)
+    assert rc.status == int(TransactionStatus.PERMISSION_DENIED)
+
+
+def _deploy_evm(env, kp=None, nonce="m1"):
+    suite, ex, state, kp0 = env
+    tx = Transaction(to=b"", input=EVM_COUNTER, nonce=nonce,
+                     block_limit=100).sign(suite, kp or kp0)
+    rc = ex.execute_transaction(tx, state, 1, 0)
+    assert rc.status == 0
+    return rc.contract_address
+
+
+def test_method_auth_whitelist(env):
+    suite, ex, state, admin = env
+    caller = suite.generate_keypair(b"method-caller")
+    addr = _deploy_evm(env)
+    sel = b"\xde\xad\xbe\xef"
+
+    # whitelist with empty ACL: everyone but the admin is denied
+    run(env, pc.CONTRACT_AUTH_ADDRESS, "setMethodAuthType",
+        lambda w: w.blob(addr).blob(sel).u8(pc.AUTH_WHITE))
+    call = Transaction(to=addr, input=sel + b"\x00", nonce="mc1",
+                       block_limit=100).sign(suite, caller)
+    rc = ex.execute_transaction(call, state, 1, 0)
+    assert rc.status == int(TransactionStatus.PERMISSION_DENIED)
+
+    run(env, pc.CONTRACT_AUTH_ADDRESS, "openMethodAuth",
+        lambda w: w.blob(addr).blob(sel).blob(caller.address))
+    call2 = Transaction(to=addr, input=sel + b"\x00", nonce="mc2",
+                        block_limit=100).sign(suite, caller)
+    rc = ex.execute_transaction(call2, state, 1, 0)
+    assert rc.status != int(TransactionStatus.PERMISSION_DENIED)
+
+    # non-admin cannot mutate the ACL
+    run(env, pc.CONTRACT_AUTH_ADDRESS, "openMethodAuth",
+        lambda w: w.blob(addr).blob(sel).blob(caller.address),
+        kp=caller, status=TransactionStatus.PERMISSION_DENIED)
+
+
+def test_contract_freeze_and_account_freeze(env):
+    suite, ex, state, admin = env
+    addr = _deploy_evm(env, nonce="fz1")
+    run(env, pc.CONTRACT_AUTH_ADDRESS, "setContractStatus",
+        lambda w: w.blob(addr).u8(1))
+    call = Transaction(to=addr, input=b"\x01\x02\x03\x04", nonce="fz2",
+                       block_limit=100).sign(suite, admin)
+    rc = ex.execute_transaction(call, state, 1, 0)
+    assert rc.status == int(TransactionStatus.CONTRACT_FROZEN)
+    run(env, pc.CONTRACT_AUTH_ADDRESS, "setContractStatus",
+        lambda w: w.blob(addr).u8(0))
+
+    victim = suite.generate_keypair(b"frozen-user")
+    run(env, pc.ACCOUNT_MANAGER_ADDRESS, "setAccountStatus",
+        lambda w: w.blob(victim.address).u8(pc.ACCOUNT_FROZEN))
+    rc2 = run(env, pc.ACCOUNT_MANAGER_ADDRESS, "getAccountStatus",
+              lambda w: w.blob(victim.address))
+    assert Reader(rc2.output).u8() == pc.ACCOUNT_FROZEN
+    tx = Transaction(to=pc.BALANCE_ADDRESS,
+                     input=pc.encode_call(
+                         "register", lambda w: w.blob(b"v").u64(1)),
+                     nonce="fz3", block_limit=100).sign(suite, victim)
+    rc = ex.execute_transaction(tx, state, 1, 0)
+    assert rc.status == int(TransactionStatus.ACCOUNT_FROZEN)
+
+
+# ---------------------------------------------------------------------------
+# cast
+# ---------------------------------------------------------------------------
+
+def test_cast_roundtrips(env):
+    rc = run(env, pc.CAST_ADDRESS, "stringToS256", lambda w: w.text("-123"))
+    assert int.from_bytes(Reader(rc.output).blob(), "big",
+                          signed=True) == -123
+    rc = run(env, pc.CAST_ADDRESS, "s256ToString",
+             lambda w: w.blob(((1 << 200)).to_bytes(32, "big", signed=True)))
+    assert Reader(rc.output).text() == str(1 << 200)
+    rc = run(env, pc.CAST_ADDRESS, "stringToS64", lambda w: w.text("-9"))
+    assert Reader(rc.output).i64() == -9
+    rc = run(env, pc.CAST_ADDRESS, "stringToU256", lambda w: w.text("0xff"))
+    assert Reader(rc.output).blob() == (255).to_bytes(32, "big")
+    rc = run(env, pc.CAST_ADDRESS, "stringToAddr",
+             lambda w: w.text("0x" + "ab" * 20))
+    assert Reader(rc.output).blob() == b"\xab" * 20
+    rc = run(env, pc.CAST_ADDRESS, "u256ToString",
+             lambda w: w.blob((77).to_bytes(32, "big")))
+    assert Reader(rc.output).text() == "77"
+    run(env, pc.CAST_ADDRESS, "stringToAddr", lambda w: w.text("zz"),
+        status=TransactionStatus.PRECOMPILED_ERROR)
