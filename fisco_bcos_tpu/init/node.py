@@ -57,6 +57,7 @@ class NodeConfig:
     view_timeout: float = 3.0
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
+    ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
 
 
 class Node:
@@ -103,6 +104,12 @@ class Node:
             from ..rpc.server import JsonRpcImpl, JsonRpcServer
             self.rpc = JsonRpcServer(JsonRpcImpl(self),
                                      host=cfg.rpc_host, port=cfg.rpc_port)
+        self.ws = None
+        if cfg.ws_port is not None:
+            from ..rpc.server import JsonRpcImpl
+            from ..rpc.ws_server import WsRpcServer
+            self.ws = WsRpcServer(JsonRpcImpl(self),
+                                  host=cfg.rpc_host, port=cfg.ws_port)
         self._started = False
 
     # -- genesis -----------------------------------------------------------
@@ -141,6 +148,8 @@ class Node:
                 self.blocksync.start()
         if self.rpc is not None:
             self.rpc.start()
+        if self.ws is not None:
+            self.ws.start()
         LOG.info(badge("NODE", "started",
                        number=self.ledger.current_number(),
                        mode=self.config.consensus))
@@ -148,6 +157,8 @@ class Node:
     def stop(self) -> None:
         if self.rpc is not None:
             self.rpc.stop()
+        if self.ws is not None:
+            self.ws.stop()
         self.sealer.stop()
         if self.consensus is not None:
             self.consensus.stop()

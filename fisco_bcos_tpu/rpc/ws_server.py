@@ -1,0 +1,285 @@
+"""WebSocket access layer: JSON-RPC + event-subscription push + AMOP bridge.
+
+Reference counterpart: the reference serves the same JSON-RPC surface over
+WS as over HTTP (bcos-rpc/bcos-rpc/jsonrpc over boostssl WsService), pushes
+event-subscription matches to WS sessions
+(/root/reference/bcos-rpc/bcos-rpc/event/EventSub.cpp), and bridges SDK
+AMOP clients into the gateway's topic plane
+(/root/reference/bcos-rpc/bcos-rpc/amop/AirAMOPClient.h).
+
+Message protocol (JSON text frames):
+  * Anything with "method" is a JSON-RPC 2.0 request; the response carries
+    the same id. The full HTTP surface (JsonRpcImpl) is available, plus WS-
+    only methods:
+      subscribeEvent   [group, {fromBlock,toBlock,addresses,topics}] -> task
+      unsubscribeEvent [group, taskId]
+      subscribeTopic   [topic, ...]        (AMOP; this session serves them)
+      unsubscribeTopic [topic, ...]
+      publishTopic     [topic, hexData]    -> responder's hex reply
+      broadcastTopic   [topic, hexData]    -> peer count
+  * Server pushes (no id):
+      {"type": "eventPush", "taskId", "blockNumber", "txHash", "logIndex",
+       "log": {address, topics, data}}
+      {"type": "amopPush", "seq", "topic", "data": hex}
+  * Client reply to an amopPush (the publish round trip):
+      {"type": "amopResp", "seq", "data": hex}
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from typing import Optional
+
+from ..net.websocket import OP_TEXT, WsConnection, WsServer
+from ..rpc.eventsub import EventFilter
+from ..utils.log import LOG, badge
+from .server import JsonRpcImpl, JsonRpcError, JSONRPC_INVALID_PARAMS
+
+_AMOP_REPLY_TIMEOUT = 5.0
+
+
+class _Session:
+    """Per-connection subscription state."""
+
+    def __init__(self, conn: WsConnection):
+        self.conn = conn
+        self.event_tasks: set[str] = set()
+        self.topics: set[str] = set()
+        self.pending: dict[int, tuple[threading.Event, list]] = {}
+
+    def push(self, obj: dict) -> bool:
+        try:
+            self.conn.send_text(json.dumps(obj))
+            return True
+        except Exception:
+            return False
+
+
+class WsRpcServer:
+    def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.impl = impl
+        self.node = impl.node
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._sessions: dict[WsConnection, _Session] = {}
+        # AMOP: topic -> sessions serving it (first healthy one answers)
+        self._topic_sessions: dict[str, list[_Session]] = {}
+        self._ws = WsServer(host, port, on_message=self._on_message,
+                            on_open=self._on_open, on_close=self._on_close)
+        self.host, self.port = self._ws.host, self._ws.port
+
+    def start(self) -> None:
+        self._ws.start()
+
+    def stop(self) -> None:
+        self._ws.stop()
+
+    # -- session lifecycle -------------------------------------------------
+    def _on_open(self, conn: WsConnection) -> None:
+        with self._lock:
+            self._sessions[conn] = _Session(conn)
+
+    def _on_close(self, conn: WsConnection) -> None:
+        with self._lock:
+            sess = self._sessions.pop(conn, None)
+        if sess is None:
+            return
+        for task_id in sess.event_tasks:
+            self.node.eventsub.unsubscribe(task_id)
+        for topic in sess.topics:
+            self._drop_topic(sess, topic)
+
+    def _drop_topic(self, sess: _Session, topic: str) -> None:
+        with self._lock:
+            lst = self._topic_sessions.get(topic, [])
+            if sess in lst:
+                lst.remove(sess)
+            if not lst:
+                self._topic_sessions.pop(topic, None)
+                if self.node.amop is not None:
+                    self.node.amop.unsubscribe(topic)
+
+    # -- ingress -----------------------------------------------------------
+    def _on_message(self, conn: WsConnection, op: int, payload: bytes
+                    ) -> None:
+        if op != OP_TEXT:
+            return
+        with self._lock:
+            sess = self._sessions.get(conn)
+        if sess is None:
+            return
+        try:
+            msg = json.loads(payload)
+        except Exception:
+            sess.push({"jsonrpc": "2.0", "id": None,
+                       "error": {"code": -32700, "message": "parse error"}})
+            return
+        if msg.get("type") == "amopResp":
+            self._on_amop_resp(sess, msg)  # non-blocking: stays inline
+            return
+        if "method" not in msg:
+            return
+        # dispatch off the reader thread: methods can block (sendTransaction
+        # waits for a receipt; publishTopic waits for an amopResp that this
+        # very reader thread must deliver — inline handling would deadlock a
+        # session publishing to a topic it also serves)
+        threading.Thread(target=self._dispatch, args=(sess, msg),
+                         name="ws-dispatch", daemon=True).start()
+
+    def _dispatch(self, sess: _Session, msg: dict) -> None:
+        handler = self._ws_methods().get(msg["method"])
+        if handler is None:
+            sess.push(self.impl.handle(msg))
+            return
+        mid = msg.get("id")
+        try:
+            result = handler(sess, msg.get("params") or [])
+            sess.push({"jsonrpc": "2.0", "id": mid, "result": result})
+        except JsonRpcError as exc:
+            sess.push({"jsonrpc": "2.0", "id": mid,
+                       "error": {"code": exc.code, "message": exc.message}})
+        except Exception as exc:
+            sess.push({"jsonrpc": "2.0", "id": mid,
+                       "error": {"code": -32603, "message": str(exc)}})
+
+    def _ws_methods(self):
+        return {
+            "subscribeEvent": self._m_subscribe_event,
+            "unsubscribeEvent": self._m_unsubscribe_event,
+            "subscribeTopic": self._m_subscribe_topic,
+            "unsubscribeTopic": self._m_unsubscribe_topic,
+            "publishTopic": self._m_publish_topic,
+            "broadcastTopic": self._m_broadcast_topic,
+        }
+
+    # -- event subscription push ------------------------------------------
+    def _m_subscribe_event(self, sess: _Session, params: list) -> str:
+        if len(params) < 2 or not isinstance(params[1], dict):
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS,
+                               "need [group, filter]")
+        f = params[1]
+        addresses = ({bytes.fromhex(a.removeprefix("0x"))
+                      for a in f["addresses"]}
+                     if f.get("addresses") else None)
+        topics = [None if t is None
+                  else {bytes.fromhex(x.removeprefix("0x")) for x in t}
+                  for t in f.get("topics", [])]
+        flt = EventFilter(from_block=int(f.get("fromBlock", 0)),
+                          to_block=int(f.get("toBlock", -1)),
+                          addresses=addresses, topics=topics)
+        # eventsub.subscribe replays history synchronously BEFORE returning
+        # the task id, and the commit thread may pump concurrently; buffer
+        # pushes under a lock until the id exists so every push carries a
+        # routable taskId and block order is preserved
+        lk = threading.Lock()
+        holder: list[str] = []
+        buffered: list[tuple] = []
+
+        def emit(task_id, number, tx_hash, log_index, log) -> None:
+            sess.push({
+                "type": "eventPush",
+                "taskId": task_id,
+                "blockNumber": number,
+                "txHash": "0x" + tx_hash.hex(),
+                "logIndex": log_index,
+                "log": {"address": "0x" + log.address.hex(),
+                        "topics": ["0x" + t.hex() for t in log.topics],
+                        "data": "0x" + log.data.hex()},
+            })
+
+        def cb(number: int, tx_hash: bytes, log_index: int, log) -> None:
+            with lk:
+                if not holder:
+                    buffered.append((number, tx_hash, log_index, log))
+                    return
+                emit(holder[0], number, tx_hash, log_index, log)
+
+        task_id = self.node.eventsub.subscribe(flt, cb)
+        with lk:
+            holder.append(task_id)
+            for args in buffered:
+                emit(task_id, *args)
+            buffered.clear()
+        sess.event_tasks.add(task_id)
+        return task_id
+
+    def _m_unsubscribe_event(self, sess: _Session, params: list) -> bool:
+        task_id = params[1] if len(params) > 1 else params[0]
+        if task_id not in sess.event_tasks:  # a session may only cancel its own
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS, "unknown task id")
+        sess.event_tasks.discard(task_id)
+        return self.node.eventsub.unsubscribe(task_id)
+
+    # -- AMOP bridge -------------------------------------------------------
+    def _require_amop(self):
+        if self.node.amop is None:
+            raise JsonRpcError(-32000, "node has no gateway/AMOP plane")
+        return self.node.amop
+
+    def _m_subscribe_topic(self, sess: _Session, params: list) -> bool:
+        amop = self._require_amop()
+        for topic in params:
+            sess.topics.add(topic)
+            with self._lock:
+                lst = self._topic_sessions.setdefault(topic, [])
+                if sess not in lst:
+                    lst.append(sess)
+            amop.subscribe(topic, self._amop_handler)
+        return True
+
+    def _m_unsubscribe_topic(self, sess: _Session, params: list) -> bool:
+        for topic in params:
+            sess.topics.discard(topic)
+            self._drop_topic(sess, topic)
+        return True
+
+    def _m_publish_topic(self, sess: _Session, params: list) -> Optional[str]:
+        amop = self._require_amop()
+        topic, data = params[0], bytes.fromhex(
+            params[1].removeprefix("0x")) if len(params) > 1 else b""
+        resp = amop.publish(topic, data)
+        return None if resp is None else "0x" + resp.hex()
+
+    def _m_broadcast_topic(self, sess: _Session, params: list) -> int:
+        amop = self._require_amop()
+        topic, data = params[0], bytes.fromhex(
+            params[1].removeprefix("0x")) if len(params) > 1 else b""
+        return amop.broadcast(topic, data)
+
+    def _amop_handler(self, topic: str, data: bytes,
+                      src: bytes) -> Optional[bytes]:
+        """Node-side AMOP handler: relay to one serving WS session and wait
+        for its amopResp (the reference's AirAMOPClient round trip)."""
+        with self._lock:
+            sessions = list(self._topic_sessions.get(topic, []))
+        for sess in sessions:
+            seq = next(self._seq)
+            ev = threading.Event()
+            out: list = []
+            sess.pending[seq] = (ev, out)
+            ok = sess.push({"type": "amopPush", "seq": seq, "topic": topic,
+                            "data": "0x" + data.hex()})
+            if not ok:
+                sess.pending.pop(seq, None)
+                continue
+            if ev.wait(_AMOP_REPLY_TIMEOUT) and out:
+                sess.pending.pop(seq, None)
+                return out[0]
+            sess.pending.pop(seq, None)
+        LOG.warning(badge("WS", "amop-no-responder", topic=topic))
+        return None
+
+    def _on_amop_resp(self, sess: _Session, msg: dict) -> None:
+        entry = sess.pending.get(int(msg.get("seq", -1)))
+        if entry is None:
+            return
+        ev, out = entry
+        try:
+            out.append(bytes.fromhex(str(msg.get("data", "")).removeprefix(
+                "0x")))
+        except ValueError:
+            out.append(b"")
+        ev.set()

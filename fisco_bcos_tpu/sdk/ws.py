@@ -1,0 +1,163 @@
+"""SDK over WebSocket: JSON-RPC, event-subscription push, AMOP client.
+
+Reference counterpart: /root/reference/bcos-sdk/bcos-cpp-sdk/ — the C++ SDK
+attaches to a node over the boostssl WS service for RPC
+(jsonrpc/JsonRpcImpl.cpp), event subscription (event/EventSub.cpp) and AMOP
+(amop/AMOP.cpp). `WsSdkClient` mirrors `SdkClient`'s method surface (it
+reuses its `_grouped` helpers by overriding `request`) and adds the push
+channels a stateless HTTP client cannot have.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional
+
+from ..net.websocket import OP_TEXT, WsError, ws_connect
+from .client import RpcCallError, SdkClient
+
+# event callback: (push: dict) -> None        (eventPush object, see server)
+# topic callback: (topic: str, data: bytes) -> bytes | None   (reply)
+
+
+class WsSdkClient(SdkClient):
+    def __init__(self, host: str, port: int, group: str = "group0",
+                 timeout: float = 10.0):
+        # note: no HTTP url — we bypass SdkClient's transport entirely
+        super().__init__(url=f"ws://{host}:{port}", group=group)
+        self.timeout = timeout
+        self.conn = ws_connect(host, port, timeout=timeout)
+        self._lock = threading.Lock()
+        self._waiting: dict[int, tuple[threading.Event, list]] = {}
+        self._event_handlers: dict[str, Callable] = {}
+        self._orphan_pushes: dict[str, list] = {}  # pushes preceding the id
+        self._topic_handlers: dict[str, Callable] = {}
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="sdk-ws-reader", daemon=True)
+        self._reader.start()
+
+    # -- transport ---------------------------------------------------------
+    def request(self, method: str, params: list):
+        rid = next(self._seq)  # SdkClient's request-id counter
+        ev = threading.Event()
+        out: list = []
+        with self._lock:
+            if self._closed:
+                raise RpcCallError(-32000, "ws connection closed")
+            self._waiting[rid] = (ev, out)
+        self.conn.send_text(json.dumps({
+            "jsonrpc": "2.0", "id": rid, "method": method,
+            "params": params}))
+        if not ev.wait(self.timeout):
+            with self._lock:
+                self._waiting.pop(rid, None)
+            raise RpcCallError(-32000, f"ws request timeout: {method}")
+        resp = out[0]
+        if "error" in resp:
+            raise RpcCallError(resp["error"].get("code", -1),
+                               resp["error"].get("message", ""))
+        return resp.get("result")
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                try:
+                    msg = self.conn.recv()
+                except (WsError, OSError):
+                    break
+                if msg is None:
+                    break
+                op, payload = msg
+                if op != OP_TEXT:
+                    continue
+                try:
+                    obj = json.loads(payload)
+                except Exception:
+                    continue
+                self._route(obj)
+        finally:
+            # fail every in-flight waiter instead of letting it time out
+            with self._lock:
+                self._closed = True
+                waiting = list(self._waiting.values())
+                self._waiting.clear()
+            for ev, out in waiting:
+                out.append({"error": {"code": -32000,
+                                      "message": "ws connection closed"}})
+                ev.set()
+
+    def _route(self, obj: dict) -> None:
+        if "id" in obj and obj.get("type") is None:
+            with self._lock:
+                entry = self._waiting.pop(obj["id"], None)
+            if entry:
+                entry[1].append(obj)
+                entry[0].set()
+        elif obj.get("type") == "eventPush":
+            tid = obj.get("taskId", "")
+            with self._lock:
+                cb = self._event_handlers.get(tid)
+                if cb is None:  # push raced ahead of the subscribe response
+                    buf = self._orphan_pushes.setdefault(tid, [])
+                    if len(buf) < 1000:
+                        buf.append(obj)
+                    return
+            try:
+                cb(obj)
+            except Exception:
+                pass
+        elif obj.get("type") == "amopPush":
+            self._on_amop_push(obj)
+
+    def _on_amop_push(self, obj: dict) -> None:
+        cb = self._topic_handlers.get(obj.get("topic", ""))
+        if cb is None:
+            return
+        data = bytes.fromhex(str(obj.get("data", "")).removeprefix("0x"))
+        try:
+            reply = cb(obj["topic"], data)
+        except Exception:
+            reply = None
+        self.conn.send_text(json.dumps({
+            "type": "amopResp", "seq": obj.get("seq"),
+            "data": "0x" + (reply or b"").hex()}))
+
+    # -- push channels -----------------------------------------------------
+    def subscribe_event(self, flt: dict, cb: Callable) -> str:
+        """flt: {fromBlock, toBlock, addresses, topics} (hex strings)."""
+        task_id = self.request("subscribeEvent", [self.group, flt])
+        with self._lock:  # linearise vs the reader's orphan buffering
+            self._event_handlers[task_id] = cb
+            orphans = self._orphan_pushes.pop(task_id, [])
+        for obj in orphans:
+            try:
+                cb(obj)
+            except Exception:
+                pass
+        return task_id
+
+    def unsubscribe_event(self, task_id: str) -> bool:
+        self._event_handlers.pop(task_id, None)
+        return bool(self.request("unsubscribeEvent", [self.group, task_id]))
+
+    def subscribe_topic(self, topic: str, cb: Callable) -> None:
+        self._topic_handlers[topic] = cb
+        self.request("subscribeTopic", [topic])
+
+    def unsubscribe_topic(self, topic: str) -> None:
+        self._topic_handlers.pop(topic, None)
+        self.request("unsubscribeTopic", [topic])
+
+    def publish_topic(self, topic: str, data: bytes) -> Optional[bytes]:
+        r = self.request("publishTopic", [topic, "0x" + data.hex()])
+        return None if r is None else bytes.fromhex(r.removeprefix("0x"))
+
+    def broadcast_topic(self, topic: str, data: bytes) -> int:
+        return int(self.request("broadcastTopic",
+                                [topic, "0x" + data.hex()]))
+
+    def close(self) -> None:
+        self._closed = True
+        self.conn.close()

@@ -1,0 +1,186 @@
+"""WebSocket plane: transport framing, WS JSON-RPC, event push, AMOP bridge.
+
+Reference: bcos-boostssl websocket/ (transport), bcos-rpc jsonrpc-over-WS +
+event/EventSub.cpp (push), bcos-rpc/amop (SDK topic bridge).
+"""
+
+import threading
+import time
+
+import pytest
+
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.net.websocket import (
+    OP_BINARY,
+    OP_TEXT,
+    WsServer,
+    ws_connect,
+)
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.sdk.ws import WsSdkClient
+
+
+def wait_until(pred, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+def test_ws_echo_roundtrip_and_large_frames():
+    got = []
+
+    def on_message(conn, op, payload):
+        got.append((op, payload))
+        if op == OP_TEXT:
+            conn.send_text(payload.decode()[::-1])
+        else:
+            conn.send_binary(payload)
+
+    srv = WsServer(on_message=on_message)
+    srv.start()
+    try:
+        conn = ws_connect("127.0.0.1", srv.port)
+        conn.send_text("hello ws")
+        op, data = conn.recv()
+        assert (op, data) == (OP_TEXT, b"sw olleh")
+        # 70 KB binary exercises the 16-bit-plus extended length path
+        blob = bytes(range(256)) * 280
+        conn.send_binary(blob)
+        op, data = conn.recv()
+        assert op == OP_BINARY and data == blob
+        conn.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# WS JSON-RPC + event push + AMOP, against a live solo node
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ws_node(tmp_path):
+    from fisco_bcos_tpu.net.gateway import FakeGateway
+
+    gateway = FakeGateway()  # gives the solo node an AMOP plane
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                           ws_port=0), gateway=gateway)
+    node.start()
+    yield node
+    node.stop()
+    gateway.stop()
+
+
+def _register_tx(node, kp, nonce, name=b"wsacct", amount=5):
+    return Transaction(
+        to=pc.BALANCE_ADDRESS,
+        input=pc.encode_call("register",
+                             lambda w: w.blob(name).u64(amount)),
+        nonce=nonce, block_limit=node.ledger.current_number() + 100,
+    ).sign(node.suite, kp)
+
+
+def test_ws_jsonrpc_surface(ws_node):
+    node = ws_node
+    cli = WsSdkClient("127.0.0.1", node.ws.port)
+    try:
+        assert cli.get_block_number() == node.ledger.current_number()
+        kp = node.suite.generate_keypair(b"ws-user")
+        tx = _register_tx(node, kp, "ws1")
+        rc = cli.send_transaction(tx)  # waits for the receipt
+        assert int(rc["status"]) == 0
+        rc2 = cli.get_transaction_receipt(rc["transactionHash"])
+        assert rc2 is not None and int(rc2["status"]) == 0
+        assert cli.get_sync_status()["blockNumber"] >= 1
+    finally:
+        cli.close()
+
+
+def test_ws_event_subscription_push(ws_node):
+    node = ws_node
+    kp = node.suite.generate_keypair(b"ws-evt")
+    cli = WsSdkClient("127.0.0.1", node.ws.port)
+    pushes = []
+    try:
+        # transfer emits a log (BalancePrecompile topics=[b"transfer"])
+        node.send_transaction(_register_tx(node, kp, "we1", b"a", 100))
+        node.send_transaction(_register_tx(node, kp, "we2", b"b", 0))
+        assert wait_until(lambda: node.ledger.current_number() >= 2)
+        tx = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call("transfer", lambda w: w.blob(b"a")
+                                 .blob(b"b").u64(7)),
+            nonce="we3", block_limit=node.ledger.current_number() + 100,
+        ).sign(node.suite, kp)
+        node.send_transaction(tx)
+        assert wait_until(lambda: node.ledger.current_number() >= 3)
+
+        # subscribe from block 0: the historical transfer must be replayed
+        task = cli.subscribe_event({"fromBlock": 0}, pushes.append)
+        assert task
+        assert wait_until(lambda: len(pushes) >= 1), "no historical push"
+        assert pushes[0]["log"]["topics"][0] == "0x" + b"transfer".hex()
+
+        # a NEW transfer must be pushed live
+        n0 = len(pushes)
+        tx2 = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call("transfer", lambda w: w.blob(b"b")
+                                 .blob(b"a").u64(1)),
+            nonce="we4", block_limit=node.ledger.current_number() + 100,
+        ).sign(node.suite, kp)
+        node.send_transaction(tx2)
+        assert wait_until(lambda: len(pushes) > n0), "no live push"
+        assert cli.unsubscribe_event(task)
+    finally:
+        cli.close()
+
+
+def test_ws_amop_bridge_unicast_roundtrip(ws_node):
+    node = ws_node
+    sub = WsSdkClient("127.0.0.1", node.ws.port)
+    pub = WsSdkClient("127.0.0.1", node.ws.port)
+    try:
+        received = []
+
+        def on_topic(topic, data):
+            received.append((topic, data))
+            return b"pong:" + data
+
+        sub.subscribe_topic("orders", on_topic)
+        resp = pub.publish_topic("orders", b"ping1")
+        assert resp == b"pong:ping1"
+        assert received == [("orders", b"ping1")]
+
+        # broadcast: delivered, no response expected
+        sub2_received = []
+        sub.broadcast_topic("orders", b"fanout")
+        assert wait_until(lambda: len(received) >= 2)
+        assert received[1] == ("orders", b"fanout")
+        assert sub2_received == []
+
+        sub.unsubscribe_topic("orders")
+        assert pub.publish_topic("orders", b"ping2") is None
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_ws_amop_self_publish_same_connection(ws_node):
+    """One connection both serves a topic and publishes to it — must not
+    deadlock the session's reader thread (methods dispatch off-reader)."""
+    node = ws_node
+    cli = WsSdkClient("127.0.0.1", node.ws.port)
+    try:
+        cli.subscribe_topic("selftopic", lambda t, d: b"me:" + d)
+        resp = cli.publish_topic("selftopic", b"loop")
+        assert resp == b"me:loop"
+    finally:
+        cli.close()
