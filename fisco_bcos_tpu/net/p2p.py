@@ -2,16 +2,24 @@
 
 Reference counterpart: /root/reference/bcos-gateway/bcos-gateway/ —
 `Host`/`Session` ASIO loops (libnetwork/Host.cpp, Session.cpp),
-`Service` connection management with reconnect (libp2p/Service.cpp), and the
-length-prefixed `P2PMessageV2` wire format; TLS contexts from
+`Service` connection management with reconnect (libp2p/Service.cpp), the
+length-prefixed compressed `P2PMessageV2` wire format, the distance-vector
+router for multi-hop delivery (libp2p/router/RouterTableImpl.cpp), and the
+peer allow/deny lists (libnetwork/PeerBlacklist.h); TLS contexts from
 bcos-boostssl/context/ContextBuilder.cpp. This implementation keeps the same
 shape on Python threads + blocking sockets: one listener, one reader thread
 per session, a reconnect loop for configured peers, length-prefixed frames.
 
 Frames: u32 length | payload. The first frame each way is a handshake
 carrying the magic, protocol version, and the sender's node ID (pubkey);
-afterwards every frame is an opaque FrontService envelope delivered to
-`front.on_network_message(src, data)`.
+afterwards frames are typed:
+
+  DATA  u8 kind=0 | u8 flags (bit0: zlib) | u8 ttl | u16 len src | u16 len
+        dst | payload — routed hop by hop to `dst`, decompressed and handed
+        to `front.on_network_message(src, payload)` at the destination.
+  ROUTE u8 kind=1 | u16 count | count * (u16 len node | u8 distance) — the
+        sender's distance vector; neighbors recompute and re-advertise on
+        change, so any node can reach any other across intermediate hops.
 
 Pass an `ssl.SSLContext` pair (server_ctx/client_ctx) for TLS — the
 reference's cert-based node authentication maps onto standard TLS certs; the
@@ -26,14 +34,19 @@ import ssl
 import struct
 import threading
 import time
+import zlib
 from typing import Optional
 
 from ..utils.log import LOG, badge
 from .gateway import Gateway
 
 MAGIC = b"FBTP"
-VERSION = 1
+VERSION = 2
 MAX_FRAME = 128 * 1024 * 1024
+MAX_TTL = 16
+MAX_DISTANCE = 8  # drop longer advertised paths (count-to-infinity guard)
+KIND_DATA, KIND_ROUTE = 0, 1
+FLAG_COMPRESSED = 1
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -60,27 +73,143 @@ def _recv_frame(sock: socket.socket) -> Optional[bytes]:
     return _recv_exact(sock, length)
 
 
+def _pack_data(flags: int, ttl: int, src: bytes, dst: bytes,
+               payload: bytes) -> bytes:
+    return (bytes([KIND_DATA, flags, ttl])
+            + struct.pack(">H", len(src)) + src
+            + struct.pack(">H", len(dst)) + dst + payload)
+
+
+def _unpack_data(frame: bytes):
+    flags, ttl = frame[1], frame[2]
+    off = 3
+    (slen,) = struct.unpack_from(">H", frame, off)
+    off += 2
+    src = frame[off:off + slen]
+    off += slen
+    (dlen,) = struct.unpack_from(">H", frame, off)
+    off += 2
+    dst = frame[off:off + dlen]
+    off += dlen
+    return flags, ttl, src, dst, frame[off:]
+
+
+def _pack_route(vector: dict[bytes, int]) -> bytes:
+    parts = [bytes([KIND_ROUTE]), struct.pack(">H", len(vector))]
+    for node, dist in vector.items():
+        parts.append(struct.pack(">H", len(node)) + node + bytes([dist]))
+    return b"".join(parts)
+
+
+def _unpack_route(frame: bytes) -> dict[bytes, int]:
+    (count,) = struct.unpack_from(">H", frame, 1)
+    off = 3
+    out = {}
+    for _ in range(count):
+        (ln,) = struct.unpack_from(">H", frame, off)
+        off += 2
+        node = frame[off:off + ln]
+        off += ln
+        out[node] = frame[off]
+        off += 1
+    return out
+
+
+class RouterTable:
+    """Distance-vector routes: dst -> (distance, next-hop neighbor).
+
+    Recomputed from scratch on every topology event (neighbor up/down,
+    vector received) — simple and correct at consortium scale (tens of
+    nodes), the shape of RouterTableImpl.cpp without incremental updates.
+    Callers hold the gateway lock.
+    """
+
+    def __init__(self, self_id: bytes):
+        self.self_id = self_id
+        self._vectors: dict[bytes, dict[bytes, int]] = {}  # neighbor -> adv
+        self.routes: dict[bytes, tuple[int, bytes]] = {}
+
+    def neighbor_up(self, neighbor: bytes) -> bool:
+        self._vectors.setdefault(neighbor, {})
+        return self._recompute()
+
+    def neighbor_down(self, neighbor: bytes) -> bool:
+        self._vectors.pop(neighbor, None)
+        return self._recompute()
+
+    def update_vector(self, neighbor: bytes, vector: dict[bytes, int]
+                      ) -> bool:
+        if neighbor not in self._vectors:
+            return False  # stale: session already dropped
+        self._vectors[neighbor] = vector
+        return self._recompute()
+
+    def _recompute(self) -> bool:
+        routes: dict[bytes, tuple[int, bytes]] = {
+            nb: (1, nb) for nb in self._vectors}
+        for nb, vec in self._vectors.items():
+            for dst, dist in vec.items():
+                if dst == self.self_id or dist + 1 > MAX_DISTANCE:
+                    continue
+                cur = routes.get(dst)
+                if cur is None or dist + 1 < cur[0] or (
+                        dist + 1 == cur[0] and nb < cur[1]):
+                    routes[dst] = (dist + 1, nb)
+        changed = routes != self.routes
+        self.routes = routes
+        return changed
+
+    def vector(self) -> dict[bytes, int]:
+        out = {self.self_id: 0}
+        out.update({dst: dist for dst, (dist, _hop) in self.routes.items()})
+        return out
+
+    def next_hop(self, dst: bytes) -> Optional[bytes]:
+        entry = self.routes.get(dst)
+        return entry[1] if entry else None
+
+    def reachable(self) -> list[bytes]:
+        return list(self.routes)
+
+
 class P2PGateway(Gateway):
     def __init__(self, node_id: bytes, host: str = "127.0.0.1",
                  port: int = 0, peers: Optional[list[tuple[str, int]]] = None,
                  server_ssl: Optional[ssl.SSLContext] = None,
                  client_ssl: Optional[ssl.SSLContext] = None,
-                 reconnect_interval: float = 1.0):
+                 reconnect_interval: float = 1.0,
+                 allow_list: Optional[set[bytes]] = None,
+                 deny_list: Optional[set[bytes]] = None,
+                 compress_threshold: int = 1024):
         self.node_id = node_id
         self.configured_peers = list(peers or [])
         self.server_ssl = server_ssl
         self.client_ssl = client_ssl
         self.reconnect_interval = reconnect_interval
+        # PeerBlacklist.h semantics: a non-None allow_list admits ONLY its
+        # members; deny_list rejects its members in any case
+        self.allow_list = allow_list
+        self.deny_list = deny_list or set()
+        self.compress_threshold = compress_threshold
         self._front = None
         self._sessions: dict[bytes, socket.socket] = {}
         self._send_locks: dict[bytes, threading.Lock] = {}
         self._peer_by_addr: dict[tuple[str, int], bytes] = {}
+        self._router = RouterTable(node_id)
         self._lock = threading.Lock()
+        # held across build+send of ROUTE frames so two concurrent topology
+        # events cannot deliver a stale vector after a newer one
+        self._adv_lock = threading.Lock()
         self._stopped = False
 
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self._threads: list[threading.Thread] = []
+
+    def _acl_ok(self, peer_id: bytes) -> bool:
+        if peer_id in self.deny_list:
+            return False
+        return self.allow_list is None or peer_id in self.allow_list
 
     # -- Gateway interface -------------------------------------------------
     def register_front(self, node_id: bytes, front) -> None:
@@ -93,26 +222,54 @@ class P2PGateway(Gateway):
         self.stop()
 
     def peers(self, src: bytes = b"") -> list[bytes]:
+        """Every reachable node — direct sessions AND multi-hop routes, so
+        front-level broadcast spans the whole connected component."""
         with self._lock:
-            return list(self._sessions)
+            return sorted(set(self._sessions) | set(self._router.reachable()))
 
     def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        flags = 0
+        if len(data) >= self.compress_threshold:
+            data = zlib.compress(data, 6)
+            flags |= FLAG_COMPRESSED
+        frame = _pack_data(flags, MAX_TTL, self.node_id, dst, data)
+        return self._forward(dst, frame)
+
+    def _forward(self, dst: bytes, frame: bytes) -> bool:
+        """Hand a DATA frame to the session for dst, or its next hop."""
         with self._lock:
-            sock = self._sessions.get(dst)
-            slock = self._send_locks.setdefault(dst, threading.Lock())
+            hop = dst if dst in self._sessions else self._router.next_hop(dst)
+            sock = self._sessions.get(hop) if hop else None
+            slock = (self._send_locks.setdefault(hop, threading.Lock())
+                     if hop else None)
         if sock is None:
             return False
         try:
             with slock:  # sendall is not atomic across threads
-                _send_frame(sock, data)
+                _send_frame(sock, frame)
             return True
         except OSError:
-            self._drop(dst)
+            self._drop(hop)
             return False
 
     def broadcast(self, src: bytes, data: bytes) -> None:
         for dst in self.peers():
             self.send(src, dst, data)
+
+    def _advertise_routes(self) -> None:
+        with self._adv_lock:
+            with self._lock:
+                frame = _pack_route(self._router.vector())
+                targets = [(nb, self._sessions[nb],
+                            self._send_locks.setdefault(nb,
+                                                        threading.Lock()))
+                           for nb in self._sessions]
+            for nb, sock, slock in targets:
+                try:
+                    with slock:
+                        _send_frame(sock, frame)
+                except OSError:
+                    self._drop(nb)
 
     def stop(self) -> None:
         self._stopped = True
@@ -157,26 +314,35 @@ class P2PGateway(Gateway):
         connects (Service.cpp keeps one session per peer the same way)."""
         if peer_id == self.node_id:
             return False
+        if not self._acl_ok(peer_id):
+            LOG.warning(badge("P2P", "peer-rejected-acl",
+                              peer=peer_id[:8].hex()))
+            return False
         if outbound != (self.node_id < peer_id):
             return False  # wrong direction: the other side owns this link
         with self._lock:
             if peer_id in self._sessions:
                 return False  # duplicate dial; first session wins
             self._sessions[peer_id] = sock
+            self._router.neighbor_up(peer_id)
         self._spawn(lambda: self._read_loop(peer_id, sock),
                     f"p2p-read-{peer_id[:4].hex()}")
         LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
                        n=len(self._sessions)))
+        self._advertise_routes()
         return True
 
     def _drop(self, peer_id: bytes) -> None:
         with self._lock:
             sock = self._sessions.pop(peer_id, None)
+            changed = self._router.neighbor_down(peer_id)
         if sock is not None:
             try:
                 sock.close()
             except OSError:
                 pass
+            if changed:
+                self._advertise_routes()
 
     def _accept_loop(self) -> None:
         while not self._stopped:
@@ -238,11 +404,59 @@ class P2PGateway(Gateway):
             if frame is None:
                 self._drop(peer_id)
                 return
-            front = self._front
-            if front is None:
-                continue
             try:
-                front.on_network_message(peer_id, frame)
+                self._on_frame(peer_id, frame)
             except Exception:
                 LOG.exception(badge("P2P", "dispatch-failed",
                                     peer=peer_id[:8].hex()))
+
+    def _on_frame(self, peer_id: bytes, frame: bytes) -> None:
+        if not frame:
+            return
+        kind = frame[0]
+        if kind == KIND_ROUTE:
+            vector = {n: d for n, d in _unpack_route(frame).items()
+                      if self._acl_ok(n)}
+            with self._lock:
+                changed = self._router.update_vector(peer_id, vector)
+            if changed:
+                self._advertise_routes()
+            return
+        if kind != KIND_DATA:
+            return
+        flags, ttl, src, dst, payload = _unpack_data(frame)
+        # hop-level filtering: ACL-denied identities may neither inject nor
+        # transit, and a frame claiming a DIRECT neighbor's identity must
+        # arrive on that neighbor's own session. End-to-end authenticity of
+        # multi-hop sources rides on message signatures (PBFT packets, tx
+        # sigs, commit seals) exactly as in the reference's routed gateway.
+        if not self._acl_ok(src) or not self._acl_ok(dst):
+            return
+        with self._lock:
+            if src in self._sessions and src != peer_id:
+                spoofed = True
+            else:
+                spoofed = False
+        if spoofed:
+            LOG.warning(badge("P2P", "src-spoof-dropped",
+                              claimed=src[:8].hex(), via=peer_id[:8].hex()))
+            return
+        if dst != self.node_id:
+            # transit: forward toward dst with a decremented ttl
+            if ttl > 0:
+                fwd = frame[:2] + bytes([ttl - 1]) + frame[3:]
+                if not self._forward(dst, fwd):
+                    LOG.warning(badge("P2P", "no-route",
+                                      dst=dst[:8].hex(), ttl=ttl))
+            return
+        if flags & FLAG_COMPRESSED:
+            # bounded inflate: a 128 MB cap stops zlib bombs cold
+            d = zlib.decompressobj()
+            payload = d.decompress(payload, MAX_FRAME)
+            if d.unconsumed_tail:
+                LOG.warning(badge("P2P", "overlong-inflate-dropped",
+                                  src=src[:8].hex()))
+                return
+        front = self._front
+        if front is not None:
+            front.on_network_message(src, payload)

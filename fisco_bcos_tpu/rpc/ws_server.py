@@ -204,7 +204,16 @@ class WsRpcServer:
                 emit(task_id, *args)
             buffered.clear()
         sess.event_tasks.add(task_id)
+        if not self._session_alive(sess):
+            # disconnect raced the subscribe: _on_close saw an empty task
+            # set, so clean up here instead of leaking the task forever
+            self.node.eventsub.unsubscribe(task_id)
+            raise JsonRpcError(-32000, "session closed")
         return task_id
+
+    def _session_alive(self, sess: _Session) -> bool:
+        with self._lock:
+            return self._sessions.get(sess.conn) is sess
 
     def _m_unsubscribe_event(self, sess: _Session, params: list) -> bool:
         task_id = params[1] if len(params) > 1 else params[0]
@@ -228,6 +237,9 @@ class WsRpcServer:
                 if sess not in lst:
                     lst.append(sess)
             amop.subscribe(topic, self._amop_handler)
+            if not self._session_alive(sess):  # disconnect raced us
+                self._drop_topic(sess, topic)
+                raise JsonRpcError(-32000, "session closed")
         return True
 
     def _m_unsubscribe_topic(self, sess: _Session, params: list) -> bool:
@@ -273,7 +285,11 @@ class WsRpcServer:
         return None
 
     def _on_amop_resp(self, sess: _Session, msg: dict) -> None:
-        entry = sess.pending.get(int(msg.get("seq", -1)))
+        try:
+            seq = int(msg.get("seq", -1))
+        except (TypeError, ValueError):
+            return  # malformed resp must not tear down the session
+        entry = sess.pending.get(seq)
         if entry is None:
             return
         ev, out = entry

@@ -74,9 +74,9 @@ class WsSdkClient(SdkClient):
                     continue
                 try:
                     obj = json.loads(payload)
+                    self._route(obj)
                 except Exception:
-                    continue
-                self._route(obj)
+                    continue  # one bad message must not kill the client
         finally:
             # fail every in-flight waiter instead of letting it time out
             with self._lock:
@@ -109,20 +109,29 @@ class WsSdkClient(SdkClient):
             except Exception:
                 pass
         elif obj.get("type") == "amopPush":
-            self._on_amop_push(obj)
+            # off the reader thread: a topic handler may itself issue
+            # request()s, whose responses only this reader can deliver
+            threading.Thread(target=self._on_amop_push, args=(obj,),
+                             name="sdk-ws-amop", daemon=True).start()
 
     def _on_amop_push(self, obj: dict) -> None:
         cb = self._topic_handlers.get(obj.get("topic", ""))
         if cb is None:
             return
-        data = bytes.fromhex(str(obj.get("data", "")).removeprefix("0x"))
+        try:
+            data = bytes.fromhex(str(obj.get("data", "")).removeprefix("0x"))
+        except ValueError:
+            data = b""
         try:
             reply = cb(obj["topic"], data)
         except Exception:
             reply = None
-        self.conn.send_text(json.dumps({
-            "type": "amopResp", "seq": obj.get("seq"),
-            "data": "0x" + (reply or b"").hex()}))
+        try:
+            self.conn.send_text(json.dumps({
+                "type": "amopResp", "seq": obj.get("seq"),
+                "data": "0x" + (reply or b"").hex()}))
+        except Exception:
+            pass  # connection raced shut; the publisher times out
 
     # -- push channels -----------------------------------------------------
     def subscribe_event(self, flt: dict, cb: Callable) -> str:

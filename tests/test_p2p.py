@@ -75,3 +75,96 @@ def test_four_node_pbft_over_tcp():
             node.stop()
         for gw in gateways:
             gw.stop()
+
+
+def test_multi_hop_routing_compression_line_topology():
+    """3-hop line A-B-C-D: the distance-vector router must deliver PBFT
+    traffic end to end (RouterTableImpl.cpp semantics) with large frames
+    compressed (P2PMessageV2)."""
+    suite = make_suite(backend="host")
+    keypairs = [suite.generate_keypair(bytes([i + 60]) * 16)
+                for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    gateways = [P2PGateway(kp.pub_bytes, compress_threshold=256)
+                for kp in keypairs]
+    # line topology: only adjacent nodes know each other's addresses
+    for i in range(3):
+        gateways[i].add_peer(gateways[i + 1].host, gateways[i + 1].port)
+        gateways[i + 1].add_peer(gateways[i].host, gateways[i].port)
+
+    nodes = []
+    try:
+        for kp, gw in zip(keypairs, gateways):
+            node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                                   min_seal_time=0.0, view_timeout=8.0),
+                        keypair=kp, gateway=gw)
+            node.build_genesis(sealers)
+            nodes.append(node)
+        for node in nodes:
+            node.start()
+
+        # every node must see all 3 others as reachable (1 direct + routed)
+        assert wait_until(
+            lambda: all(len(gw.peers()) == 3 for gw in gateways), 30), \
+            [len(gw.peers()) for gw in gateways]
+        # ends of the line have ONE session but THREE reachable peers
+        assert len(gateways[0]._sessions) == 1
+        assert len(gateways[3]._sessions) == 1
+
+        kp = suite.generate_keypair(b"hop-user")
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register", lambda w: w.blob(b"hop").u64(3)),
+                         nonce="hop1", block_limit=100).sign(suite, kp)
+        res = nodes[0].send_transaction(tx)
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes), 30), \
+            [n.ledger.current_number() for n in nodes]
+        headers = [n.ledger.header_by_number(1) for n in nodes]
+        assert len({h.hash(suite) for h in headers}) == 1
+    finally:
+        for n in nodes:
+            n.stop()
+        for gw in gateways:
+            gw.stop()
+
+
+def test_peer_acl_allow_and_deny():
+    suite = make_suite(backend="host")
+    kps = [suite.generate_keypair(bytes([i + 80]) * 16) for i in range(3)]
+
+    class StubFront:
+        def __init__(self):
+            self.got = []
+
+        def on_network_message(self, src, data):
+            self.got.append((src, data))
+
+    # gw0 denies kp1 and allows only kp2
+    gw0 = P2PGateway(kps[0].pub_bytes,
+                     allow_list={kps[2].pub_bytes},
+                     deny_list={kps[1].pub_bytes})
+    gw1 = P2PGateway(kps[1].pub_bytes)
+    gw2 = P2PGateway(kps[2].pub_bytes)
+    fronts = [StubFront() for _ in range(3)]
+    try:
+        for gw, kp, fr in zip((gw0, gw1, gw2), kps, fronts):
+            gw.register_front(kp.pub_bytes, fr)
+        gw0.add_peer(gw1.host, gw1.port)
+        gw1.add_peer(gw0.host, gw0.port)
+        gw0.add_peer(gw2.host, gw2.port)
+        gw2.add_peer(gw0.host, gw0.port)
+
+        assert wait_until(lambda: kps[2].pub_bytes in gw0.peers(), 10)
+        time.sleep(1.5)  # give the denied link time to (not) form
+        assert kps[1].pub_bytes not in gw0.peers()
+
+        # compressed large payload round trip over the allowed link
+        blob = b"Z" * 50_000
+        assert gw0.send(kps[0].pub_bytes, kps[2].pub_bytes, blob)
+        assert wait_until(lambda: len(fronts[2].got) >= 1, 10)
+        assert fronts[2].got[0] == (kps[0].pub_bytes, blob)
+    finally:
+        for gw in (gw0, gw1, gw2):
+            gw.stop()
