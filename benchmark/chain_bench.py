@@ -1,0 +1,198 @@
+#!/usr/bin/env python3
+"""End-to-end chain TPS benchmark — BASELINE.json configs 4-5.
+
+Drives a LIVE 4-node PBFT chain (in-process transport, real engines/
+txpool/scheduler/ledger — the reference's 4-node Air chain shape,
+tools/BcosAirBuilder/build_chain.sh + docs/README_EN.md:11 "20k TPS") and
+reports:
+
+  * end-to-end TPS (committed txs / wall time from first submit),
+  * mean block interval and blocks committed,
+  * block-verify p50/p95 — the txpool verify_proposal latency per proposal
+    (BASELINE config 4's "block-verify p50" for large mixed blocks).
+
+Suites: --suite ecdsa | sm | both (config 4's "mixed secp256k1+SM2" is two
+chains, one per suite — a FISCO chain is single-suite by genesis).
+
+Host-side signing of the workload is NOT the benchmark; it is parallelised
+across processes and excluded from the timed window.
+
+Usage: python benchmark/chain_bench.py [-n 2000] [--backend auto|host]
+       [--suite ecdsa|sm|both] [--tx-count-limit 1000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SIGN_CHUNK = 250
+
+
+def _sign_chunk(args) -> list[bytes]:
+    """Worker: sign a chunk of register txs (picklable, re-imports)."""
+    sm, seed, start, count, block_limit = args
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.protocol import Transaction
+
+    suite = make_suite(sm, backend="host")
+    kp = suite.generate_keypair(seed)
+    out = []
+    for i in range(start, start + count):
+        tx = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call(
+                "register",
+                lambda w, i=i: w.blob(b"acct%d" % i).u64(1)),
+            nonce=f"cb-{i}", block_limit=block_limit,
+        ).sign(suite, kp)
+        out.append(tx.encode())
+    return out
+
+
+def _build_workload(sm: bool, n: int, block_limit: int) -> list[bytes]:
+    from concurrent.futures import ProcessPoolExecutor
+    import multiprocessing
+
+    chunks = [(sm, b"chain-bench", s, min(_SIGN_CHUNK, n - s), block_limit)
+              for s in range(0, n, _SIGN_CHUNK)]
+    workers = os.cpu_count() or 1
+    if workers == 1 or len(chunks) == 1:
+        return [tx for ch in map(_sign_chunk, chunks) for tx in ch]
+    ctx = multiprocessing.get_context("spawn")
+    with ProcessPoolExecutor(workers, mp_context=ctx) as ex:
+        return [tx for ch in ex.map(_sign_chunk, chunks) for tx in ch]
+
+
+def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int) -> dict:
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+    from fisco_bcos_tpu.net.gateway import FakeGateway
+    from fisco_bcos_tpu.protocol import Transaction
+
+    suite = make_suite(sm, backend="host")  # node identity keys
+    gateway = FakeGateway()
+    keypairs = [suite.generate_keypair(bytes([i + 1]) * 16)
+                for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    nodes = []
+    for kp in keypairs:
+        node = Node(NodeConfig(consensus="pbft", sm_crypto=sm,
+                               crypto_backend=backend, min_seal_time=0.0,
+                               view_timeout=30.0,
+                               tx_count_limit=tx_count_limit),
+                    keypair=kp, gateway=gateway)
+        node.build_genesis(sealers)
+        nodes.append(node)
+
+    # instrument proposal verification latency on every node
+    verify_times: list[float] = []
+    for node in nodes:
+        orig = node.txpool.verify_proposal
+
+        def timed(block, _orig=orig):
+            t0 = time.perf_counter()
+            ok = _orig(block)
+            verify_times.append(time.perf_counter() - t0)
+            return ok
+
+        node.txpool.verify_proposal = timed
+
+    print(f"signing {n} txs (excluded from the timed window)...",
+          file=sys.stderr, flush=True)
+    # block_limit must satisfy current < limit <= current + range (default
+    # range 600, chain starts at 0)
+    wire_txs = _build_workload(sm, n, block_limit=500)
+
+    commit_times: dict[int, float] = {}
+    orig_commit = nodes[0].scheduler.commit_block
+
+    def commit_hook(header, _orig=orig_commit):
+        ok = _orig(header)
+        if ok:
+            commit_times[header.number] = time.perf_counter()
+        return ok
+
+    nodes[0].scheduler.commit_block = commit_hook
+
+    for node in nodes:
+        node.start()
+    try:
+        # submit in wire-realistic gossip batches round-robin across nodes
+        # (TransactionSync.cpp:516 imports downloaded txs in batches); the
+        # batch path is what the TPU batch-recover accelerates
+        t0 = time.perf_counter()
+        chunk = 512
+        for i, s in enumerate(range(0, len(wire_txs), chunk)):
+            txs = [Transaction.decode(raw) for raw in wire_txs[s:s + chunk]]
+            results = nodes[i % 4].txpool.submit_batch(txs)
+            if i == 0 and int(results[0].status) != 0:
+                raise RuntimeError(
+                    f"first submit rejected: {results[0].status}")
+        t_submitted = time.perf_counter()
+        deadline = time.monotonic() + max(120.0, n / 50)
+        want = nodes[0].ledger  # all nodes advance in lockstep
+        while time.monotonic() < deadline:
+            total = want.total_tx_count()
+            if total >= n:
+                break
+            time.sleep(0.05)
+        t_end = time.perf_counter()
+        committed = want.total_tx_count()
+        height = want.current_number()
+    finally:
+        for node in nodes:
+            node.stop()
+        gateway.stop()
+
+    intervals = []
+    ordered = [commit_times[k] for k in sorted(commit_times)]
+    intervals = [b - a for a, b in zip(ordered, ordered[1:])]
+    vt = sorted(verify_times)
+
+    def pct(p):
+        return vt[min(len(vt) - 1, int(p * len(vt)))] if vt else 0.0
+
+    return {
+        "suite": "sm" if sm else "ecdsa",
+        "txs_committed": int(committed),
+        "blocks": int(height),
+        "tps": round(committed / (t_end - t0), 1) if t_end > t0 else 0.0,
+        "submit_seconds": round(t_submitted - t0, 3),
+        "wall_seconds": round(t_end - t0, 3),
+        "block_interval_mean_ms": round(
+            statistics.mean(intervals) * 1000, 1) if intervals else None,
+        "block_verify_p50_ms": round(pct(0.50) * 1000, 2),
+        "block_verify_p95_ms": round(pct(0.95) * 1000, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=2000)
+    ap.add_argument("--backend", default="host",
+                    choices=["auto", "host", "device"])
+    ap.add_argument("--suite", default="ecdsa",
+                    choices=["ecdsa", "sm", "both"])
+    ap.add_argument("--tx-count-limit", type=int, default=1000)
+    args = ap.parse_args()
+
+    suites = [False, True] if args.suite == "both" else \
+        [args.suite == "sm"]
+    for sm in suites:
+        res = run_chain(sm, args.n, args.backend, args.tx_count_limit)
+        res.update({"metric": f"chain_tps_4node_{res['suite']}",
+                    "value": res["tps"], "unit": "tx/sec"})
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

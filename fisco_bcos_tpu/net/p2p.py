@@ -198,8 +198,10 @@ class P2PGateway(Gateway):
         self._router = RouterTable(node_id)
         self._lock = threading.Lock()
         # held across build+send of ROUTE frames so two concurrent topology
-        # events cannot deliver a stale vector after a newer one
-        self._adv_lock = threading.Lock()
+        # events cannot deliver a stale vector after a newer one. RLock: a
+        # send failure inside the advertise loop drops the session, which
+        # re-advertises re-entrantly (bounded — each drop removes a session).
+        self._adv_lock = threading.RLock()
         self._stopped = False
 
         self._listener = socket.create_server((host, port))
@@ -227,12 +229,14 @@ class P2PGateway(Gateway):
         with self._lock:
             return sorted(set(self._sessions) | set(self._router.reachable()))
 
-    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
-        flags = 0
+    def _encode_payload(self, data: bytes) -> tuple[int, bytes]:
         if len(data) >= self.compress_threshold:
-            data = zlib.compress(data, 6)
-            flags |= FLAG_COMPRESSED
-        frame = _pack_data(flags, MAX_TTL, self.node_id, dst, data)
+            return FLAG_COMPRESSED, zlib.compress(data, 6)
+        return 0, data
+
+    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        flags, payload = self._encode_payload(data)
+        frame = _pack_data(flags, MAX_TTL, self.node_id, dst, payload)
         return self._forward(dst, frame)
 
     def _forward(self, dst: bytes, frame: bytes) -> bool:
@@ -253,8 +257,10 @@ class P2PGateway(Gateway):
             return False
 
     def broadcast(self, src: bytes, data: bytes) -> None:
+        flags, payload = self._encode_payload(data)  # compress ONCE
         for dst in self.peers():
-            self.send(src, dst, data)
+            self._forward(dst, _pack_data(flags, MAX_TTL, self.node_id,
+                                          dst, payload))
 
     def _advertise_routes(self) -> None:
         with self._adv_lock:
@@ -432,6 +438,8 @@ class P2PGateway(Gateway):
         # sigs, commit seals) exactly as in the reference's routed gateway.
         if not self._acl_ok(src) or not self._acl_ok(dst):
             return
+        if src == self.node_id:
+            return  # a frame claiming OUR identity off the wire is forged
         with self._lock:
             if src in self._sessions and src != peer_id:
                 spoofed = True

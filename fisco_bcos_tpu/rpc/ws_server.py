@@ -87,9 +87,11 @@ class WsRpcServer:
             sess = self._sessions.pop(conn, None)
         if sess is None:
             return
-        for task_id in sess.event_tasks:
+        # copies: a concurrent subscribe dispatch may still add entries (it
+        # re-checks session liveness afterwards and cleans up its own)
+        for task_id in list(sess.event_tasks):
             self.node.eventsub.unsubscribe(task_id)
-        for topic in sess.topics:
+        for topic in list(sess.topics):
             self._drop_topic(sess, topic)
 
     def _drop_topic(self, sess: _Session, topic: str) -> None:

@@ -121,7 +121,8 @@ class WsSdkClient(SdkClient):
         try:
             data = bytes.fromhex(str(obj.get("data", "")).removeprefix("0x"))
         except ValueError:
-            data = b""
+            return  # corrupt push: let the publisher time out, don't
+            # hand the handler a payload it never received
         try:
             reply = cb(obj["topic"], data)
         except Exception:
