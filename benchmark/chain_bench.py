@@ -109,8 +109,15 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int) -> dict:
     print(f"signing {n} txs (excluded from the timed window)...",
           file=sys.stderr, flush=True)
     # block_limit must satisfy current < limit <= current + range (default
-    # range 600, chain starts at 0)
-    wire_txs = _build_workload(sm, n, block_limit=500)
+    # range 600, chain starts at 0) AND outlive every block this run needs,
+    # or txs expire at seal time and the bench stalls to its deadline
+    blocks_needed = -(-n // max(1, tx_count_limit))
+    block_limit = min(600, max(100, 2 * blocks_needed + 20))
+    if blocks_needed > 550:
+        raise SystemExit(
+            f"n/tx_count_limit needs ~{blocks_needed} blocks, beyond the "
+            f"600-block tx lifetime; raise --tx-count-limit")
+    wire_txs = _build_workload(sm, n, block_limit=block_limit)
 
     commit_times: dict[int, float] = {}
     orig_commit = nodes[0].scheduler.commit_block

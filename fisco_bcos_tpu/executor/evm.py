@@ -176,6 +176,11 @@ class EVM:
         self.suite = suite
         # framework precompiles (Table/Consensus/...) visible to EVM CALLs
         self.registry = registry or {}
+        # DMC seam: when set, internal CALL/STATICCALL targets the hook may
+        # claim (contracts owned by ANOTHER executor shard) are routed out
+        # instead of executed locally. hook(caller, to, value, data, gas,
+        # static, depth) -> EVMResult, or None to execute locally.
+        self.external_call = None
 
     # -- account helpers ---------------------------------------------------
     @staticmethod
@@ -214,6 +219,11 @@ class EVM:
         """CALL semantics against `to` (code fetched from state)."""
         if depth > MAX_DEPTH:
             return EVMResult(False, gas_left=gas, error="call depth")
+        if self.external_call is not None and depth > 0:
+            ext = self.external_call(caller, to, value, data, gas, static,
+                                     depth)
+            if ext is not None:
+                return ext
         sp = state.savepoint()
         if not static and not self.transfer(state, caller, to, value):
             state.rollback_to(sp)

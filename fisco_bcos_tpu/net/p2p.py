@@ -202,6 +202,7 @@ class P2PGateway(Gateway):
         # send failure inside the advertise loop drops the session, which
         # re-advertises re-entrantly (bounded — each drop removes a session).
         self._adv_lock = threading.RLock()
+        self._topo_version = 0  # bumped under _lock on any routing change
         self._stopped = False
 
         self._listener = socket.create_server((host, port))
@@ -263,19 +264,29 @@ class P2PGateway(Gateway):
                                           dst, payload))
 
     def _advertise_routes(self) -> None:
+        # loop until the vector we just finished sending is still current:
+        # a send failure mid-loop drops the session and re-enters (RLock),
+        # sending a NEWER vector; when the outer pass then resumes with its
+        # stale frame, the version check catches it and resends fresh — the
+        # LAST frame every neighbor sees is always the newest.
         with self._adv_lock:
-            with self._lock:
-                frame = _pack_route(self._router.vector())
-                targets = [(nb, self._sessions[nb],
-                            self._send_locks.setdefault(nb,
-                                                        threading.Lock()))
-                           for nb in self._sessions]
-            for nb, sock, slock in targets:
-                try:
-                    with slock:
-                        _send_frame(sock, frame)
-                except OSError:
-                    self._drop(nb)
+            while True:
+                with self._lock:
+                    ver = self._topo_version
+                    frame = _pack_route(self._router.vector())
+                    targets = [(nb, self._sessions[nb],
+                                self._send_locks.setdefault(
+                                    nb, threading.Lock()))
+                               for nb in self._sessions]
+                for nb, sock, slock in targets:
+                    try:
+                        with slock:
+                            _send_frame(sock, frame)
+                    except OSError:
+                        self._drop(nb)
+                with self._lock:
+                    if self._topo_version == ver:
+                        return
 
     def stop(self) -> None:
         self._stopped = True
@@ -331,6 +342,7 @@ class P2PGateway(Gateway):
                 return False  # duplicate dial; first session wins
             self._sessions[peer_id] = sock
             self._router.neighbor_up(peer_id)
+            self._topo_version += 1
         self._spawn(lambda: self._read_loop(peer_id, sock),
                     f"p2p-read-{peer_id[:4].hex()}")
         LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
@@ -342,6 +354,8 @@ class P2PGateway(Gateway):
         with self._lock:
             sock = self._sessions.pop(peer_id, None)
             changed = self._router.neighbor_down(peer_id)
+            if sock is not None:
+                self._topo_version += 1
         if sock is not None:
             try:
                 sock.close()
@@ -425,6 +439,8 @@ class P2PGateway(Gateway):
                       if self._acl_ok(n)}
             with self._lock:
                 changed = self._router.update_vector(peer_id, vector)
+                if changed:
+                    self._topo_version += 1
             if changed:
                 self._advertise_routes()
             return

@@ -1,0 +1,159 @@
+"""DMC message rounds: cross-shard call routing, nesting, deadlock revert.
+
+Reference scenarios: bcos-scheduler/test/testDmcExecutor.cpp — executives
+pause at cross-contract calls, the scheduler routes ExecutionMessages
+between (remote) executors in rounds, and lock cycles revert the higher
+context (BlockExecutive.cpp:861-978, GraphKeyLocks.cpp).
+"""
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor.evm import T_CODE, T_STORE
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.scheduler.dmc_rounds import (
+    DmcRoundScheduler,
+    ShardExecutor,
+)
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+
+
+def _push_addr(addr: bytes) -> bytes:
+    return b"\x73" + addr  # PUSH20
+
+
+def _call_forward(target: bytes) -> bytes:
+    """CALL target (no args), SSTORE(0, success), return its 32-byte out."""
+    return (
+        b"\x60\x20\x5f\x5f\x5f\x5f"  # out_size=32 out_off in_size in_off val
+        + _push_addr(target)
+        + b"\x61\xff\xff"  # gas
+        + b"\xf1"          # CALL -> success
+        + b"\x5f\x55"      # SSTORE(slot 0, success)
+        + b"\x60\x20\x5f\xf3"  # RETURN(0, 32)
+    )
+
+
+LEAF = (b"\x60\x07\x5f\x55"          # SSTORE(0, 7)
+        b"\x60\x2a\x5f\x52"          # MSTORE(0, 42)
+        b"\x60\x20\x5f\xf3")         # RETURN(0, 32)
+
+
+def _setup(partition):
+    """-> (suite, base_state, scheduler, shards) with an address partition
+    fn mapping addr -> shard index."""
+    suite = make_suite(backend="host")
+    base = StateStorage(MemoryStorage())
+    shards = [
+        ShardExecutor(b"shard-%d" % i, suite,
+                      owns=lambda a, i=i: partition(a) == i)
+        for i in range(2)
+    ]
+    return suite, base, DmcRoundScheduler(shards), shards
+
+
+def _tx(suite, kp, to, nonce, data=b""):
+    return Transaction(to=to, input=data, nonce=nonce,
+                       block_limit=100).sign(suite, kp)
+
+
+def test_cross_shard_call_roundtrip():
+    # A (shard 0) calls B (shard 1); B writes storage and returns 42
+    A, B = b"\xaa" * 20, b"\xbb" * 20
+    suite, base, sched, _ = _setup(lambda a: 0 if a == A else 1)
+    kp = suite.generate_keypair(b"dmc-user")
+    base.set(T_CODE, A, _call_forward(B))
+    base.set(T_CODE, B, LEAF)
+
+    [rc] = sched.execute_block([_tx(suite, kp, A, "r1")], base, 1, 0)
+    assert rc.status == 0, rc.message
+    assert int.from_bytes(rc.output, "big") == 42  # B's return, via A
+    # B's write landed (on shard 1's partition, merged into base)
+    assert base.get(T_STORE, B + (0).to_bytes(32, "big")) == (7).to_bytes(32, "big")
+    # A recorded the call success flag
+    assert base.get(T_STORE, A + (0).to_bytes(32, "big")) == (1).to_bytes(32, "big")
+
+
+def test_nested_reentrant_chain_across_shards():
+    # A (shard 0) -> B (shard 1) -> C (shard 0): the sub-call re-enters the
+    # origin shard while the root frame is paused there
+    A, B, C = b"\xaa" * 20, b"\xbb" * 20, b"\xcc" * 20
+    suite, base, sched, _ = _setup(lambda a: 1 if a == B else 0)
+    kp = suite.generate_keypair(b"dmc-user2")
+    base.set(T_CODE, A, _call_forward(B))
+    base.set(T_CODE, B, _call_forward(C))
+    base.set(T_CODE, C, LEAF)
+
+    [rc] = sched.execute_block([_tx(suite, kp, A, "n1")], base, 1, 0)
+    assert rc.status == 0, rc.message
+    assert int.from_bytes(rc.output, "big") == 42  # C -> B -> A
+    assert base.get(T_STORE, C + (0).to_bytes(32, "big")) == (7).to_bytes(32, "big")
+
+
+def test_two_contexts_opposite_shards_no_conflict():
+    # tx0 runs entirely on shard 0, tx1 on shard 1 — both succeed
+    A, B = b"\xaa" * 20, b"\xbb" * 20
+    suite, base, sched, _ = _setup(lambda a: 0 if a == A else 1)
+    kp = suite.generate_keypair(b"dmc-user3")
+    base.set(T_CODE, A, LEAF)
+    base.set(T_CODE, B, LEAF)
+    rcs = sched.execute_block(
+        [_tx(suite, kp, A, "p1"), _tx(suite, kp, B, "p2")], base, 1, 0)
+    assert all(rc.status == 0 for rc in rcs)
+    assert base.get(T_STORE, A + (0).to_bytes(32, "big")) == (7).to_bytes(32, "big")
+    assert base.get(T_STORE, B + (0).to_bytes(32, "big")) == (7).to_bytes(32, "big")
+
+
+def test_deadlock_reverts_higher_context_and_completes():
+    # ctx0: A1 (shard0) -> B1 (shard1); ctx1: B2 (shard1) -> A2 (shard0).
+    # FIFO processing: ctx0 takes shard0 and pauses; ctx1 takes shard1 and
+    # pauses; each waits on the other's shard -> deadlock. ctx1 (higher id)
+    # reverts and re-runs after ctx0 completes. Both must end successful
+    # with all four stores visible.
+    A1, B1 = b"\xa1" * 20, b"\xb1" * 20
+    B2, A2 = b"\xb2" * 20, b"\xa2" * 20
+    shard_of = lambda a: 0 if a in (A1, A2) else 1  # noqa: E731
+    suite, base, sched, _ = _setup(shard_of)
+    kp = suite.generate_keypair(b"dmc-user4")
+    base.set(T_CODE, A1, _call_forward(B1))
+    base.set(T_CODE, B1, LEAF)
+    base.set(T_CODE, B2, _call_forward(A2))
+    base.set(T_CODE, A2, LEAF)
+
+    rcs = sched.execute_block(
+        [_tx(suite, kp, A1, "d1"), _tx(suite, kp, B2, "d2")], base, 1, 0)
+    assert all(rc.status == 0 for rc in rcs), [
+        (rc.status, rc.message) for rc in rcs]
+    for addr in (B1, A2):
+        assert base.get(T_STORE, addr + (0).to_bytes(32, "big")) == (7).to_bytes(32, "big")
+    for addr in (A1, B2):  # call success flags
+        assert base.get(T_STORE, addr + (0).to_bytes(32, "big")) == (1).to_bytes(32, "big")
+
+
+def test_deterministic_across_runs():
+    """Same block twice on fresh state -> identical receipts + changesets."""
+    A1, B1 = b"\xa1" * 20, b"\xb1" * 20
+    B2, A2 = b"\xb2" * 20, b"\xa2" * 20
+    shard_of = lambda a: 0 if a in (A1, A2) else 1  # noqa: E731
+    suite = make_suite(backend="host")
+    kp = suite.generate_keypair(b"dmc-user5")
+
+    def run_once():
+        base = StateStorage(MemoryStorage())
+        shards = [ShardExecutor(b"s%d" % i, suite,
+                                owns=lambda a, i=i: shard_of(a) == i)
+                  for i in range(2)]
+        sched = DmcRoundScheduler(shards)
+        base.set(T_CODE, A1, _call_forward(B1))
+        base.set(T_CODE, B1, LEAF)
+        base.set(T_CODE, B2, _call_forward(A2))
+        base.set(T_CODE, A2, LEAF)
+        rcs = sched.execute_block(
+            [_tx(suite, kp, A1, "x1"), _tx(suite, kp, B2, "x2")],
+            base, 1, 0)
+        return ([(rc.status, rc.output, rc.gas_used) for rc in rcs],
+                sorted((t, k, e.value) for (t, k), e
+                       in base.changeset().items()))
+
+    assert run_once() == run_once()
