@@ -1,0 +1,89 @@
+"""Ed25519 signatures (RFC 8032) — the suite's third signature family.
+
+Reference counterpart: /root/reference/bcos-crypto/bcos-crypto/signature/
+ed25519/Ed25519Crypto.cpp (sign/verify/recover-less keypair surface over
+the WeDPR FFI). Here the primitive rides the OpenSSL implementation shipped
+in the `cryptography` package (the same backend class the reference links),
+with the framework's batch-first calling convention on top. Ed25519 has no
+public-key recovery; like the SM2 suite, wire signatures carry the public
+key (sig = R||S||pub, 96 bytes) so `recover_batch` degenerates to
+verify + extract — the SignatureDataWithPub.h pattern.
+
+Edwards-curve batch verification on the TPU is a seam, not a kernel, for
+now: consortium chains sign consensus/tx traffic with secp256k1 or SM2
+(where the device kernels live); Ed25519 is the auxiliary identity suite.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+SIGNATURE_SIZE = 96  # R(32) | S(32) | pub(32)
+
+
+def _backend():
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _e
+
+    return _e
+
+
+def keygen(seed: Optional[bytes] = None) -> tuple[bytes, bytes]:
+    """-> (private_bytes(32), public_bytes(32))."""
+    e = _backend()
+    if seed is not None:
+        if len(seed) < 32:
+            seed = seed.ljust(32, b"\x00")
+        sk = e.Ed25519PrivateKey.from_private_bytes(seed[:32])
+    else:
+        sk = e.Ed25519PrivateKey.generate()
+    from cryptography.hazmat.primitives import serialization as s
+
+    priv = sk.private_bytes(s.Encoding.Raw, s.PrivateFormat.Raw,
+                            s.NoEncryption())
+    pub = sk.public_key().public_bytes(s.Encoding.Raw, s.PublicFormat.Raw)
+    return priv, pub
+
+
+def sign(priv: bytes, message: bytes) -> bytes:
+    """-> 64-byte RFC 8032 signature over the message."""
+    e = _backend()
+    return e.Ed25519PrivateKey.from_private_bytes(priv).sign(message)
+
+
+def verify(pub: bytes, message: bytes, sig: bytes) -> bool:
+    e = _backend()
+    try:
+        e.Ed25519PublicKey.from_public_bytes(pub).verify(sig[:64], message)
+        return True
+    except Exception:
+        return False
+
+
+def verify_batch(pubs: Sequence[bytes], messages: Sequence[bytes],
+                 sigs: Sequence[bytes]) -> np.ndarray:
+    """-> bool[N] (batch-first convention; OpenSSL per-item underneath)."""
+    return np.array([verify(p, m, g)
+                     for p, m, g in zip(pubs, messages, sigs)], dtype=bool)
+
+
+class Ed25519KeyPair:
+    """Suite-compatible keypair: sign_digest dispatches here (the same duck
+    type the HSM keypairs use, crypto/hsm.py)."""
+
+    def __init__(self, suite, seed: Optional[bytes] = None):
+        self.suite = suite
+        self.secret, self.pub_raw = keygen(seed)
+
+    @property
+    def pub_bytes(self) -> bytes:
+        return self.pub_raw + b"\x00" * 32  # padded to the 64B suite shape
+
+    @property
+    def address(self) -> bytes:
+        return self.suite.address_of_pub(self.pub_bytes)
+
+    def sign_digest(self, digest: bytes) -> bytes:
+        sig = sign(self.secret, digest)
+        return sig + self.pub_raw  # R||S||pub — carries the key like SM2

@@ -160,6 +160,17 @@ SM2P256V1 = CurveParams(
 )
 
 
+def is_on_curve(c: CurveParams, P) -> bool:
+    """Affine point validity (None = infinity is NOT considered on-curve
+    for input validation purposes)."""
+    if P is None:
+        return False
+    x, y = P
+    if not (0 <= x < c.p and 0 <= y < c.p):
+        return False
+    return (y * y - (x * x * x + c.a * x + c.b)) % c.p == 0
+
+
 def ec_add(c: CurveParams, P, Q):
     if P is None:
         return Q

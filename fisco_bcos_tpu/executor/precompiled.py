@@ -37,9 +37,13 @@ DAG_TRANSFER_ADDRESS = addr(0x100C)  # parallel-transfer benchmark contract
 BFS_ADDRESS = addr(0x100E)
 CAST_ADDRESS = addr(0x100F)
 BALANCE_ADDRESS = addr(0x1011)
-AUTH_MANAGER_ADDRESS = addr(0x10001)  # committee/auth plane (extension/)
-CONTRACT_AUTH_ADDRESS = addr(0x1005)
+# extension plane (PrecompiledTypeDef.h:63,73-83)
+AUTH_MANAGER_ADDRESS = addr(0x1005)
+CONTRACT_AUTH_ADDRESS = addr(0x10002)
 ACCOUNT_MANAGER_ADDRESS = addr(0x10003)
+GROUP_SIG_ADDRESS = addr(0x5004)
+RING_SIG_ADDRESS = addr(0x5005)
+DISCRETE_ZKP_ADDRESS = addr(0x5100)
 
 
 class PrecompileError(Exception):
@@ -1038,6 +1042,90 @@ class CastPrecompile(Precompile):
         w.text("0x" + r.blob().hex())
 
 
+# ---------------------------------------------------------------------------
+# Discrete-log ZKP verifiers (zkp/discretezkp via ZkpPrecompiled) and
+# linkable ring signatures (extension/RingSigPrecompiled.cpp). Group
+# signatures (extension/GroupSigPrecompiled.cpp) stay gated like the
+# reference's optional GroupSig lib.
+# ---------------------------------------------------------------------------
+
+class ZkpPrecompile(Precompile):
+    name = "discrete_zkp"
+
+    def methods(self):
+        return {
+            "verifyKnowledgeProof": self._know,
+            "verifyEqualityProof": self._eq,
+        }
+
+    def _know(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        from ..crypto import zkp
+
+        try:
+            point = zkp._dec(r.blob())
+            proof = zkp.KnowledgeProof.decode(r.blob())
+            ok = zkp.verify_knowledge(point, proof, r.blob())
+        except (ValueError, IndexError):
+            ok = False
+        w.u8(1 if ok else 0)
+
+    def _eq(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        from ..crypto import zkp
+
+        try:
+            P, Q, H = (zkp._dec(r.blob()) for _ in range(3))
+            proof = zkp.EqualityProof.decode(r.blob())
+            ok = zkp.verify_equality(P, Q, H, proof, r.blob())
+        except (ValueError, IndexError):
+            ok = False
+        w.u8(1 if ok else 0)
+
+
+class RingSigPrecompile(Precompile):
+    name = "ring_sig"
+
+    def methods(self):
+        return {"ringSigVerify": self._verify, "linked": self._linked}
+
+    def _verify(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        from ..crypto import zkp
+
+        try:
+            message = r.blob()
+            ring = [zkp._dec(b) for b in r.seq(lambda rr: rr.blob())]
+            sig = zkp.RingSignature.decode(r.blob())
+            ok = zkp.ring_verify(message, ring, sig)
+        except (ValueError, IndexError):
+            ok = False
+        w.u8(1 if ok else 0)
+
+    def _linked(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        from ..crypto import zkp
+
+        try:
+            a = zkp.RingSignature.decode(r.blob())
+            b = zkp.RingSignature.decode(r.blob())
+            w.u8(1 if zkp.linked(a, b) else 0)
+        except (ValueError, IndexError):
+            w.u8(0)
+
+
+class GroupSigPrecompile(Precompile):
+    """Gated: the reference links an optional BBS04 GroupSig library; no
+    equivalent is bundled, so verification reports unavailable (the same
+    failure surface as a reference build without the lib)."""
+
+    name = "group_sig"
+
+    def methods(self):
+        return {"groupSigVerify": self._verify}
+
+    def _verify(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        raise PrecompileError(
+            "group signature verification requires the optional GroupSig "
+            "backend (reference: cmake/ProjectGroupSig.cmake)")
+
+
 PRECOMPILED_REGISTRY: dict[bytes, Precompile] = {
     BALANCE_ADDRESS: BalancePrecompile(),
     DAG_TRANSFER_ADDRESS: BalancePrecompile(),  # same semantics, bench alias
@@ -1052,4 +1140,7 @@ PRECOMPILED_REGISTRY: dict[bytes, Precompile] = {
     AUTH_MANAGER_ADDRESS: AuthManagerPrecompile(),
     CONTRACT_AUTH_ADDRESS: ContractAuthPrecompile(),
     ACCOUNT_MANAGER_ADDRESS: AccountManagerPrecompile(),
+    DISCRETE_ZKP_ADDRESS: ZkpPrecompile(),
+    RING_SIG_ADDRESS: RingSigPrecompile(),
+    GROUP_SIG_ADDRESS: GroupSigPrecompile(),
 }

@@ -46,6 +46,7 @@ from ..executor.evm import EVMResult
 from ..protocol import Receipt, Transaction, TransactionStatus
 from ..storage.state import StateStorage
 from ..utils.log import LOG, badge, metric
+from ..utils.trace import DmcStepRecorder
 
 MSG_ROOT, MSG_CALL = 0, 1
 
@@ -128,19 +129,29 @@ class ShardExecutor:
     """
 
     def __init__(self, shard_id: bytes, suite,
-                 owns: Callable[[bytes], bool]):
+                 owns: Callable[[bytes], bool],
+                 precompile_home: bool = False):
         self.shard_id = shard_id
         self.suite = suite
         self.owns = owns
+        # system precompiles live on ONE deterministic shard (the scheduler
+        # marks shards[0]) so their state has a single writer under the
+        # shard lock — replicating them would lose updates at merge
+        self.precompile_home = precompile_home
         self.executor = TransactionExecutor(suite)
         self._tls = threading.local()
         self.executor.evm.external_call = self._hook
         self._overlays: dict[int, StateStorage] = {}
 
+    def _is_local(self, to: bytes) -> bool:
+        if to in self.executor.registry:
+            return self.precompile_home
+        return self.owns(to)
+
     # -- cross-shard hook (runs ON an executive thread) --------------------
     def _hook(self, caller, to, value, data, gas, static, depth):
-        if self.owns(to) or to in self.executor.registry:
-            return None  # local: precompiles replicate on every shard
+        if self._is_local(to):
+            return None
         external = getattr(self._tls, "external", None)
         if external is None:
             return None  # not executing under the round scheduler
@@ -220,16 +231,20 @@ class DmcRoundScheduler:
 
     def __init__(self, shards: Sequence[ShardExecutor]):
         self.shards = list(shards)
+        if self.shards and not any(sh.precompile_home for sh in self.shards):
+            self.shards[0].precompile_home = True
 
     def _shard_for(self, addr: bytes) -> Optional[ShardExecutor]:
         for sh in self.shards:
-            if sh.owns(addr):
+            if sh._is_local(addr):
                 return sh
         return None  # unowned: the scheduler fails the message (a fallback
         # shard would re-externalize the same call forever)
 
     def execute_block(self, txs: Sequence[Transaction], base: StateStorage,
-                      block_number: int, timestamp: int) -> list[Receipt]:
+                      block_number: int, timestamp: int,
+                      recorder: Optional[DmcStepRecorder] = None
+                      ) -> list[Receipt]:
         receipts: list[Optional[Receipt]] = [None] * len(txs)
         # shard lock table: shard_id -> holding context (the GraphKeyLocks
         # grain here is the contract partition, the DMC sharding unit)
@@ -267,7 +282,7 @@ class DmcRoundScheduler:
                     result = payload
                 step(parent_sh, ctx, parent_ex.resume(result), parent_ex)
                 return
-            # root frame done -> context complete: merge + release
+            # root frame done -> context complete
             if kind == "error":
                 rc = Receipt(block_number=block_number)
                 rc.status = int(TransactionStatus.EXECUTION_ABORTED)
@@ -275,8 +290,19 @@ class DmcRoundScheduler:
                 receipts[ctx] = rc
             else:
                 receipts[ctx] = payload  # type: ignore[assignment]
-            for shard in self.shards:
-                shard.merge(ctx, base)
+            # TRANSACTION atomicity across shards: merge overlays only when
+            # the root tx succeeded; a reverted/aborted tx discards every
+            # shard's writes, including remote callees'. (Frame-granular
+            # rollback of a cross-shard sub-call whose ENCLOSING frame later
+            # reverts inside a successful tx would need the reference's
+            # per-seq revert messages — not modeled; contracts share state
+            # across shards at tx granularity.)
+            if receipts[ctx] is not None and receipts[ctx].status == 0:
+                for shard in self.shards:
+                    shard.merge(ctx, base)
+            else:
+                for shard in self.shards:
+                    shard.discard(ctx)
             for sid in held[ctx]:
                 if lock_of.get(sid) == ctx:
                     del lock_of[sid]
@@ -330,6 +356,8 @@ class DmcRoundScheduler:
                 lock_of[sh.shard_id] = ctx
                 held[ctx].add(sh.shard_id)
                 progressed = True
+                if recorder is not None:  # determinism checksum per message
+                    recorder.record_message(ctx, msg.seq, msg.to, msg.data)
                 if msg.kind == MSG_ROOT:
                     ex = sh.start_root(msg, base, block_number, timestamp)
                 else:
@@ -338,6 +366,8 @@ class DmcRoundScheduler:
                 # messages generated during the step join this round's work
                 while ready:
                     work.append(ready.popleft())
+            if recorder is not None:
+                recorder.next_round()
             # lock-blocked messages retry next round in deterministic order
             ready.extend(sorted(still_blocked,
                                 key=lambda m: (m.context_id, m.seq)))

@@ -77,6 +77,8 @@ class Scheduler:
             parent = self.ledger.header_by_number(current)
             parent_hash = parent.hash(self.suite) if parent else b"\x00" * 32
 
+            from ..utils.trace import block_trace
+            trace = block_trace(header.number)
             txs = block.transactions
             if not txs and block.tx_hashes:
                 if self.txpool is None:
@@ -86,10 +88,12 @@ class Scheduler:
                     LOG.warning(badge("SCHED", "missing-txs", number=header.number))
                     return None
                 block.transactions = txs
+            trace.stage("fill")
 
             state = StateStorage(self.storage)
             receipts = self.executor.execute_block_dag(
                 txs, state, header.number, header.timestamp)
+            trace.stage("execute")
 
             # finalise header: parent info + roots
             header.parent_info = [ParentInfo(current, parent_hash)]
@@ -98,6 +102,7 @@ class Scheduler:
             header.receipts_root = block.calculate_receipts_root(self.suite)
             self.ledger.prewrite_block(block, state)
             header.state_root = self.executor.state_root(state.changeset())
+            trace.stage("roots")
             header.gas_used = sum(r.gas_used for r in receipts)
             header.invalidate()
             if sealer_list is not None:
@@ -143,6 +148,10 @@ class Scheduler:
             nonces = self.ledger.nonces_by_number(header.number)
             self.txpool.on_block_committed(header.number, tx_hashes, nonces)
         self._notify_q.put(header.number)
+        from ..utils.trace import drop_block_trace
+        trace = drop_block_trace(header.number)
+        if trace is not None:
+            trace.finish()
         metric("scheduler.commit", number=header.number,
                ms=int((time.monotonic() - t0) * 1000))
         return True

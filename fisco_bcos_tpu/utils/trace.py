@@ -1,0 +1,121 @@
+"""Pipeline tracing + cross-node determinism checksums.
+
+Reference counterpart: the stage-stamped `BlockTrace` lines the reference
+emits through the block pipeline ("DMCExecute.0..5", "DAGExecute.0..3"
+with per-stage timestamps, bcos-scheduler/src/BlockExecutive.cpp:761-801,
+878-993) and `DmcStepRecorder` (bcos-scheduler/src/DmcStepRecorder.cpp),
+which checksums every DMC message round so two replicas that diverge can
+be diffed down to the first differing round — exactly the tooling a
+CPU/TPU dual-path system needs when a device kernel and the host oracle
+disagree.
+
+Both sinks write structured METRIC log lines (utils/log.py) so the
+existing metrics registry and log tooling pick them up.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Optional
+
+from .log import metric
+
+
+class BlockTrace:
+    """Per-block stage stamps: trace = BlockTrace(number); trace.stage(
+    "seal"); ...; trace.stage("execute"); trace.finish()."""
+
+    def __init__(self, number: int, pipeline: str = "block"):
+        self.number = number
+        self.pipeline = pipeline
+        self._t0 = time.monotonic()
+        self._last = self._t0
+        self._stages: list[tuple[str, float]] = []
+
+    def stage(self, name: str) -> None:
+        now = time.monotonic()
+        self._stages.append((name, now - self._last))
+        metric(f"trace.{self.pipeline}", number=self.number, stage=name,
+               ms=round((now - self._last) * 1000, 2),
+               total_ms=round((now - self._t0) * 1000, 2))
+        self._last = now
+
+    def finish(self) -> dict[str, float]:
+        self.stage("finish")
+        return {name: dt for name, dt in self._stages}
+
+
+class DmcStepRecorder:
+    """Order-independent checksum of each DMC round's message stream.
+
+    Replicas executing the same block must record identical checksums per
+    round; the first differing round localises a divergence (scheduler bug,
+    nondeterministic executor, device/host kernel mismatch). XOR-combined
+    SHA-256 per message makes the checksum independent of intra-round
+    arrival order, like the reference's add-based checksum.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rounds: list[bytes] = []
+        self._current = bytes(32)
+        self._count = 0
+
+    @staticmethod
+    def _digest(ctx: int, seq: int, to: bytes, data: bytes) -> bytes:
+        return hashlib.sha256(
+            ctx.to_bytes(8, "big") + seq.to_bytes(8, "big")
+            + len(to).to_bytes(2, "big") + to + data).digest()
+
+    def record_message(self, ctx: int, seq: int, to: bytes,
+                       data: bytes) -> None:
+        d = self._digest(ctx, seq, to, data)
+        with self._lock:
+            self._current = bytes(a ^ b for a, b in zip(self._current, d))
+            self._count += 1
+
+    def next_round(self) -> bytes:
+        """Close the current round; -> its checksum."""
+        with self._lock:
+            cksum = self._current
+            self._rounds.append(cksum)
+            self._current = bytes(32)
+            n = self._count
+            self._count = 0
+        metric("dmc.round_checksum", round=len(self._rounds),
+               messages=n, checksum=cksum[:8].hex())
+        return cksum
+
+    def checksums(self) -> list[bytes]:
+        with self._lock:
+            return list(self._rounds)
+
+    def summary(self) -> bytes:
+        """One digest over all rounds (order-sensitive across rounds)."""
+        h = hashlib.sha256()
+        for c in self.checksums():
+            h.update(c)
+        return h.digest()
+
+
+_block_traces: dict[int, BlockTrace] = {}
+_bt_lock = threading.Lock()
+
+
+def block_trace(number: int) -> BlockTrace:
+    """Shared per-height trace so sealer/consensus/scheduler stamp the same
+    object without threading it through every signature."""
+    with _bt_lock:
+        tr = _block_traces.get(number)
+        if tr is None:
+            tr = _block_traces[number] = BlockTrace(number)
+            for old in [n for n in _block_traces if n < number - 64]:
+                del _block_traces[old]
+        return tr
+
+
+def drop_block_trace(number: int) -> Optional[BlockTrace]:
+    with _bt_lock:
+        return _block_traces.pop(number, None)

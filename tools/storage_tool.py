@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""storage-tool — inspect and repair a node's storage offline.
+
+Reference counterpart: /root/reference/tools/storage-tool (RocksDB
+inspection utility). Operates on a stopped node's WAL storage directory.
+
+Commands:
+  stats  <path>                      table/row/byte counts
+  tables <path>                      list tables
+  scan   <path> <table> [prefix-hex] list keys (values with --values)
+  get    <path> <table> <key-hex>    print one value (hex)
+  set    <path> <table> <key-hex> <value-hex>   write one value (repair)
+  remove <path> <table> <key-hex>    delete one key
+  compact <path>                     rewrite snapshot, truncate the WAL
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fisco_bcos_tpu.storage.wal import WalStorage  # noqa: E402
+
+
+def _open(path: str) -> WalStorage:
+    if not os.path.isdir(path):
+        raise SystemExit(f"no storage directory at {path}")
+    return WalStorage(path)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, extra in (
+            ("stats", []), ("tables", []), ("compact", []),
+            ("scan", ["table", ["prefix", "?"]]),
+            ("get", ["table", "key"]),
+            ("set", ["table", "key", "value"]),
+            ("remove", ["table", "key"])):
+        p = sub.add_parser(name)
+        p.add_argument("path")
+        for arg in extra:
+            if isinstance(arg, list):
+                p.add_argument(arg[0], nargs="?", default="")
+            else:
+                p.add_argument(arg)
+        if name == "scan":
+            p.add_argument("--values", action="store_true")
+    args = ap.parse_args()
+    st = _open(args.path)
+    try:
+        if args.cmd == "tables":
+            print(json.dumps(sorted(st._tables)))
+        elif args.cmd == "stats":
+            out = {t: {"rows": len(rows),
+                       "bytes": sum(len(k) + len(v)
+                                    for k, v in rows.items())}
+                   for t, rows in sorted(st._tables.items())}
+            print(json.dumps(out, indent=1))
+        elif args.cmd == "scan":
+            prefix = bytes.fromhex(args.prefix) if args.prefix else b""
+            for k in st.keys(args.table, prefix):
+                if args.values:
+                    print(k.hex(), (st.get(args.table, k) or b"").hex())
+                else:
+                    print(k.hex())
+        elif args.cmd == "get":
+            v = st.get(args.table, bytes.fromhex(args.key))
+            if v is None:
+                raise SystemExit("no such key")
+            print(v.hex())
+        elif args.cmd == "set":
+            st.set(args.table, bytes.fromhex(args.key),
+                   bytes.fromhex(args.value))
+            print("ok")
+        elif args.cmd == "remove":
+            st.remove(args.table, bytes.fromhex(args.key))
+            print("ok")
+        elif args.cmd == "compact":
+            st.compact()
+            print("ok")
+    finally:
+        st.close()
+
+
+if __name__ == "__main__":
+    main()
