@@ -1,0 +1,127 @@
+"""Ledger as a service: chain reads + config over service RPC.
+
+Reference counterpart: /root/reference/fisco-bcos-tars-service/
+LedgerService-style access used by Pro/Max services that need chain data
+without owning the storage (RPC service answering queries, sync serving
+peers). Write paths stay with the scheduler/storage services (2PC), so
+this surface is read-only plus config.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..codec.wire import Reader, Writer
+from ..ledger.ledger import ConsensusNode, LedgerConfig
+from ..protocol import BlockHeader, Receipt, Transaction
+from .rpc import ServiceClient, ServiceServer
+
+
+class LedgerServer:
+    def __init__(self, ledger, host: str = "127.0.0.1", port: int = 0):
+        self.ledger = ledger
+        self.server = ServiceServer("ledger", host, port)
+        s = self.server
+        s.register("currentNumber", self._number)
+        s.register("totalTxCount", self._total)
+        s.register("headerByNumber", self._header)
+        s.register("txHashesByNumber", self._tx_hashes)
+        s.register("transaction", self._tx)
+        s.register("receipt", self._receipt)
+        s.register("noncesByNumber", self._nonces)
+        s.register("systemConfig", self._sys_config)
+        s.register("consensusNodes", self._nodes)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def _number(self, r: Reader, w: Writer) -> None:
+        w.i64(self.ledger.current_number())
+
+    def _total(self, r: Reader, w: Writer) -> None:
+        w.i64(self.ledger.total_tx_count())
+
+    def _header(self, r: Reader, w: Writer) -> None:
+        h = self.ledger.header_by_number(r.i64())
+        w.blob(h.encode() if h else b"")
+
+    def _tx_hashes(self, r: Reader, w: Writer) -> None:
+        w.seq(self.ledger.tx_hashes_by_number(r.i64()),
+              lambda ww, h: ww.blob(h))
+
+    def _tx(self, r: Reader, w: Writer) -> None:
+        t = self.ledger.transaction(r.blob())
+        w.blob(t.encode() if t else b"")
+
+    def _receipt(self, r: Reader, w: Writer) -> None:
+        rc = self.ledger.receipt(r.blob())
+        w.blob(rc.encode() if rc else b"")
+
+    def _nonces(self, r: Reader, w: Writer) -> None:
+        w.seq(self.ledger.nonces_by_number(r.i64()),
+              lambda ww, n: ww.text(n))
+
+    def _sys_config(self, r: Reader, w: Writer) -> None:
+        cfg = self.ledger.system_config(r.text())  # None when unset
+        value, enable = cfg if cfg is not None else ("", -1)
+        w.text(value)
+        w.i64(enable)
+
+    def _nodes(self, r: Reader, w: Writer) -> None:
+        nodes = self.ledger.consensus_nodes()
+        w.seq(nodes, lambda ww, n: ww.blob(n.node_id).u64(n.weight)
+              .text(n.node_type).i64(n.enable_number))
+
+
+class RemoteLedger:
+    """Read-only ledger proxy (duck-types the query surface)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.client = ServiceClient(host, port, timeout)
+
+    def current_number(self) -> int:
+        return self.client.call("currentNumber").i64()
+
+    def total_tx_count(self) -> int:
+        return self.client.call("totalTxCount").i64()
+
+    def header_by_number(self, n: int) -> Optional[BlockHeader]:
+        raw = self.client.call("headerByNumber", lambda w: w.i64(n)).blob()
+        return BlockHeader.decode(raw) if raw else None
+
+    def tx_hashes_by_number(self, n: int) -> list[bytes]:
+        r = self.client.call("txHashesByNumber", lambda w: w.i64(n))
+        return r.seq(lambda rr: rr.blob())
+
+    def transaction(self, h: bytes) -> Optional[Transaction]:
+        raw = self.client.call("transaction", lambda w: w.blob(h)).blob()
+        return Transaction.decode(raw) if raw else None
+
+    def receipt(self, h: bytes) -> Optional[Receipt]:
+        raw = self.client.call("receipt", lambda w: w.blob(h)).blob()
+        return Receipt.decode(raw) if raw else None
+
+    def nonces_by_number(self, n: int) -> list[str]:
+        r = self.client.call("noncesByNumber", lambda w: w.i64(n))
+        return r.seq(lambda rr: rr.text())
+
+    def system_config(self, key: str) -> tuple[Optional[str], int]:
+        r = self.client.call("systemConfig", lambda w: w.text(key))
+        value = r.text()
+        enable = r.i64()
+        return (value or None), enable
+
+    def consensus_nodes(self) -> list[ConsensusNode]:
+        r = self.client.call("consensusNodes")
+        return r.seq(lambda rr: ConsensusNode(rr.blob(), rr.u64(),
+                                              rr.text(), rr.i64()))
+
+    def close(self) -> None:
+        self.client.close()

@@ -131,12 +131,15 @@ class ServiceClient:
         return self._sock
 
     def call(self, method: str, build: Optional[Callable[[Writer], None]]
-             = None) -> Reader:
+             = None, retry: bool = True) -> Reader:
+        """retry=False: do NOT resend on a broken connection — required for
+        non-idempotent server ops (a resend could execute them twice)."""
         w = Writer()
         if build:
             build(w)
+        attempts = (0, 1) if retry else (1,)
         with self._lock:
-            for attempt in (0, 1):  # one reconnect on a broken connection
+            for attempt in attempts:  # one reconnect on broken connection
                 try:
                     sock = self._connect()
                     seq = next(self._seq)

@@ -26,7 +26,11 @@ from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libbcoskv.so")
+# FBTPU_BCOSKV_LIB selects an alternate build (e.g. the ASan/TSan variants
+# from `make -C native SANITIZE=...`) for race/memory testing.
+_SO_PATH = os.environ.get(
+    "FBTPU_BCOSKV_LIB",
+    os.path.join(_NATIVE_DIR, "build", "libbcoskv.so"))
 
 _lib = None
 _lib_err: Optional[str] = None

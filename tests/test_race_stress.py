@@ -1,0 +1,158 @@
+"""Race-stress tests: hammer the concurrency-bearing components from many
+threads and check the invariants that data races would break.
+
+Reference counterpart: SURVEY §5 sanitizers/race detection — the reference
+relies on cmake SANITIZE_ADDRESS/SANITIZE_THREAD builds plus thread-safe-
+by-design structures. The native engine's sanitizer builds exist via
+`make -C native SANITIZE=address|thread` (FBTPU_BCOSKV_LIB selects them);
+these tests are the Python-side analogue: deterministic invariant checks
+under real thread contention.
+"""
+
+import queue
+import threading
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.ledger.ledger import ConsensusNode, Ledger
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+from fisco_bcos_tpu.txpool.txpool import TxPool
+
+THREADS = 8
+
+
+def _hammer(fn, n_threads=THREADS):
+    errs: "queue.Queue" = queue.Queue()
+    barrier = threading.Barrier(n_threads)
+
+    def run(i):
+        try:
+            barrier.wait(timeout=10)
+            fn(i)
+        except Exception as exc:  # noqa: BLE001 — collected for the assert
+            errs.put(exc)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert errs.empty(), list(errs.queue)
+
+
+def test_txpool_concurrent_submit_seal_commit():
+    """Duplicate-submission races must never double-admit a tx, and
+    concurrent seal/unseal must conserve the pending set."""
+    suite = make_suite(backend="host")
+    ledger = Ledger(MemoryStorage(), suite)
+    kp = suite.generate_keypair(b"race-user")
+    ledger.build_genesis([ConsensusNode(kp.pub_bytes)])
+    pool = TxPool(suite, ledger, "chain0", "group0", 100000, 600)
+    txs = [Transaction(to=pc.BALANCE_ADDRESS,
+                       input=pc.encode_call(
+                           "register",
+                           lambda w, i=i: w.blob(b"r%d" % i).u64(1)),
+                       nonce=f"race{i}", block_limit=100).sign(suite, kp)
+           for i in range(48)]
+
+    # every thread submits the SAME txs; exactly one admission each
+    _hammer(lambda i: pool.submit_batch(txs))
+    assert pool.pending_count() == len(txs)
+
+    sealed_hashes: list[bytes] = []
+    lk = threading.Lock()
+
+    def seal_some(i):
+        got, hashes = pool.seal(6)
+        with lk:
+            sealed_hashes.extend(hashes)
+
+    _hammer(seal_some)
+    # no tx sealed twice across concurrent sealers
+    assert len(sealed_hashes) == len(set(sealed_hashes))
+    pool.unseal(sealed_hashes)
+
+    def commit_disjoint(i):
+        chunk = txs[i * 6:(i + 1) * 6]
+        pool.on_block_committed(1 + i, [t.hash(suite) for t in chunk],
+                                [t.nonce for t in chunk])
+
+    _hammer(commit_disjoint)
+    assert pool.pending_count() == 0
+
+
+def test_state_overlay_parallel_readers_single_writer():
+    """Readers racing a writer THROUGH THE OVERLAY must see either the old
+    (backend) or a new (overlay) value — never a torn/absent entry."""
+    base = MemoryStorage()
+    for i in range(64):
+        base.set("t", b"k%d" % i, b"old")
+    state = StateStorage(base)
+    stop = threading.Event()
+    bad: list = []
+
+    def writer(_):
+        for r in range(100):
+            for i in range(64):
+                state.set("t", b"k%d" % i, b"new%d" % r)
+        stop.set()
+
+    def reader(i):
+        if i == 0:
+            writer(i)
+            return
+        while not stop.is_set():
+            for j in range(64):
+                v = state.get("t", b"k%d" % j)
+                if v is None or not (v == b"old" or v.startswith(b"new")):
+                    bad.append(v)
+                    return
+
+    _hammer(reader)
+    assert not bad
+    assert all(state.get("t", b"k%d" % i) == b"new99" for i in range(64))
+
+
+def test_wal_storage_concurrent_direct_writes(tmp_path):
+    """Concurrent direct writes to WalStorage must all be durable and the
+    log replayable (no interleaved/corrupt records)."""
+    from fisco_bcos_tpu.storage.wal import WalStorage
+
+    st = WalStorage(str(tmp_path / "race"))
+
+    def write_mine(i):
+        for j in range(50):
+            st.set("t%d" % i, b"k%d" % j, b"v%d-%d" % (i, j))
+
+    _hammer(write_mine)
+    st.close()
+
+    st2 = WalStorage(str(tmp_path / "race"))
+    try:
+        for i in range(THREADS):
+            for j in range(50):
+                assert st2.get("t%d" % i, b"k%d" % j) == b"v%d-%d" % (i, j)
+    finally:
+        st2.close()
+
+
+def test_native_bcoskv_concurrent_if_available(tmp_path):
+    from fisco_bcos_tpu.storage import native
+
+    if not native.available():
+        pytest.skip("native bcoskv not built")
+    st = native.NativeStorage(str(tmp_path / "nkv"))
+
+    def write_mine(i):
+        for j in range(40):
+            st.set("t%d" % i, b"k%d" % j, b"n%d-%d" % (i, j))
+
+    _hammer(write_mine)
+    for i in range(THREADS):
+        for j in range(40):
+            assert st.get("t%d" % i, b"k%d" % j) == b"n%d-%d" % (i, j)
+    st.close()

@@ -1,0 +1,173 @@
+"""Pro/Max service split: txpool, ledger, gateway/front services.
+
+Reference: fisco-bcos-tars-service/{TxPool,Gateway,Front}Service +
+bcos-tars-protocol/client proxies — module surfaces served over RPC so
+each subsystem can run in its own process.
+"""
+
+import time
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.ledger.ledger import ConsensusNode, Ledger
+from fisco_bcos_tpu.net.front import FrontService
+from fisco_bcos_tpu.net.gateway import FakeGateway
+from fisco_bcos_tpu.protocol import Block, Transaction
+from fisco_bcos_tpu.services.gateway_service import FrontServer, RemoteFront
+from fisco_bcos_tpu.services.ledger_service import LedgerServer, RemoteLedger
+from fisco_bcos_tpu.services.txpool_service import TxPoolServer, RemoteTxPool
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.txpool.txpool import TxPool
+
+
+def wait_until(pred, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture()
+def pool_env():
+    suite = make_suite(backend="host")
+    ledger = Ledger(MemoryStorage(), suite)
+    kp = suite.generate_keypair(b"svc-user")
+    ledger.build_genesis([ConsensusNode(kp.pub_bytes)])
+    pool = TxPool(suite, ledger, "chain0", "group0", 1000, 600)
+    return suite, ledger, pool, kp
+
+
+def _tx(suite, kp, nonce):
+    return Transaction(to=pc.BALANCE_ADDRESS,
+                       input=pc.encode_call(
+                           "register", lambda w: w.blob(nonce.encode())
+                           .u64(1)),
+                       nonce=nonce, block_limit=100).sign(suite, kp)
+
+
+def test_txpool_service_roundtrip(pool_env):
+    suite, ledger, pool, kp = pool_env
+    server = TxPoolServer(pool)
+    server.start()
+    remote = RemoteTxPool("127.0.0.1", server.port)
+    try:
+        txs = [_tx(suite, kp, f"svc{i}") for i in range(5)]
+        results = remote.submit_batch(txs)
+        assert all(r.status == 0 for r in results)
+        assert remote.pending_count() == 5
+
+        sealed, hashes = remote.seal(3)
+        assert len(sealed) == 3 and len(hashes) == 3
+        remote.unseal(hashes)
+
+        filled = remote.fill_block([t.hash(suite) for t in txs[:2]])
+        assert filled is not None and len(filled) == 2
+        assert remote.fill_block([b"\x00" * 32]) is None
+
+        block = Block(tx_hashes=[t.hash(suite) for t in txs])
+        assert remote.verify_proposal(block)
+        assert remote.missing_hashes([txs[0].hash(suite), b"\x01" * 32]) \
+            == [b"\x01" * 32]
+
+        remote.on_block_committed(1, [t.hash(suite) for t in txs],
+                                  [t.nonce for t in txs])
+        assert remote.pending_count() == 0
+    finally:
+        remote.close()
+        server.stop()
+
+
+def test_ledger_service_roundtrip(pool_env):
+    suite, ledger, pool, kp = pool_env
+    server = LedgerServer(ledger)
+    server.start()
+    remote = RemoteLedger("127.0.0.1", server.port)
+    try:
+        assert remote.current_number() == ledger.current_number() == 0
+        h0 = remote.header_by_number(0)
+        assert h0 is not None
+        assert h0.hash(suite) == ledger.header_by_number(0).hash(suite)
+        assert remote.header_by_number(99) is None
+        assert remote.transaction(b"\x00" * 32) is None
+        value, enable = remote.system_config("tx_count_limit")
+        assert value is not None and int(value) >= 1
+        nodes = remote.consensus_nodes()
+        assert nodes and nodes[0].node_id == kp.pub_bytes
+    finally:
+        remote.close()
+        server.stop()
+
+
+def test_front_service_split_dispatch_and_send():
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    kp_a = suite.generate_keypair(b"fsvc-a")
+    kp_b = suite.generate_keypair(b"fsvc-b")
+    front_a = FrontService(kp_a.pub_bytes, gateway)
+    front_b = FrontService(kp_b.pub_bytes, gateway)
+    server = FrontServer(front_a)
+    server.start()
+    remote = RemoteFront("127.0.0.1", server.port, kp_a.pub_bytes)
+
+    got_remote, got_b = [], []
+    try:
+        MODULE = 4242
+        remote.register_module(MODULE, lambda s, p, r: got_remote.append(
+            (s, p)))
+        front_b.register_module(MODULE, lambda s, p, r: got_b.append((s, p)))
+
+        # network -> split service: B sends to A; the remote module (in the
+        # "other process") must receive it via the poll channel
+        front_b.send(MODULE, kp_a.pub_bytes, b"to-split-service")
+        assert wait_until(lambda: got_remote)
+        assert got_remote[0] == (kp_b.pub_bytes, b"to-split-service")
+
+        # split service -> network: remote sends through A's gateway to B
+        assert remote.send(MODULE, kp_b.pub_bytes, b"from-split-service")
+        assert wait_until(lambda: got_b)
+        assert got_b[0] == (kp_a.pub_bytes, b"from-split-service")
+
+        # broadcast + peers
+        remote.broadcast(MODULE, b"fanout")
+        assert wait_until(lambda: len(got_b) >= 2)
+        assert kp_b.pub_bytes in remote.peers()
+    finally:
+        remote.stop()
+        server.stop()
+        front_a.stop()
+        front_b.stop()
+        gateway.stop()
+
+
+def test_front_service_request_response_bridging():
+    """front.request() to a module served by a SPLIT service must round
+    trip: the respond channel bridges through the poll protocol."""
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    kp_a = suite.generate_keypair(b"freq-a")
+    kp_b = suite.generate_keypair(b"freq-b")
+    front_a = FrontService(kp_a.pub_bytes, gateway)
+    front_b = FrontService(kp_b.pub_bytes, gateway)
+    server = FrontServer(front_a)
+    server.start()
+    remote = RemoteFront("127.0.0.1", server.port, kp_a.pub_bytes)
+    try:
+        MODULE = 777
+
+        def handler(src, payload, respond):
+            assert respond is not None  # delivered as a request
+            respond(b"echo:" + payload)
+
+        remote.register_module(MODULE, handler)
+        resp = front_b.request(MODULE, kp_a.pub_bytes, b"ping", timeout=10)
+        assert resp == b"echo:ping"
+    finally:
+        remote.stop()
+        server.stop()
+        front_a.stop()
+        front_b.stop()
+        gateway.stop()
