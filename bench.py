@@ -119,22 +119,29 @@ def main() -> None:
         backend = jax.devices()[0].platform
         batch = int(os.environ.get("BENCH_BATCH", "65536"))
         iters = int(os.environ.get("BENCH_ITERS", "3"))
-        params = refimpl.SECP256K1
         rng = np.random.default_rng(11)
 
-        # sign a few host-side, tile to the batch (kernel cost is per-element)
-        base = []
-        for i in range(8):
-            sk, _ = refimpl.keygen(params, bytes([i + 3]) * 32)
-            digest = refimpl.keccak256(rng.bytes(64))
-            r, s, v = refimpl.ecdsa_sign(params, sk, digest)
-            pub = refimpl.ec_mul(params, sk, (params.gx, params.gy))
-            base.append((int.from_bytes(digest, "big"), r, s, v,
-                         pub[0], pub[1]))
-        cols = [[base[i % 8][k] for i in range(batch)] for k in range(6)]
-        e, r, s = (jax.device_put(bigint.batch_to_limbs(c)) for c in cols[:3])
-        v = jax.device_put(np.asarray(cols[3], np.uint32))
-        qx, qy = (jax.device_put(bigint.batch_to_limbs(c)) for c in cols[4:])
+        def build_args(params, batch_n, sm=False):
+            base = []
+            for i in range(8):
+                sk, _ = refimpl.keygen(params, bytes([i + 3]) * 32)
+                digest = refimpl.keccak256(rng.bytes(64))
+                pub = refimpl.ec_mul(params, sk, (params.gx, params.gy))
+                if sm:
+                    r, s = refimpl.sm2_sign(sk, digest)
+                    v = 0
+                else:
+                    r, s, v = refimpl.ecdsa_sign(params, sk, digest)
+                base.append((int.from_bytes(digest, "big"), r, s, v,
+                             pub[0], pub[1]))
+            cols = [[base[i % 8][k] for i in range(batch_n)]
+                    for k in range(6)]
+            e, r, s = (jax.device_put(bigint.batch_to_limbs(c))
+                       for c in cols[:3])
+            v = jax.device_put(np.asarray(cols[3], np.uint32))
+            qx, qy = (jax.device_put(bigint.batch_to_limbs(c))
+                      for c in cols[4:])
+            return e, r, s, v, qx, qy
 
         def timed(fn, *args):
             out = fn(*args)
@@ -145,10 +152,35 @@ def main() -> None:
             jax.block_until_ready(out)
             return (time.perf_counter() - t0) / iters, out
 
+        e, r, s, v, qx, qy = build_args(refimpl.SECP256K1, batch)
         dt_v, ok = timed(ec.ecdsa_verify_batch, ec.SECP256K1, e, r, s, qx, qy)
         assert bool(np.asarray(ok).all()), "verify kernel rejected valid sigs"
         dt_r, rec = timed(ec.ecdsa_recover_batch, ec.SECP256K1, e, r, s, v)
         assert bool(np.asarray(rec[2]).all()), "recover kernel rejected sigs"
+
+        detail = []
+        if os.environ.get("BENCH_FULL") == "1":
+            # the rest of BASELINE's config grid -> BENCH_DETAIL.json
+            for b in (1024, 16384):
+                if b == batch:
+                    continue
+                ee, rr, ss, _vv, xx, yy = build_args(refimpl.SECP256K1, b)
+                dt, okb = timed(ec.ecdsa_verify_batch, ec.SECP256K1,
+                                ee, rr, ss, xx, yy)
+                assert bool(np.asarray(okb).all())
+                detail.append({"metric": f"secp256k1_batch_verify_{b}",
+                               "value": round(b / dt, 1)})
+            for b in (16384, batch):
+                ee, rr, ss, _vv, xx, yy = build_args(refimpl.SM2P256V1, b,
+                                                     sm=True)
+                dt, okb = timed(ec.sm2_verify_batch, ec.SM2P256V1,
+                                ee, rr, ss, xx, yy)
+                assert bool(np.asarray(okb).all())
+                detail.append({"metric": f"sm2_batch_verify_{b}",
+                               "value": round(b / dt, 1)})
+            with open(os.path.join(_REPO, "BENCH_DETAIL.json"), "w") as f:
+                json.dump({"backend": backend, "configs": detail}, f,
+                          indent=1)
 
         value = batch / dt_v
         recover = batch / dt_r

@@ -70,6 +70,7 @@ class LedgerServer:
 
     def _sys_config(self, r: Reader, w: Writer) -> None:
         cfg = self.ledger.system_config(r.text())  # None when unset
+        w.u8(1 if cfg is not None else 0)
         value, enable = cfg if cfg is not None else ("", -1)
         w.text(value)
         w.i64(enable)
@@ -112,11 +113,14 @@ class RemoteLedger:
         r = self.client.call("noncesByNumber", lambda w: w.i64(n))
         return r.seq(lambda rr: rr.text())
 
-    def system_config(self, key: str) -> tuple[Optional[str], int]:
+    def system_config(self, key: str) -> Optional[tuple[str, int]]:
+        """Drop-in for Ledger.system_config: None when the key is unset,
+        (value, enable_number) otherwise — empty string preserved."""
         r = self.client.call("systemConfig", lambda w: w.text(key))
+        present = r.u8()
         value = r.text()
         enable = r.i64()
-        return (value or None), enable
+        return (value, enable) if present else None
 
     def consensus_nodes(self) -> list[ConsensusNode]:
         r = self.client.call("consensusNodes")

@@ -63,6 +63,13 @@ class ServiceServer:
 
         class _H(socketserver.BaseRequestHandler):
             def handle(self):
+                try:
+                    self._serve()
+                except (ConnectionError, OSError):
+                    pass  # abrupt client disconnects are routine (long-poll
+                    # proxies close mid-park); not worth a traceback
+
+            def _serve(self):
                 while True:
                     frame = _recv_frame(self.request)
                     if frame is None:

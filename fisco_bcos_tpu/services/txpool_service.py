@@ -97,7 +97,11 @@ class RemoteTxPool:
 
     def submit_batch(self, txs: Sequence[Transaction]
                      ) -> list[TxSubmitResult]:
-        r = self.client.call("submitBatch", lambda w: _write_txs(w, txs))
+        # retry=False: a resend after a broken connection would re-admit —
+        # the server's dedup then reports ALREADY_IN_TXPOOL for txs that
+        # were in fact accepted, misleading the caller
+        r = self.client.call("submitBatch", lambda w: _write_txs(w, txs),
+                             retry=False)
         return r.seq(lambda rr: TxSubmitResult(
             rr.blob(), TransactionStatus(rr.u32())))
 

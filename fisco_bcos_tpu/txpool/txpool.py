@@ -41,6 +41,14 @@ class TxSubmitResult:
     sender: Optional[bytes] = None
 
 
+class SubmitRejected(RuntimeError):
+    """Async submission failed admission; carries the TxSubmitResult."""
+
+    def __init__(self, result: TxSubmitResult):
+        super().__init__(f"tx rejected: {result.status!r}")
+        self.result = result
+
+
 class TxPool:
     def __init__(self, suite, ledger: Ledger, chain_id: str = "chain0",
                  group_id: str = "group0", pool_limit: int = DEFAULT_POOL_LIMIT,
@@ -60,6 +68,7 @@ class TxPool:
         self._on_ready: list[Callable[[], None]] = []
         # receipt futures: tx hash -> Event set at commit (RPC waits on it)
         self._waiters: dict[bytes, threading.Event] = {}
+        self._async_waiters: dict[bytes, "object"] = {}  # hash -> Task
         # TransactionSync gossip hook (TransactionSync.cpp broadcast path)
         self._broadcast_hooks: list[Callable[[Sequence[Transaction]], None]] = []
 
@@ -166,8 +175,14 @@ class TxPool:
                 if len(out) >= max_txs:
                     break
             self._sealed.update(hashes)
+            dropped_tasks = []
             for h in expired:
                 self._pending.pop(h, None)
+                t = self._async_waiters.pop(h, None)
+                if t is not None:
+                    dropped_tasks.append(t)
+        for t in dropped_tasks:  # settle, never leak an expired submission
+            t.reject(TimeoutError("tx expired: block_limit passed unsealed"))
         return out, hashes
 
     def unseal(self, hashes: Sequence[bytes]) -> None:
@@ -248,9 +263,39 @@ class TxPool:
                 self._known_nonces -= self._nonces_by_block.pop(bn)
             events = [self._waiters.pop(h) for h in tx_hashes
                       if h in self._waiters]
+            tasks = [(h, self._async_waiters.pop(h)) for h in tx_hashes
+                     if h in self._async_waiters]
         for ev in events:
             ev.set()
+        for h, task in tasks:
+            task.resolve(self.ledger.receipt(h))
         self._notify_ready()
+
+    def submit_async(self, tx: Transaction):
+        """Submit and return a Task[Receipt] that settles at commit — the
+        libtask analogue of the reference's coroutine submitTransaction
+        (Task.h:19-50 awaited at JsonRpcImpl_2_0.cpp:455). Rejected with
+        SubmitRejected if admission fails."""
+        from ..utils.task import Task
+
+        task: Task = Task()
+        res = self.submit(tx)
+        if int(res.status) != 0:
+            task.reject(SubmitRejected(res))
+            return task
+        h = res.tx_hash
+        rc = self.ledger.receipt(h)
+        if rc is not None:
+            task.resolve(rc)
+            return task
+        with self._lock:
+            self._async_waiters[h] = task
+        rc = self.ledger.receipt(h)  # commit raced the registration
+        if rc is not None:
+            with self._lock:
+                self._async_waiters.pop(h, None)
+            task.resolve(rc)
+        return task
 
     # -- RPC receipt waiting ----------------------------------------------
     def wait_for_receipt(self, tx_hash: bytes, timeout: float = 30.0):

@@ -77,3 +77,34 @@ def test_merkle_proof():
         assert merkle.verify_merkle_proof(leaves[idx], proof, root)
     bad = merkle.merkle_proof(leaves, 3)
     assert not merkle.verify_merkle_proof(leaves[4], bad, root)
+
+
+def test_suite_chunked_device_batches(monkeypatch):
+    """Batches above CHUNK pipeline multiple kernel calls (double-buffered
+    staging analogue) and must be bit-identical to the host oracle."""
+    from fisco_bcos_tpu.crypto import suite as suite_mod
+    from fisco_bcos_tpu.crypto.suite import make_suite
+
+    monkeypatch.setattr(suite_mod, "CHUNK", 8)
+    s = make_suite(backend="device", device_min_batch=1)
+    host = make_suite(backend="host")
+    kps = [host.generate_keypair(bytes([i + 1]) * 8) for i in range(4)]
+    digests, sigs, pubs = [], [], []
+    for i in range(20):  # > 2 chunks of 8
+        kp = kps[i % 4]
+        d = host.hash(b"chunk-%d" % i)
+        digests.append(d)
+        sigs.append(host.sign(kp, d))
+        pubs.append(kp.pub_bytes)
+    # corrupt one signature: chunking must preserve per-index results
+    sigs[13] = sigs[12]
+
+    ok_dev = s.verify_batch(digests, sigs, pubs)
+    ok_host = host.verify_batch(digests, sigs, pubs)
+    assert list(ok_dev) == list(ok_host)
+    assert not ok_dev[13] and ok_dev[12]
+
+    pubs_dev, okr_dev = s.recover_batch(digests, sigs)
+    pubs_host, okr_host = host.recover_batch(digests, sigs)
+    assert list(okr_dev) == list(okr_host)
+    assert pubs_dev == pubs_host

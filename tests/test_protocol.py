@@ -135,3 +135,37 @@ def test_sm_suite_transaction(sm_suite):
     tx2 = Transaction.decode(tx.encode())
     assert tx2.sender(sm_suite) == kp.address
     assert len(tx.signature) == 128  # r|s|pub per SignatureDataWithPub
+
+
+def test_structural_concepts_conformance():
+    """typing.Protocol contracts (the C++20-concepts analogue) hold for
+    both in-process objects and split-service proxies."""
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode, Ledger
+    from fisco_bcos_tpu.protocol import concepts
+    from fisco_bcos_tpu.services.ledger_service import RemoteLedger
+    from fisco_bcos_tpu.services.txpool_service import RemoteTxPool
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+    from fisco_bcos_tpu.storage.state import StateStorage
+    from fisco_bcos_tpu.storage.wal import WalStorage
+    from fisco_bcos_tpu.txpool.txpool import TxPool
+    from fisco_bcos_tpu.net.front import FrontService
+
+    suite = make_suite(backend="host")
+    ledger = Ledger(MemoryStorage(), suite)
+    kp = suite.generate_keypair(b"concept")
+    ledger.build_genesis([ConsensusNode(kp.pub_bytes)])
+    pool = TxPool(suite, ledger, "chain0", "group0", 10, 600)
+
+    assert isinstance(MemoryStorage(), concepts.KVWritable)
+    assert isinstance(StateStorage(MemoryStorage()), concepts.KVWritable)
+    assert isinstance(ledger, concepts.LedgerReader)
+    assert isinstance(pool, concepts.TxPoolLike)
+    # split-service proxies satisfy the SAME structural contracts
+    assert issubclass(RemoteLedger, concepts.LedgerReader)
+    assert issubclass(RemoteTxPool, concepts.TxPoolLike)
+    assert issubclass(FrontService, concepts.FrontLike)
+    # wire objects satisfy Serializable/Hashable
+    tx = Transaction(nonce="c1", block_limit=9).sign(suite, kp)
+    assert isinstance(tx, concepts.Serializable)
+    assert isinstance(tx, concepts.Hashable)

@@ -95,6 +95,7 @@ def test_ledger_service_roundtrip(pool_env):
         assert remote.transaction(b"\x00" * 32) is None
         value, enable = remote.system_config("tx_count_limit")
         assert value is not None and int(value) >= 1
+        assert remote.system_config("no_such_key") is None  # drop-in None
         nodes = remote.consensus_nodes()
         assert nodes and nodes[0].node_id == kp.pub_bytes
     finally:

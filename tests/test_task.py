@@ -1,0 +1,67 @@
+"""Task primitive (libtask analogue) + async tx submission."""
+
+import threading
+import time
+
+import pytest
+
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.txpool.txpool import SubmitRejected
+from fisco_bcos_tpu.utils.task import Task, TaskTimeout
+
+
+def test_task_resolve_then_and_gather():
+    t = Task()
+    got = []
+    t.then(lambda tk: got.append(tk.result()))
+    assert not t.done()
+    t.resolve(42)
+    assert t.done() and t.result() == 42 and got == [42]
+    # continuation added after settlement fires immediately
+    t.then(lambda tk: got.append(tk.result() + 1))
+    assert got == [42, 43]
+    # first settlement wins
+    t.resolve(99)
+    assert t.result() == 42
+
+    e = Task()
+    e.reject(ValueError("boom"))
+    with pytest.raises(ValueError):
+        e.result()
+    assert isinstance(e.exception(), ValueError)
+
+    with pytest.raises(TaskTimeout):
+        Task().result(timeout=0.05)
+
+    ts = [Task() for _ in range(3)]
+    threading.Thread(target=lambda: [t.resolve(i)
+                                     for i, t in enumerate(ts)]).start()
+    assert Task.gather(ts, timeout=5) == [0, 1, 2]
+
+
+def test_submit_async_settles_at_commit():
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0))
+    node.start()
+    try:
+        kp = node.suite.generate_keypair(b"task-user")
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register", lambda w: w.blob(b"tk").u64(2)),
+                         nonce="tk1", block_limit=100).sign(node.suite, kp)
+        chained = []
+        task = node.txpool.submit_async(tx)
+        task.then(lambda t: chained.append(t.result().block_number))
+        rc = task.result(timeout=15)
+        assert rc is not None and rc.status == 0
+        assert chained == [rc.block_number]
+
+        # admission failure rejects the task
+        bad = Transaction(to=pc.BALANCE_ADDRESS, input=b"", nonce="tk1",
+                          block_limit=100).sign(node.suite, kp)
+        t2 = node.txpool.submit_async(bad)  # duplicate nonce
+        with pytest.raises(SubmitRejected):
+            t2.result(timeout=5)
+    finally:
+        node.stop()
