@@ -326,7 +326,7 @@ def ecdsa_verify_batch(cv: Curve, e, r, s, qx, qy):
     ok &= _on_curve(cv, qxr, qyr)
     ok &= ~(is_zero(qx) & is_zero(qy))
 
-    w = fn_.inv(fn_.to_rep(s))  # Mont(s^-1)
+    w = fn_.inv_batch(fn_.to_rep(s))  # Mont(s^-1), batched tree
     u1 = fn_.from_rep(fn_.mul(fn_.to_rep(e), w))
     u2 = fn_.from_rep(fn_.mul(fn_.to_rep(r), w))
     R = shamir_mult(cv, u1, u2, qxr, qyr)
@@ -369,14 +369,14 @@ def ecdsa_recover_batch(cv: Curve, e, r, s, v):
     flip = (yc[..., 0, :] & 1) != (v & 1)
     ym = select(flip, f.neg(y), y)
 
-    rinv = fn_.inv(fn_.to_rep(r))
+    rinv = fn_.inv_batch(fn_.to_rep(r))
     u1 = fn_.from_rep(fn_.mul(fn_.neg(fn_.to_rep(e)), rinv))  # -e/r mod n
     u2 = fn_.from_rep(fn_.mul(fn_.to_rep(s), rinv))  # s/r mod n
     Q = shamir_mult(cv, u1, u2, xm, ym)
     X, Y, Z = _unpack(Q)
     ok &= ~is_zero(Z)
 
-    zinv = f.inv(Z)
+    zinv = f.inv_batch(Z)
     zi2 = f.sqr(zinv)
     qx = f.from_rep(f.mul(X, zi2))
     qy = f.from_rep(f.mul(Y, f.mul(zi2, zinv)))

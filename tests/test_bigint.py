@@ -94,3 +94,23 @@ def test_window_digits():
     d = bi.window_digits(a, 4)
     for i in range(64):
         assert int(d[i]) == (x >> (4 * i)) & 0xF
+
+
+def test_inv_batch_matches_fermat_and_handles_zeros():
+    import numpy as np
+
+    from fisco_bcos_tpu.crypto import refimpl
+    from fisco_bcos_tpu.ops import fp
+
+    for F, mod in ((fp.SolinasField(refimpl.SECP256K1.p, "p"),
+                    refimpl.SECP256K1.p),
+                   (fp.MontField(refimpl.SECP256K1.n, "n"),
+                    refimpl.SECP256K1.n)):
+        vals = [pow(3, i + 1, mod) for i in range(14)] + [0, mod - 1]
+        a = np.stack([fp.to_limbs(v) for v in vals], axis=1)  # [16, 16]
+        rep = F.to_rep(a)
+        out = F.from_rep(F.inv_batch(rep))
+        got = [fp.from_limbs_np(np.asarray(out)[:, j])
+               for j in range(len(vals))]
+        exp = [pow(v, -1, mod) if v else 0 for v in vals]
+        assert got == exp, F.name
