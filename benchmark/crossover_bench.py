@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Measure the host/device batch crossover for the crypto suite.
+
+VERDICT flagged `device_min_batch=64` as an unmeasured guess. This harness
+measures host-oracle and device-kernel verify throughput across batch
+sizes and reports the crossover — run it on the deployment's real
+accelerator to pick the node's `device_min_batch` (NodeConfig).
+
+Usage: python benchmark/crossover_bench.py [--sizes 1,4,16,64,256,1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="1,4,16,64,256,1024")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+
+    import jax
+
+    from fisco_bcos_tpu.crypto.suite import make_suite
+
+    host = make_suite(backend="host")
+    dev = make_suite(backend="device", device_min_batch=1)
+    kp = host.generate_keypair(b"crossover")
+    backend = jax.devices()[0].platform
+
+    rows = []
+    crossover = None
+    for n in sizes:
+        ds = [host.hash(b"x%d" % i) for i in range(n)]
+        sigs = [host.sign(kp, d) for d in ds]
+        pubs = [kp.pub_bytes] * n
+
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            host.verify_batch(ds, sigs, pubs)
+        host_dt = (time.perf_counter() - t0) / args.iters
+
+        dev.verify_batch(ds, sigs, pubs)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            dev.verify_batch(ds, sigs, pubs)
+        dev_dt = (time.perf_counter() - t0) / args.iters
+
+        rows.append({"batch": n,
+                     "host_ms": round(host_dt * 1000, 2),
+                     "device_ms": round(dev_dt * 1000, 2),
+                     "winner": "device" if dev_dt < host_dt else "host"})
+        if crossover is None and dev_dt < host_dt:
+            crossover = n
+    print(json.dumps({"backend": backend, "rows": rows,
+                      "device_min_batch_suggestion": crossover}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

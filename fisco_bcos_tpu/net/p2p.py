@@ -29,6 +29,7 @@ identity (enforced by the caller's context verify settings).
 
 from __future__ import annotations
 
+import queue
 import socket
 import ssl
 import struct
@@ -43,6 +44,7 @@ from .gateway import Gateway
 MAGIC = b"FBTP"
 VERSION = 2
 MAX_FRAME = 128 * 1024 * 1024
+MAX_SEND_QUEUE = 64 * 1024 * 1024  # per-session outbound byte budget
 MAX_TTL = 16
 MAX_DISTANCE = 8  # drop longer advertised paths (count-to-infinity guard)
 KIND_DATA, KIND_ROUTE = 0, 1
@@ -172,6 +174,63 @@ class RouterTable:
         return list(self.routes)
 
 
+class _Session:
+    """One peer link: socket + bounded outbound queue + writer thread.
+
+    Backpressure (the reference's Session.cpp send-buffer discipline): the
+    caller NEVER blocks on a slow peer's socket — frames queue up to a byte
+    budget and a dedicated writer drains them; beyond the budget the newest
+    frame is dropped (counted) so a stalled peer cannot make this node lag
+    or grow without bound. Consensus floods tolerate loss by design
+    (retransmit/view-change paths)."""
+
+    def __init__(self, peer_id: bytes, sock: socket.socket, on_dead):
+        self.peer_id = peer_id
+        self.sock = sock
+        self._on_dead = on_dead
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self._writer = threading.Thread(
+            target=self._write_loop, name=f"p2p-w-{peer_id[:4].hex()}",
+            daemon=True)
+        self._writer.start()
+
+    def enqueue(self, frame: bytes) -> bool:
+        with self._lock:
+            if self._bytes + len(frame) > MAX_SEND_QUEUE:
+                self.dropped += 1
+                if self.dropped in (1, 100, 10000):
+                    LOG.warning(badge("P2P", "send-queue-full",
+                                      peer=self.peer_id[:8].hex(),
+                                      dropped=self.dropped))
+                return False
+            self._bytes += len(frame)
+        self._q.put(frame)
+        return True
+
+    def _write_loop(self) -> None:
+        while True:
+            frame = self._q.get()
+            if frame is None:
+                return
+            try:
+                _send_frame(self.sock, frame)
+            except OSError:
+                self._on_dead(self.peer_id)
+                return
+            with self._lock:
+                self._bytes -= len(frame)
+
+    def close(self) -> None:
+        self._q.put(None)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class P2PGateway(Gateway):
     def __init__(self, node_id: bytes, host: str = "127.0.0.1",
                  port: int = 0, peers: Optional[list[tuple[str, int]]] = None,
@@ -192,15 +251,15 @@ class P2PGateway(Gateway):
         self.deny_list = deny_list or set()
         self.compress_threshold = compress_threshold
         self._front = None
-        self._sessions: dict[bytes, socket.socket] = {}
-        self._send_locks: dict[bytes, threading.Lock] = {}
+        self._sessions: dict[bytes, _Session] = {}
         self._peer_by_addr: dict[tuple[str, int], bytes] = {}
         self._router = RouterTable(node_id)
         self._lock = threading.Lock()
-        # held across build+send of ROUTE frames so two concurrent topology
-        # events cannot deliver a stale vector after a newer one. RLock: a
-        # send failure inside the advertise loop drops the session, which
-        # re-advertises re-entrantly (bounded — each drop removes a session).
+        # held across build+enqueue of ROUTE frames so two concurrent
+        # topology events cannot deliver a stale vector after a newer one.
+        # RLock: a full send queue inside the advertise loop drops that
+        # session, which re-advertises re-entrantly (bounded — each drop
+        # removes a session).
         self._adv_lock = threading.RLock()
         self._topo_version = 0  # bumped under _lock on any routing change
         self._stopped = False
@@ -241,21 +300,14 @@ class P2PGateway(Gateway):
         return self._forward(dst, frame)
 
     def _forward(self, dst: bytes, frame: bytes) -> bool:
-        """Hand a DATA frame to the session for dst, or its next hop."""
+        """Hand a DATA frame to the session for dst, or its next hop.
+        Non-blocking: enqueues on the session's bounded writer queue."""
         with self._lock:
             hop = dst if dst in self._sessions else self._router.next_hop(dst)
-            sock = self._sessions.get(hop) if hop else None
-            slock = (self._send_locks.setdefault(hop, threading.Lock())
-                     if hop else None)
-        if sock is None:
+            sess = self._sessions.get(hop) if hop else None
+        if sess is None:
             return False
-        try:
-            with slock:  # sendall is not atomic across threads
-                _send_frame(sock, frame)
-            return True
-        except OSError:
-            self._drop(hop)
-            return False
+        return sess.enqueue(frame)
 
     def broadcast(self, src: bytes, data: bytes) -> None:
         flags, payload = self._encode_payload(data)  # compress ONCE
@@ -264,26 +316,24 @@ class P2PGateway(Gateway):
                                           dst, payload))
 
     def _advertise_routes(self) -> None:
-        # loop until the vector we just finished sending is still current:
-        # a send failure mid-loop drops the session and re-enters (RLock),
-        # sending a NEWER vector; when the outer pass then resumes with its
-        # stale frame, the version check catches it and resends fresh — the
-        # LAST frame every neighbor sees is always the newest.
+        # loop until the vector we just finished enqueueing is still
+        # current: a full-queue drop mid-loop removes that session and
+        # re-enters (RLock) with a NEWER vector; when the outer pass then
+        # resumes with its stale frame, the version check catches it and
+        # re-enqueues fresh — the LAST frame every live neighbor gets is
+        # always the newest.
         with self._adv_lock:
             while True:
                 with self._lock:
                     ver = self._topo_version
                     frame = _pack_route(self._router.vector())
-                    targets = [(nb, self._sessions[nb],
-                                self._send_locks.setdefault(
-                                    nb, threading.Lock()))
-                               for nb in self._sessions]
-                for nb, sock, slock in targets:
-                    try:
-                        with slock:
-                            _send_frame(sock, frame)
-                    except OSError:
-                        self._drop(nb)
+                    targets = list(self._sessions.values())
+                for sess in targets:
+                    if not sess.enqueue(frame):
+                        # a peer 64MB behind cannot be kept route-consistent;
+                        # drop the session (it re-advertises re-entrantly)
+                        # rather than silently desync its routing table
+                        self._drop(sess.peer_id)
                 with self._lock:
                     if self._topo_version == ver:
                         return
@@ -295,13 +345,10 @@ class P2PGateway(Gateway):
         except OSError:
             pass
         with self._lock:
-            socks = list(self._sessions.values())
+            sessions = list(self._sessions.values())
             self._sessions.clear()
-        for s in socks:
-            try:
-                s.close()
-            except OSError:
-                pass
+        for sess in sessions:
+            sess.close()
 
     def add_peer(self, host: str, port: int) -> None:
         with self._lock:
@@ -340,7 +387,7 @@ class P2PGateway(Gateway):
         with self._lock:
             if peer_id in self._sessions:
                 return False  # duplicate dial; first session wins
-            self._sessions[peer_id] = sock
+            self._sessions[peer_id] = _Session(peer_id, sock, self._drop)
             self._router.neighbor_up(peer_id)
             self._topo_version += 1
         self._spawn(lambda: self._read_loop(peer_id, sock),
@@ -352,15 +399,12 @@ class P2PGateway(Gateway):
 
     def _drop(self, peer_id: bytes) -> None:
         with self._lock:
-            sock = self._sessions.pop(peer_id, None)
+            sess = self._sessions.pop(peer_id, None)
             changed = self._router.neighbor_down(peer_id)
-            if sock is not None:
+            if sess is not None:
                 self._topo_version += 1
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        if sess is not None:
+            sess.close()
             if changed:
                 self._advertise_routes()
 
