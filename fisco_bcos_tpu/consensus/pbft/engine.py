@@ -67,7 +67,7 @@ class _ProposalCache:
     __slots__ = ("proposal", "proposal_hash", "prepares", "commits",
                  "checkpoints", "checkpoint_msgs", "prepared",
                  "committed_phase", "executed", "executed_hash",
-                 "preprepare_msg")
+                 "executed_header", "preprepare_msg")
 
     def __init__(self):
         self.proposal: Optional[Block] = None
@@ -81,6 +81,7 @@ class _ProposalCache:
         self.committed_phase = False
         self.executed = False
         self.executed_hash: bytes = b""
+        self.executed_header = None  # the FINALISED header (roots filled)
 
 
 class PBFTEngine(Worker):
@@ -518,6 +519,7 @@ class PBFTEngine(Worker):
             return
         cache.executed = True
         cache.executed_hash = result.header.hash(self.suite)
+        cache.executed_header = result.header
         # the checkpoint seal IS the commit seal for signature_list
         seal = self.suite.sign(self.keypair, cache.executed_hash)
         cache.checkpoints[self.index] = seal
@@ -546,7 +548,11 @@ class PBFTEngine(Worker):
                     cache.checkpoints.pop(i, None)
             return
         cache.committed_phase = True
-        header = cache.proposal.header
+        # commit the EXECUTED result's header, not the proposal's: the two
+        # are the same object for the in-process scheduler (finalised in
+        # place) but differ behind a scheduler-service proxy, where the
+        # proposal header never learns its roots
+        header = cache.executed_header
         header.signature_list = good
         if not self.scheduler.commit_block(header):
             LOG.error(badge("PBFT", "ledger-commit-failed", number=number))

@@ -187,10 +187,11 @@ class _Session:
     def __init__(self, peer_id: bytes, sock: socket.socket, on_dead):
         self.peer_id = peer_id
         self.sock = sock
-        self._on_dead = on_dead
+        self._on_dead = on_dead  # called with THIS session (identity-safe)
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._bytes = 0
         self._lock = threading.Lock()
+        self._closed = False
         self.dropped = 0
         self._writer = threading.Thread(
             target=self._write_loop, name=f"p2p-w-{peer_id[:4].hex()}",
@@ -199,6 +200,8 @@ class _Session:
 
     def enqueue(self, frame: bytes) -> bool:
         with self._lock:
+            if self._closed:
+                return False  # writer already gone; don't strand frames
             if self._bytes + len(frame) > MAX_SEND_QUEUE:
                 self.dropped += 1
                 if self.dropped in (1, 100, 10000):
@@ -218,12 +221,14 @@ class _Session:
             try:
                 _send_frame(self.sock, frame)
             except OSError:
-                self._on_dead(self.peer_id)
+                self._on_dead(self)
                 return
             with self._lock:
                 self._bytes -= len(frame)
 
     def close(self) -> None:
+        with self._lock:
+            self._closed = True
         self._q.put(None)
         try:
             self.sock.close()
@@ -333,7 +338,7 @@ class P2PGateway(Gateway):
                         # a peer 64MB behind cannot be kept route-consistent;
                         # drop the session (it re-advertises re-entrantly)
                         # rather than silently desync its routing table
-                        self._drop(sess.peer_id)
+                        self._drop_session(sess)
                 with self._lock:
                     if self._topo_version == ver:
                         return
@@ -387,26 +392,41 @@ class P2PGateway(Gateway):
         with self._lock:
             if peer_id in self._sessions:
                 return False  # duplicate dial; first session wins
-            self._sessions[peer_id] = _Session(peer_id, sock, self._drop)
+            sess = _Session(peer_id, sock, self._drop_session)
+            self._sessions[peer_id] = sess
             self._router.neighbor_up(peer_id)
             self._topo_version += 1
-        self._spawn(lambda: self._read_loop(peer_id, sock),
+        self._spawn(lambda: self._read_loop(sess, sock),
                     f"p2p-read-{peer_id[:4].hex()}")
         LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
                        n=len(self._sessions)))
         self._advertise_routes()
         return True
 
-    def _drop(self, peer_id: bytes) -> None:
+    def _drop_session(self, sess: "_Session") -> None:
+        """Tear down a SPECIFIC session: a stale writer/reader for a dead
+        link must not remove a healthy replacement registered under the
+        same peer id."""
+        self._drop(sess.peer_id, sess)
+
+    def _drop(self, peer_id: bytes, expect: "Optional[_Session]" = None
+              ) -> None:
         with self._lock:
-            sess = self._sessions.pop(peer_id, None)
-            changed = self._router.neighbor_down(peer_id)
-            if sess is not None:
+            sess = self._sessions.get(peer_id)
+            if sess is None or (expect is not None and sess is not expect):
+                stale = expect
+                sess = None
+            else:
+                self._sessions.pop(peer_id, None)
+                self._router.neighbor_down(peer_id)
                 self._topo_version += 1
+                stale = None
+        if stale is not None:
+            stale.close()  # silence the dead session; topology unchanged
+            return
         if sess is not None:
             sess.close()
-            if changed:
-                self._advertise_routes()
+            self._advertise_routes()
 
     def _accept_loop(self) -> None:
         while not self._stopped:
@@ -459,14 +479,15 @@ class P2PGateway(Gateway):
                     continue
             time.sleep(self.reconnect_interval)
 
-    def _read_loop(self, peer_id: bytes, sock: socket.socket) -> None:
+    def _read_loop(self, sess: "_Session", sock: socket.socket) -> None:
+        peer_id = sess.peer_id
         while not self._stopped:
             try:
                 frame = _recv_frame(sock)
             except OSError:
                 frame = None
             if frame is None:
-                self._drop(peer_id)
+                self._drop_session(sess)
                 return
             try:
                 self._on_frame(peer_id, frame)

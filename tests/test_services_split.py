@@ -172,3 +172,57 @@ def test_front_service_request_response_bridging():
         front_a.stop()
         front_b.stop()
         gateway.stop()
+
+
+def test_scheduler_service_execute_commit_call():
+    """Consensus-side proxy executes and commits a block while storage and
+    execution state live entirely in the scheduler process (Max split)."""
+    from fisco_bcos_tpu.executor.executor import TransactionExecutor
+    from fisco_bcos_tpu.scheduler.scheduler import Scheduler
+    from fisco_bcos_tpu.services.scheduler_service import (
+        RemoteScheduler,
+        SchedulerServer,
+    )
+
+    suite = make_suite(backend="host")
+    storage = MemoryStorage()
+    ledger = Ledger(storage, suite)
+    kp = suite.generate_keypair(b"sched-svc")
+    ledger.build_genesis([ConsensusNode(kp.pub_bytes)])
+    sched = Scheduler(storage, ledger, TransactionExecutor(suite), suite,
+                      txpool=None)
+    server = SchedulerServer(sched)
+    server.start()
+    remote = RemoteScheduler("127.0.0.1", server.port)
+    try:
+        txs = [_tx(suite, kp, f"ss{i}") for i in range(3)]
+        block = Block(transactions=txs)
+        block.header.number = 1
+        block.header.timestamp = 1234
+        res = remote.execute_block(block, [kp.pub_bytes])
+        assert res is not None
+        assert len(res.receipts) == 3
+        assert all(rc.status == 0 for rc in res.receipts)
+        assert res.header.txs_root != b""
+
+        assert remote.commit_block(res.header)
+        assert ledger.current_number() == 1
+        assert ledger.total_tx_count() == 3
+
+        # read path: remote call for a balance query
+        q = Transaction(to=pc.BALANCE_ADDRESS,
+                        input=pc.encode_call(
+                            "balanceOf", lambda w: w.blob(b"ss0")))
+        rc = remote.call(q)
+        assert rc.status == 0
+        from fisco_bcos_tpu.codec.wire import Reader
+        assert Reader(rc.output).u64() == 1
+
+        # out-of-order execution fails cleanly across the wire
+        bad = Block(transactions=[_tx(suite, kp, "ss9")])
+        bad.header.number = 5
+        assert remote.execute_block(bad) is None
+    finally:
+        remote.close()
+        server.stop()
+        sched.shutdown()
