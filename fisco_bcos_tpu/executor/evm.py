@@ -29,6 +29,7 @@ Contract state layout (tables on the framework's storage):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional
 
 from ..protocol import LogEntry, TransactionStatus
@@ -167,6 +168,36 @@ def _sign(v: int) -> int:
 
 def _addr_bytes(v: int) -> bytes:
     return (v & ((1 << 160) - 1)).to_bytes(20, "big")
+
+
+# evmone-style code-analysis LRU (VMFactory.h:46-64 keeps analyzed code
+# cached so repeated calls to the same contract skip the O(len) scan)
+_JD_CACHE_MAX = 256
+_jd_cache: "dict[bytes, frozenset[int]]" = {}
+_jd_lock = threading.Lock()
+
+
+def _analyze_jumpdests(code: bytes) -> frozenset[int]:
+    with _jd_lock:
+        cached = _jd_cache.get(code)
+        if cached is not None:
+            return cached
+    dests = set()
+    i = 0
+    n = len(code)
+    while i < n:
+        op = code[i]
+        if op == 0x5B:
+            dests.add(i)
+        if 0x60 <= op <= 0x7F:
+            i += op - 0x5F
+        i += 1
+    frozen = frozenset(dests)
+    with _jd_lock:
+        if len(_jd_cache) >= _JD_CACHE_MAX:
+            _jd_cache.pop(next(iter(_jd_cache)))  # FIFO eviction
+        _jd_cache[code] = frozen
+    return frozen
 
 
 class EVM:
@@ -385,17 +416,7 @@ class EVM:
              gas: int, depth: int, static: bool) -> EVMResult:
         f = Frame(gas)
         logs: list[LogEntry] = []
-        # jumpdest analysis (evmone's code analysis, VMFactory.h:51 cache
-        # motivation — analysis here is O(len) per frame)
-        jumpdests = set()
-        i = 0
-        while i < len(code):
-            op = code[i]
-            if op == 0x5B:
-                jumpdests.add(i)
-            if 0x60 <= op <= 0x7F:
-                i += op - 0x5F
-            i += 1
+        jumpdests = _analyze_jumpdests(code)
 
         def store_key(slot: int) -> bytes:
             return address + slot.to_bytes(32, "big")

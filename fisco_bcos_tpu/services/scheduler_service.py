@@ -17,8 +17,10 @@ import dataclasses
 from typing import Optional, Sequence
 
 from ..codec.wire import Reader, Writer
-from ..protocol import Block, BlockHeader, Receipt, Transaction
-from .rpc import ServiceClient, ServiceServer
+from ..protocol import Block, BlockHeader, Receipt, Transaction, \
+    TransactionStatus
+from ..utils.log import LOG, badge
+from .rpc import ServiceClient, ServiceRemoteError, ServiceServer
 
 
 @dataclasses.dataclass
@@ -37,6 +39,7 @@ class SchedulerServer:
         s = self.server
         s.register("executeBlock", self._execute)
         s.register("commitBlock", self._commit)
+        s.register("dropExecuted", self._drop_executed)
         s.register("call", self._call)
 
     @property
@@ -65,6 +68,10 @@ class SchedulerServer:
         header = BlockHeader.decode(r.blob())
         w.u8(1 if self.scheduler.commit_block(header) else 0)
 
+    def _drop_executed(self, r: Reader, w: Writer) -> None:
+        self.scheduler.drop_executed(BlockHeader.decode(r.blob()))
+        w.u8(1)
+
     def _call(self, r: Reader, w: Writer) -> None:
         rc = self.scheduler.call(Transaction.decode(r.blob()))
         w.blob(rc.encode())
@@ -91,8 +98,14 @@ class RemoteScheduler:
                 w.seq(list(sealer_list), lambda ww, nid: ww.blob(nid))
 
         # retry=False: execution mutates scheduler state (pending results);
-        # a blind resend could double-execute a proposal
-        r = self.client.call("executeBlock", build, retry=False)
+        # a blind resend could double-execute a proposal. Transport/remote
+        # failures map to the in-process contract (None) so PBFT/sync state
+        # machines keep their failure paths instead of catching exceptions.
+        try:
+            r = self.client.call("executeBlock", build, retry=False)
+        except (ConnectionError, OSError, ServiceRemoteError) as exc:
+            LOG.warning(badge("SCHED-SVC", "execute-failed", err=str(exc)))
+            return None
         if not r.u8():
             return None
         header = BlockHeader.decode(r.blob())
@@ -100,12 +113,30 @@ class RemoteScheduler:
         return RemoteExecutionResult(header, receipts)
 
     def commit_block(self, header: BlockHeader) -> bool:
-        r = self.client.call("commitBlock",
-                             lambda w: w.blob(header.encode()), retry=False)
+        try:
+            r = self.client.call("commitBlock",
+                                 lambda w: w.blob(header.encode()),
+                                 retry=False)
+        except (ConnectionError, OSError, ServiceRemoteError) as exc:
+            LOG.warning(badge("SCHED-SVC", "commit-failed", err=str(exc)))
+            return False
         return bool(r.u8())
 
+    def drop_executed(self, header: BlockHeader) -> None:
+        try:
+            self.client.call("dropExecuted",
+                             lambda w: w.blob(header.encode()))
+        except (ConnectionError, OSError, ServiceRemoteError):
+            pass  # server-side entry expires with the process; best effort
+
     def call(self, tx: Transaction) -> Receipt:
-        r = self.client.call("call", lambda w: w.blob(tx.encode()))
+        try:
+            r = self.client.call("call", lambda w: w.blob(tx.encode()))
+        except (ConnectionError, OSError, ServiceRemoteError) as exc:
+            rc = Receipt()
+            rc.status = int(TransactionStatus.EXECUTION_ABORTED)
+            rc.message = f"scheduler service unreachable: {exc}"
+            return rc
         return Receipt.decode(r.blob())
 
     def close(self) -> None:

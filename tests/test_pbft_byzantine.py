@@ -1,0 +1,209 @@
+"""Byzantine-behavior PBFT tests: equivocation, tampered seals, garbage.
+
+Reference scenarios: bcos-pbft's PBFTEngineTest exercises faulty packets
+and view changes; these tests inject adversarial traffic through the
+FakeGateway filter (the fixture-level fault injection the reference does
+with faked nodes)."""
+
+import time
+
+from fisco_bcos_tpu.codec.wire import Reader, Writer
+from fisco_bcos_tpu.consensus.pbft.messages import (
+    PacketType,
+    PBFTMessage,
+    make_packet,
+)
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+from fisco_bcos_tpu.net.gateway import FakeGateway
+from fisco_bcos_tpu.net.moduleid import ModuleID
+from fisco_bcos_tpu.protocol import Block, Transaction, TransactionStatus
+
+
+def wait_until(pred, timeout=25.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _cluster(view_timeout=2.0):
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    keypairs = [suite.generate_keypair(bytes([i + 21]) * 16)
+                for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    nodes = []
+    for kp in keypairs:
+        node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                               min_seal_time=0.0,
+                               view_timeout=view_timeout),
+                    keypair=kp, gateway=gateway)
+        node.build_genesis(sealers)
+        nodes.append(node)
+    return suite, gateway, keypairs, nodes
+
+
+def _tx(suite, kp, nonce):
+    return Transaction(to=pc.BALANCE_ADDRESS,
+                       input=pc.encode_call(
+                           "register", lambda w: w.blob(nonce.encode())
+                           .u64(1)),
+                       nonce=nonce, block_limit=100).sign(suite, kp)
+
+
+def _front_pack(payload: bytes) -> bytes:
+    return (Writer().u16(int(ModuleID.PBFT)).u8(0).u64(0)
+            .blob(payload).bytes())
+
+
+def _parse_pbft(data: bytes):
+    r = Reader(data)
+    module, _, _ = r.u16(), r.u8(), r.u64()
+    if module != int(ModuleID.PBFT):
+        return None
+    try:
+        return PBFTMessage.decode(r.blob())
+    except Exception:
+        return None
+
+
+def test_equivocating_leader_does_not_fork(tmp_path):
+    """The height-1 leader sends DIFFERENT proposals to different nodes;
+    the chain must never fork — all nodes converge on one header."""
+    suite, gateway, keypairs, nodes = _cluster()
+    # leader for height 1, view 0: index (1 // leader_period + 0) % 4 in
+    # the engine's sorted node-id ordering (engine.py leader_for)
+    sorted_ids = sorted(kp.pub_bytes for kp in keypairs)
+    leader_kp = next(kp for kp in keypairs
+                     if kp.pub_bytes == sorted_ids[1 % 4])
+    victim_id = next(i for i in sorted_ids if i != leader_kp.pub_bytes)
+
+    def equivocate(src, dst, data):
+        if src != leader_kp.pub_bytes or dst != victim_id:
+            return True
+        msg = _parse_pbft(data)
+        if msg is None or msg.packet_type != int(PacketType.PRE_PREPARE):
+            return True
+        # substitute a CONFLICTING, validly-signed proposal for the victim
+        try:
+            block = Block.decode(msg.payload)
+        except Exception:
+            return True
+        block.header.timestamp += 1  # different content -> different hash
+        block.header.invalidate()
+        phash = block.header.hash(suite)
+        forged = make_packet(PacketType.PRE_PREPARE, msg.view, msg.number,
+                             msg.from_idx, phash, block.encode())
+        forged.sign(suite, leader_kp)
+        gateway.send(src, dst, _front_pack(forged.encode()))
+        return False  # drop the original toward the victim
+
+    gateway.set_filter(equivocate)
+    try:
+        kp = suite.generate_keypair(b"byz-user")
+        for node in nodes:
+            node.start()
+        res = nodes[0].send_transaction(_tx(suite, kp, "bz1"))
+        assert res.status == TransactionStatus.OK
+
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes)), \
+            [n.ledger.current_number() for n in nodes]
+        headers = [n.ledger.header_by_number(1) for n in nodes]
+        assert len({h.hash(suite) for h in headers}) == 1, "chain forked"
+    finally:
+        for n in nodes:
+            n.stop()
+        gateway.stop()
+
+
+def test_tampered_checkpoint_seal_rejected_but_chain_commits(tmp_path):
+    """One node's checkpoint seal is corrupted in flight: the batch seal
+    verification must reject it while the honest quorum still commits, and
+    the committed header must carry only VALID seals."""
+    suite, gateway, keypairs, nodes = _cluster(view_timeout=8.0)
+    tampered = {"n": 0}
+
+    def corrupt_one_seal(src, dst, data):
+        msg = _parse_pbft(data)
+        if (msg is not None
+                and msg.packet_type == int(PacketType.CHECKPOINT)
+                and msg.from_idx == 3):
+            # flip bits in the seal payload; packet signature stays intact
+            bad = bytes([msg.payload[0] ^ 0xFF]) + msg.payload[1:]
+            msg.payload = bad
+            msg._hash = None
+            msg.sign(suite, keypairs[3])  # re-signed packet, garbage seal
+            tampered["n"] += 1
+            gateway.send(src, dst, _front_pack(msg.encode()))
+            return False
+        return True
+
+    gateway.set_filter(corrupt_one_seal)
+    try:
+        kp = suite.generate_keypair(b"byz-user2")
+        for node in nodes:
+            node.start()
+        res = nodes[0].send_transaction(_tx(suite, kp, "bz2"))
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes)), \
+            [n.ledger.current_number() for n in nodes]
+        assert tampered["n"] > 0, "filter never fired"
+        for node in nodes:
+            header = node.ledger.header_by_number(1)
+            ehash = header.hash(suite)
+            # drop the self-added signature_list then re-verify each seal
+            for idx, seal in header.signature_list:
+                pub = sorted(k.pub_bytes for k in keypairs)[idx]
+                assert suite.verify(pub, ehash, seal), \
+                    "committed header carries an invalid seal"
+            assert len(header.signature_list) >= 3
+    finally:
+        for n in nodes:
+            n.stop()
+        gateway.stop()
+
+
+def test_garbage_and_replayed_packets_ignored(tmp_path):
+    """Random garbage and stale replayed packets on the PBFT module must
+    not disturb consensus."""
+    suite, gateway, keypairs, nodes = _cluster()
+    try:
+        for node in nodes:
+            node.start()
+        kp = suite.generate_keypair(b"byz-user3")
+        res = nodes[0].send_transaction(_tx(suite, kp, "bz3"))
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes))
+
+        # blast garbage + replays at every node from a non-member identity
+        intruder = suite.generate_keypair(b"intruder").pub_bytes
+        stale = make_packet(PacketType.PRE_PREPARE, 0, 1, 0, b"\x00" * 32,
+                            b"not-a-block")
+        stale.sign(suite, keypairs[0])
+        for node_kp in keypairs:
+            gateway.register_front(intruder, type("F", (), {
+                "on_network_message": staticmethod(lambda s, d: None)})())
+            gateway.send(intruder, node_kp.pub_bytes,
+                         _front_pack(b"\xde\xad\xbe\xef"))
+            gateway.send(intruder, node_kp.pub_bytes,
+                         _front_pack(stale.encode()))
+
+        res = nodes[1].send_transaction(_tx(suite, kp, "bz4"))
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 2 for n in nodes)), \
+            [n.ledger.current_number() for n in nodes]
+        headers = [n.ledger.header_by_number(2) for n in nodes]
+        assert len({h.hash(suite) for h in headers}) == 1
+    finally:
+        for n in nodes:
+            n.stop()
+        gateway.stop()
