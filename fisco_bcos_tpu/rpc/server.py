@@ -289,6 +289,8 @@ class JsonRpcImpl:
         return self.node.ledger.current_number()
 
     def get_code(self, group: str, node_name: str = "", address: str = ""):
+        if self.node.storage is None:  # Pro RPC without a storage service
+            return "0x"
         self._check_group(group)
         code = self.node.executor.get_code(_unhex(address),
                                            self.node.storage)
@@ -296,6 +298,8 @@ class JsonRpcImpl:
 
     def get_abi(self, group: str, node_name: str = "", address: str = ""):
         self._check_group(group)
+        if self.node.storage is None:  # Pro RPC without a storage service
+            return ""
         return self.node.executor.get_abi(_unhex(address), self.node.storage)
 
     def get_sealer_list(self, group: str, node_name: str = ""):

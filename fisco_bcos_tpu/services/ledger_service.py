@@ -31,6 +31,12 @@ class LedgerServer:
         s.register("noncesByNumber", self._nonces)
         s.register("systemConfig", self._sys_config)
         s.register("consensusNodes", self._nodes)
+        s.register("blockByNumber", self._block)
+        s.register("numberByHash", self._num_by_hash)
+        s.register("totalFailedCount", self._total_failed)
+        s.register("txProof", self._tx_proof)
+        s.register("receiptProof", self._receipt_proof)
+        s.register("ledgerConfig", self._ledger_config)
 
     @property
     def port(self) -> int:
@@ -80,6 +86,49 @@ class LedgerServer:
         w.seq(nodes, lambda ww, n: ww.blob(n.node_id).u64(n.weight)
               .text(n.node_type).i64(n.enable_number))
 
+    def _block(self, r: Reader, w: Writer) -> None:
+        n, with_txs = r.i64(), bool(r.u8())
+        blk = self.ledger.block_by_number(n, with_txs)
+        w.blob(blk.encode() if blk else b"")
+
+    def _num_by_hash(self, r: Reader, w: Writer) -> None:
+        n = self.ledger.number_by_hash(r.blob())
+        w.i64(-1 if n is None else n)
+
+    def _total_failed(self, r: Reader, w: Writer) -> None:
+        w.i64(self.ledger.total_failed_count())
+
+    @staticmethod
+    def _write_proof(w: Writer, pr) -> None:
+        if pr is None:
+            w.u8(0)
+            return
+        proof, root = pr
+        w.u8(1)
+        w.blob(root)
+        w.seq(proof, lambda ww, lvl: ww.seq(
+            lvl[0], lambda www, s: www.blob(s)).u32(lvl[1]))
+
+    def _tx_proof(self, r: Reader, w: Writer) -> None:
+        self._write_proof(w, self.ledger.tx_proof(r.blob()))
+
+    def _receipt_proof(self, r: Reader, w: Writer) -> None:
+        self._write_proof(w, self.ledger.receipt_proof(r.blob()))
+
+    @staticmethod
+    def _write_nodes(w: Writer, nodes) -> None:
+        w.seq(nodes, lambda ww, n: ww.blob(n.node_id).u64(n.weight)
+              .text(n.node_type).i64(n.enable_number))
+
+    def _ledger_config(self, r: Reader, w: Writer) -> None:
+        cfg = self.ledger.ledger_config()
+        self._write_nodes(w, cfg.consensus_nodes)
+        self._write_nodes(w, cfg.observer_nodes)
+        w.i64(cfg.block_number)
+        w.blob(cfg.block_hash)
+        w.u32(cfg.block_tx_count_limit)
+        w.u32(cfg.leader_switch_period)
+
 
 class RemoteLedger:
     """Read-only ledger proxy (duck-types the query surface)."""
@@ -126,6 +175,51 @@ class RemoteLedger:
         r = self.client.call("consensusNodes")
         return r.seq(lambda rr: ConsensusNode(rr.blob(), rr.u64(),
                                               rr.text(), rr.i64()))
+
+    def block_by_number(self, n: int, with_txs: bool = True):
+        from ..protocol import Block
+
+        raw = self.client.call(
+            "blockByNumber",
+            lambda w: w.i64(n).u8(1 if with_txs else 0)).blob()
+        return Block.decode(raw) if raw else None
+
+    def number_by_hash(self, h: bytes) -> Optional[int]:
+        n = self.client.call("numberByHash", lambda w: w.blob(h)).i64()
+        return None if n < 0 else n
+
+    def total_failed_count(self) -> int:
+        return self.client.call("totalFailedCount").i64()
+
+    @staticmethod
+    def _read_proof(r: Reader):
+        if not r.u8():
+            return None
+        root = r.blob()
+        proof = r.seq(lambda rr: (rr.seq(lambda www: www.blob()), rr.u32()))
+        return proof, root
+
+    def tx_proof(self, tx_hash: bytes):
+        return self._read_proof(
+            self.client.call("txProof", lambda w: w.blob(tx_hash)))
+
+    def receipt_proof(self, tx_hash: bytes):
+        return self._read_proof(
+            self.client.call("receiptProof", lambda w: w.blob(tx_hash)))
+
+    def ledger_config(self) -> LedgerConfig:
+        r = self.client.call("ledgerConfig")
+
+        def nodes(rr):
+            return rr.seq(lambda x: ConsensusNode(x.blob(), x.u64(),
+                                                  x.text(), x.i64()))
+
+        return LedgerConfig(consensus_nodes=nodes(r),
+                            observer_nodes=nodes(r),
+                            block_number=r.i64(),
+                            block_hash=r.blob(),
+                            block_tx_count_limit=r.u32(),
+                            leader_switch_period=r.u32())
 
     def close(self) -> None:
         self.client.close()

@@ -40,6 +40,7 @@ class TxPoolServer:
         s.register("missingHashes", self._missing)
         s.register("pendingCount", self._pending)
         s.register("onCommitted", self._on_committed)
+        s.register("waitReceipt", self._wait_receipt)
 
     @property
     def port(self) -> int:
@@ -88,12 +89,22 @@ class TxPoolServer:
         self.txpool.on_block_committed(number, hashes, nonces)
         w.u8(1)
 
+    def _wait_receipt(self, r: Reader, w: Writer) -> None:
+        tx_hash = r.blob()
+        timeout = min(r.u32(), 25)  # bounded park; client re-polls
+        rc = self.txpool.wait_for_receipt(tx_hash, timeout)
+        w.blob(rc.encode() if rc is not None else b"")
+
 
 class RemoteTxPool:
     """Pool proxy for services in other processes (sealer/PBFT-side)."""
 
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self.client = ServiceClient(host, port, timeout)
+        # receipt waits park server-side for up to 25 s; give them their
+        # own connection so they never serialize pool operations behind
+        # the shared client's per-call lock
+        self._wait_client = ServiceClient(host, port, timeout)
 
     def submit_batch(self, txs: Sequence[Transaction]
                      ) -> list[TxSubmitResult]:
@@ -149,5 +160,23 @@ class RemoteTxPool:
                        .seq(list(hashes), lambda ww, h: ww.blob(h))
                        .seq(list(nonces), lambda ww, n: ww.text(n))))
 
+    def wait_for_receipt(self, tx_hash: bytes, timeout: float = 30.0):
+        """Server-side park (bounded), client-side re-poll loop."""
+        import time as _time
+
+        from ..protocol import Receipt
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            budget = max(1, int(min(25, deadline - _time.monotonic())))
+            raw = self._wait_client.call(
+                "waitReceipt",
+                lambda w: w.blob(tx_hash).u32(budget)).blob()
+            if raw:
+                return Receipt.decode(raw)
+            if _time.monotonic() >= deadline:
+                return None
+
     def close(self) -> None:
         self.client.close()
+        self._wait_client.close()

@@ -226,3 +226,68 @@ def test_scheduler_service_execute_commit_call():
         remote.close()
         server.stop()
         sched.shutdown()
+
+
+def test_pro_rpc_service_full_stack():
+    """Pro deployment shape: an HTTP JSON-RPC service owning NO chain
+    state, backed by txpool/ledger/scheduler/storage service proxies into
+    the core node process; the SDK works unchanged against it."""
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.sdk.client import SdkClient
+    from fisco_bcos_tpu.services.ledger_service import LedgerServer
+    from fisco_bcos_tpu.services.rpc_service import (
+        ProNodeConfig,
+        make_pro_rpc,
+    )
+    from fisco_bcos_tpu.services.scheduler_service import SchedulerServer
+    from fisco_bcos_tpu.services.storage_service import StorageServer
+    from fisco_bcos_tpu.services.txpool_service import TxPoolServer
+
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0))
+    node.start()
+    servers = [TxPoolServer(node.txpool), LedgerServer(node.ledger),
+               SchedulerServer(node.scheduler), StorageServer(node.storage)]
+    for s in servers:
+        s.start()
+    rpc_kp = node.suite.generate_keypair(b"pro-rpc-identity")
+    rpc_server, facade = make_pro_rpc(
+        node.suite, rpc_kp, ProNodeConfig(),
+        txpool_addr=("127.0.0.1", servers[0].port),
+        ledger_addr=("127.0.0.1", servers[1].port),
+        scheduler_addr=("127.0.0.1", servers[2].port),
+        storage_addr=("127.0.0.1", servers[3].port))
+    rpc_server.start()
+    try:
+        cli = SdkClient(f"http://127.0.0.1:{rpc_server.port}")
+        kp = node.suite.generate_keypair(b"pro-user")
+        tx = _tx(node.suite, kp, "pro1")
+        rc = cli.send_transaction(tx)  # waits for the receipt via services
+        assert int(rc["status"]) == 0
+        assert cli.get_block_number() >= 1
+        blk = cli.get_block_by_number(1)
+        assert blk is not None and int(blk["number"]) == 1
+        got = cli.get_transaction("0x" + tx.hash(node.suite).hex(),
+                                  require_proof=True)
+        assert got is not None and "txProof" in got, got
+        # verify the inclusion proof that crossed the service wire (empty
+        # proof is valid for a single-tx block: leaf == root)
+        from fisco_bcos_tpu.ops.merkle import verify_merkle_proof
+
+        proof = [([bytes.fromhex(s[2:]) for s in lvl["siblings"]],
+                  lvl["index"]) for lvl in got["txProof"]]
+        root = bytes.fromhex(got["txsRoot"][2:])
+        assert verify_merkle_proof(tx.hash(node.suite), proof, root)
+        sealers = cli.get_sealer_list()  # needs RemoteLedger.ledger_config
+        assert len(sealers) == 1
+        cfg = cli.get_system_config("tx_count_limit")
+        assert int(cfg["value"]) >= 1
+        # read-only call through the scheduler service
+        out = cli.call(pc.BALANCE_ADDRESS,
+                       pc.encode_call("balanceOf", lambda w: w.blob(b"pro1")))
+        assert int(out["status"]) == 0
+    finally:
+        for s in servers:
+            s.stop()
+        rpc_server.stop()
+        facade.close()
+        node.stop()
