@@ -95,6 +95,11 @@ def _measure_cpu_baseline() -> tuple[float, int, str]:
 def _cpu_reexec() -> None:
     env = cpu_pinned_env(extra_path=_REPO)
     env["FBTPU_BENCH_CHILD"] = "1"
+    # the CPU fallback exists to always produce a parseable line, not to
+    # grind a 64k batch through a 1-core interpreter for 20 minutes: cap
+    # the batch unless the caller pinned one explicitly
+    env.setdefault("BENCH_BATCH", "1024")
+    env.setdefault("BENCH_ITERS", "1")
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
@@ -159,7 +164,10 @@ def main() -> None:
         assert bool(np.asarray(rec[2]).all()), "recover kernel rejected sigs"
 
         detail = []
-        if os.environ.get("BENCH_FULL") == "1":
+        if (os.environ.get("BENCH_FULL") == "1"
+                and "FBTPU_BENCH_CHILD" not in os.environ):
+            # the sweep's 16k+ batches are accelerator-scale; skip it on
+            # the CPU fallback so the headline line still lands in minutes
             # the rest of BASELINE's config grid -> BENCH_DETAIL.json
             for b in (1024, 16384):
                 if b == batch:
