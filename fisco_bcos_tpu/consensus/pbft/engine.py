@@ -111,7 +111,9 @@ class PBFTEngine(Worker):
         self.index = self.nodes.index(keypair.pub_bytes)
         self.n = len(self.nodes)
         self.f = (self.n - 1) // 3
-        self.quorum = 2 * self.f + 1
+        # n - f, the reference's minRequiredQuorum: equals 2f+1 when
+        # n = 3f+1 but stays safe for other sizes (e.g. n=3 -> 3, not 1)
+        self.quorum = self.n - self.f
 
         # durable consensus log (LedgerStorage.cpp analogue); replayed in
         # start() so an in-flight round survives a crash/restart
@@ -120,6 +122,7 @@ class PBFTEngine(Worker):
 
         self.view = 0
         self.to_view = 0  # > view while a view change is in flight
+        self._last_seen_number = ledger.current_number()
         self._caches: dict[int, _ProposalCache] = {}
         self._viewchanges: dict[int, dict[int, PBFTMessage]] = {}
         self._inbox: "queue.Queue[tuple[str, object]]" = queue.Queue()
@@ -216,11 +219,40 @@ class PBFTEngine(Worker):
                votes=len(replayed))
 
     def _grant_sealer(self) -> None:
-        nxt = self.ledger.current_number() + 1
-        lead = self.leader_for(nxt, self.view) == self.index
         cfg = self.ledger.ledger_config()
+        self._reload_membership(cfg)
+        nxt = self.ledger.current_number() + 1
+        lead = (self.index >= 0
+                and self.leader_for(nxt, self.view) == self.index)
         self.sealer.set_should_seal(lead, nxt,
                                     max_txs=cfg.block_tx_count_limit)
+
+    def _reload_membership(self, cfg) -> None:
+        """Apply on-chain consensus-set changes LIVE (the reference reloads
+        LedgerConfig per block: addSealer/remove governance takes effect at
+        its enable block, no restart). A node voted out keeps following via
+        sync but stops proposing/voting (index = -1); remaining members
+        recompute n/f/quorum."""
+        nodes = sorted(n.node_id for n in cfg.consensus_nodes)
+        if nodes == self.nodes or not nodes:
+            return  # unchanged, or refuse an empty sealer set
+        old_n = self.n
+        self.nodes = nodes
+        self.n = len(nodes)
+        self.f = (self.n - 1) // 3
+        self.quorum = self.n - self.f
+        self.index = (nodes.index(self.keypair.pub_bytes)
+                      if self.keypair.pub_bytes in nodes else -1)
+        # all cached round state is keyed by OLD-epoch indices: counting it
+        # against the new set would misattribute votes (or walk off the
+        # node list); discard it like a view entry does — in-flight txs go
+        # back to the pool and the round restarts under the new epoch
+        for _number, cache in list(self._caches.items()):
+            if cache.proposal is not None and not cache.committed_phase:
+                self.txpool.unseal(cache.proposal.tx_hashes)
+        self._caches.clear()
+        self._viewchanges.clear()
+        metric("pbft.membership", n=self.n, was=old_n, index=self.index)
 
     # -- ingress -----------------------------------------------------------
     def submit_proposal(self, block: Block) -> bool:
@@ -242,6 +274,12 @@ class PBFTEngine(Worker):
 
     # -- worker loop (PBFTEngine.cpp:555 executeWorker) --------------------
     def execute_worker(self) -> None:
+        # a block committed by SYNC (not by this engine) must still apply
+        # membership changes and re-grant the sealer
+        number = self.ledger.current_number()
+        if number != self._last_seen_number:
+            self._last_seen_number = number
+            self._grant_sealer()
         local: list[Block] = []
         msgs: list[PBFTMessage] = []
         while True:
@@ -455,6 +493,8 @@ class PBFTEngine(Worker):
                                block.encode())
 
     def _vote_prepare(self, number: int, phash: bytes) -> None:
+        if self.index < 0:
+            return  # voted out: follow via sync, don't participate
         cache = self._cache(number)
         if self.index in cache.prepares:
             return
@@ -496,7 +536,8 @@ class PBFTEngine(Worker):
         phash = cache.proposal_hash
         prepares = sum(1 for m in cache.prepares.values()
                        if m.proposal_hash == phash)
-        if not cache.prepared and prepares >= self.quorum:
+        if not cache.prepared and prepares >= self.quorum \
+                and self.index >= 0:
             cache.prepared = True
             vote = self._signed(make_packet(PacketType.COMMIT, self.view,
                                             number, self.index, phash))
@@ -520,6 +561,8 @@ class PBFTEngine(Worker):
         cache.executed = True
         cache.executed_hash = result.header.hash(self.suite)
         cache.executed_header = result.header
+        if self.index < 0:
+            return  # voted out: executed for local progress, no seal
         # the checkpoint seal IS the commit seal for signature_list
         seal = self.suite.sign(self.keypair, cache.executed_hash)
         cache.checkpoints[self.index] = seal
@@ -574,6 +617,9 @@ class PBFTEngine(Worker):
         self._deadline = time.monotonic() + self._timeout
 
     def _on_timeout(self) -> None:
+        if self.index < 0:  # voted out: no view-change participation
+            self._reset_timer()
+            return
         # nothing to agree on -> idle quietly unless a round is in flight
         in_flight = any(c.proposal is not None and not c.committed_phase
                         for c in self._caches.values())

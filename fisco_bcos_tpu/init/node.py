@@ -134,16 +134,14 @@ class Node:
             sealers = {n.node_id
                        for n in self.ledger.ledger_config().consensus_nodes}
             if self.keypair.pub_bytes in sealers:
-                if self.consensus is None:
-                    self.consensus = PBFTEngine(
-                        self.suite, self.keypair, self.front, self.txpool,
-                        self.sealer, self.scheduler, self.ledger,
-                        leader_period=self.config.leader_period,
-                        view_timeout=self.config.view_timeout,
-                        txsync=self.txsync)
-                self.consensus.start()
-                self.sealer.start()
-            # observers (not in the sealer set) just follow via block sync
+                self._start_engine()
+            else:
+                # observer today, maybe a sealer tomorrow: live governance
+                # (addSealer) must promote a RUNNING node without restart —
+                # peers raise their quorum to count us the moment the
+                # membership block commits, so we must start voting then
+                self.scheduler.on_commit.append(self._maybe_promote)
+            # observers (not in the sealer set) follow via block sync
             if self.blocksync is not None:
                 self.blocksync.start()
         if self.rpc is not None:
@@ -153,6 +151,31 @@ class Node:
         LOG.info(badge("NODE", "started",
                        number=self.ledger.current_number(),
                        mode=self.config.consensus))
+
+    def _start_engine(self) -> None:
+        if self.consensus is None:
+            self.consensus = PBFTEngine(
+                self.suite, self.keypair, self.front, self.txpool,
+                self.sealer, self.scheduler, self.ledger,
+                leader_period=self.config.leader_period,
+                view_timeout=self.config.view_timeout,
+                txsync=self.txsync)
+        self.consensus.start()
+        self.sealer.start()
+
+    def _maybe_promote(self, _number: int) -> None:
+        """Observer -> sealer promotion at the commit that enacts it."""
+        if self.consensus is not None or not self._started:
+            return
+        with self._commit_lock:
+            if self.consensus is not None:
+                return
+            sealers = {n.node_id
+                       for n in self.ledger.ledger_config().consensus_nodes}
+            if self.keypair.pub_bytes not in sealers:
+                return
+            LOG.info(badge("NODE", "promoted-to-sealer"))
+            self._start_engine()
 
     def stop(self) -> None:
         if self.rpc is not None:

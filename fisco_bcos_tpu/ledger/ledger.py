@@ -272,8 +272,13 @@ class Ledger:
         n = self.current_number()
         header = self.header_by_number(n)
         cfg = LedgerConfig(
-            consensus_nodes=[x for x in nodes if x.node_type == "consensus_sealer"],
-            observer_nodes=[x for x in nodes if x.node_type == "consensus_observer"],
+            # next-block effectiveness of governance changes falls out of
+            # commit visibility: the write (enable_number = block+1) only
+            # becomes readable here once its block committed
+            consensus_nodes=[x for x in nodes
+                             if x.node_type == "consensus_sealer"],
+            observer_nodes=[x for x in nodes
+                            if x.node_type == "consensus_observer"],
             block_number=n,
             block_hash=header.hash(self.suite) if header else b"\x00" * 32,
         )

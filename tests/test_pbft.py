@@ -256,3 +256,96 @@ def test_crash_restart_replays_consensus_log(tmp_path):
         assert len(hashes) == 1
     finally:
         stop_cluster(gateway, nodes)
+
+
+def test_live_consensus_membership_change(tmp_path):
+    """Governance removes a sealer on-chain: remaining members recompute
+    quorum and keep committing WITHOUT any restart; the removed node stops
+    participating but keeps following via sync (the reference reloads
+    LedgerConfig per block)."""
+    suite, gateway, nodes, sealers = build_cluster(4, view_timeout=20.0)
+    try:
+        kp = suite.generate_keypair(b"member-user")
+        res = nodes[0].send_transaction(make_tx(suite, kp, nonce="m1"))
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes))
+
+        # vote node X out (pick a non-leader for the next heights)
+        sorted_ids = sorted(s.node_id for s in sealers)
+        victim_id = sorted_ids[3]
+        victim = next(n for n in nodes
+                      if n.keypair.pub_bytes == victim_id)
+        from fisco_bcos_tpu.executor import precompiled as pc
+        gov = Transaction(
+            to=pc.CONSENSUS_ADDRESS,
+            input=pc.encode_call("remove", lambda w: w.blob(victim_id)),
+            nonce="gov1", block_limit=100).sign(suite, kp)
+        res = nodes[0].send_transaction(gov)
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 2 for n in nodes))
+
+        # remaining engines shrink to n=3 live; victim drops out
+        survivors = [n for n in nodes if n is not victim]
+        assert wait_until(lambda: all(
+            n.consensus.n == 3 for n in survivors)), \
+            [n.consensus.n for n in survivors]
+        assert wait_until(lambda: victim.consensus.index == -1)
+
+        # chain keeps committing with the reduced set, no restarts
+        h0 = nodes[0].ledger.current_number()
+        res = nodes[0].send_transaction(make_tx(suite, kp, nonce="m2"))
+        assert res.status == TransactionStatus.OK
+        assert wait_until(lambda: all(
+            n.ledger.current_number() >= h0 + 1 for n in survivors)), \
+            [n.ledger.current_number() for n in survivors]
+        committed = survivors[0].ledger.header_by_number(h0 + 1)
+        # the new block's seal quorum comes from the REDUCED set
+        assert len(committed.signature_list) >= 3
+        assert all(idx < 3 for idx, _seal in committed.signature_list)
+        # the removed node still follows the chain via block sync
+        assert wait_until(
+            lambda: victim.ledger.current_number() >= h0 + 1, 20)
+    finally:
+        stop_cluster(gateway, nodes)
+
+
+def test_observer_promoted_to_sealer_live(tmp_path):
+    """addObserver/addSealer governance promotes a RUNNING observer into
+    consensus with no restart: peers raise n/quorum and the promoted node
+    starts its engine at the enacting commit."""
+    suite, gateway, nodes, sealers = build_cluster(4, view_timeout=20.0)
+    obs_kp = suite.generate_keypair(b"promotee")
+    observer = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                               min_seal_time=0.0, view_timeout=20.0),
+                    keypair=obs_kp, gateway=gateway)
+    observer.build_genesis(sealers)
+    observer.start()
+    nodes = nodes + [observer]
+    try:
+        assert observer.consensus is None
+        kp = suite.generate_keypair(b"promo-user")
+        gov = Transaction(
+            to=pc.CONSENSUS_ADDRESS,
+            input=pc.encode_call("addSealer",
+                                 lambda w: w.blob(obs_kp.pub_bytes).u64(1)),
+            nonce="pr1", block_limit=100).sign(suite, kp)
+        assert nodes[0].send_transaction(gov).status == TransactionStatus.OK
+
+        # the promoted node grows an engine; peers grow to n=5
+        assert wait_until(lambda: observer.consensus is not None, 25)
+        assert wait_until(lambda: all(
+            n.consensus.n == 5 for n in nodes if n.consensus), 25), \
+            [n.consensus.n for n in nodes if n.consensus]
+
+        h0 = nodes[0].ledger.current_number()
+        tx = make_tx(suite, kp, nonce="pr2", name=b"promo")
+        assert nodes[0].send_transaction(tx).status == TransactionStatus.OK
+        assert wait_until(lambda: all(
+            n.ledger.current_number() >= h0 + 1 for n in nodes), 30), \
+            [n.ledger.current_number() for n in nodes]
+        hdr = nodes[0].ledger.header_by_number(h0 + 1)
+        assert len(hdr.signature_list) >= 4  # n=5 -> quorum = 5 - 1 = 4
+    finally:
+        stop_cluster(gateway, nodes)
