@@ -58,6 +58,7 @@ class NodeConfig:
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
     ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
+    metrics_port: Optional[int] = None  # None = no Prometheus endpoint
 
 
 class Node:
@@ -110,6 +111,11 @@ class Node:
             from ..rpc.ws_server import WsRpcServer
             self.ws = WsRpcServer(JsonRpcImpl(self),
                                   host=cfg.rpc_host, port=cfg.ws_port)
+        self.metrics = None
+        if cfg.metrics_port is not None:
+            from ..utils.metrics import MetricsServer
+            self.metrics = MetricsServer(host=cfg.rpc_host,
+                                         port=cfg.metrics_port)
         self._started = False
 
     # -- genesis -----------------------------------------------------------
@@ -148,6 +154,8 @@ class Node:
             self.rpc.start()
         if self.ws is not None:
             self.ws.start()
+        if self.metrics is not None:
+            self.metrics.start()
         LOG.info(badge("NODE", "started",
                        number=self.ledger.current_number(),
                        mode=self.config.consensus))
@@ -178,6 +186,8 @@ class Node:
             self._start_engine()
 
     def stop(self) -> None:
+        if self.metrics is not None:
+            self.metrics.stop()
         if self.rpc is not None:
             self.rpc.stop()
         if self.ws is not None:

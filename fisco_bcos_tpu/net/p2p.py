@@ -400,8 +400,15 @@ class P2PGateway(Gateway):
                     f"p2p-read-{peer_id[:4].hex()}")
         LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
                        n=len(self._sessions)))
+        self._update_session_gauge()
         self._advertise_routes()
         return True
+
+    def _update_session_gauge(self) -> None:
+        from ..utils.metrics import REGISTRY
+        with self._lock:
+            n = len(self._sessions)
+        REGISTRY.set_gauge("bcos_p2p_sessions", n)
 
     def _drop_session(self, sess: "_Session") -> None:
         """Tear down a SPECIFIC session: a stale writer/reader for a dead
@@ -426,6 +433,7 @@ class P2PGateway(Gateway):
             return
         if sess is not None:
             sess.close()
+            self._update_session_gauge()
             self._advertise_routes()
 
     def _accept_loop(self) -> None:

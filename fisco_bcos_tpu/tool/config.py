@@ -94,6 +94,8 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                      "path": cfg.storage_path or ""}
     cp["rpc"] = {"listen_ip": cfg.rpc_host,
                  "listen_port": "" if cfg.rpc_port is None else str(cfg.rpc_port)}
+    cp["monitor"] = {"metrics_port": ""
+                     if cfg.metrics_port is None else str(cfg.metrics_port)}
     cp["executor"] = {}
     cp["crypto"] = {"backend": cfg.crypto_backend,
                     "device_min_batch": str(cfg.device_min_batch)}
@@ -110,6 +112,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
     if path and base_dir and not os.path.isabs(path):
         path = os.path.join(base_dir, path)
     port_s = cp.get("rpc", "listen_port", fallback="")
+    metrics_s = cp.get("monitor", "metrics_port", fallback="")
     return NodeConfig(
         chain_id=cp.get("chain", "chain_id", fallback="chain0"),
         group_id=cp.get("chain", "group_id", fallback="group0"),
@@ -129,6 +132,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         device_min_batch=cp.getint("crypto", "device_min_batch", fallback=64),
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
         rpc_port=int(port_s) if port_s else None,
+        metrics_port=int(metrics_s) if metrics_s else None,
     )
 
 
@@ -149,6 +153,48 @@ def save_node_config(node_dir: str, cfg: NodeConfig, chain: ChainConfig,
     else:
         with open(os.path.join(node_dir, "node.key"), "wb") as f:
             f.write(key_bytes)
+
+
+def save_smtls_files(node_dir: str, ca_pub, credential,
+                     storage_passphrase: Optional[bytes] = None) -> None:
+    """Write the dual-cert transport identity (build_chain --sm-tls):
+    `ca.pub` trust root + `node.smtls` credential (certs + private keys,
+    encrypted at rest alongside node.key when a passphrase is set)."""
+    from ..net.smtls import _point_bytes
+    with open(os.path.join(node_dir, "ca.pub"), "wb") as f:
+        f.write(_point_bytes(ca_pub))
+    blob = credential.encode()
+    if storage_passphrase:
+        enc = DataEncryption(KeyCenter(storage_passphrase))
+        with open(os.path.join(node_dir, "node.smtls.enc"), "wb") as f:
+            f.write(enc.encrypt(blob))
+    else:
+        with open(os.path.join(node_dir, "node.smtls"), "wb") as f:
+            f.write(blob)
+
+
+def load_smtls_context(node_dir: str,
+                       storage_passphrase: Optional[bytes] = None):
+    """-> SMTLSContext for this node's dual-cert files, or None if the
+    chain was built without --sm-tls. Pass the result as the gateway's
+    server_ssl/client_ssl (one context serves both directions)."""
+    from ..net.smtls import Credential, SMTLSContext, _parse_point
+    ca_path = os.path.join(node_dir, "ca.pub")
+    if not os.path.exists(ca_path):
+        return None
+    with open(ca_path, "rb") as f:
+        ca_pub = _parse_point(f.read())
+    enc_path = os.path.join(node_dir, "node.smtls.enc")
+    if os.path.exists(enc_path):
+        if not storage_passphrase:
+            raise ValueError("SM-TLS credential is encrypted; "
+                             "passphrase required")
+        enc = DataEncryption(KeyCenter(storage_passphrase))
+        blob = enc.decrypt_file(enc_path)
+    else:
+        with open(os.path.join(node_dir, "node.smtls"), "rb") as f:
+            blob = f.read()
+    return SMTLSContext(ca_pub, Credential.decode(blob))
 
 
 def load_node(node_dir: str, gateway=None,
@@ -179,11 +225,13 @@ def load_node(node_dir: str, gateway=None,
         node.build_genesis([ConsensusNode(pk) for pk in chain.sealers]
                            or None)
     elif chain.sealers:
-        # restart: the genesis file must agree with the built chain
-        existing = {n.node_id
-                    for n in node.ledger.ledger_config().consensus_nodes}
-        if existing != set(chain.sealers):
+        # restart: the genesis file must agree with the built chain's
+        # GENESIS block (header 0's immutable sealer_list) — NOT the live
+        # consensus set, which legitimately diverges over time through
+        # addSealer/remove governance (the Consensus precompile)
+        g0 = node.ledger.header_by_number(0)
+        if g0 is not None and set(g0.sealer_list) != set(chain.sealers):
             raise ValueError(
                 "genesis consensus_node_list does not match the existing "
-                "ledger's consensus set — refusing to boot")
+                "ledger's genesis block — refusing to boot")
     return node

@@ -81,6 +81,13 @@ class TxPool:
         """TransactionSync registers here to gossip newly accepted txs."""
         self._broadcast_hooks.append(fn)
 
+    def _update_pending_gauge(self) -> None:
+        """Feed the dashboard's pending-tx panel (tools/monitor)."""
+        from ..utils.metrics import REGISTRY
+        with self._lock:
+            n = len(self._pending) - len(self._sealed)
+        REGISTRY.set_gauge("bcos_txpool_pending", n)
+
     def _notify_ready(self) -> None:
         for fn in self._on_ready:
             fn()
@@ -128,6 +135,7 @@ class TxPool:
         metric("txpool.submit_batch", n=len(txs),
                ok=sum(1 for r in results if r.status == TransactionStatus.OK),
                ms=int((time.monotonic() - t0) * 1000))
+        self._update_pending_gauge()
         if need_verify:
             self._notify_ready()
         if broadcast and self._broadcast_hooks:
@@ -183,6 +191,7 @@ class TxPool:
                     dropped_tasks.append(t)
         for t in dropped_tasks:  # settle, never leak an expired submission
             t.reject(TimeoutError("tx expired: block_limit passed unsealed"))
+        self._update_pending_gauge()
         return out, hashes
 
     def unseal(self, hashes: Sequence[bytes]) -> None:
@@ -190,6 +199,7 @@ class TxPool:
         with self._lock:
             for h in hashes:
                 self._sealed.discard(h)
+        self._update_pending_gauge()
         self._notify_ready()
 
     def pending_count(self) -> int:
@@ -269,6 +279,7 @@ class TxPool:
             ev.set()
         for h, task in tasks:
             task.resolve(self.ledger.receipt(h))
+        self._update_pending_gauge()
         self._notify_ready()
 
     def submit_async(self, tx: Transaction):

@@ -40,6 +40,13 @@ class _Histogram:
 
 
 class MetricsRegistry:
+    """Process-wide by default (`REGISTRY`), like the reference's logger-
+    backed METRIC channel. Deployments run ONE node per process (the Air
+    binary's shape), so unlabeled series are per-node in practice; when
+    several Nodes share a process (in-process test clusters), their gauges
+    share the default registry and the last writer wins — scrape accuracy
+    there requires per-node registries passed to MetricsServer."""
+
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
     def __init__(self):

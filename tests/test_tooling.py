@@ -106,5 +106,81 @@ def test_genesis_mismatch_rejected_on_restart(tmp_path):
     text = re.sub(r"node\.0=[0-9a-f]+", "node.0=" + "ab" * 64, text)
     open(gpath, "w").write(text)
     import pytest
-    with pytest.raises(ValueError, match="consensus set"):
+    with pytest.raises(ValueError, match="genesis block"):
         load_node(os.path.join(out, "node0"))
+
+
+def test_build_chain_monitor_and_smtls(tmp_path):
+    """--metrics-base-port emits per-node Prometheus ports + the monitor
+    bundle; --sm-tls issues loadable dual-cert credentials; a booted node
+    serves the pending-tx gauge on its metrics endpoint."""
+    import json
+    import urllib.request
+
+    from fisco_bcos_tpu.net.smtls import SMTLSContext
+    from fisco_bcos_tpu.tool.config import load_smtls_context
+
+    out = str(tmp_path / "chain")
+    info = build_chain(out, 2, consensus="pbft", crypto_backend="host",
+                       metrics_base_port=0, sm_tls=True)
+    assert info["sm_tls"] and info["nodes"][0]["metrics_port"] == 0
+
+    # monitor bundle materialized with rewritten scrape targets
+    assert os.path.exists(os.path.join(out, "monitor", "Dashboard.json"))
+    with open(os.path.join(out, "monitor", "prometheus.yml")) as f:
+        assert "127.0.0.1:0" in f.read()
+    with open(os.path.join(out, "monitor", "Dashboard.json")) as f:
+        dash = json.load(f)
+    assert any("bcos_txpool_pending" in t.get("expr", "")
+               for p in dash["panels"] for t in p.get("targets", []))
+
+    # SM-TLS credentials load into contexts whose subjects chain to the CA
+    ctx0 = load_smtls_context(os.path.join(out, "node0"))
+    ctx1 = load_smtls_context(os.path.join(out, "node1"))
+    assert isinstance(ctx0, SMTLSContext) and isinstance(ctx1, SMTLSContext)
+    assert ctx0.cred.sign_cert.subject == "node0"
+
+    # a booted node serves Prometheus text incl. the pending gauge
+    node = load_node(os.path.join(out, "node0"), gateway=FakeGateway())
+    node.config.consensus = "solo"  # lone boot for the scrape check
+    node.start()
+    try:
+        node.txpool._update_pending_gauge()
+        url = f"http://127.0.0.1:{node.metrics.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "bcos_txpool_pending" in body
+    finally:
+        node.stop()
+
+
+def test_restart_after_governance_membership_change(tmp_path):
+    """Live addSealer governance diverges the consensus set from the
+    genesis file; a restart must still boot (the check pins the IMMUTABLE
+    genesis block, not the evolving set)."""
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+
+    out = str(tmp_path / "chain")
+    build_chain(out, 1, consensus="solo", crypto_backend="host")
+    node = load_node(os.path.join(out, "node0"))
+    # a governance-added sealer (what the Consensus precompile writes)
+    node.ledger._set_consensus_direct(ConsensusNode(b"\xaa" * 64))
+    assert len(node.ledger.ledger_config().consensus_nodes) == 2
+
+    # restart with the original genesis file: must NOT refuse
+    node2 = load_node(os.path.join(out, "node0"))
+    assert len(node2.ledger.ledger_config().consensus_nodes) == 2
+
+    # a genuinely different genesis file must still be rejected
+    import configparser
+    with open(os.path.join(out, "node0", "genesis")) as f:
+        text = f.read()
+    other = ChainConfig.from_ini(text)
+    other.sealers = [b"\xbb" * 64]
+    with open(os.path.join(out, "node0", "genesis"), "w") as f:
+        f.write(other.to_ini())
+    try:
+        load_node(os.path.join(out, "node0"))
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised

@@ -33,18 +33,43 @@ from fisco_bcos_tpu.init.node import NodeConfig  # noqa: E402
 from fisco_bcos_tpu.tool.config import ChainConfig, save_node_config  # noqa: E402
 
 
+def _write_monitor_stack(out_dir: str, targets: list[str]) -> None:
+    """Copy the monitor bundle (tools/monitor) into the chain dir with the
+    Prometheus target list rewritten to the generated nodes' ports."""
+    import shutil
+
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "monitor")
+    dst = os.path.join(out_dir, "monitor")
+    shutil.copytree(src, dst, dirs_exist_ok=True)
+    lines = ["global:", "  scrape_interval: 5s", "", "scrape_configs:",
+             "  - job_name: fisco-bcos-tpu", "    static_configs:",
+             "      - targets:"]
+    lines += [f'          - "{t}"' for t in targets]
+    with open(os.path.join(dst, "prometheus.yml"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
 def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
                 consensus: str = "pbft", chain_id: str = "chain0",
                 group_id: str = "group0", rpc_base_port: int | None = None,
                 encrypt_passphrase: bytes | None = None,
-                crypto_backend: str = "auto") -> dict:
+                crypto_backend: str = "auto",
+                metrics_base_port: int | None = None,
+                sm_tls: bool = False) -> dict:
     suite = make_suite(sm_crypto, backend="host")
     keypairs = [suite.generate_keypair() for _ in range(n_nodes)]
     chain = ChainConfig(chain_id=chain_id, group_id=group_id,
                         sm_crypto=sm_crypto, consensus_type=consensus,
                         sealers=[kp.pub_bytes for kp in keypairs])
+    ca = None
+    if sm_tls:
+        from fisco_bcos_tpu.net.smtls import CertificateAuthority
+        from fisco_bcos_tpu.tool.config import save_smtls_files
+        ca = CertificateAuthority(name=f"{chain_id}-ca")
     info = {"chain_id": chain_id, "group_id": group_id,
-            "sm_crypto": sm_crypto, "consensus": consensus, "nodes": []}
+            "sm_crypto": sm_crypto, "sm_tls": sm_tls,
+            "consensus": consensus, "nodes": []}
+    metric_targets = []
     for i, kp in enumerate(keypairs):
         node_dir = os.path.join(out_dir, f"node{i}")
         cfg = NodeConfig(
@@ -52,14 +77,24 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
             storage_path="data", consensus=consensus,
             crypto_backend=crypto_backend,
             rpc_port=(rpc_base_port + i) if rpc_base_port is not None else None,
+            metrics_port=(metrics_base_port + i)
+            if metrics_base_port is not None else None,
         )
         save_node_config(node_dir, cfg, chain, kp.secret,
                          storage_passphrase=encrypt_passphrase)
+        if ca is not None:
+            save_smtls_files(node_dir, ca.pub, ca.issue(f"node{i}"),
+                             storage_passphrase=encrypt_passphrase)
+        if cfg.metrics_port is not None:
+            metric_targets.append(f"127.0.0.1:{cfg.metrics_port}")
         info["nodes"].append({
             "dir": node_dir,
             "node_id": kp.pub_bytes.hex(),
             "rpc_port": cfg.rpc_port,
+            "metrics_port": cfg.metrics_port,
         })
+    if metric_targets:
+        _write_monitor_stack(out_dir, metric_targets)
     with open(os.path.join(out_dir, "chain_info.json"), "w") as f:
         json.dump(info, f, indent=2)
     return info
@@ -74,6 +109,10 @@ def main() -> None:
     ap.add_argument("--chain-id", default="chain0")
     ap.add_argument("--group-id", default="group0")
     ap.add_argument("--rpc-base-port", type=int, default=None)
+    ap.add_argument("--metrics-base-port", type=int, default=None,
+                    help="per-node Prometheus ports + monitor stack bundle")
+    ap.add_argument("--sm-tls", action="store_true",
+                    help="issue dual-cert SM-TLS credentials per node")
     ap.add_argument("--encrypt-key", default=None,
                     help="passphrase to encrypt node keys at rest")
     args = ap.parse_args()
@@ -81,6 +120,7 @@ def main() -> None:
         args.output, args.nodes, sm_crypto=args.sm,
         consensus=args.consensus, chain_id=args.chain_id,
         group_id=args.group_id, rpc_base_port=args.rpc_base_port,
+        metrics_base_port=args.metrics_base_port, sm_tls=args.sm_tls,
         encrypt_passphrase=args.encrypt_key.encode() if args.encrypt_key else None)
     print(json.dumps(info, indent=2))
 
