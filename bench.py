@@ -17,10 +17,14 @@ in TransactionSync.cpp:516-537). The measured figure and core count are
 included in the JSON so the judge can audit the divisor.
 
 Backend hardening (VERDICT r2 weak #2): the accelerator plugin this
-container force-registers can hang or raise at init. The benchmark probes
-the default backend in a bounded subprocess first; on failure it re-execs
-itself pinned to CPU (plugin disabled) so a JSON line is always produced,
-tagged with the backend actually used.
+container force-registers can hang or raise at init — and the device
+tunnel has also been observed to wedge MID-RUN after a healthy probe. The
+benchmark therefore (a) probes the default backend in a bounded
+subprocess, (b) runs the device work itself in a BOUNDED child process
+(BENCH_DEVICE_TIMEOUT, default 900 s), and (c) on probe failure, child
+failure, or child timeout re-runs pinned to CPU (plugin disabled) with a
+capped batch — so ONE parseable JSON line is always produced, tagged with
+the backend actually used.
 """
 
 from __future__ import annotations
@@ -95,6 +99,7 @@ def _measure_cpu_baseline() -> tuple[float, int, str]:
 def _cpu_reexec() -> None:
     env = cpu_pinned_env(extra_path=_REPO)
     env["FBTPU_BENCH_CHILD"] = "1"
+    env["FBTPU_BENCH_CPU_FALLBACK"] = "1"
     # the CPU fallback exists to always produce a parseable line, not to
     # grind a 64k batch through a 1-core interpreter for 20 minutes: cap
     # the batch unless the caller pinned one explicitly
@@ -110,6 +115,32 @@ def main() -> None:
             print(f"bench: default backend unhealthy ({diag}); "
                   f"re-exec pinned to CPU", file=sys.stderr, flush=True)
             _cpu_reexec()
+        # healthy probe: still run the device work BOUNDED — the tunnel has
+        # been seen to wedge mid-run after a clean probe
+        import subprocess
+        env = dict(os.environ)
+        env["FBTPU_BENCH_CHILD"] = "1"
+        timeout = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
+        try:
+            # capture the child's stdout: only a SUCCESSFUL child's JSON
+            # line is forwarded, so stdout carries exactly ONE record even
+            # when the device run fails and the CPU fallback prints its own
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], cwd=_REPO,
+                env=env, timeout=timeout, stdout=subprocess.PIPE,
+                stderr=None, text=True)
+            if r.returncode == 0:
+                sys.stdout.write(r.stdout)
+                sys.stdout.flush()
+                return
+            print(f"bench: device child failed (rc={r.returncode}); "
+                  f"falling back to CPU. Child output:\n{r.stdout[-1000:]}",
+                  file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"bench: device child exceeded {timeout:.0f}s (wedged "
+                  f"tunnel?); falling back to CPU", file=sys.stderr,
+                  flush=True)
+        _cpu_reexec()
 
     try:
         # measure the CPU divisor FIRST (before any device work contends
@@ -165,7 +196,7 @@ def main() -> None:
 
         detail = []
         if (os.environ.get("BENCH_FULL") == "1"
-                and "FBTPU_BENCH_CHILD" not in os.environ):
+                and "FBTPU_BENCH_CPU_FALLBACK" not in os.environ):
             # the sweep's 16k+ batches are accelerator-scale; skip it on
             # the CPU fallback so the headline line still lands in minutes
             # the rest of BASELINE's config grid -> BENCH_DETAIL.json

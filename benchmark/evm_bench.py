@@ -65,21 +65,32 @@ def main() -> None:
             gas += rc.gas_used
         return time.perf_counter() - t0, gas
 
-    dt_loop, gas_loop = bench(loop_addr, "lp")
-    dt_ctr, _ = bench(ctr_addr, "ct")
+    from fisco_bcos_tpu.executor import nevm
 
     ops_per_call = 255 * 8
-    print(json.dumps({
-        "metric": "evm_interpreter",
-        "opcode_throughput_ops_per_sec": round(
-            args.n * ops_per_call / dt_loop, 1),
-        "loop_calls_per_sec": round(args.n / dt_loop, 1),
-        "counter_calls_per_sec": round(args.n / dt_ctr, 1),
-        "gas_per_sec": round(gas_loop / dt_loop, 1),
-        "note": ("pure-Python interpreter; evmone-class native throughput "
-                 "is a known gap — chain TPS for EVM-heavy load is bounded "
-                 "by this, not by the TPU crypto plane"),
-    }), flush=True)
+    out = {"metric": "evm_interpreter"}
+    variants = [("python", False)]
+    if nevm.available():
+        variants.append(("native", True))
+    for label, use_native in variants:
+        ex.evm.native = use_native
+        dt_loop, gas_loop = bench(loop_addr, f"lp-{label}")
+        dt_ctr, _ = bench(ctr_addr, f"ct-{label}")
+        out[f"{label}_opcode_throughput_ops_per_sec"] = round(
+            args.n * ops_per_call / dt_loop, 1)
+        out[f"{label}_loop_calls_per_sec"] = round(args.n / dt_loop, 1)
+        out[f"{label}_counter_calls_per_sec"] = round(args.n / dt_ctr, 1)
+        out[f"{label}_gas_per_sec"] = round(gas_loop / dt_loop, 1)
+    if nevm.available():
+        out["native_vs_python_loop"] = round(
+            out["native_loop_calls_per_sec"]
+            / out["python_loop_calls_per_sec"], 1)
+        out["note"] = ("native/nevm frame interpreter (the evmone "
+                       "analogue) vs the pure-Python fallback")
+    else:
+        out["note"] = ("pure-Python interpreter only — build native/ "
+                       "(make -C native) for the evmone-class path")
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -29,6 +29,7 @@ Contract state layout (tables on the framework's storage):
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Optional
 
@@ -203,7 +204,7 @@ def _analyze_jumpdests(code: bytes) -> frozenset[int]:
 class EVM:
     """Interpreter bound to a state overlay + crypto suite."""
 
-    def __init__(self, suite, registry=None):
+    def __init__(self, suite, registry=None, native: Optional[bool] = None):
         self.suite = suite
         # framework precompiles (Table/Consensus/...) visible to EVM CALLs
         self.registry = registry or {}
@@ -212,6 +213,21 @@ class EVM:
         # instead of executed locally. hook(caller, to, value, data, gas,
         # static, depth) -> EVMResult, or None to execute locally.
         self.external_call = None
+        # native frame interpreter (native/nevm, the evmone analogue):
+        # None = auto (use when the built library loads; FBTPU_EVM_NATIVE=0
+        # forces the pure-Python interpreter, =1 requires native)
+        if native is None:
+            flag = os.environ.get("FBTPU_EVM_NATIVE", "auto")
+            if flag == "0":
+                self.native = False
+            else:
+                from . import nevm as _nevm
+                self.native = _nevm.available()
+                if flag == "1" and not self.native:
+                    raise RuntimeError("FBTPU_EVM_NATIVE=1 but "
+                                       "native/build/libnevm.so not loadable")
+        else:
+            self.native = native
 
     # -- account helpers ---------------------------------------------------
     @staticmethod
@@ -414,9 +430,14 @@ class EVM:
     def _run(self, state: StateStorage, env: TxEnv, code: bytes,
              caller: bytes, address: bytes, value: int, calldata: bytes,
              gas: int, depth: int, static: bool) -> EVMResult:
+        jumpdests = _analyze_jumpdests(code)
+        if self.native:
+            from . import nevm
+            return nevm.run_frame(self, state, env, code, caller, address,
+                                  value, calldata, gas, depth, static,
+                                  jumpdests)
         f = Frame(gas)
         logs: list[LogEntry] = []
-        jumpdests = _analyze_jumpdests(code)
 
         def store_key(slot: int) -> bytes:
             return address + slot.to_bytes(32, "big")

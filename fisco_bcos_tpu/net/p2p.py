@@ -445,8 +445,8 @@ class P2PGateway(Gateway):
             if self.server_ssl is not None:
                 try:
                     sock = self.server_ssl.wrap_socket(sock, server_side=True)
-                except ssl.SSLError:
-                    continue
+                except OSError:  # ssl.SSLError AND smtls.SMTLSError — a
+                    continue     # garbage dial must not kill the acceptor
             try:
                 peer_id = self._handshake(sock)
             except OSError:

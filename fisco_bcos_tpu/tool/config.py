@@ -230,7 +230,11 @@ def load_node(node_dir: str, gateway=None,
         # consensus set, which legitimately diverges over time through
         # addSealer/remove governance (the Consensus precompile)
         g0 = node.ledger.header_by_number(0)
-        if g0 is not None and set(g0.sealer_list) != set(chain.sealers):
+        if g0 is None:
+            raise ValueError(
+                "ledger has blocks but no readable genesis header — "
+                "refusing to boot on corrupt chain data")
+        if set(g0.sealer_list) != set(chain.sealers):
             raise ValueError(
                 "genesis consensus_node_list does not match the existing "
                 "ledger's genesis block — refusing to boot")

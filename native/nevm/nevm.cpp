@@ -1,0 +1,1128 @@
+// nevm — native EVM frame interpreter for fisco-bcos-tpu.
+//
+// Reference counterpart: /root/reference/bcos-executor/src/vm/ — the
+// reference links evmone (VMFactory.h:46-64) behind an EVMC host interface
+// (HostContext.cpp). This is the same architecture rebuilt for this
+// framework: a C++ interpreter executes ONE call frame's bytecode at native
+// speed, and everything that touches chain state (storage, balances, code,
+// sub-calls, creates, logs, selfdestruct) goes through a host callback
+// table provided by the Python executor, which retains the savepoint /
+// revert / precompile / DMC-routing logic unchanged.
+//
+// Determinism contract: this interpreter must be bit-for-bit equivalent to
+// fisco_bcos_tpu/executor/evm.py::EVM._run — including its documented
+// deviations from mainnet (flat warm gas costs, PUSH-past-end semantics,
+// JUMP landing at dest+1 so JUMPDEST's 1 gas is skipped) — so a chain can
+// mix native and pure-Python executors freely. Any change here must land in
+// evm.py too, and vice versa; tests/test_nevm.py diffs the two paths
+// opcode family by opcode family.
+//
+// ABI (ctypes): nevm_execute() + NevmHost callback table + NevmResult.
+// Callback buffers (code / call output) must stay valid until the NEXT
+// callback or return; the interpreter copies them immediately.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// u256: little-endian 4x64 limbs
+// ---------------------------------------------------------------------------
+
+struct U256 {
+  uint64_t w[4] = {0, 0, 0, 0};
+
+  static U256 from_u64(uint64_t v) {
+    U256 r;
+    r.w[0] = v;
+    return r;
+  }
+  static U256 from_be(const uint8_t* b, size_t n) {  // big-endian bytes
+    U256 r;
+    for (size_t i = 0; i < n && i < 32; ++i) {
+      size_t bit = (n - 1 - i) * 8;
+      r.w[bit / 64] |= (uint64_t)b[i] << (bit % 64);
+    }
+    return r;
+  }
+  void to_be(uint8_t out[32]) const {
+    for (int i = 0; i < 32; ++i)
+      out[i] = (uint8_t)(w[(31 - i) / 8] >> (((31 - i) % 8) * 8));
+  }
+  bool is_zero() const { return !(w[0] | w[1] | w[2] | w[3]); }
+  uint64_t low64() const { return w[0]; }
+  bool fits_u64() const { return !(w[1] | w[2] | w[3]); }
+  int bit_length() const {
+    for (int i = 3; i >= 0; --i)
+      if (w[i]) return i * 64 + (64 - __builtin_clzll(w[i]));
+    return 0;
+  }
+  bool bit(int i) const { return (w[i / 64] >> (i % 64)) & 1; }
+  void set_bit(int i) { w[i / 64] |= (uint64_t)1 << (i % 64); }
+};
+
+static inline int cmp(const U256& a, const U256& b) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.w[i] < b.w[i]) return -1;
+    if (a.w[i] > b.w[i]) return 1;
+  }
+  return 0;
+}
+
+static inline U256 add(const U256& a, const U256& b, uint64_t* carry_out = nullptr) {
+  U256 r;
+  unsigned __int128 c = 0;
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 s = (unsigned __int128)a.w[i] + b.w[i] + c;
+    r.w[i] = (uint64_t)s;
+    c = s >> 64;
+  }
+  if (carry_out) *carry_out = (uint64_t)c;
+  return r;
+}
+
+static inline U256 sub(const U256& a, const U256& b, uint64_t* borrow_out = nullptr) {
+  U256 r;
+  unsigned __int128 br = 0;
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 d = (unsigned __int128)a.w[i] - b.w[i] - br;
+    r.w[i] = (uint64_t)d;
+    br = (d >> 64) ? 1 : 0;
+  }
+  if (borrow_out) *borrow_out = (uint64_t)br;
+  return r;
+}
+
+static inline U256 mul(const U256& a, const U256& b) {  // low 256 bits
+  U256 r;
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 carry = 0;
+    for (int j = 0; i + j < 4; ++j) {
+      unsigned __int128 cur =
+          (unsigned __int128)a.w[i] * b.w[j] + r.w[i + j] + carry;
+      r.w[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+  }
+  return r;
+}
+
+static inline U256 shl(const U256& a, unsigned s) {
+  U256 r;
+  if (s >= 256) return r;
+  unsigned limb = s / 64, off = s % 64;
+  for (int i = 3; i >= 0; --i) {
+    uint64_t v = 0;
+    int src = i - (int)limb;
+    if (src >= 0) v = a.w[src] << off;
+    if (off && src - 1 >= 0) v |= a.w[src - 1] >> (64 - off);
+    r.w[i] = v;
+  }
+  return r;
+}
+
+static inline U256 shr(const U256& a, unsigned s) {
+  U256 r;
+  if (s >= 256) return r;
+  unsigned limb = s / 64, off = s % 64;
+  for (int i = 0; i < 4; ++i) {
+    uint64_t v = 0;
+    unsigned src = i + limb;
+    if (src < 4) v = a.w[src] >> off;
+    if (off && src + 1 < 4) v |= a.w[src + 1] << (64 - off);
+    r.w[i] = v;
+  }
+  return r;
+}
+
+// binary long division: returns quotient, sets rem
+static U256 divmod(const U256& a, const U256& b, U256* rem) {
+  U256 q, r;
+  if (b.is_zero()) {
+    if (rem) *rem = U256();
+    return q;
+  }
+  int n = a.bit_length();
+  for (int i = n - 1; i >= 0; --i) {
+    r = shl(r, 1);
+    if (a.bit(i)) r.w[0] |= 1;
+    if (cmp(r, b) >= 0) {
+      r = sub(r, b);
+      q.set_bit(i);
+    }
+  }
+  if (rem) *rem = r;
+  return q;
+}
+
+static U256 addmod(const U256& a, const U256& b, const U256& n) {
+  if (n.is_zero()) return U256();
+  U256 ra, rb, rem;
+  divmod(a, n, &ra);
+  divmod(b, n, &rb);
+  uint64_t carry;
+  U256 s = add(ra, rb, &carry);
+  // ra, rb < n <= 2^256-1; sum < 2n: one conditional subtract (carry means
+  // the 257-bit value >= 2^256 > n, so subtract always applies then)
+  if (carry || cmp(s, n) >= 0) s = sub(s, n);
+  return s;
+}
+
+static U256 mulmod_(const U256& a, const U256& b, const U256& n) {
+  if (n.is_zero()) return U256();
+  U256 acc;  // double-and-add: acc = a*b mod n without a 512-bit product
+  U256 base, rem;
+  divmod(a, n, &base);
+  for (int i = b.bit_length() - 1; i >= 0; --i) {
+    acc = addmod(acc, acc, n);
+    if (b.bit(i)) acc = addmod(acc, base, n);
+  }
+  return acc;
+}
+
+static U256 exp_mod2_256(const U256& a, const U256& e) {
+  U256 r = U256::from_u64(1);
+  U256 base = a;
+  int n = e.bit_length();
+  for (int i = 0; i < n; ++i) {
+    if (e.bit(i)) r = mul(r, base);
+    base = mul(base, base);
+  }
+  return r;
+}
+
+static inline bool sign_bit(const U256& v) { return v.w[3] >> 63; }
+static inline U256 neg(const U256& v) {
+  U256 zero;
+  return sub(zero, v);
+}
+
+// ---------------------------------------------------------------------------
+// Keccak-256 + SM3 (the two CryptoSuite hash flavors)
+// ---------------------------------------------------------------------------
+
+static const uint64_t KECCAK_RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+static inline uint64_t rotl64(uint64_t x, int s) {
+  return (x << s) | (x >> (64 - s));
+}
+
+static void keccak_f(uint64_t st[25]) {
+  static const int R[24] = {1,  3,  6,  10, 15, 21, 28, 36, 45, 55, 2,  14,
+                            27, 41, 56, 8,  25, 43, 62, 18, 39, 61, 20, 44};
+  static const int P[24] = {10, 7,  11, 17, 18, 3,  5,  16, 8,  21, 24, 4,
+                            15, 23, 19, 13, 12, 2,  20, 14, 22, 9,  6,  1};
+  for (int round = 0; round < 24; ++round) {
+    uint64_t bc[5];
+    for (int i = 0; i < 5; ++i)
+      bc[i] = st[i] ^ st[i + 5] ^ st[i + 10] ^ st[i + 15] ^ st[i + 20];
+    for (int i = 0; i < 5; ++i) {
+      uint64_t t = bc[(i + 4) % 5] ^ rotl64(bc[(i + 1) % 5], 1);
+      for (int j = 0; j < 25; j += 5) st[j + i] ^= t;
+    }
+    uint64_t t = st[1];
+    for (int i = 0; i < 24; ++i) {
+      uint64_t tmp = st[P[i]];
+      st[P[i]] = rotl64(t, R[i]);
+      t = tmp;
+    }
+    for (int j = 0; j < 25; j += 5) {
+      for (int i = 0; i < 5; ++i) bc[i] = st[j + i];
+      for (int i = 0; i < 5; ++i)
+        st[j + i] ^= (~bc[(i + 1) % 5]) & bc[(i + 2) % 5];
+    }
+    st[0] ^= KECCAK_RC[round];
+  }
+}
+
+static void keccak256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  uint64_t st[25] = {0};
+  const size_t rate = 136;
+  uint8_t block[136];
+  while (len >= rate) {
+    for (size_t i = 0; i < rate / 8; ++i) {
+      uint64_t v;
+      memcpy(&v, data + i * 8, 8);
+      st[i] ^= v;
+    }
+    keccak_f(st);
+    data += rate;
+    len -= rate;
+  }
+  memset(block, 0, rate);
+  memcpy(block, data, len);
+  block[len] ^= 0x01;
+  block[rate - 1] ^= 0x80;
+  for (size_t i = 0; i < rate / 8; ++i) {
+    uint64_t v;
+    memcpy(&v, block + i * 8, 8);
+    st[i] ^= v;
+  }
+  keccak_f(st);
+  for (int i = 0; i < 4; ++i) memcpy(out + i * 8, &st[i], 8);
+}
+
+static inline uint32_t rotl32(uint32_t x, int s) {
+  return (x << s) | (x >> (32 - s));
+}
+
+static void sm3(const uint8_t* data, size_t len, uint8_t out[32]) {
+  uint32_t v[8] = {0x7380166f, 0x4914b2b9, 0x172442d7, 0xda8a0600,
+                   0xa96f30bc, 0x163138aa, 0xe38dee4d, 0xb0fb0e4e};
+  size_t total = len;
+  std::vector<uint8_t> msg(data, data + len);
+  msg.push_back(0x80);
+  while (msg.size() % 64 != 56) msg.push_back(0);
+  uint64_t bits = (uint64_t)total * 8;
+  for (int i = 7; i >= 0; --i) msg.push_back((uint8_t)(bits >> (i * 8)));
+  for (size_t off = 0; off < msg.size(); off += 64) {
+    uint32_t w[68], w1[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = ((uint32_t)msg[off + 4 * i] << 24) |
+             ((uint32_t)msg[off + 4 * i + 1] << 16) |
+             ((uint32_t)msg[off + 4 * i + 2] << 8) | msg[off + 4 * i + 3];
+    for (int i = 16; i < 68; ++i) {
+      uint32_t x = w[i - 16] ^ w[i - 9] ^ rotl32(w[i - 3], 15);
+      x = x ^ rotl32(x, 15) ^ rotl32(x, 23);
+      w[i] = x ^ rotl32(w[i - 13], 7) ^ w[i - 6];
+    }
+    for (int i = 0; i < 64; ++i) w1[i] = w[i] ^ w[i + 4];
+    uint32_t a = v[0], b = v[1], c = v[2], d = v[3], e = v[4], f = v[5],
+             g = v[6], h = v[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t t = i < 16 ? 0x79cc4519 : 0x7a879d8a;
+      uint32_t ss1 = rotl32(rotl32(a, 12) + e + rotl32(t, i % 32), 7);
+      uint32_t ss2 = ss1 ^ rotl32(a, 12);
+      uint32_t ff = i < 16 ? (a ^ b ^ c) : ((a & b) | (a & c) | (b & c));
+      uint32_t gg = i < 16 ? (e ^ f ^ g) : ((e & f) | ((~e) & g));
+      uint32_t tt1 = ff + d + ss2 + w1[i];
+      uint32_t tt2 = gg + h + ss1 + w[i];
+      d = c;
+      c = rotl32(b, 9);
+      b = a;
+      a = tt1;
+      h = g;
+      g = rotl32(f, 19);
+      f = e;
+      e = tt2 ^ rotl32(tt2, 9) ^ rotl32(tt2, 17);
+    }
+    v[0] ^= a; v[1] ^= b; v[2] ^= c; v[3] ^= d;
+    v[4] ^= e; v[5] ^= f; v[6] ^= g; v[7] ^= h;
+  }
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = (uint8_t)(v[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(v[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(v[i] >> 8);
+    out[4 * i + 3] = (uint8_t)v[i];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// ABI structs
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+typedef struct {
+  void* ctx;
+  int32_t (*sload)(void*, const uint8_t slot[32], uint8_t out[32]);
+  // -> old_exists (0/1) or <0 on host error; val_zero mirrors v == 0
+  int32_t (*sstore)(void*, const uint8_t slot[32], const uint8_t val[32],
+                    int32_t val_zero);
+  int32_t (*balance)(void*, const uint8_t addr[20], uint8_t out[32]);
+  int32_t (*get_code)(void*, const uint8_t addr[20], const uint8_t** code,
+                      uint64_t* len);
+  int32_t (*do_log)(void*, const uint8_t* topics, int32_t ntopics,
+                    const uint8_t* data, uint64_t len);
+  // kind: the opcode (0xF1 CALL / 0xF2 CALLCODE / 0xF4 DELEGATECALL /
+  // 0xFA STATICCALL). -> 1 success / 0 failure / <0 host error.
+  int32_t (*do_call)(void*, int32_t kind, const uint8_t to[20],
+                     const uint8_t value[32], const uint8_t* input,
+                     uint64_t input_len, int64_t gas, int64_t* gas_left,
+                     const uint8_t** out, uint64_t* out_len);
+  int32_t (*do_create)(void*, int32_t is_create2, const uint8_t value[32],
+                       const uint8_t* init, uint64_t init_len,
+                       const uint8_t salt[32], int64_t gas, int64_t* gas_left,
+                       const uint8_t** out, uint64_t* out_len,
+                       uint8_t addr_out[20]);
+  int32_t (*selfdestruct)(void*, const uint8_t heir[20]);
+} NevmHost;
+
+typedef struct {
+  uint8_t origin[20];
+  uint8_t coinbase[20];
+  uint64_t gas_price;
+  int64_t block_number;
+  int64_t timestamp_ms;
+  int64_t gas_limit;
+  uint64_t chain_id;
+  int32_t sm_crypto;
+} NevmEnv;
+
+typedef struct {
+  int32_t status;  // 0 ok, 1 revert, 2 oog, 3 evm error, 4 host error
+  int64_t gas_left;
+  uint8_t* output;
+  uint64_t output_len;
+  char error[64];
+} NevmResult;
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// interpreter
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// gas schedule — mirror evm.py exactly
+constexpr int64_t G_BASE = 2, G_VERYLOW = 3, G_LOW = 5, G_MID = 8,
+                  G_HIGH = 10, G_KECCAK = 30, G_KECCAK_WORD = 6,
+                  G_COPY_WORD = 3, G_SLOAD = 100, G_SSTORE_SET = 20000,
+                  G_SSTORE_RESET = 2900, G_LOG = 375, G_LOG_TOPIC = 375,
+                  G_LOG_DATA = 8, G_CREATE = 32000, G_CALL = 100,
+                  G_CALLVALUE = 9000, G_CALLSTIPEND = 2300, G_EXP = 10,
+                  G_EXP_BYTE = 50, G_MEMORY = 3, G_BALANCE = 100,
+                  G_EXTCODE = 100, G_SELFDESTRUCT = 5000,
+                  G_INITCODE_WORD = 2;
+
+struct OutOfGas {};
+struct EvmErr {
+  const char* msg;
+};
+struct HostErr {};
+
+struct Frame {
+  U256 stack[1024];
+  int sp = 0;
+  std::vector<uint8_t> mem;
+  std::string ret;
+  int64_t gas;
+  uint64_t pc = 0;
+
+  explicit Frame(int64_t g) : gas(g) {}
+
+  void use_gas(int64_t n) {
+    gas -= n;
+    if (gas < 0) throw OutOfGas{};
+  }
+  void push(const U256& v) {
+    if (sp >= 1024) throw EvmErr{"stack overflow"};
+    stack[sp++] = v;
+  }
+  U256 pop() {
+    if (sp == 0) throw EvmErr{"stack underflow"};
+    return stack[--sp];
+  }
+
+  static int64_t mem_cost(uint64_t words) {
+    return G_MEMORY * (int64_t)words +
+           (int64_t)((words * words) / 512);
+  }
+  // charge + grow for [off, off+size); huge offsets burn out via gas
+  void extend(const U256& off_u, const U256& size_u) {
+    if (size_u.is_zero()) return;
+    if (!off_u.fits_u64() || !size_u.fits_u64()) throw OutOfGas{};
+    unsigned __int128 end =
+        (unsigned __int128)off_u.low64() + size_u.low64();
+    if (end > ((unsigned __int128)1 << 34)) throw OutOfGas{};
+    uint64_t e = (uint64_t)end;
+    if (e > mem.size()) {
+      uint64_t old_words = (mem.size() + 31) / 32;
+      uint64_t new_words = (e + 31) / 32;
+      use_gas(mem_cost(new_words) - mem_cost(old_words));
+      mem.resize(new_words * 32, 0);
+    }
+  }
+  std::string read_mem(const U256& off_u, const U256& size_u) {
+    extend(off_u, size_u);
+    if (size_u.is_zero()) return std::string();
+    return std::string((const char*)mem.data() + off_u.low64(),
+                       size_u.low64());
+  }
+  void write_mem(const U256& off_u, const uint8_t* data, uint64_t n) {
+    if (n == 0) return;
+    U256 sz = U256::from_u64(n);
+    extend(off_u, sz);
+    memcpy(mem.data() + off_u.low64(), data, n);
+  }
+};
+
+inline void addr_of(const U256& v, uint8_t out[20]) {
+  uint8_t full[32];
+  v.to_be(full);
+  memcpy(out, full + 12, 20);
+}
+
+inline uint64_t words32(uint64_t n) { return (n + 31) / 32; }
+
+// code/calldata slice with Python's `buf[s:s+n].ljust(n, b"\0")` semantics
+std::string py_slice_pad(const uint8_t* buf, uint64_t len, const U256& s_u,
+                         uint64_t n) {
+  std::string out(n, '\0');
+  if (s_u.fits_u64()) {
+    uint64_t s = s_u.low64();
+    if (s < len) {
+      uint64_t take = len - s < n ? len - s : n;
+      memcpy(out.data(), buf + s, take);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void nevm_free(uint8_t* p) { delete[] p; }
+
+int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
+                     const uint8_t* code, uint64_t code_len,
+                     const uint8_t* jd_bitmap, const uint8_t* calldata,
+                     uint64_t calldata_len, const uint8_t caller[20],
+                     const uint8_t address[20], const uint8_t value32[32],
+                     int64_t gas, int32_t static_flag, NevmResult* res) {
+  Frame f(gas);
+  U256 value = U256::from_be(value32, 32);
+  auto hash_fn = env->sm_crypto ? sm3 : keccak256;
+
+  auto finish = [&](int32_t status, const std::string& out,
+                    int64_t gas_left, const char* err) {
+    res->status = status;
+    res->gas_left = gas_left;
+    res->output_len = out.size();
+    if (!out.empty()) {
+      res->output = new uint8_t[out.size()];
+      memcpy(res->output, out.data(), out.size());
+    } else {
+      res->output = nullptr;
+    }
+    snprintf(res->error, sizeof(res->error), "%s", err ? err : "");
+    return status;
+  };
+  auto hostcheck = [](int32_t rc) {
+    if (rc < 0) throw HostErr{};
+    return rc;
+  };
+
+  try {
+    while (f.pc < code_len) {
+      uint64_t op_pc = f.pc;
+      uint8_t op = code[f.pc++];
+
+      // PUSH0..PUSH32
+      if (op >= 0x5F && op <= 0x7F) {
+        unsigned n = op - 0x5F;
+        f.use_gas(n == 0 ? G_BASE : G_VERYLOW);
+        uint64_t avail = code_len - f.pc;
+        uint64_t take = n < avail ? n : avail;
+        // Python's int.from_bytes(code[pc:pc+n]): value of the REMAINING
+        // slice (not right-zero-padded) — mirrored deliberately
+        f.push(U256::from_be(code + f.pc, take));
+        f.pc += n;
+        if (f.pc > code_len) f.pc = code_len;
+        continue;
+      }
+      if (op >= 0x80 && op <= 0x8F) {  // DUP1..16
+        f.use_gas(G_VERYLOW);
+        int n = op - 0x7F;
+        if (f.sp < n) throw EvmErr{"stack underflow"};
+        f.push(f.stack[f.sp - n]);
+        continue;
+      }
+      if (op >= 0x90 && op <= 0x9F) {  // SWAP1..16
+        f.use_gas(G_VERYLOW);
+        int n = op - 0x8F;
+        if (f.sp < n + 1) throw EvmErr{"stack underflow"};
+        std::swap(f.stack[f.sp - 1], f.stack[f.sp - n - 1]);
+        continue;
+      }
+
+      switch (op) {
+        case 0x00:  // STOP
+          return finish(0, "", f.gas, nullptr);
+        case 0x01: {  // ADD
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(add(a, b));
+          break;
+        }
+        case 0x02: {  // MUL
+          f.use_gas(G_LOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(mul(a, b));
+          break;
+        }
+        case 0x03: {  // SUB
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(sub(a, b));
+          break;
+        }
+        case 0x04: {  // DIV
+          f.use_gas(G_LOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(b.is_zero() ? U256() : divmod(a, b, nullptr));
+          break;
+        }
+        case 0x05: {  // SDIV
+          f.use_gas(G_LOW);
+          U256 a = f.pop(), b = f.pop();
+          if (b.is_zero()) {
+            f.push(U256());
+          } else {
+            bool na = sign_bit(a), nb = sign_bit(b);
+            U256 ua = na ? neg(a) : a, ub = nb ? neg(b) : b;
+            U256 q = divmod(ua, ub, nullptr);
+            f.push(na != nb ? neg(q) : q);
+          }
+          break;
+        }
+        case 0x06: {  // MOD
+          f.use_gas(G_LOW);
+          U256 a = f.pop(), b = f.pop(), r;
+          if (b.is_zero()) {
+            f.push(U256());
+          } else {
+            divmod(a, b, &r);
+            f.push(r);
+          }
+          break;
+        }
+        case 0x07: {  // SMOD: sign of dividend (Python: abs%abs * sign(a))
+          f.use_gas(G_LOW);
+          U256 a = f.pop(), b = f.pop(), r;
+          if (b.is_zero()) {
+            f.push(U256());
+          } else {
+            bool na = sign_bit(a);
+            U256 ua = na ? neg(a) : a, ub = sign_bit(b) ? neg(b) : b;
+            divmod(ua, ub, &r);
+            f.push(na ? neg(r) : r);
+          }
+          break;
+        }
+        case 0x08: {  // ADDMOD
+          f.use_gas(G_MID);
+          U256 a = f.pop(), b = f.pop(), n = f.pop();
+          f.push(addmod(a, b, n));
+          break;
+        }
+        case 0x09: {  // MULMOD
+          f.use_gas(G_MID);
+          U256 a = f.pop(), b = f.pop(), n = f.pop();
+          f.push(mulmod_(a, b, n));
+          break;
+        }
+        case 0x0A: {  // EXP
+          U256 a = f.pop(), e = f.pop();
+          f.use_gas(G_EXP + G_EXP_BYTE * ((e.bit_length() + 7) / 8));
+          f.push(exp_mod2_256(a, e));
+          break;
+        }
+        case 0x0B: {  // SIGNEXTEND
+          f.use_gas(G_LOW);
+          U256 b = f.pop(), x = f.pop();
+          if (b.fits_u64() && b.low64() < 31) {
+            int bit = 8 * (int)b.low64() + 7;
+            if (x.bit(bit)) {
+              // set all bits above `bit`
+              for (int i = bit + 1; i < 256; ++i) x.set_bit(i);
+            } else {
+              U256 mask;
+              for (int i = 0; i <= bit; ++i) mask.set_bit(i);
+              for (int i = 0; i < 4; ++i) x.w[i] &= mask.w[i];
+            }
+          }
+          f.push(x);
+          break;
+        }
+        case 0x10: {  // LT
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(U256::from_u64(cmp(a, b) < 0));
+          break;
+        }
+        case 0x11: {  // GT
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(U256::from_u64(cmp(a, b) > 0));
+          break;
+        }
+        case 0x12: {  // SLT
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          bool na = sign_bit(a), nb = sign_bit(b);
+          bool lt = na != nb ? na : cmp(a, b) < 0;
+          f.push(U256::from_u64(lt));
+          break;
+        }
+        case 0x13: {  // SGT
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          bool na = sign_bit(a), nb = sign_bit(b);
+          bool gt = na != nb ? nb : cmp(a, b) > 0;
+          f.push(U256::from_u64(gt));
+          break;
+        }
+        case 0x14: {  // EQ
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop();
+          f.push(U256::from_u64(cmp(a, b) == 0));
+          break;
+        }
+        case 0x15: {  // ISZERO
+          f.use_gas(G_VERYLOW);
+          f.push(U256::from_u64(f.pop().is_zero()));
+          break;
+        }
+        case 0x16: {  // AND
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop(), r;
+          for (int i = 0; i < 4; ++i) r.w[i] = a.w[i] & b.w[i];
+          f.push(r);
+          break;
+        }
+        case 0x17: {  // OR
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop(), r;
+          for (int i = 0; i < 4; ++i) r.w[i] = a.w[i] | b.w[i];
+          f.push(r);
+          break;
+        }
+        case 0x18: {  // XOR
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), b = f.pop(), r;
+          for (int i = 0; i < 4; ++i) r.w[i] = a.w[i] ^ b.w[i];
+          f.push(r);
+          break;
+        }
+        case 0x19: {  // NOT
+          f.use_gas(G_VERYLOW);
+          U256 a = f.pop(), r;
+          for (int i = 0; i < 4; ++i) r.w[i] = ~a.w[i];
+          f.push(r);
+          break;
+        }
+        case 0x1A: {  // BYTE
+          f.use_gas(G_VERYLOW);
+          U256 i_u = f.pop(), x = f.pop();
+          if (i_u.fits_u64() && i_u.low64() < 32) {
+            uint8_t be[32];
+            x.to_be(be);
+            f.push(U256::from_u64(be[i_u.low64()]));
+          } else {
+            f.push(U256());
+          }
+          break;
+        }
+        case 0x1B: {  // SHL
+          f.use_gas(G_VERYLOW);
+          U256 s = f.pop(), v = f.pop();
+          f.push((s.fits_u64() && s.low64() < 256)
+                     ? shl(v, (unsigned)s.low64())
+                     : U256());
+          break;
+        }
+        case 0x1C: {  // SHR
+          f.use_gas(G_VERYLOW);
+          U256 s = f.pop(), v = f.pop();
+          f.push((s.fits_u64() && s.low64() < 256)
+                     ? shr(v, (unsigned)s.low64())
+                     : U256());
+          break;
+        }
+        case 0x1D: {  // SAR
+          f.use_gas(G_VERYLOW);
+          U256 s = f.pop(), v = f.pop();
+          bool nv = sign_bit(v);
+          if (s.fits_u64() && s.low64() < 256) {
+            U256 r = shr(v, (unsigned)s.low64());
+            if (nv) {  // fill the vacated high bits with ones
+              for (int i = 255; i >= 256 - (int)s.low64(); --i) r.set_bit(i);
+            }
+            f.push(r);
+          } else {
+            U256 r;
+            if (nv)
+              for (int i = 0; i < 4; ++i) r.w[i] = ~0ULL;
+            f.push(r);
+          }
+          break;
+        }
+        case 0x20: {  // KECCAK256 (suite hash: keccak or sm3)
+          U256 off = f.pop(), size = f.pop();
+          uint64_t n = size.fits_u64() ? size.low64() : ~0ULL;
+          f.use_gas(G_KECCAK + G_KECCAK_WORD * (int64_t)words32(n));
+          std::string data = f.read_mem(off, size);
+          uint8_t h[32];
+          hash_fn((const uint8_t*)data.data(), data.size(), h);
+          f.push(U256::from_be(h, 32));
+          break;
+        }
+        case 0x30:  // ADDRESS
+          f.use_gas(G_BASE);
+          f.push(U256::from_be(address, 20));
+          break;
+        case 0x31: {  // BALANCE
+          f.use_gas(G_BALANCE);
+          uint8_t a20[20], out[32];
+          addr_of(f.pop(), a20);
+          hostcheck(host->balance(host->ctx, a20, out));
+          f.push(U256::from_be(out, 32));
+          break;
+        }
+        case 0x32:  // ORIGIN
+          f.use_gas(G_BASE);
+          f.push(U256::from_be(env->origin, 20));
+          break;
+        case 0x33:  // CALLER
+          f.use_gas(G_BASE);
+          f.push(U256::from_be(caller, 20));
+          break;
+        case 0x34:  // CALLVALUE
+          f.use_gas(G_BASE);
+          f.push(value);
+          break;
+        case 0x35: {  // CALLDATALOAD
+          f.use_gas(G_VERYLOW);
+          U256 off = f.pop();
+          std::string w = py_slice_pad(calldata, calldata_len, off, 32);
+          f.push(U256::from_be((const uint8_t*)w.data(), 32));
+          break;
+        }
+        case 0x36:  // CALLDATASIZE
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(calldata_len));
+          break;
+        case 0x37: {  // CALLDATACOPY
+          U256 d = f.pop(), s = f.pop(), n_u = f.pop();
+          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
+          if (!n_u.fits_u64()) throw OutOfGas{};
+          std::string blob = py_slice_pad(calldata, calldata_len, s, n);
+          f.write_mem(d, (const uint8_t*)blob.data(), n);
+          break;
+        }
+        case 0x38:  // CODESIZE
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(code_len));
+          break;
+        case 0x39: {  // CODECOPY
+          U256 d = f.pop(), s = f.pop(), n_u = f.pop();
+          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
+          if (!n_u.fits_u64()) throw OutOfGas{};
+          std::string blob = py_slice_pad(code, code_len, s, n);
+          f.write_mem(d, (const uint8_t*)blob.data(), n);
+          break;
+        }
+        case 0x3A:  // GASPRICE
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(env->gas_price));
+          break;
+        case 0x3B: {  // EXTCODESIZE
+          f.use_gas(G_EXTCODE);
+          uint8_t a20[20];
+          addr_of(f.pop(), a20);
+          const uint8_t* c = nullptr;
+          uint64_t n = 0;
+          hostcheck(host->get_code(host->ctx, a20, &c, &n));
+          f.push(U256::from_u64(n));
+          break;
+        }
+        case 0x3C: {  // EXTCODECOPY
+          uint8_t a20[20];
+          addr_of(f.pop(), a20);
+          U256 d = f.pop(), s = f.pop(), n_u = f.pop();
+          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          f.use_gas(G_EXTCODE + G_COPY_WORD * (int64_t)words32(n));
+          if (!n_u.fits_u64()) throw OutOfGas{};
+          const uint8_t* c = nullptr;
+          uint64_t clen = 0;
+          hostcheck(host->get_code(host->ctx, a20, &c, &clen));
+          std::string blob = py_slice_pad(c, clen, s, n);
+          f.write_mem(d, (const uint8_t*)blob.data(), n);
+          break;
+        }
+        case 0x3D:  // RETURNDATASIZE
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(f.ret.size()));
+          break;
+        case 0x3E: {  // RETURNDATACOPY
+          U256 d = f.pop(), s = f.pop(), n_u = f.pop();
+          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
+          // overflow-safe bounds: s + n > len without wrapping uint64
+          if (!s.fits_u64() || !n_u.fits_u64() ||
+              s.low64() > f.ret.size() || n > f.ret.size() - s.low64())
+            throw EvmErr{"returndata out of bounds"};
+          f.write_mem(d, (const uint8_t*)f.ret.data() + s.low64(), n);
+          break;
+        }
+        case 0x3F: {  // EXTCODEHASH
+          f.use_gas(G_EXTCODE);
+          uint8_t a20[20];
+          addr_of(f.pop(), a20);
+          const uint8_t* c = nullptr;
+          uint64_t n = 0;
+          hostcheck(host->get_code(host->ctx, a20, &c, &n));
+          if (n == 0) {
+            f.push(U256());
+          } else {
+            uint8_t h[32];
+            hash_fn(c, n, h);
+            f.push(U256::from_be(h, 32));
+          }
+          break;
+        }
+        case 0x40:  // BLOCKHASH (not tracked: zero)
+          f.use_gas(20);
+          f.pop();
+          f.push(U256());
+          break;
+        case 0x41:  // COINBASE
+          f.use_gas(G_BASE);
+          f.push(U256::from_be(env->coinbase, 20));
+          break;
+        case 0x42:  // TIMESTAMP (seconds)
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64((uint64_t)(env->timestamp_ms / 1000)));
+          break;
+        case 0x43:  // NUMBER
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64((uint64_t)env->block_number));
+          break;
+        case 0x44:  // PREVRANDAO (deterministic chain: 0)
+          f.use_gas(G_BASE);
+          f.push(U256());
+          break;
+        case 0x45:  // GASLIMIT
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64((uint64_t)env->gas_limit));
+          break;
+        case 0x46:  // CHAINID
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(env->chain_id));
+          break;
+        case 0x47: {  // SELFBALANCE
+          f.use_gas(G_LOW);
+          uint8_t out[32];
+          hostcheck(host->balance(host->ctx, address, out));
+          f.push(U256::from_be(out, 32));
+          break;
+        }
+        case 0x48:  // BASEFEE
+          f.use_gas(G_BASE);
+          f.push(U256());
+          break;
+        case 0x50:  // POP
+          f.use_gas(G_BASE);
+          f.pop();
+          break;
+        case 0x51: {  // MLOAD
+          f.use_gas(G_VERYLOW);
+          U256 off = f.pop();
+          std::string w = f.read_mem(off, U256::from_u64(32));
+          f.push(U256::from_be((const uint8_t*)w.data(), 32));
+          break;
+        }
+        case 0x52: {  // MSTORE
+          f.use_gas(G_VERYLOW);
+          U256 off = f.pop(), v = f.pop();
+          uint8_t be[32];
+          v.to_be(be);
+          f.write_mem(off, be, 32);
+          break;
+        }
+        case 0x53: {  // MSTORE8
+          f.use_gas(G_VERYLOW);
+          U256 off = f.pop(), v = f.pop();
+          uint8_t b = (uint8_t)(v.w[0] & 0xFF);
+          f.write_mem(off, &b, 1);
+          break;
+        }
+        case 0x54: {  // SLOAD
+          f.use_gas(G_SLOAD);
+          uint8_t slot[32], out[32] = {0};
+          f.pop().to_be(slot);
+          int32_t exists = hostcheck(host->sload(host->ctx, slot, out));
+          f.push(exists ? U256::from_be(out, 32) : U256());
+          break;
+        }
+        case 0x55: {  // SSTORE
+          if (static_flag) throw EvmErr{"SSTORE in static call"};
+          U256 slot_u = f.pop(), v = f.pop();
+          uint8_t slot[32], val[32];
+          slot_u.to_be(slot);
+          v.to_be(val);
+          int vz = v.is_zero();
+          int32_t old = hostcheck(host->sstore(host->ctx, slot, val, vz));
+          if (vz)
+            f.use_gas(old ? G_SSTORE_RESET : G_SLOAD);
+          else
+            f.use_gas(old ? G_SSTORE_RESET : G_SSTORE_SET);
+          break;
+        }
+        case 0x56: {  // JUMP
+          f.use_gas(G_MID);
+          U256 d = f.pop();
+          if (!d.fits_u64() || d.low64() >= code_len ||
+              !(jd_bitmap[d.low64() / 8] >> (d.low64() % 8) & 1))
+            throw EvmErr{"bad jump destination"};
+          f.pc = d.low64() + 1;  // mirror evm.py: lands past the JUMPDEST
+          break;
+        }
+        case 0x57: {  // JUMPI
+          f.use_gas(G_HIGH);
+          U256 d = f.pop(), c = f.pop();
+          if (!c.is_zero()) {
+            if (!d.fits_u64() || d.low64() >= code_len ||
+                !(jd_bitmap[d.low64() / 8] >> (d.low64() % 8) & 1))
+              throw EvmErr{"bad jump destination"};
+            f.pc = d.low64() + 1;
+          }
+          break;
+        }
+        case 0x58:  // PC
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(op_pc));
+          break;
+        case 0x59:  // MSIZE
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64(f.mem.size()));
+          break;
+        case 0x5A:  // GAS
+          f.use_gas(G_BASE);
+          f.push(U256::from_u64((uint64_t)f.gas));
+          break;
+        case 0x5B:  // JUMPDEST
+          f.use_gas(1);
+          break;
+        case 0xA0:
+        case 0xA1:
+        case 0xA2:
+        case 0xA3:
+        case 0xA4: {  // LOG0..LOG4
+          if (static_flag) throw EvmErr{"LOG in static call"};
+          int ntopics = op - 0xA0;
+          U256 off = f.pop(), size = f.pop();
+          uint8_t topics[4 * 32];
+          for (int i = 0; i < ntopics; ++i) f.pop().to_be(topics + 32 * i);
+          uint64_t n = size.fits_u64() ? size.low64() : ~0ULL;
+          f.use_gas(G_LOG + G_LOG_TOPIC * ntopics +
+                    G_LOG_DATA * (int64_t)n);
+          if (!size.fits_u64()) throw OutOfGas{};
+          std::string data = f.read_mem(off, size);
+          hostcheck(host->do_log(host->ctx, topics, ntopics,
+                                 (const uint8_t*)data.data(), data.size()));
+          break;
+        }
+        case 0xF0:
+        case 0xF5: {  // CREATE / CREATE2
+          if (static_flag) throw EvmErr{"CREATE in static call"};
+          U256 v = f.pop(), off = f.pop(), size = f.pop();
+          uint8_t salt[32] = {0};
+          if (op == 0xF5) f.pop().to_be(salt);
+          uint64_t n = size.fits_u64() ? size.low64() : ~0ULL;
+          f.use_gas(G_CREATE + G_INITCODE_WORD * (int64_t)words32(n));
+          std::string init = f.read_mem(off, size);
+          int64_t gas_child = f.gas - f.gas / 64;
+          f.use_gas(gas_child);
+          uint8_t val[32];
+          v.to_be(val);
+          int64_t child_left = 0;
+          const uint8_t* out = nullptr;
+          uint64_t out_len = 0;
+          uint8_t addr20[20] = {0};
+          int32_t ok = hostcheck(host->do_create(
+              host->ctx, op == 0xF5, val, (const uint8_t*)init.data(),
+              init.size(), salt, gas_child, &child_left, &out, &out_len,
+              addr20));
+          f.gas += child_left;
+          f.ret = ok ? std::string()
+                     : std::string((const char*)out, out_len);
+          f.push(ok ? U256::from_be(addr20, 20) : U256());
+          break;
+        }
+        case 0xF1:
+        case 0xF2:
+        case 0xF4:
+        case 0xFA: {  // CALL / CALLCODE / DELEGATECALL / STATICCALL
+          U256 gas_req = f.pop(), to = f.pop();
+          U256 v;
+          if (op == 0xF1 || op == 0xF2) v = f.pop();
+          U256 in_off = f.pop(), in_size = f.pop();
+          U256 out_off = f.pop(), out_size = f.pop();
+          if (static_flag && !v.is_zero() && op == 0xF1)
+            throw EvmErr{"value call in static context"};
+          f.use_gas(G_CALL + (v.is_zero() ? 0 : G_CALLVALUE));
+          std::string args = f.read_mem(in_off, in_size);
+          f.extend(out_off, out_size);
+          int64_t avail = f.gas - f.gas / 64;
+          int64_t child = (gas_req.fits_u64() &&
+                           gas_req.low64() <= (uint64_t)avail)
+                              ? (int64_t)gas_req.low64()
+                              : avail;
+          f.use_gas(child);
+          if (!v.is_zero()) child += G_CALLSTIPEND;
+          uint8_t to20[20], val[32];
+          addr_of(to, to20);
+          v.to_be(val);
+          int64_t child_left = 0;
+          const uint8_t* out = nullptr;
+          uint64_t out_len = 0;
+          int32_t ok = hostcheck(host->do_call(
+              host->ctx, op, to20, val, (const uint8_t*)args.data(),
+              args.size(), child, &child_left, &out, &out_len));
+          f.gas += child_left;
+          f.ret = std::string((const char*)out, out_len);
+          uint64_t copy = out_size.fits_u64() && out_size.low64() < out_len
+                              ? out_size.low64()
+                              : out_len;
+          if (copy) f.write_mem(out_off, (const uint8_t*)f.ret.data(), copy);
+          f.push(U256::from_u64(ok ? 1 : 0));
+          break;
+        }
+        case 0xF3: {  // RETURN
+          U256 off = f.pop(), size = f.pop();
+          return finish(0, f.read_mem(off, size), f.gas, nullptr);
+        }
+        case 0xFD: {  // REVERT
+          U256 off = f.pop(), size = f.pop();
+          return finish(1, f.read_mem(off, size), f.gas, "revert");
+        }
+        case 0xFE:
+          throw EvmErr{"invalid opcode 0xfe"};
+        case 0xFF: {  // SELFDESTRUCT
+          if (static_flag) throw EvmErr{"SELFDESTRUCT in static call"};
+          f.use_gas(G_SELFDESTRUCT);
+          uint8_t heir[20];
+          addr_of(f.pop(), heir);
+          hostcheck(host->selfdestruct(host->ctx, heir));
+          return finish(0, "", f.gas, nullptr);
+        }
+        default:
+          throw EvmErr{"unknown opcode"};
+      }
+    }
+    return finish(0, "", f.gas, nullptr);
+  } catch (OutOfGas&) {
+    return finish(2, "", 0, "out of gas");
+  } catch (EvmErr& e) {
+    return finish(3, "", 0, e.msg);
+  } catch (HostErr&) {
+    return finish(4, "", 0, "host error");
+  }
+}
+
+}  // extern "C"

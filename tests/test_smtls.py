@@ -222,3 +222,39 @@ def test_transcript_signature_is_role_bound():
     assert refimpl.sm2_verify(cred.sign_cert.pub,
                               refimpl.sm3(b"client" + t_digest),
                               *client_sig)
+
+
+def test_gateway_accept_survives_garbage_dial():
+    """A port-scan / garbage inbound connection must not kill the SM-TLS
+    gateway's accept loop (SMTLSError is an OSError, not an ssl.SSLError)."""
+    from fisco_bcos_tpu.net.p2p import P2PGateway
+
+    ca = CertificateAuthority(seed=b"acc-ca" * 5)
+    ids = [b"\x07" * 32, b"\x08" * 32]
+    ctxs = [SMTLSContext(ca.pub, ca.issue(f"n{i}", seed=bytes([9 + i]) * 8))
+            for i in range(2)]
+    gws = [P2PGateway(ids[i], server_ssl=ctxs[i], client_ssl=ctxs[i])
+           for i in range(2)]
+
+    class NullFront:
+        def on_network_message(self, src, payload):
+            pass
+
+    try:
+        gws[0].register_front(ids[0], NullFront())
+        # garbage dial straight at the listener
+        s = socket.create_connection((gws[0].host, gws[0].port), timeout=5)
+        s.sendall(b"\x00\x00\x00\x04junk")
+        s.close()
+        time.sleep(0.2)
+        # a legitimate SM-TLS peer must still be able to connect
+        gws[1].register_front(ids[1], NullFront())
+        gws[1].add_peer(gws[0].host, gws[0].port)
+        gws[0].add_peer(gws[1].host, gws[1].port)  # smaller id owns the dial
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15 and len(gws[0].peers()) != 1:
+            time.sleep(0.05)
+        assert len(gws[0].peers()) == 1, "accept loop died after garbage dial"
+    finally:
+        for gw in gws:
+            gw.stop()
