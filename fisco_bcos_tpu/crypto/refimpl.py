@@ -323,6 +323,41 @@ def sm2_verify(pub, msg_hash: bytes, r: int, s: int) -> bool:
     return (e + P[0]) % c.n == r
 
 
+# ---------------------------------------------------------------------------
+# GLV endomorphism (secp256k1) — host oracle for the device decomposition
+# ---------------------------------------------------------------------------
+# secp256k1 has j-invariant 0 (a = 0, p = 1 mod 3), so phi(x, y) =
+# (beta*x, y) is an endomorphism with phi(P) = lambda*P for the matching
+# cube roots of unity (beta^3 = 1 mod p, lambda^3 = 1 mod n). Splitting a
+# scalar k = k1 + k2*lambda (mod n) with |k1|, |k2| ~ sqrt(n) halves the
+# doubling ladder. Constants are the standard public secp256k1 values
+# (verified against each other in ec.Curve.__init__); the decomposition is
+# the mul-shift form: c_i = floor(k * g_i / 2^384) with g_i =
+# round(2^384 * b_i' / n), then k2 = c1*(-b1) + c2*(-b2) mod n and
+# k1 = k - k2*lambda mod n — exact by construction, the rounding only
+# nudges the (still ~128-bit) magnitudes.
+
+GLV_LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+GLV_BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+_GLV_MINUS_B1 = 0xE4437ED6010E88286F547FA90ABFE4C3
+_GLV_B2 = 0x3086D221A7D46BCDE86C90E49284EB15
+_GLV_MINUS_B2 = (-_GLV_B2) % SECP256K1.n
+_GLV_G1 = ((1 << 384) * _GLV_B2 + SECP256K1.n // 2) // SECP256K1.n
+_GLV_G2 = ((1 << 384) * _GLV_MINUS_B1 + SECP256K1.n // 2) // SECP256K1.n
+
+
+def glv_split(k: int, n: int = SECP256K1.n) -> tuple[int, int]:
+    """k -> (k1, k2) with k1 + k2*lambda = k (mod n), both in [0, n).
+
+    Mapped to signed form (min(k_i, n - k_i)) the magnitudes are ~2^128.
+    """
+    c1 = (k * _GLV_G1) >> 384
+    c2 = (k * _GLV_G2) >> 384
+    k2 = (c1 * _GLV_MINUS_B1 + c2 * _GLV_MINUS_B2) % n
+    k1 = (k - k2 * GLV_LAMBDA) % n
+    return k1, k2
+
+
 def keygen(c: CurveParams = SECP256K1, seed: bytes | None = None):
     """-> (secret_int, (pub_x, pub_y)). Seed for deterministic test keys."""
     if seed is not None:

@@ -48,6 +48,7 @@ from ..crypto import refimpl
 WINDOW = 4
 NDIGITS = fp.BITS // WINDOW  # 64 digit positions
 TBL = 1 << WINDOW  # 16 window entries (index 0 = skip)
+GLV_DIGITS = 34  # 136-bit signed halves (worst observed magnitude: 129 bits)
 
 __all__ = [
     "Curve",
@@ -80,12 +81,41 @@ class Curve:
         # affine window table for G: entry k = k*G in field rep, k >= 1;
         # flattened [TBL, 2*NLIMBS] for the constant-table lane select.
         tbl = np.zeros((TBL, 2 * NLIMBS), np.uint32)
+        chain = []  # k*G affine ints, reused for the phi(G) table below
         P = None
         for k in range(1, TBL):
             P = refimpl.ec_add(params, P, (params.gx, params.gy))
+            chain.append(P)
             tbl[k, :NLIMBS] = self.fp.encode_int(P[0])
             tbl[k, NLIMBS:] = self.fp.encode_int(P[1])
         self.g_table = tbl
+
+        # GLV endomorphism plane (secp256k1: j-invariant 0). Guarded by an
+        # explicit host check that the published (beta, lambda) pair is
+        # consistent (phi(G) == lambda*G) — if not, the plain double-width
+        # Shamir ladder is used.
+        self.has_endo = False
+        if self.a_is_zero and params.p % 3 == 1:
+            lG = refimpl.ec_mul(params, refimpl.GLV_LAMBDA,
+                                (params.gx, params.gy))
+            if lG == (refimpl.GLV_BETA * params.gx % params.p, params.gy):
+                self.has_endo = True
+                self.beta_rep = self.fp.encode_int(refimpl.GLV_BETA)
+                self.glv_lambda = refimpl.GLV_LAMBDA
+                # phi(G) window table: phi(k*G) = (beta*x_k, y_k)
+                tbl2 = np.zeros_like(tbl)
+                for k, (px, py) in enumerate(chain, start=1):
+                    tbl2[k, :NLIMBS] = self.fp.encode_int(
+                        refimpl.GLV_BETA * px % params.p)
+                    tbl2[k, NLIMBS:] = self.fp.encode_int(py)
+                self.g_table_endo = tbl2
+                # split constants as plain canonical limb columns
+                self.g1_limbs = fp.to_limbs(refimpl._GLV_G1)
+                self.g2_limbs = fp.to_limbs(refimpl._GLV_G2)
+                self.mb1_int = refimpl._GLV_MINUS_B1
+                self.mb2_int = refimpl._GLV_MINUS_B2
+                # n/2 threshold for the signed mapping
+                self.half_n_limbs = fp.to_limbs(params.n // 2)
 
     def __repr__(self):
         return f"Curve({self.params.name})"
@@ -233,14 +263,10 @@ def _take_batch(tq, dig):
     return jnp.sum(tq * oh[:, None, None, :], axis=0)
 
 
-def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
-    """k1*G + k2*Q -> packed Jacobian point (field rep).
-
-    k1, k2: plain canonical scalar limbs [L, B]; qx_r/qy_r: affine Q in
-    field rep. 64-step scan, 4-bit windows for both scalars.
-    """
-    # per-element Q window table tq[k] = k*Q (Jacobian), k in [0, 16),
-    # built with a scan so the add body compiles once
+def _q_window_table(cv: Curve, qx_r, qy_r):
+    """Per-element window table tq[k] = k*Q (Jacobian), k in [0, 16),
+    built with a scan so the add body compiles once. Shared by the plain
+    and GLV ladders."""
     q1 = _pack(qx_r, qy_r, cv.fp.one_rep(qx_r.shape))
 
     def tbl_step(prev, _):
@@ -248,7 +274,16 @@ def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
         return nxt, nxt
 
     _, rest = jax.lax.scan(tbl_step, q1, None, length=TBL - 2)
-    tq = jnp.concatenate([_inf_like(q1)[None], q1[None], rest], axis=0)
+    return jnp.concatenate([_inf_like(q1)[None], q1[None], rest], axis=0)
+
+
+def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
+    """k1*G + k2*Q -> packed Jacobian point (field rep).
+
+    k1, k2: plain canonical scalar limbs [L, B]; qx_r/qy_r: affine Q in
+    field rep. 64-step scan, 4-bit windows for both scalars.
+    """
+    tq = _q_window_table(cv, qx_r, qy_r)
 
     d1 = fp.window_digits(k1, WINDOW)[..., ::-1, :]  # [64, B] MSB-first
     d2 = fp.window_digits(k2, WINDOW)[..., ::-1, :]
@@ -268,6 +303,107 @@ def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     init = jnp.zeros((3, NLIMBS) + k1.shape[-1:], jnp.uint32)
     acc, _ = jax.lax.scan(body, init, (d1, d2))
     return acc
+
+
+# ---------------------------------------------------------------------------
+# GLV endomorphism ladder (secp256k1): half-length scalars, 4 tables
+# ---------------------------------------------------------------------------
+
+def _mul_shift_384(k, g_limbs):
+    """floor(k * g / 2^384) for canonical scalar limbs k [L, B] and a
+    256-bit constant g — the GLV rounding step (c_i), done as one wide
+    multiply and a limb slice (384 / 16 = limb 24)."""
+    cols = fp.mul_wide(k, fp._col(jnp.asarray(g_limbs)))
+    exact, _ = fp.carry_prop(cols, 2 * NLIMBS)
+    hi = exact[..., 24:, :]  # 8 limbs ~ 2^128
+    return fp._pad(hi, 0, NLIMBS - hi.shape[-2])
+
+
+def _glv_split_device(cv: Curve, k):
+    """k [L, B] canonical mod n -> (m1, neg1, m2, neg2): signed halves
+    with magnitudes < 2^136, matching refimpl.glv_split + signed mapping."""
+    fn_ = cv.fn
+    c1 = _mul_shift_384(k, cv.g1_limbs)
+    c2 = _mul_shift_384(k, cv.g2_limbs)
+    mb1 = fp._col(fn_.encode_int(cv.mb1_int))  # Montgomery-domain consts
+    mb2 = fp._col(fn_.encode_int(cv.mb2_int))
+    lam = fp._col(fn_.encode_int(cv.glv_lambda))
+    k2 = fn_.from_rep(fn_.add(fn_.mul(fn_.to_rep(c1), mb1),
+                              fn_.mul(fn_.to_rep(c2), mb2)))
+    k1 = fn_.sub(fn_.reduce_loose(k),
+                 fn_.from_rep(fn_.mul(fn_.to_rep(k2), lam)))
+
+    half = fp._col(cv.half_n_limbs)
+    nl = fp._col(fn_.limbs)
+
+    def signed(x):
+        neg_flag = ~fp.geq(half, x)  # x > n/2  <=>  not (n/2 >= x)
+        mag, _ = fp.sub_limbs(nl + jnp.zeros_like(x), x)
+        return select(neg_flag, mag, x), neg_flag
+
+    m1, n1 = signed(k1)
+    m2, n2 = signed(k2)
+    return m1, n1, m2, n2
+
+
+def _neg_y(f, Y, flag):
+    """Conditionally negate a field-rep Y coordinate (branch-free)."""
+    return select(flag, f.neg(Y), Y)
+
+
+def glv_shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
+    """k1*G + k2*Q via the endomorphism: both scalars split into signed
+    ~128-bit halves, then one 34-step scan over FOUR window tables
+    (G, phi(G) as affine constants; Q, phi(Q) per batch element) — 136
+    doublings instead of 256. Same complete-by-selection point ops as
+    `shamir_mult`, so adversarial inputs stay safe."""
+    f = cv.fp
+    a1, s1, a2, s2 = _glv_split_device(cv, k1)
+    b1, t1, b2, t2 = _glv_split_device(cv, k2)
+
+    # per-element tables: tq[k] = k*Q (Jacobian); phi applies beta to X
+    tq = _q_window_table(cv, qx_r, qy_r)
+    beta = jnp.broadcast_to(fp._col(cv.beta_rep), tq[..., 0, :, :].shape)
+    tql = jnp.stack([f.mul(tq[..., 0, :, :], beta), tq[..., 1, :, :],
+                     tq[..., 2, :, :]], axis=-3)
+
+    def digs(m):
+        d = fp.window_digits(m, WINDOW)[..., :GLV_DIGITS, :]
+        return d[..., ::-1, :]  # MSB-first
+
+    da1, da2, db1, db2 = digs(a1), digs(a2), digs(b1), digs(b2)
+
+    def body(acc, ds):
+        d_g, d_gl, d_q, d_ql = ds
+        for _ in range(WINDOW):
+            acc = jac_double(cv, acc)
+        gx_e, gy_e = _take_const(cv.g_table, d_g)
+        added = jac_add_affine(cv, acc, gx_e, _neg_y(f, gy_e, s1))
+        acc = _sel(d_g == 0, acc, added)
+        gx_e, gy_e = _take_const(cv.g_table_endo, d_gl)
+        added = jac_add_affine(cv, acc, gx_e, _neg_y(f, gy_e, s2))
+        acc = _sel(d_gl == 0, acc, added)
+        qe = _take_batch(tq, d_q)
+        qe = qe.at[..., 1, :, :].set(_neg_y(f, qe[..., 1, :, :], t1))
+        added = jac_add(cv, acc, qe)
+        acc = _sel(d_q == 0, acc, added)
+        qe = _take_batch(tql, d_ql)
+        qe = qe.at[..., 1, :, :].set(_neg_y(f, qe[..., 1, :, :], t2))
+        added = jac_add(cv, acc, qe)
+        acc = _sel(d_ql == 0, acc, added)
+        return acc, None
+
+    init = jnp.zeros((3, NLIMBS) + k1.shape[-1:], jnp.uint32)
+    acc, _ = jax.lax.scan(body, init, (da1, da2, db1, db2))
+    return acc
+
+
+def double_mult(cv: Curve, k1, k2, qx_r, qy_r):
+    """k1*G + k2*Q — GLV ladder when the curve has the endomorphism,
+    plain double-width Shamir otherwise."""
+    if cv.has_endo:
+        return glv_shamir_mult(cv, k1, k2, qx_r, qy_r)
+    return shamir_mult(cv, k1, k2, qx_r, qy_r)
 
 
 # ---------------------------------------------------------------------------
@@ -329,7 +465,7 @@ def ecdsa_verify_batch(cv: Curve, e, r, s, qx, qy):
     w = fn_.inv_batch(fn_.to_rep(s))  # Mont(s^-1), batched tree
     u1 = fn_.from_rep(fn_.mul(fn_.to_rep(e), w))
     u2 = fn_.from_rep(fn_.mul(fn_.to_rep(r), w))
-    R = shamir_mult(cv, u1, u2, qxr, qyr)
+    R = double_mult(cv, u1, u2, qxr, qyr)
     X, _, Z = _unpack(R)
     ok &= ~is_zero(Z)
     ok &= _x_matches_mod_n(cv, X, Z, fn_.reduce_loose(r))
@@ -372,7 +508,7 @@ def ecdsa_recover_batch(cv: Curve, e, r, s, v):
     rinv = fn_.inv_batch(fn_.to_rep(r))
     u1 = fn_.from_rep(fn_.mul(fn_.neg(fn_.to_rep(e)), rinv))  # -e/r mod n
     u2 = fn_.from_rep(fn_.mul(fn_.to_rep(s), rinv))  # s/r mod n
-    Q = shamir_mult(cv, u1, u2, xm, ym)
+    Q = double_mult(cv, u1, u2, xm, ym)
     X, Y, Z = _unpack(Q)
     ok &= ~is_zero(Z)
 

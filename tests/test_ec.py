@@ -158,3 +158,94 @@ def test_sm2_verify_batch_golden():
             for p, d, rr, sv in zip(pubs2, es2, rs2, ss2)]
     assert ok.tolist() == want
     assert ok.tolist() == [True] * 4 + [False] * 2
+
+
+def test_glv_split_device_matches_oracle():
+    """Device GLV decomposition: identity k1 + lambda*k2 == k (mod n) and
+    signed magnitudes within the 34-window budget, vs refimpl.glv_split."""
+    import jax
+    import jax.numpy as jnp
+    from fisco_bcos_tpu.ops.ec import _glv_split_device
+
+    cv = ec.SECP256K1
+    assert cv.has_endo
+    n = cv.params.n
+    rng = np.random.default_rng(17)
+    ks = [int.from_bytes(rng.bytes(32), "big") % n for _ in range(6)] + [0, 1]
+    k = jnp.transpose(ec.limbs(ks))
+    m1, n1, m2, n2 = jax.jit(lambda kk: _glv_split_device(cv, kk))(k)
+    m1, n1 = np.asarray(m1), np.asarray(n1)
+    m2, n2 = np.asarray(m2), np.asarray(n2)
+    for i, kv in enumerate(ks):
+        k1 = int(bigint.from_limbs(m1[:, i][None].T.flatten()))
+        k2 = int(bigint.from_limbs(m2[:, i][None].T.flatten()))
+        if n1[i]:
+            k1 = n - k1
+        if n2[i]:
+            k2 = n - k2
+        assert (k1 + k2 * refimpl.GLV_LAMBDA) % n == kv
+        for mag in (int(bigint.from_limbs(m1[:, i])),
+                    int(bigint.from_limbs(m2[:, i]))):
+            assert mag.bit_length() <= 4 * ec.GLV_DIGITS
+
+
+def test_glv_ladder_matches_plain_shamir():
+    """The endomorphism ladder and the plain Shamir ladder compute the
+    same affine points for random (k1, k2, Q)."""
+    import jax
+    import jax.numpy as jnp
+    from fisco_bcos_tpu.ops.ec import (_unpack, glv_shamir_mult,
+                                       shamir_mult)
+
+    cv = ec.SECP256K1
+    params = cv.params
+    rng = np.random.default_rng(23)
+    k1s, k2s, qxs, qys = [], [], [], []
+    for i in range(4):
+        _, pub = refimpl.keygen(params, bytes([i + 70]) * 32)
+        k1s.append(int.from_bytes(rng.bytes(32), "big") % params.n)
+        k2s.append(int.from_bytes(rng.bytes(32), "big") % params.n)
+        qxs.append(pub[0])
+        qys.append(pub[1])
+    # edge rows: zero scalars
+    k1s += [0, 5]
+    k2s += [7, 0]
+    qxs += qxs[:2]
+    qys += qys[:2]
+
+    k1 = jnp.transpose(ec.limbs(k1s))
+    k2 = jnp.transpose(ec.limbs(k2s))
+    qx = cv.fp.to_rep(jnp.transpose(ec.limbs(qxs)))
+    qy = cv.fp.to_rep(jnp.transpose(ec.limbs(qys)))
+
+    def affine(P):
+        X, Y, Z = _unpack(P)
+        X, Y, Z = (np.asarray(v) for v in (X, Y, Z))
+        out = []
+        f = cv.fp
+        for i in range(X.shape[-1]):
+            xi = int(bigint.from_limbs(np.asarray(
+                f.from_rep(X[:, i:i + 1]))[:, 0]))
+            yi = int(bigint.from_limbs(np.asarray(
+                f.from_rep(Y[:, i:i + 1]))[:, 0]))
+            zi = int(bigint.from_limbs(np.asarray(
+                f.from_rep(Z[:, i:i + 1]))[:, 0]))
+            if zi == 0:
+                out.append(None)
+                continue
+            zinv = pow(zi, -1, params.p)
+            out.append((xi * zinv * zinv % params.p,
+                        yi * zinv * zinv * zinv % params.p))
+        return out
+
+    Pg = jax.jit(lambda *a: glv_shamir_mult(cv, *a))(k1, k2, qx, qy)
+    Pp = jax.jit(lambda *a: shamir_mult(cv, *a))(k1, k2, qx, qy)
+    got, want = affine(Pg), affine(Pp)
+    assert got == want
+    # and against the host oracle
+    for i in range(len(k1s)):
+        exp = refimpl.ec_add(
+            params,
+            refimpl.ec_mul(params, k1s[i], (params.gx, params.gy)),
+            refimpl.ec_mul(params, k2s[i], (qxs[i], qys[i])))
+        assert got[i] == exp
