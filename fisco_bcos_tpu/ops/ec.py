@@ -277,13 +277,38 @@ def _q_window_table(cv: Curve, qx_r, qy_r):
     return jnp.concatenate([_inf_like(q1)[None], q1[None], rest], axis=0)
 
 
+def _q_window_affine(cv: Curve, qx_r, qy_r):
+    """Affine Q window table (ax, ay), each [TBL, L, B]: the Jacobian
+    table batch-normalized with ONE product-tree inversion over all
+    TBL x B Z values, so every ladder add against it is a cheap mixed
+    add. Entry 0 (infinity) normalizes to garbage — harmless, because a
+    zero window digit skips the add entirely (`_sel(d == 0, ...)`)."""
+    f = cv.fp
+    tq = _q_window_table(cv, qx_r, qy_r)
+    X, Y, Z = tq[:, 0], tq[:, 1], tq[:, 2]  # each [TBL, L, B]
+    tbl_n, L, B = X.shape
+    zf = jnp.transpose(Z, (1, 0, 2)).reshape(L, tbl_n * B)
+    w = zf.shape[-1]
+    pad = (1 << (w - 1).bit_length()) - w  # inv_batch's product tree
+    if pad:  # needs a power-of-two width; ones invert to ones harmlessly
+        zf = jnp.concatenate([zf, f.one_rep((L, pad))], axis=-1)
+    zi = f.inv_batch(zf)[..., :tbl_n * B]
+    zi = jnp.transpose(zi.reshape(L, tbl_n, B), (1, 0, 2))
+    zi2 = f.mul(zi, zi)
+    ax = f.mul(X, zi2)
+    ay = f.mul(Y, f.mul(zi2, zi))
+    return ax, ay
+
+
 def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     """k1*G + k2*Q -> packed Jacobian point (field rep).
 
     k1, k2: plain canonical scalar limbs [L, B]; qx_r/qy_r: affine Q in
-    field rep. 64-step scan, 4-bit windows for both scalars.
+    field rep. 64-step scan, 4-bit windows for both scalars; the Q table
+    is batch-normalized to affine so both adds per step are mixed adds.
     """
-    tq = _q_window_table(cv, qx_r, qy_r)
+    aqx, aqy = _q_window_affine(cv, qx_r, qy_r)
+    tq2 = jnp.stack([aqx, aqy], axis=1)  # [TBL, 2, L, B]
 
     d1 = fp.window_digits(k1, WINDOW)[..., ::-1, :]  # [64, B] MSB-first
     d2 = fp.window_digits(k2, WINDOW)[..., ::-1, :]
@@ -295,8 +320,8 @@ def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
         gx_e, gy_e = _take_const(cv.g_table, dg)
         added_g = jac_add_affine(cv, acc, gx_e, gy_e)
         acc = _sel(dg == 0, acc, added_g)
-        qe = _take_batch(tq, dq)
-        added_q = jac_add(cv, acc, qe)
+        qe = _take_batch(tq2, dq)
+        added_q = jac_add_affine(cv, acc, qe[..., 0, :, :], qe[..., 1, :, :])
         acc = _sel(dq == 0, acc, added_q)
         return acc, None
 
@@ -361,11 +386,11 @@ def glv_shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     a1, s1, a2, s2 = _glv_split_device(cv, k1)
     b1, t1, b2, t2 = _glv_split_device(cv, k2)
 
-    # per-element tables: tq[k] = k*Q (Jacobian); phi applies beta to X
-    tq = _q_window_table(cv, qx_r, qy_r)
-    beta = jnp.broadcast_to(fp._col(cv.beta_rep), tq[..., 0, :, :].shape)
-    tql = jnp.stack([f.mul(tq[..., 0, :, :], beta), tq[..., 1, :, :],
-                     tq[..., 2, :, :]], axis=-3)
+    # per-element tables, batch-normalized affine; phi applies beta to x
+    aqx, aqy = _q_window_affine(cv, qx_r, qy_r)
+    tq2 = jnp.stack([aqx, aqy], axis=1)  # [TBL, 2, L, B]
+    beta = jnp.broadcast_to(fp._col(cv.beta_rep), aqx.shape)
+    tql2 = jnp.stack([f.mul(aqx, beta), aqy], axis=1)
 
     def digs(m):
         d = fp.window_digits(m, WINDOW)[..., :GLV_DIGITS, :]
@@ -383,13 +408,13 @@ def glv_shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
         gx_e, gy_e = _take_const(cv.g_table_endo, d_gl)
         added = jac_add_affine(cv, acc, gx_e, _neg_y(f, gy_e, s2))
         acc = _sel(d_gl == 0, acc, added)
-        qe = _take_batch(tq, d_q)
-        qe = qe.at[..., 1, :, :].set(_neg_y(f, qe[..., 1, :, :], t1))
-        added = jac_add(cv, acc, qe)
+        qe = _take_batch(tq2, d_q)
+        added = jac_add_affine(cv, acc, qe[..., 0, :, :],
+                               _neg_y(f, qe[..., 1, :, :], t1))
         acc = _sel(d_q == 0, acc, added)
-        qe = _take_batch(tql, d_ql)
-        qe = qe.at[..., 1, :, :].set(_neg_y(f, qe[..., 1, :, :], t2))
-        added = jac_add(cv, acc, qe)
+        qe = _take_batch(tql2, d_ql)
+        added = jac_add_affine(cv, acc, qe[..., 0, :, :],
+                               _neg_y(f, qe[..., 1, :, :], t2))
         acc = _sel(d_ql == 0, acc, added)
         return acc, None
 

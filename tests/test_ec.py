@@ -177,16 +177,18 @@ def test_glv_split_device_matches_oracle():
     m1, n1 = np.asarray(m1), np.asarray(n1)
     m2, n2 = np.asarray(m2), np.asarray(n2)
     for i, kv in enumerate(ks):
-        k1 = int(bigint.from_limbs(m1[:, i][None].T.flatten()))
-        k2 = int(bigint.from_limbs(m2[:, i][None].T.flatten()))
-        if n1[i]:
-            k1 = n - k1
-        if n2[i]:
-            k2 = n - k2
+        mag1 = int(bigint.from_limbs(m1[:, i]))
+        mag2 = int(bigint.from_limbs(m2[:, i]))
+        k1 = n - mag1 if n1[i] else mag1
+        k2 = n - mag2 if n2[i] else mag2
         assert (k1 + k2 * refimpl.GLV_LAMBDA) % n == kv
-        for mag in (int(bigint.from_limbs(m1[:, i])),
-                    int(bigint.from_limbs(m2[:, i]))):
-            assert mag.bit_length() <= 4 * ec.GLV_DIGITS
+        assert mag1.bit_length() <= 4 * ec.GLV_DIGITS
+        assert mag2.bit_length() <= 4 * ec.GLV_DIGITS
+        # the device decomposition IS the documented mul-shift formula:
+        # it must agree with the host oracle exactly, not just satisfy
+        # the identity
+        ok1, ok2 = refimpl.glv_split(kv)
+        assert (k1 % n, k2 % n) == (ok1, ok2)
 
 
 def test_glv_ladder_matches_plain_shamir():
