@@ -75,6 +75,7 @@ class Curve:
             self.fp = fp.MontField(params.p, params.name + ".p")
         self.fn = fp.MontField(params.n, params.name + ".n")
         self.a_is_zero = params.a % params.p == 0
+        self.a_is_minus3 = params.a % params.p == params.p - 3
 
         self.a_rep = self.fp.encode_int(params.a)
         self.b_rep = self.fp.encode_int(params.b)
@@ -167,6 +168,13 @@ def jac_double(cv: Curve, P):
         XX, YY = _mulk(f, [(X, X), (Y, Y)])
         XYY, YYYY, Z3 = _mulk(f, [(X, YY), (YY, YY), (two_y, Z)])
         M = f.add(f.add(XX, XX), XX)  # 3*X^2
+    elif cv.a_is_minus3:
+        # a = -3 (SM2, NIST curves): M = 3*(X - Z^2)*(X + Z^2)
+        YY, ZZ = _mulk(f, [(Y, Y), (Z, Z)])
+        XYY, YYYY, Z3, T = _mulk(
+            f, [(X, YY), (YY, YY), (two_y, Z),
+                (f.sub(X, ZZ), f.add(X, ZZ))])
+        M = f.add(f.add(T, T), T)
     else:
         XX, YY, ZZ = _mulk(f, [(X, X), (Y, Y), (Z, Z)])
         XYY, YYYY, Z3, ZZZZ = _mulk(
@@ -257,7 +265,8 @@ def _take_const(gt_flat: np.ndarray, dig):
 
 
 def _take_batch(tq, dig):
-    """Per-element table [TBL, 3, L, B] x digits [B] -> [3, L, B]."""
+    """Per-element table [TBL, C, L, B] x digits [B] -> [C, L, B]
+    (C = 2 affine coords for the ladders' normalized tables)."""
     oh = (dig[None, :] == jnp.arange(TBL, dtype=dig.dtype)[:, None]
           ).astype(jnp.uint32)
     return jnp.sum(tq * oh[:, None, None, :], axis=0)
@@ -278,9 +287,9 @@ def _q_window_table(cv: Curve, qx_r, qy_r):
 
 
 def _q_window_affine(cv: Curve, qx_r, qy_r):
-    """Affine Q window table (ax, ay), each [TBL, L, B]: the Jacobian
-    table batch-normalized with ONE product-tree inversion over all
-    TBL x B Z values, so every ladder add against it is a cheap mixed
+    """Affine Q window table stacked as [TBL, 2, L, B] (x, y): the
+    Jacobian table batch-normalized with ONE product-tree inversion over
+    all TBL x B Z values, so every ladder add against it is a cheap mixed
     add. Entry 0 (infinity) normalizes to garbage — harmless, because a
     zero window digit skips the add entirely (`_sel(d == 0, ...)`)."""
     f = cv.fp
@@ -295,9 +304,9 @@ def _q_window_affine(cv: Curve, qx_r, qy_r):
     zi = f.inv_batch(zf)[..., :tbl_n * B]
     zi = jnp.transpose(zi.reshape(L, tbl_n, B), (1, 0, 2))
     zi2 = f.mul(zi, zi)
-    ax = f.mul(X, zi2)
-    ay = f.mul(Y, f.mul(zi2, zi))
-    return ax, ay
+    ax, zi3 = _mulk(f, [(X, zi2), (zi2, zi)])
+    ay = f.mul(Y, zi3)
+    return jnp.stack([ax, ay], axis=1)
 
 
 def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
@@ -307,8 +316,7 @@ def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     field rep. 64-step scan, 4-bit windows for both scalars; the Q table
     is batch-normalized to affine so both adds per step are mixed adds.
     """
-    aqx, aqy = _q_window_affine(cv, qx_r, qy_r)
-    tq2 = jnp.stack([aqx, aqy], axis=1)  # [TBL, 2, L, B]
+    tq2 = _q_window_affine(cv, qx_r, qy_r)  # [TBL, 2, L, B]
 
     d1 = fp.window_digits(k1, WINDOW)[..., ::-1, :]  # [64, B] MSB-first
     d2 = fp.window_digits(k2, WINDOW)[..., ::-1, :]
@@ -387,10 +395,9 @@ def glv_shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     b1, t1, b2, t2 = _glv_split_device(cv, k2)
 
     # per-element tables, batch-normalized affine; phi applies beta to x
-    aqx, aqy = _q_window_affine(cv, qx_r, qy_r)
-    tq2 = jnp.stack([aqx, aqy], axis=1)  # [TBL, 2, L, B]
-    beta = jnp.broadcast_to(fp._col(cv.beta_rep), aqx.shape)
-    tql2 = jnp.stack([f.mul(aqx, beta), aqy], axis=1)
+    tq2 = _q_window_affine(cv, qx_r, qy_r)  # [TBL, 2, L, B]
+    beta = jnp.broadcast_to(fp._col(cv.beta_rep), tq2[:, 0].shape)
+    tql2 = jnp.stack([f.mul(tq2[:, 0], beta), tq2[:, 1]], axis=1)
 
     def digs(m):
         d = fp.window_digits(m, WINDOW)[..., :GLV_DIGITS, :]

@@ -138,6 +138,10 @@ class Scheduler:
                 LOG.exception(badge("SCHED", "commit-2pc-failed",
                                     number=header.number))
                 self.storage.rollback(header.number)
+                # put the executed result back: a transient storage failure
+                # must not strand the height (PBFT retries the checkpoint;
+                # without this the node could only recover via block sync)
+                self._executed[hh] = result
                 return False
             # drop any other stale executed results for this height
             for h in [h for h, r in self._executed.items()

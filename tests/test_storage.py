@@ -117,3 +117,45 @@ def test_wal_torn_tail_ignored(tmp_path):
     st2 = WalStorage(p)
     assert st2.get("t", b"good") == b"1"
     st2.close()
+
+
+def test_commit_block_retry_after_transient_2pc_failure():
+    """A failed storage 2PC must not strand the executed result: PBFT
+    retries the checkpoint commit and the scheduler must still have the
+    block (regression: commit_block popped the result before the 2PC)."""
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor.executor import TransactionExecutor
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode, Ledger
+    from fisco_bcos_tpu.protocol import Block, BlockHeader
+    from fisco_bcos_tpu.scheduler.scheduler import Scheduler
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+
+    suite = make_suite(backend="host")
+    storage = MemoryStorage()
+    ledger = Ledger(storage, suite)
+    kp = suite.generate_keypair(b"retry-node")
+    ledger.build_genesis([ConsensusNode(kp.pub_bytes)])
+    sched = Scheduler(storage, ledger, TransactionExecutor(suite), suite,
+                      None)
+    blk = Block(header=BlockHeader(number=1,
+                                   sealer_list=[kp.pub_bytes]))
+    result = sched.execute_block(blk)
+    assert result is not None
+
+    fails = {"n": 1}
+    orig_prepare = storage.prepare
+
+    def flaky_prepare(number, changes):
+        if fails["n"]:
+            fails["n"] -= 1
+            raise RuntimeError("transient storage failure")
+        return orig_prepare(number, changes)
+
+    storage.prepare = flaky_prepare
+    try:
+        assert not sched.commit_block(result.header)  # transient failure
+        assert sched.commit_block(result.header)      # retry succeeds
+    finally:
+        storage.prepare = orig_prepare
+    assert ledger.current_number() == 1
+    sched.shutdown()
