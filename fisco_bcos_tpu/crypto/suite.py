@@ -88,15 +88,24 @@ class CryptoSuite:
           (ProtocolInitializer.cpp:102/:110).
     backend: "device" | "host" | "auto". "auto" uses the device kernels at or
           above `device_min_batch` and the host oracle below it.
+    mesh_devices: shard device batches over up to this many local chips
+          (a `jax.sharding.Mesh` "dp" axis — the ICI analogue of the
+          reference's txpool.verify_worker_num tbb fan-out). 0/None =
+          single-device; the mesh is built lazily on first device use so
+          constructing a suite never touches the accelerator backend.
     """
 
     def __init__(self, kind: str = "ecdsa", backend: str = "auto",
-                 device_min_batch: int = 64):
+                 device_min_batch: int = 64,
+                 mesh_devices: int | None = None):
         if kind not in ("ecdsa", "sm"):
             raise ValueError(f"unknown crypto suite kind: {kind}")
         self.kind = kind
         self.backend = backend
         self.device_min_batch = device_min_batch
+        self.mesh_devices = mesh_devices or 0
+        self._mesh_kernels = None
+        self._mesh_tried = False
         if kind == "ecdsa":
             self.curve = ec.SECP256K1
             self.params = refimpl.SECP256K1
@@ -174,6 +183,23 @@ class CryptoSuite:
             return True
         return n >= self.device_min_batch
 
+    def _mesh(self):
+        """Lazy mesh kernels (None on single-device hosts)."""
+        if not self._mesh_tried:
+            self._mesh_tried = True
+            if self.mesh_devices >= 2:
+                from ..parallel import MeshKernels, local_mesh
+
+                mesh = local_mesh(self.mesh_devices)
+                if mesh is not None:
+                    self._mesh_kernels = MeshKernels(mesh)
+        return self._mesh_kernels
+
+    def _bucket_for(self, n: int) -> int:
+        b = _bucket(n)
+        mk = self._mesh()  # lazy+cached: no call-order dependency
+        return max(b, mk.n_devices) if mk is not None else b
+
     def _split_sigs(self, sigs: Sequence[bytes]):
         """r, s scalars per sig; malformed (short) sigs become r=s=0, which
         every verify/recover path rejects as invalid."""
@@ -211,10 +237,14 @@ class CryptoSuite:
         sl = bigint.batch_to_limbs(ss)
         xl = bigint.batch_to_limbs(qx)
         yl = bigint.batch_to_limbs(qy)
-        fn = (ec.ecdsa_verify_batch if self.kind == "ecdsa"
-              else ec.sm2_verify_batch)
+        mk = self._mesh()
+        if mk is not None:
+            fn = (mk.verify if self.kind == "ecdsa" else mk.sm2_verify)
+        else:
+            fn = (ec.ecdsa_verify_batch if self.kind == "ecdsa"
+                  else ec.sm2_verify_batch)
         if n <= CHUNK:
-            b = _bucket(n)
+            b = self._bucket_for(n)
             ok = fn(self.curve, *(_pad_rows(a, b)
                                   for a in (el, rl, sl, xl, yl)))
             return np.asarray(ok)[:n]
@@ -258,13 +288,15 @@ class CryptoSuite:
         rl = bigint.batch_to_limbs(rs)
         sl = bigint.batch_to_limbs(ss)
         vl = np.array(vs, np.uint32)
+        mk = self._mesh()
+        rec = mk.recover if mk is not None else ec.ecdsa_recover_batch
         if n <= CHUNK:
-            b = _bucket(n)
-            qx, qy, ok = ec.ecdsa_recover_batch(
+            b = self._bucket_for(n)
+            qx, qy, ok = rec(
                 self.curve, _pad_rows(el, b), _pad_rows(rl, b),
                 _pad_rows(sl, b), _pad_rows(vl, b))
         else:
-            parts = [ec.ecdsa_recover_batch(
+            parts = [rec(
                 self.curve, _pad_rows(el[o:o + ln], CHUNK),
                 _pad_rows(rl[o:o + ln], CHUNK),
                 _pad_rows(sl[o:o + ln], CHUNK),

@@ -53,6 +53,9 @@ class NodeConfig:
     consensus: str = "solo"  # solo | pbft
     crypto_backend: str = "auto"  # device | host | auto
     device_min_batch: int = 64
+    # shard device crypto batches over up to N local chips (0 = off);
+    # the ICI analogue of txpool.verify_worker_num (NodeConfig.cpp:486)
+    crypto_mesh_devices: int = 0
     leader_period: int = 1  # consensus_leader_period (NodeConfig.cpp:568)
     view_timeout: float = 3.0
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
@@ -67,9 +70,10 @@ class Node:
                  gateway: Optional[Gateway] = None):
         self.config = config or NodeConfig()
         cfg = self.config
-        self.suite = suite or make_suite(cfg.sm_crypto,
-                                         backend=cfg.crypto_backend,
-                                         device_min_batch=cfg.device_min_batch)
+        self.suite = suite or make_suite(
+            cfg.sm_crypto, backend=cfg.crypto_backend,
+            device_min_batch=cfg.device_min_batch,
+            mesh_devices=cfg.crypto_mesh_devices)
         self.keypair = keypair or self.suite.generate_keypair()
         self.storage = (WalStorage(cfg.storage_path) if cfg.storage_path
                         else MemoryStorage())

@@ -1,0 +1,1 @@
+from .mesh import MeshKernels, local_mesh  # noqa: F401

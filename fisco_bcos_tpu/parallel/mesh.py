@@ -1,0 +1,107 @@
+"""Device-mesh plane: shard the crypto batch over local chips.
+
+This is the framework's ICI communication backend (SURVEY §2 "distributed
+communication backend" + §5's 64k-block scaling analogue): the reference
+spreads its per-tx signature work across CPU cores with a tbb parallel
+loop sized by `txpool.verify_worker_num`
+(/root/reference/bcos-txpool/bcos-txpool/sync/TransactionSync.cpp:516-537,
+ /root/reference/bcos-tool/bcos-tool/NodeConfig.cpp:486); here the same
+scaling axis is the TPU **device mesh** — one `jax.sharding.Mesh` over the
+host's chips with the batch data-parallel on a "dp" axis. XLA inserts the
+ICI collectives; the kernels themselves are unchanged. Scope: the three
+SIGNATURE kernels (verify / SM2 verify / recover) are sharded — they
+dominate block validation; within them only the batched-inversion product
+tree couples batch elements, and its upper levels become cross-shard
+collectives. Hashing and the Merkle root stay single-device.
+
+`CryptoSuite(mesh_devices=N)` routes its device path through `MeshKernels`;
+the driver's `__graft_entry__.dryrun_multichip` exercises the same sharding
+on the virtual CPU mesh, which is also how the tests run
+(tests/conftest.py forces 8 host devices).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+def local_mesh(max_devices: Optional[int] = None):
+    """-> Mesh over the largest power-of-two prefix of local devices on a
+    1-D "dp" axis, or None when fewer than two devices exist (single-chip
+    and host-only deployments: the unsharded path is already optimal)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = len(devs) if max_devices is None else min(max_devices, len(devs))
+    if n < 2:
+        return None
+    n = 1 << (n.bit_length() - 1)
+    return Mesh(np.array(devs[:n]), ("dp",))
+
+
+class MeshKernels:
+    """Sharded jit wrappers for the EC signature kernels.
+
+    Compiled executables are cached per (kernel, curve) — shapes vary only
+    by the suite's bucket sizes, which jit caches internally. Batch sizes
+    must be divisible by the mesh size (the suite pads buckets, all powers
+    of two >= the mesh size).
+    """
+
+    def __init__(self, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.n_devices = mesh.devices.size
+        self._data = NamedSharding(mesh, P("dp", None))  # [B, L] arrays
+        self._flat = NamedSharding(mesh, P("dp"))  # [B] arrays
+        self._jits: dict = {}
+        self._lock = threading.Lock()
+        self._jax = jax
+
+    def _get(self, name: str, fn, n_mat: int, n_flat: int, out_spec):
+        """Sharded jit of fn(curve, *args): n_mat [B, L] args then n_flat
+        [B] args; out_spec mirrors the output structure."""
+        with self._lock:
+            got = self._jits.get(name)
+            if got is None:
+                got = self._jax.jit(
+                    fn.__wrapped__ if hasattr(fn, "__wrapped__") else fn,
+                    static_argnums=0,
+                    in_shardings=(self._data,) * n_mat
+                    + (self._flat,) * n_flat,
+                    out_shardings=out_spec)
+                self._jits[name] = got
+            return got
+
+    def _put(self, arrs, shardings):
+        return [self._jax.device_put(a, s) for a, s in zip(arrs, shardings)]
+
+    def verify(self, curve, e, r, s, qx, qy):
+        from ..ops import ec
+
+        fn = self._get("ecdsa_verify", ec.ecdsa_verify_batch, 5, 0,
+                       self._flat)
+        args = self._put((e, r, s, qx, qy), (self._data,) * 5)
+        return fn(curve, *args)
+
+    def sm2_verify(self, curve, e, r, s, qx, qy):
+        from ..ops import ec
+
+        fn = self._get("sm2_verify", ec.sm2_verify_batch, 5, 0, self._flat)
+        args = self._put((e, r, s, qx, qy), (self._data,) * 5)
+        return fn(curve, *args)
+
+    def recover(self, curve, e, r, s, v):
+        from ..ops import ec
+
+        fn = self._get("ecdsa_recover", ec.ecdsa_recover_batch, 3, 1,
+                       (self._data, self._data, self._flat))
+        args = self._put((e, r, s), (self._data,) * 3) + self._put(
+            (v,), (self._flat,))
+        return fn(curve, *args)

@@ -98,7 +98,8 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                      if cfg.metrics_port is None else str(cfg.metrics_port)}
     cp["executor"] = {}
     cp["crypto"] = {"backend": cfg.crypto_backend,
-                    "device_min_batch": str(cfg.device_min_batch)}
+                    "device_min_batch": str(cfg.device_min_batch),
+                    "mesh_devices": str(cfg.crypto_mesh_devices)}
     import io
     buf = io.StringIO()
     cp.write(buf)
@@ -130,6 +131,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
                                  fallback=1000),
         crypto_backend=cp.get("crypto", "backend", fallback="auto"),
         device_min_batch=cp.getint("crypto", "device_min_batch", fallback=64),
+        crypto_mesh_devices=cp.getint("crypto", "mesh_devices", fallback=0),
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
         rpc_port=int(port_s) if port_s else None,
         metrics_port=int(metrics_s) if metrics_s else None,
