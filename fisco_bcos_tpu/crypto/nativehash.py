@@ -1,0 +1,71 @@
+"""Native host-path hashing — ctypes binding to native/nevm's C++
+Keccak-256 / SM3.
+
+The reference hashes through OpenSSL EVP everywhere
+(/root/reference/bcos-crypto/bcos-crypto/hasher/OpenSSLHasher.h:23); this
+framework's DEVICE batches hash on TPU (ops.keccak / ops.sm3), but
+below-threshold host-path hashing (single tx hashes, header hashes,
+address derivation, test fixtures) ran on the pure-Python reference
+implementation. These bindings give the host path native speed while
+`crypto.refimpl` stays the untouched pure-Python oracle the golden tests
+compare every implementation against.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Optional
+
+_BUF32 = ctypes.c_uint8 * 32
+
+_fns: dict = {}
+_loaded = False
+_lock = threading.Lock()
+
+
+def _load() -> dict:
+    global _loaded
+    with _lock:  # _loaded flips only AFTER binding: a concurrent first
+        if _loaded:  # caller can never observe a half-initialized state
+            return _fns
+        from ..executor import nevm
+
+        lib = nevm.load_library()
+        if lib is not None:
+            try:
+                for name in ("nevm_keccak256", "nevm_sm3"):
+                    fn = getattr(lib, name)
+                    fn.argtypes = [ctypes.c_char_p, ctypes.c_uint64, _BUF32]
+                    fn.restype = None
+                _fns["keccak256"] = lib.nevm_keccak256
+                _fns["sm3"] = lib.nevm_sm3
+            except AttributeError:  # library build without the exports
+                _fns.clear()
+        _loaded = True
+        return _fns
+
+
+def _wrap(name: str) -> Optional[Callable[[bytes], bytes]]:
+    fn = _load().get(name)
+    if fn is None:
+        return None
+
+    def h(data) -> bytes:
+        out = _BUF32()
+        # bytes() coercion: match refimpl's acceptance of bytearray/
+        # memoryview (c_char_p takes only bytes)
+        fn(data if isinstance(data, bytes) else bytes(data), len(data), out)
+        return bytes(out)
+
+    return h
+
+
+def keccak256() -> Optional[Callable[[bytes], bytes]]:
+    """-> native keccak256(data)->digest, or None when unavailable."""
+    return _wrap("keccak256")
+
+
+def sm3() -> Optional[Callable[[bytes], bytes]]:
+    """-> native sm3(data)->digest, or None when unavailable."""
+    return _wrap("sm3")

@@ -485,6 +485,17 @@ extern "C" {
 
 void nevm_free(uint8_t* p) { delete[] p; }
 
+// standalone hash entry points: the host-path CryptoSuite hashing
+// (tx/header hashes, address derivation) routes here when the library is
+// loadable — ~100x the pure-Python reference implementation it mirrors
+void nevm_keccak256(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  keccak256(data, len, out);
+}
+
+void nevm_sm3(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  sm3(data, len, out);
+}
+
 int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
                      const uint8_t* code, uint64_t code_len,
                      const uint8_t* jd_bitmap, const uint8_t* calldata,

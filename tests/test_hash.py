@@ -108,3 +108,47 @@ def test_suite_chunked_device_batches(monkeypatch):
     pubs_host, okr_host = host.recover_batch(digests, sigs)
     assert list(okr_dev) == list(okr_host)
     assert pubs_dev == pubs_host
+
+
+def test_native_host_hash_matches_refimpl():
+    """native/nevm's C++ Keccak-256 and SM3 (the host-path suite hashers)
+    must match the pure-Python oracle across padding boundaries (empty,
+    sub-rate, rate-1/rate/rate+1, multi-block)."""
+    import pytest
+
+    from fisco_bcos_tpu.crypto import nativehash, refimpl
+
+    nk, ns = nativehash.keccak256(), nativehash.sm3()
+    if nk is None:
+        pytest.skip("libnevm.so not built")
+    rng = np.random.default_rng(9)
+    sizes = [0, 1, 31, 32, 55, 56, 63, 64, 65, 135, 136, 137, 200, 500,
+             1000]
+    for n in sizes:
+        data = rng.bytes(n)
+        assert nk(data) == refimpl.keccak256(data), n
+        assert ns(data) == refimpl.sm3(data), n
+
+
+def test_suite_host_hash_uses_native_when_available():
+    from fisco_bcos_tpu.crypto import nativehash, refimpl
+    from fisco_bcos_tpu.crypto.suite import make_suite
+
+    s = make_suite(backend="host")
+    if nativehash.keccak256() is not None:
+        assert s._host_hash is not refimpl.keccak256
+    assert s.hash(b"abc") == refimpl.keccak256(b"abc")
+    sm = make_suite(True, backend="host")
+    assert sm.hash(b"abc") == refimpl.sm3(b"abc")
+
+
+def test_native_host_hash_accepts_buffer_types():
+    from fisco_bcos_tpu.crypto import nativehash, refimpl
+
+    nk = nativehash.keccak256()
+    if nk is None:
+        import pytest
+        pytest.skip("libnevm.so not built")
+    want = refimpl.keccak256(b"buffer-shapes")
+    assert nk(bytearray(b"buffer-shapes")) == want
+    assert nk(memoryview(b"buffer-shapes")) == want
