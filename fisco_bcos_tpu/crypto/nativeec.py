@@ -1,0 +1,123 @@
+"""Native host-path EC signatures — ctypes binding to native/ncrypto.
+
+The reference's per-signature functions are native (WeDPR FFI,
+bcos-crypto/signature/secp256k1/Secp256k1Crypto.cpp:40,57,85); this
+framework batches them on TPU for large blocks (ops/ec.py) and uses this
+library as the native HOST floor — sub-threshold batches, ingest
+fallback, accelerator-free deployments — at ~100x the pure-Python oracle
+(`crypto.refimpl`), which stays untouched as the golden reference.
+
+Row format: count x 32 big-endian bytes per scalar/coordinate.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_LIB_ENV = "FBTPU_NCRYPTO_LIB"
+_DEFAULT_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "build", "libncrypto.so")
+
+_lib = None
+_loaded = False
+_lock = threading.Lock()
+
+_CURVE_SECP, _CURVE_SM2 = 0, 1
+
+
+def load_library():
+    global _lib, _loaded
+    with _lock:
+        if _loaded:
+            return _lib
+        path = os.environ.get(_LIB_ENV, _DEFAULT_LIB)
+        try:
+            lib = ctypes.CDLL(path)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.ncrypto_ecdsa_verify_batch.argtypes = [
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, u8p]
+            lib.ncrypto_ecdsa_verify_batch.restype = None
+            lib.ncrypto_ecdsa_recover_batch.argtypes = [
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                u8p, u8p]
+            lib.ncrypto_ecdsa_recover_batch.restype = None
+            lib.ncrypto_sm2_verify_batch.argtypes = [
+                ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u8p]
+            lib.ncrypto_sm2_verify_batch.restype = None
+            _lib = lib
+        except (OSError, AttributeError):
+            _lib = None
+        _loaded = True
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def _rows(ints, n) -> bytes:
+    return b"".join(int(v).to_bytes(32, "big") for v in ints[:n])
+
+
+def _e_rows(es, n, order: int) -> bytes:
+    """Digest ints as 32-byte rows. Digests longer than 32 bytes (allowed
+    by the suite contract) are pre-reduced mod the group order, exactly
+    what refimpl's `e % n` does for any length."""
+    return b"".join(
+        int(v if v < (1 << 256) else v % order).to_bytes(32, "big")
+        for v in es[:n])
+
+
+def ecdsa_verify_batch(es, rs, ss, qxs, qys) -> Optional[list]:
+    """ints -> [bool]; None when the library is unavailable."""
+    from . import refimpl
+
+    lib = load_library()
+    if lib is None:
+        return None
+    n = len(es)
+    ok = (ctypes.c_uint8 * n)()
+    lib.ncrypto_ecdsa_verify_batch(
+        _CURVE_SECP, n, _e_rows(es, n, refimpl.SECP256K1.n), _rows(rs, n),
+        _rows(ss, n), _rows(qxs, n), _rows(qys, n), ok)
+    return [bool(v) for v in ok]
+
+
+def sm2_verify_batch(es, rs, ss, qxs, qys) -> Optional[list]:
+    from . import refimpl
+
+    lib = load_library()
+    if lib is None:
+        return None
+    n = len(es)
+    ok = (ctypes.c_uint8 * n)()
+    lib.ncrypto_sm2_verify_batch(n, _e_rows(es, n, refimpl.SM2P256V1.n),
+                                 _rows(rs, n), _rows(ss, n), _rows(qxs, n),
+                                 _rows(qys, n), ok)
+    return [bool(v) for v in ok]
+
+
+def ecdsa_recover_batch(es, rs, ss, vs) -> Optional[tuple]:
+    """ints + v bytes -> ([pub64 | None], [bool]); None when unavailable."""
+    from . import refimpl
+
+    lib = load_library()
+    if lib is None:
+        return None
+    n = len(es)
+    ok = (ctypes.c_uint8 * n)()
+    pubs = (ctypes.c_uint8 * (64 * n))()
+    lib.ncrypto_ecdsa_recover_batch(
+        _CURVE_SECP, n, _e_rows(es, n, refimpl.SECP256K1.n), _rows(rs, n),
+        _rows(ss, n), bytes(v & 0xFF for v in vs[:n]), pubs, ok)
+    raw = bytes(pubs)
+    out = [raw[64 * i:64 * i + 64] if ok[i] else None for i in range(n)]
+    return out, [bool(v) for v in ok]

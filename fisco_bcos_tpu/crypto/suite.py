@@ -225,11 +225,19 @@ class CryptoSuite:
         qy = [int.from_bytes(p[32:64], "big") for p in pubs]
         es = [int.from_bytes(d, "big") for d in digests]
         if not self._use_device(n):
+            from . import nativeec
+
             if self.kind == "ecdsa":
+                native = nativeec.ecdsa_verify_batch(es, rs, ss, qx, qy)
+                if native is not None:
+                    return np.array(native)
                 return np.array([
                     refimpl.ecdsa_verify(self.params, (x, y), d, r, s)
                     for x, y, d, r, s in zip(qx, qy, digests, rs, ss)
                 ])
+            native = nativeec.sm2_verify_batch(es, rs, ss, qx, qy)
+            if native is not None:
+                return np.array(native)
             return np.array([
                 refimpl.sm2_verify((x, y), d, r, s)
                 for x, y, d, r, s in zip(qx, qy, digests, rs, ss)
@@ -278,6 +286,11 @@ class CryptoSuite:
         vs = [g[64] if len(g) >= 65 else 255 for g in sigs]
         es = [int.from_bytes(d, "big") for d in digests]
         if not self._use_device(n):
+            from . import nativeec
+
+            native = nativeec.ecdsa_recover_batch(es, rs, ss, vs)
+            if native is not None:
+                return native[0], np.array(native[1])
             out, okl = [], []
             for d, r, s, v in zip(digests, rs, ss, vs):
                 Q = refimpl.ecdsa_recover(self.params, d, r, s, v)

@@ -1,0 +1,147 @@
+"""Native EC engine (native/ncrypto) vs the pure-Python oracle.
+
+Equivalence across valid, invalid, and malformed inputs: the host-path
+suite swaps the oracle for the native engine when the library loads, so
+classification AND recovered keys must match refimpl bit for bit.
+"""
+
+import numpy as np
+import pytest
+
+from fisco_bcos_tpu.crypto import nativeec, refimpl
+from fisco_bcos_tpu.crypto.suite import make_suite
+
+pytestmark = pytest.mark.skipif(
+    not nativeec.available(), reason="libncrypto.so not built")
+
+
+def _sigs(params, count, sm=False):
+    rows = []
+    for i in range(count):
+        sk, pub = refimpl.keygen(params, bytes([i + 9]) * 24)
+        digest = (refimpl.sm3 if sm else refimpl.keccak256)(
+            b"native-ec-%d" % i)
+        if sm:
+            r, s = refimpl.sm2_sign(sk, digest)
+            v = 0
+        else:
+            r, s, v = refimpl.ecdsa_sign(params, sk, digest)
+        rows.append((int.from_bytes(digest, "big"), r, s, v, pub, digest))
+    return rows
+
+
+def test_ecdsa_verify_matches_oracle():
+    params = refimpl.SECP256K1
+    rows = _sigs(params, 6)
+    es = [r[0] for r in rows]
+    rs = [r[1] for r in rows]
+    ss = [r[2] for r in rows]
+    qx = [r[4][0] for r in rows]
+    qy = [r[4][1] for r in rows]
+    # edge rows: r=0, s=n, tampered e, swapped pub, x>=p style huge coords
+    es += [es[0], es[1], es[2] ^ 1, es[3], es[4]]
+    rs += [0, rs[1], rs[2], rs[3], rs[4]]
+    ss += [ss[0], params.n, ss[2], ss[3], ss[4]]
+    qx += [qx[0], qx[1], qx[2], qx[4], params.p + 1]  # x >= p: implicit
+    qy += [qy[0], qy[1], qy[2], qy[4], qy[4]]         # mod-p reduction
+    got = nativeec.ecdsa_verify_batch(es, rs, ss, qx, qy)
+    want = [refimpl.ecdsa_verify(params, (x, y),
+                                 int(e).to_bytes(32, "big"), r, s)
+            for e, r, s, x, y in zip(es, rs, ss, qx, qy)]
+    assert got == want
+    assert got[:6] == [True] * 6 and got[6:9] == [False] * 3
+
+
+def test_sm2_verify_matches_oracle():
+    params = refimpl.SM2P256V1
+    rows = _sigs(params, 5, sm=True)
+    es = [r[0] for r in rows] + [rows[0][0] ^ 1]
+    rs = [r[1] for r in rows] + [rows[0][1]]
+    ss = [r[2] for r in rows] + [rows[0][2]]
+    qx = [r[4][0] for r in rows] + [rows[0][4][0]]
+    qy = [r[4][1] for r in rows] + [rows[0][4][1]]
+    got = nativeec.sm2_verify_batch(es, rs, ss, qx, qy)
+    want = [refimpl.sm2_verify((x, y), int(e).to_bytes(32, "big"), r, s)
+            for e, r, s, x, y in zip(es, rs, ss, qx, qy)]
+    assert got == want
+    assert got == [True] * 5 + [False]
+
+
+def test_ecdsa_recover_matches_oracle():
+    params = refimpl.SECP256K1
+    rows = _sigs(params, 6)
+    es = [r[0] for r in rows]
+    rs = [r[1] for r in rows]
+    ss = [r[2] for r in rows]
+    vs = [r[3] for r in rows]
+    # edge rows: flipped v (wrong key, still valid), v>=4, r=0, huge v
+    es += [es[0], es[1], es[2], es[3]]
+    rs += [rs[0], rs[1], 0, rs[3]]
+    ss += [ss[0], ss[1], ss[2], ss[3]]
+    vs += [vs[0] ^ 1, 4, vs[2], 255]
+    pubs, ok = nativeec.ecdsa_recover_batch(es, rs, ss, vs)
+    for i, (e, r, s, v) in enumerate(zip(es, rs, ss, vs)):
+        Q = refimpl.ecdsa_recover(params, int(e).to_bytes(32, "big"),
+                                  r, s, v)
+        assert ok[i] == (Q is not None), i
+        if Q is not None:
+            want = Q[0].to_bytes(32, "big") + Q[1].to_bytes(32, "big")
+            assert pubs[i] == want, i
+    # the 6 untampered rows recover the signing keys
+    for i in range(6):
+        assert ok[i] and pubs[i] == (
+            rows[i][4][0].to_bytes(32, "big")
+            + rows[i][4][1].to_bytes(32, "big"))
+
+
+def test_host_suite_routes_through_native():
+    """The host-path CryptoSuite classification equals the oracle's for a
+    mixed good/bad workload (suite-level integration)."""
+    for sm in (False, True):
+        suite = make_suite(sm, backend="host")
+        kps = [suite.generate_keypair(bytes([i + 3]) * 20)
+               for i in range(4)]
+        digests = [suite.hash(b"route-%d" % i) for i in range(4)]
+        sigs = [suite.sign(kp, d) for kp, d in zip(kps, digests)]
+        pubs = [kp.pub_bytes for kp in kps]
+        sigs[-1] = sigs[-1][:10] + b"\x77" + sigs[-1][11:]
+        ok = suite.verify_batch(digests, sigs, pubs)
+        assert ok.tolist() == [True, True, True, False]
+        if not sm:
+            addrs, okr = suite.recover_addresses(digests, sigs)
+            assert okr.tolist()[:3] == [True] * 3
+            assert addrs[:3] == [kp.address for kp in kps[:3]]
+
+
+def test_native_ec_throughput_sane():
+    """Native recover must be orders faster than the Python oracle —
+    a cheap regression guard against silently falling back."""
+    import time
+
+    params = refimpl.SECP256K1
+    rows = _sigs(params, 2)
+    es = [rows[0][0]] * 64
+    rs = [rows[0][1]] * 64
+    ss = [rows[0][2]] * 64
+    vs = [rows[0][3]] * 64
+    nativeec.ecdsa_recover_batch(es[:2], rs[:2], ss[:2], vs[:2])  # warm
+    t0 = time.perf_counter()
+    _, ok = nativeec.ecdsa_recover_batch(es, rs, ss, vs)
+    dt = time.perf_counter() - t0
+    assert all(ok)
+    assert 64 / dt > 500, f"native recover too slow: {64 / dt:.0f}/s"
+
+
+def test_oversized_digest_matches_oracle():
+    """Digests longer than 32 bytes classify exactly like refimpl
+    (e reduced mod n), instead of crashing the batch."""
+    params = refimpl.SECP256K1
+    sk, pub = refimpl.keygen(params, b"\x21" * 24)
+    digest = b"\x9f" * 40  # 320-bit digest
+    r, s, v = refimpl.ecdsa_sign(params, sk, digest)
+    e = int.from_bytes(digest, "big")
+    got = nativeec.ecdsa_verify_batch([e], [r], [s], [pub[0]], [pub[1]])
+    assert got == [refimpl.ecdsa_verify(params, pub, digest, r, s)] == [True]
+    pubs, ok = nativeec.ecdsa_recover_batch([e], [r], [s], [v])
+    assert ok == [True]
+    assert pubs[0] == pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
