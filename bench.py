@@ -96,6 +96,32 @@ def _measure_cpu_baseline() -> tuple[float, int, str]:
             return ESTIMATED_CPU_BASELINE, cores, "estimate"
 
 
+def _measure_native_floor() -> float:
+    """verifies/sec of the framework's OWN native host engine
+    (native/ncrypto) on one core — the accelerator-free floor a node
+    falls back to, reported alongside the OpenSSL divisor."""
+    try:
+        from fisco_bcos_tpu.crypto import nativeec, refimpl
+
+        if not nativeec.available():
+            return 0.0
+        p = refimpl.SECP256K1
+        sk, pub = refimpl.keygen(p, b"\x11" * 16)
+        d = refimpl.keccak256(b"floor")
+        r, s, _v = refimpl.ecdsa_sign(p, sk, d)
+        e = int.from_bytes(d, "big")
+        n = 512
+        nativeec.ecdsa_verify_batch([e] * 8, [r] * 8, [s] * 8,
+                                    [pub[0]] * 8, [pub[1]] * 8)  # warm
+        t0 = time.perf_counter()
+        ok = nativeec.ecdsa_verify_batch([e] * n, [r] * n, [s] * n,
+                                         [pub[0]] * n, [pub[1]] * n)
+        dt = time.perf_counter() - t0
+        return n / dt if ok and all(ok) else 0.0
+    except Exception:
+        return 0.0
+
+
 def _cpu_reexec() -> None:
     env = cpu_pinned_env(extra_path=_REPO)
     env["FBTPU_BENCH_CHILD"] = "1"
@@ -146,6 +172,7 @@ def main() -> None:
         # measure the CPU divisor FIRST (before any device work contends
         # for cores or the XLA client spawns threads)
         cpu_base, cores, src = _measure_cpu_baseline()
+        native_floor = _measure_native_floor()
 
         import jax
 
@@ -232,6 +259,7 @@ def main() -> None:
             "cpu_baseline_sigs_per_sec": round(cpu_base, 1),
             "cpu_baseline_source": src,
             "cpu_cores": cores,
+            "native_host_floor_sigs_per_sec": round(native_floor, 1),
             "recover_sigs_per_sec": round(recover, 1),
             "recover_vs_baseline": round(recover / cpu_base, 3),
         }), flush=True)

@@ -63,6 +63,14 @@ def available() -> bool:
     return load_library() is not None
 
 
+def _check_lens(n: int, *seqs) -> None:
+    """The C side reads n rows from EVERY buffer: a short argument list
+    would be a heap overread, so fail loudly at the boundary instead."""
+    for s in seqs:
+        if len(s) != n:
+            raise ValueError(f"batch length mismatch: {len(s)} != {n}")
+
+
 def _rows(ints, n) -> bytes:
     return b"".join(int(v).to_bytes(32, "big") for v in ints[:n])
 
@@ -84,6 +92,7 @@ def ecdsa_verify_batch(es, rs, ss, qxs, qys) -> Optional[list]:
     if lib is None:
         return None
     n = len(es)
+    _check_lens(n, rs, ss, qxs, qys)
     ok = (ctypes.c_uint8 * n)()
     lib.ncrypto_ecdsa_verify_batch(
         _CURVE_SECP, n, _e_rows(es, n, refimpl.SECP256K1.n), _rows(rs, n),
@@ -98,6 +107,7 @@ def sm2_verify_batch(es, rs, ss, qxs, qys) -> Optional[list]:
     if lib is None:
         return None
     n = len(es)
+    _check_lens(n, rs, ss, qxs, qys)
     ok = (ctypes.c_uint8 * n)()
     lib.ncrypto_sm2_verify_batch(n, _e_rows(es, n, refimpl.SM2P256V1.n),
                                  _rows(rs, n), _rows(ss, n), _rows(qxs, n),
@@ -113,6 +123,7 @@ def ecdsa_recover_batch(es, rs, ss, vs) -> Optional[tuple]:
     if lib is None:
         return None
     n = len(es)
+    _check_lens(n, rs, ss, vs)
     ok = (ctypes.c_uint8 * n)()
     pubs = (ctypes.c_uint8 * (64 * n))()
     lib.ncrypto_ecdsa_recover_batch(

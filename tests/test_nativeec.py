@@ -145,3 +145,11 @@ def test_oversized_digest_matches_oracle():
     pubs, ok = nativeec.ecdsa_recover_batch([e], [r], [s], [v])
     assert ok == [True]
     assert pubs[0] == pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+
+
+def test_mismatched_batch_lengths_rejected():
+    """Short argument lists must fail loudly, never read past a buffer."""
+    with pytest.raises(ValueError):
+        nativeec.ecdsa_verify_batch([1, 2], [1], [1, 2], [1, 2], [1, 2])
+    with pytest.raises(ValueError):
+        nativeec.ecdsa_recover_batch([1, 2], [1, 2], [1, 2], [0])
