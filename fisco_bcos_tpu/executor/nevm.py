@@ -220,29 +220,24 @@ class _Host:
         self.logs = []
         self._keep = []
 
-    # -- callbacks ---------------------------------------------------------
-    def _guard(self, fn, *args):
-        try:
-            return fn(*args)
-        except BaseException as exc:  # noqa: BLE001 — surfaced to caller
-            self.exc = exc
-            return -1
-
+    # -- callbacks (direct try/except bodies: no per-op closure churn) -----
     def _store_key(self, slot: bytes) -> bytes:
         return self.address + slot
 
     def _sload(self, _ctx, slot, out):
-        def go():
+        try:
             raw = self.state.get(self._evm_mod.T_STORE,
                                  self._store_key(_bytes_at(slot, 32)))
             if not raw:
                 return 0
             ctypes.memmove(out, raw.rjust(32, b"\x00"), 32)
             return 1
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001 — surfaced to caller
+            self.exc = exc
+            return -1
 
     def _sstore(self, _ctx, slot, val, val_zero):
-        def go():
+        try:
             key = self._store_key(_bytes_at(slot, 32))
             old = self.state.get(self._evm_mod.T_STORE, key)
             if val_zero:
@@ -252,38 +247,46 @@ class _Host:
                 self.state.set(self._evm_mod.T_STORE, key,
                                _bytes_at(val, 32))
             return 1 if old else 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
     def _balance(self, _ctx, addr, out):
-        def go():
+        try:
             v = self.evm.balance_of(self.state, _bytes_at(addr, 20))
             ctypes.memmove(out, v.to_bytes(32, "big"), 32)
             return 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
     def _get_code(self, _ctx, addr, code_out, len_out):
-        def go():
+        try:
             code = self.evm.get_code(self.state, _bytes_at(addr, 20))
             buf = _u8(code)
             self._keep = [buf]  # valid until the next callback
             code_out[0] = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
             len_out[0] = len(code)
             return 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
     def _log(self, _ctx, topics, ntopics, data, data_len):
-        def go():
+        try:
             raw = _bytes_at(topics, 32 * ntopics) if ntopics else b""
             self.logs.append(LogEntry(
                 address=self.address,
                 topics=[raw[32 * i:32 * i + 32] for i in range(ntopics)],
                 data=_bytes_at(data, data_len)))
             return 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
     def _call(self, _ctx, kind, to, value, input_, input_len, gas,
               gas_left_out, out, out_len_out):
-        def go():
+        try:
             to_b = _bytes_at(to, 20)
             v = int.from_bytes(_bytes_at(value, 32), "big")
             args = _bytes_at(input_, input_len)
@@ -313,11 +316,13 @@ class _Host:
             out_len_out[0] = len(res.output)
             gas_left_out[0] = res.gas_left
             return 1 if res.success else 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
     def _create(self, _ctx, is_create2, value, init, init_len, salt, gas,
                 gas_left_out, out, out_len_out, addr_out):
-        def go():
+        try:
             v = int.from_bytes(_bytes_at(value, 32), "big")
             initcode = _bytes_at(init, init_len)
             salt_i = int.from_bytes(_bytes_at(salt, 32), "big") \
@@ -333,10 +338,12 @@ class _Host:
             if res.success and len(res.create_address) == 20:
                 ctypes.memmove(addr_out, res.create_address, 20)
             return 1 if res.success else 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
     def _selfdestruct(self, _ctx, heir):
-        def go():
+        try:
             e = self.evm
             heir_b = _bytes_at(heir, 20)
             bal = e.balance_of(self.state, self.address)
@@ -346,7 +353,9 @@ class _Host:
                               e.balance_of(self.state, heir_b) + bal)
             self.state.remove(self._evm_mod.T_CODE, self.address)
             return 0
-        return self._guard(go)
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
 
 
 _tls = threading.local()
