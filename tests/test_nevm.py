@@ -268,3 +268,46 @@ def test_returndatacopy_overflow_equivalent():
     code = asm(push(1, 1), push((1 << 64) - 1), push(0, 1), 0x3E) + ret_top()
     n, p = run_both(code)
     assert not n.success and not p.success
+
+
+def test_block_execution_state_identical_across_interpreters():
+    """Consensus safety for mixed fleets: executing the SAME block of
+    contract txs with the native and Python interpreters must produce
+    identical receipts (encoded) and an identical state changeset —
+    stronger than per-frame equality, this covers executor dispatch,
+    deploy addresses, logs and gas accounting end to end."""
+    from fisco_bcos_tpu.executor.executor import TransactionExecutor
+    from fisco_bcos_tpu.protocol import Transaction
+
+    runtime = asm(
+        push(0, 1), 0x54, push(1, 1), 0x01, push(0, 1), 0x55,   # slot0 += 1
+        push(0x11), push(32, 1), push(0, 1), 0xA1,              # LOG1
+        push(0, 1), 0x54, push(0, 1), 0x52, push(32, 1), push(0, 1), 0xF3)
+    prefix = asm(push(0, 1), push(0, 1), push(0, 1), 0x39,
+                 push(0, 1), push(0, 1), 0xF3)
+    init = asm(push(len(runtime), 1), push(len(prefix), 1), push(0, 1), 0x39,
+               push(len(runtime), 1), push(0, 1), 0xF3) + runtime
+
+    kp = SUITE.generate_keypair(b"block-eq")
+    txs = [Transaction(to=b"", input=init, nonce="bd",
+                       block_limit=100).sign(SUITE, kp)]
+    outputs = []
+    for native in (True, False):
+        ex = TransactionExecutor(SUITE)
+        ex.evm.native = native
+        st = StateStorage(MemoryStorage())
+        recs = [ex.execute_transaction(txs[0], st, 1, 1000)]
+        addr = recs[0].contract_address
+        assert recs[0].status == 0 and addr, "deploy must succeed"
+        calls = [Transaction(to=addr, input=b"", nonce=f"bc{i}",
+                             block_limit=100).sign(SUITE, kp)
+                 for i in range(4)]
+        for i, tx in enumerate(calls):
+            recs.append(ex.execute_transaction(tx, st, 2, 2000 + i))
+        outputs.append((
+            [r.encode() for r in recs],
+            sorted(st.changeset().items()),
+        ))
+    native_out, python_out = outputs
+    assert native_out[0] == python_out[0], "receipts differ"
+    assert native_out[1] == python_out[1], "state changesets differ"
