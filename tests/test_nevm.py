@@ -311,3 +311,21 @@ def test_block_execution_state_identical_across_interpreters():
     native_out, python_out = outputs
     assert native_out[0] == python_out[0], "receipts differ"
     assert native_out[1] == python_out[1], "state changesets differ"
+
+
+def test_random_bytecode_differential_fuzz():
+    """Seeded differential fuzz: arbitrary byte programs (mostly invalid —
+    unknown opcodes, stack underflows, wild jumps, truncated PUSHes) must
+    produce identical outcomes on both interpreters. Complements the
+    per-family equivalence tests with coverage of the weird corners."""
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    # biased byte soup: plenty of real opcodes, some immediates
+    pool = list(range(0x00, 0x20)) + list(range(0x30, 0x60)) + \
+        [0x60, 0x61, 0x7F, 0x80, 0x90, 0xA0, 0xF3, 0xFD, 0x5B, 0x56, 0x57]
+    for trial in range(150):
+        n = int(rng.integers(1, 48))
+        code = bytes(int(rng.choice(pool)) for _ in range(n))
+        run_both(code, calldata=bytes(rng.integers(0, 256, 8, np.uint8)),
+                 gas=50_000)
