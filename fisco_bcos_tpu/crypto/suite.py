@@ -145,6 +145,15 @@ class CryptoSuite:
         if not self._use_device(len(leaves)):
             return merkle.merkle_levels_host(list(leaves), self.hash_name)[-1][0]
         arr = np.stack([np.frombuffer(l, np.uint8) for l in leaves])
+        mk = self._mesh()
+        if mk is not None:
+            import jax.numpy as jnp
+
+            n = arr.shape[0]
+            bucket = max(merkle.WIDTH, mk.n_devices,
+                         1 << (n - 1).bit_length())
+            return bytes(np.asarray(mk.merkle_root(
+                _pad_rows(arr, bucket), jnp.int32(n), self.hash_name)))
         return bytes(np.asarray(merkle.merkle_root(arr, self.hash_name)))
 
     # -- keys --------------------------------------------------------------

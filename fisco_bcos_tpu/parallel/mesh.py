@@ -9,10 +9,12 @@ loop sized by `txpool.verify_worker_num`
 scaling axis is the TPU **device mesh** — one `jax.sharding.Mesh` over the
 host's chips with the batch data-parallel on a "dp" axis. XLA inserts the
 ICI collectives; the kernels themselves are unchanged. Scope: the three
-SIGNATURE kernels (verify / SM2 verify / recover) are sharded — they
-dominate block validation; within them only the batched-inversion product
-tree couples batch elements, and its upper levels become cross-shard
-collectives. Hashing and the Merkle root stay single-device.
+SIGNATURE kernels (verify / SM2 verify / recover) and the MERKLE-ROOT
+reduction are sharded — they dominate block validation. The signature
+kernels are elementwise over the batch except the batched-inversion
+product tree, whose upper levels become cross-shard collectives; the
+Merkle tree's upper levels cross shards the same way (the
+sequence-parallel analogue). Per-message hashing stays single-device.
 
 `CryptoSuite(mesh_devices=N)` routes its device path through `MeshKernels`;
 the driver's `__graft_entry__.dryrun_multichip` exercises the same sharding
@@ -64,17 +66,21 @@ class MeshKernels:
         self._lock = threading.Lock()
         self._jax = jax
 
-    def _get(self, name: str, fn, n_mat: int, n_flat: int, out_spec):
-        """Sharded jit of fn(curve, *args): n_mat [B, L] args then n_flat
-        [B] args; out_spec mirrors the output structure."""
+    def _get(self, name: str, fn, n_mat: int, n_flat: int, out_spec,
+             static_argnums=0, in_shardings=None):
+        """Sharded jit of fn, cached by name. Default arg layout: a static
+        leading curve arg, then n_mat [B, L] args and n_flat [B] args;
+        pass explicit static_argnums/in_shardings for other shapes."""
         with self._lock:
             got = self._jits.get(name)
             if got is None:
+                if in_shardings is None:
+                    in_shardings = (self._data,) * n_mat \
+                        + (self._flat,) * n_flat
                 got = self._jax.jit(
                     fn.__wrapped__ if hasattr(fn, "__wrapped__") else fn,
-                    static_argnums=0,
-                    in_shardings=(self._data,) * n_mat
-                    + (self._flat,) * n_flat,
+                    static_argnums=static_argnums,
+                    in_shardings=in_shardings,
                     out_shardings=out_spec)
                 self._jits[name] = got
             return got
@@ -105,3 +111,18 @@ class MeshKernels:
         args = self._put((e, r, s), (self._data,) * 3) + self._put(
             (v,), (self._flat,))
         return fn(curve, *args)
+
+    def merkle_root(self, leaves, n, alg: str):
+        """Sharded width-16 tree reduction: leaves split on "dp", the
+        log-depth reduction's upper levels cross shards (the
+        sequence-parallel analogue of SURVEY §2's parallelism table)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops import merkle
+
+        rep = NamedSharding(self.mesh, P())
+        fn = self._get(f"merkle-{alg}", merkle._merkle_root_bucketed,
+                       0, 0, rep, static_argnums=(2,),
+                       in_shardings=(self._data, rep))
+        leaves = self._jax.device_put(leaves, self._data)
+        return fn(leaves, n, alg)

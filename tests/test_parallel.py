@@ -71,3 +71,19 @@ def test_mesh_bucket_padding_covers_small_batches():
     host = make_suite(backend="host")
     digests, sigs, pubs = _workload(host, 3, make_bad=False)
     assert meshed.verify_batch(digests, sigs, pubs).tolist() == [True] * 3
+
+
+def test_mesh_merkle_root_matches_host():
+    """The mesh-sharded Merkle reduction must produce the same root as
+    the host oracle for assorted leaf counts (incl. sub-mesh and
+    non-power-of-two)."""
+    from fisco_bcos_tpu.ops import merkle
+
+    meshed = make_suite(backend="device", device_min_batch=1,
+                        mesh_devices=8)
+    host = make_suite(backend="host")
+    rng = np.random.default_rng(31)
+    for n in (1, 3, 8, 17, 40, 64):
+        leaves = [rng.bytes(32) for _ in range(n)]
+        want = merkle.merkle_levels_host(list(leaves), "keccak256")[-1][0]
+        assert meshed.merkle_root(leaves) == want == host.merkle_root(leaves)
