@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""WASM interpreter throughput — completing the VM measurement story.
+
+The reference executes WASM contracts on the native BCOS-WASM VM with
+GasInjector metering (/root/reference/bcos-executor/src/vm/gas_meter/
+GasInjector.cpp); this framework's WASM path is the in-tree metered
+interpreter (executor/wasm_interp.py). Like benchmark/evm_bench.py did
+for the EVM, this quantifies the interpreter's budget instead of leaving
+it unknown:
+
+  * metered instructions/sec in a tight i32 loop,
+  * invocations/sec of a small exported function.
+
+Usage: python benchmark/wasm_bench.py [-n 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the hand-assembler lives with the VM tests (no wasm toolchain in-image)
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=20, help="timed invocations")
+    args = ap.parse_args()
+
+    from test_wasm_vm import I32, _Asm, c32  # noqa: E402
+
+    from fisco_bcos_tpu.executor.wasm_interp import Instance, Module
+
+    # loop(n): i32 countdown with an accumulator — 10 metered ops per
+    # iteration (verified against the interpreter's own gas charge below)
+    a = _Asm()
+    body = (
+        b"\x03\x40"            # loop void
+        + b"\x20\x00"          # local.get 0 (n)
+        + c32(1) + b"\x6b"     # i32.sub
+        + b"\x22\x00"          # local.tee 0
+        + b"\x20\x01" + b"\x20\x00" + b"\x6a" + b"\x21\x01"  # acc += n
+        + b"\x20\x00"          # local.get 0
+        + b"\x0d\x00"          # br_if 0
+        + b"\x0b"              # end loop
+        + b"\x20\x01"          # local.get 1 (acc)
+    )
+    a.func([I32], [I32], body, locals_=[I32])
+    a.exports = [("run", 0, 0)]
+    mod = Module(a.build())
+
+    args.n = max(1, args.n)
+    iters = 100_000
+    Instance(mod, {}, gas=10**9).invoke("run", [iters])  # warm-up
+    t0 = time.perf_counter()
+    for _ in range(args.n):
+        inst = Instance(mod, {}, gas=10**9)
+        (out,) = inst.invoke("run", [iters])
+    dt = (time.perf_counter() - t0) / args.n
+    gas_used = 10**9 - inst.gas
+    insns = gas_used  # every metered op costs 1: gas IS the op count
+
+    # small-call rate: same module, 1-iteration calls
+    small = Instance(mod, {}, gas=10**9)
+    t0 = time.perf_counter()
+    calls = args.n * 200
+    for _ in range(calls):
+        small.invoke("run", [1])
+    call_dt = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "wasm_interpreter",
+        "metered_insns_per_sec": round(insns / dt, 1),
+        "loop_calls_per_sec": round(1 / dt, 2),
+        "small_invocations_per_sec": round(calls / call_dt, 1),
+        "gas_metered_per_loop_call": gas_used,
+        "note": ("pure-Python metered interpreter (executor/wasm_interp); "
+                 "the EVM path has a native engine — WASM's native "
+                 "counterpart is future work, this quantifies the gap"),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
