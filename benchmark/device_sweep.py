@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Full BASELINE device sweep with incremental persistence.
+
+Runs the complete BASELINE.md config grid — secp256k1 verify+recover at
+1k/16k/64k, SM2 verify at 1k/16k/64k, Keccak256 Merkle root at 10k/64k
+leaves, plus small-batch points (64/256/1024) for the host/device
+crossover (VERDICT r3 weak #2) — and writes results to --out after EVERY
+config via atomic rename, so a tunnel wedge mid-sweep keeps everything
+measured so far.
+
+Configs are ordered headline-first (64k secp verify/recover, 64k SM2)
+so the most valuable numbers land even if the healthy window is short.
+
+Intended caller: tools/tpu_watcher.py, which probes the default backend
+(bounded) before launching this in a bounded child. Do NOT run bare on a
+host with a wedged tunnel — it will hang at jax import.
+
+Reference counterpart: benchmark/merkleBench.cpp + bcos-crypto/demo/
+perf_demo.cpp (the reference's CPU harnesses for the same grid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(_REPO, "BENCH_LAST_GOOD.json"))
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--skip-done", action="store_true",
+                    help="skip configs already recorded for this backend")
+    args = ap.parse_args()
+
+    import jax
+
+    import bench as bench_mod
+    from fisco_bcos_tpu.crypto import refimpl
+    from fisco_bcos_tpu.ops import ec, merkle
+
+    backend = jax.devices()[0].platform
+    bench_mod._LAST_GOOD = args.out  # save() routes through the shared lock
+    record: dict = {"backend": backend, "updated_at": _now(), "configs": {}}
+    if os.path.exists(args.out):
+        try:
+            prev = json.load(open(args.out))
+            if prev.get("backend") == backend:
+                record["configs"] = prev.get("configs", {})
+        except Exception:
+            pass
+
+    print(f"sweep: backend={backend} out={args.out}", flush=True)
+
+    def build_args(params, batch_n, sm=False):
+        return bench_mod.build_sig_args(params, batch_n, sm=sm)
+
+    def timed(fn, *fargs):
+        return bench_mod.timed_device(fn, *fargs, iters=args.iters)
+
+    def save(name: str, payload: dict) -> None:
+        payload["measured_at"] = _now()
+        record["configs"][name] = payload
+
+        def _merge(rec):
+            if rec.get("backend") != backend:
+                rec["configs"] = {}
+            rec["backend"] = backend
+            rec["updated_at"] = _now()
+            rec.setdefault("configs", {})[name] = dict(payload)
+            return rec
+
+        bench_mod.update_last_good(_merge)
+        print(f"sweep: {name}: {payload}", flush=True)
+
+    # CPU OpenSSL divisor for vs_baseline (same measurement as bench.py)
+    if not (args.skip_done and "cpu_baseline" in record["configs"]):
+        base, cores, src = bench_mod._measure_cpu_baseline()
+        save("cpu_baseline", {"sigs_per_sec": round(base, 1),
+                              "cores": cores, "source": src})
+
+    # -- EC configs, headline-first ----------------------------------------
+    ec_grid = [
+        ("secp_verify_65536", "secp", "verify", 65536),
+        ("secp_recover_65536", "secp", "recover", 65536),
+        ("sm2_verify_65536", "sm2", "verify", 65536),
+        ("secp_verify_16384", "secp", "verify", 16384),
+        ("sm2_verify_16384", "sm2", "verify", 16384),
+        ("secp_recover_16384", "secp", "recover", 16384),
+        ("secp_verify_1024", "secp", "verify", 1024),
+        ("sm2_verify_1024", "sm2", "verify", 1024),
+        ("secp_recover_1024", "secp", "recover", 1024),
+        # small batches: locate the host/device crossover
+        ("secp_verify_256", "secp", "verify", 256),
+        ("secp_verify_64", "secp", "verify", 64),
+    ]
+    for name, curve, op, batch in ec_grid:
+        if args.skip_done and name in record["configs"]:
+            continue
+        sm = curve == "sm2"
+        params = refimpl.SM2P256V1 if sm else refimpl.SECP256K1
+        cv = ec.SM2P256V1 if sm else ec.SECP256K1
+        e, r, s, v, qx, qy = build_args(params, batch, sm=sm)
+        if op == "verify":
+            fn = ec.sm2_verify_batch if sm else ec.ecdsa_verify_batch
+            dt, ok = timed(fn, cv, e, r, s, qx, qy)
+            assert bool(np.asarray(ok).all()), f"{name}: kernel rejected sigs"
+        else:
+            dt, rec = timed(ec.ecdsa_recover_batch, cv, e, r, s, v)
+            assert bool(np.asarray(rec[2]).all()), f"{name}: recover failed"
+        save(name, {"sigs_per_sec": round(batch / dt, 1),
+                    "batch": batch, "ms": round(dt * 1e3, 2)})
+
+    # -- Merkle configs ----------------------------------------------------
+    rng = np.random.default_rng(11)
+    for name, nleaves in [("merkle_keccak_10000", 10000),
+                          ("merkle_keccak_65536", 65536),
+                          ("merkle_sm3_10000", 10000)]:
+        if args.skip_done and name in record["configs"]:
+            continue
+        alg = "sm3" if "sm3" in name else "keccak256"
+        leaves = rng.integers(0, 256, (nleaves, 32), dtype=np.uint8)
+        leaves_d = jax.device_put(leaves)
+        dt, root = timed(merkle.merkle_root, leaves_d, alg)
+        host_root = merkle.merkle_levels_host(
+            [bytes(x) for x in leaves[:64]], alg)[-1][0]
+        dev_small = bytes(np.asarray(merkle.merkle_root(leaves[:64], alg)))
+        assert dev_small == host_root, f"{name}: device/host root mismatch"
+        save(name, {"ms_per_root": round(dt * 1e3, 2), "leaves": nleaves,
+                    "leaves_per_sec": round(nleaves / dt, 1)})
+
+    # -- derived: crossover estimate ---------------------------------------
+    cfgs = record["configs"]
+    floor = 5391.3  # native/ncrypto 1-core measured floor (BENCH_r03)
+    crossover = None
+    for b in (64, 256, 1024, 16384, 65536):
+        c = cfgs.get(f"secp_verify_{b}")
+        if c and c["sigs_per_sec"] > floor:
+            crossover = b
+            break
+    save("crossover", {"device_min_batch_suggest": crossover,
+                       "native_floor_sigs_per_sec": floor})
+    print("sweep: DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

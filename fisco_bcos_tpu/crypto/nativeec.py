@@ -37,6 +37,12 @@ def load_library():
         path = os.environ.get(_LIB_ENV, _DEFAULT_LIB)
         try:
             lib = ctypes.CDLL(path)
+            from ..utils.nativelib import check_src_hash
+            src = os.path.join(os.path.dirname(_DEFAULT_LIB), os.pardir,
+                               "ncrypto", "ncrypto.cpp")
+            if not check_src_hash(lib, "ncrypto", src):
+                _loaded = True
+                return None
             u8p = ctypes.POINTER(ctypes.c_uint8)
             lib.ncrypto_ecdsa_verify_batch.argtypes = [
                 ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p,

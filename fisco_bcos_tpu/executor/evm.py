@@ -109,6 +109,20 @@ def _mem_cost(words: int) -> int:
     return G_MEMORY * words + (words * words) // 512
 
 
+MEM_CAP = 1 << 34  # hard memory ceiling, lockstep with nevm.cpp Frame::extend
+
+
+def _gas_size(n: int) -> int:
+    """Validated attacker-chosen size for a gas multiply: anything beyond
+    the memory cap can never be paid for or materialised — out-of-gas
+    before any charge or slice allocation (lockstep with nevm.cpp
+    checked_size; the native side additionally needs this to keep
+    per*size products inside int64)."""
+    if n > MEM_CAP:
+        raise OutOfGas("out of gas")
+    return n
+
+
 class Memory:
     __slots__ = ("data", "_frame")
 
@@ -120,6 +134,8 @@ class Memory:
         if size == 0:
             return
         end = off + size
+        if end > MEM_CAP:
+            raise OutOfGas("out of gas")
         if end > len(self.data):
             old_words = (len(self.data) + 31) // 32
             new_words = (end + 31) // 32
@@ -567,7 +583,7 @@ class EVM:
                     f.push((v >> s) if s < 256 else (0 if v >= 0 else M256))
                 elif op == 0x20:  # KECCAK256
                     off, size = f.pop(), f.pop()
-                    f.use_gas(G_KECCAK + G_KECCAK_WORD * ((size + 31) // 32))
+                    f.use_gas(G_KECCAK + G_KECCAK_WORD * ((_gas_size(size) + 31) // 32))
                     f.push(int.from_bytes(
                         self.suite.hash(f.mem.read(off, size)), "big"))
                 elif op == 0x30:  # ADDRESS
@@ -595,14 +611,16 @@ class EVM:
                     f.push(len(calldata))
                 elif op == 0x37:  # CALLDATACOPY
                     d, s, n = f.pop(), f.pop(), f.pop()
-                    f.use_gas(G_VERYLOW + G_COPY_WORD * ((n + 31) // 32))
+                    f.use_gas(G_VERYLOW
+                              + G_COPY_WORD * ((_gas_size(n) + 31) // 32))
                     f.mem.write(d, calldata[s:s + n].ljust(n, b"\x00"))
                 elif op == 0x38:  # CODESIZE
                     f.use_gas(G_BASE)
                     f.push(len(code))
                 elif op == 0x39:  # CODECOPY
                     d, s, n = f.pop(), f.pop(), f.pop()
-                    f.use_gas(G_VERYLOW + G_COPY_WORD * ((n + 31) // 32))
+                    f.use_gas(G_VERYLOW
+                              + G_COPY_WORD * ((_gas_size(n) + 31) // 32))
                     f.mem.write(d, code[s:s + n].ljust(n, b"\x00"))
                 elif op == 0x3A:  # GASPRICE
                     f.use_gas(G_BASE)
@@ -613,7 +631,8 @@ class EVM:
                 elif op == 0x3C:  # EXTCODECOPY
                     a = _addr_bytes(f.pop())
                     d, s, n = f.pop(), f.pop(), f.pop()
-                    f.use_gas(G_EXTCODE + G_COPY_WORD * ((n + 31) // 32))
+                    f.use_gas(G_EXTCODE
+                              + G_COPY_WORD * ((_gas_size(n) + 31) // 32))
                     c = self.get_code(state, a)
                     f.mem.write(d, c[s:s + n].ljust(n, b"\x00"))
                 elif op == 0x3D:  # RETURNDATASIZE
@@ -621,7 +640,8 @@ class EVM:
                     f.push(len(f.ret))
                 elif op == 0x3E:  # RETURNDATACOPY
                     d, s, n = f.pop(), f.pop(), f.pop()
-                    f.use_gas(G_VERYLOW + G_COPY_WORD * ((n + 31) // 32))
+                    f.use_gas(G_VERYLOW
+                              + G_COPY_WORD * ((_gas_size(n) + 31) // 32))
                     if s + n > len(f.ret):
                         raise EVMError("returndata out of bounds")
                     f.mem.write(d, f.ret[s:s + n])
@@ -720,7 +740,7 @@ class EVM:
                     topics = [f.pop().to_bytes(32, "big")
                               for _ in range(ntopics)]
                     f.use_gas(G_LOG + G_LOG_TOPIC * ntopics
-                              + G_LOG_DATA * size)
+                              + G_LOG_DATA * _gas_size(size))
                     logs.append(LogEntry(address=address, topics=topics,
                                          data=f.mem.read(off, size)))
                 elif op == 0xF0 or op == 0xF5:  # CREATE / CREATE2
@@ -729,7 +749,8 @@ class EVM:
                     v = f.pop()
                     off, size = f.pop(), f.pop()
                     salt = f.pop() if op == 0xF5 else None
-                    f.use_gas(G_CREATE + G_INITCODE_WORD * ((size + 31) // 32))
+                    f.use_gas(G_CREATE
+                              + G_INITCODE_WORD * ((_gas_size(size) + 31) // 32))
                     init = f.mem.read(off, size)
                     gas_child = f.gas - f.gas // 64
                     f.use_gas(gas_child)

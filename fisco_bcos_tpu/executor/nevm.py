@@ -119,6 +119,12 @@ def load_library():
         path = os.environ.get(_LIB_ENV, _DEFAULT_LIB)
         try:
             lib = ctypes.CDLL(path)
+            from ..utils.nativelib import check_src_hash
+            src = os.path.join(os.path.dirname(_DEFAULT_LIB), os.pardir,
+                               "nevm", "nevm.cpp")
+            if not check_src_hash(lib, "nevm", src):
+                _lib_failed = True
+                return None
             lib.nevm_execute.restype = ctypes.c_int32
             lib.nevm_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
             lib.nevm_free.restype = None
@@ -413,6 +419,12 @@ def run_frame(evm, state, env, code: bytes, caller: bytes, address: bytes,
         # a host callback raised: real errors (storage failures etc.)
         # propagate exactly as they would from the Python interpreter
         raise exc
+    if result.status == 5:
+        # the catch-all backstop fired inside the interpreter: this is a
+        # native bug, never a consensus result — fail loudly, don't let it
+        # masquerade as a deterministic tx failure
+        err = result.error.decode(errors="replace")
+        raise RuntimeError(f"native EVM internal error: {err}")
     if result.status == 0:
         return EVMResult(True, output, result.gas_left, logs)
     err = result.error.decode(errors="replace")

@@ -35,6 +35,7 @@ ContextBuilder flavor produced its asio context).
 
 from __future__ import annotations
 
+import hmac
 import os
 import socket
 import struct
@@ -280,7 +281,9 @@ class SMSocket:
             seq, ct, tag = rec[:8], rec[8:-32], rec[-32:]
             if struct.unpack(">Q", seq)[0] != self._recv_seq:
                 raise SMTLSError("SM-TLS sequence violation (replay?)")
-            if self._tag(self._recv_mac, seq, ct) != tag:
+            # constant-time compare: rules out timing-assisted tag forgery
+            if not hmac.compare_digest(
+                    self._tag(self._recv_mac, seq, ct), tag):
                 raise SMTLSError("SM-TLS record MAC mismatch")
             self._recv_seq += 1
             self._rbuf = self._recv_cipher.ctr(seq + bytes(8), ct)

@@ -52,6 +52,12 @@ def _load() -> Optional[ctypes.CDLL]:
         except Exception as e:  # toolchain missing / build failure
             _lib_err = str(e)
             return None
+        from ..utils.nativelib import check_src_hash
+        if not check_src_hash(lib, "bcoskv",
+                              os.path.join(_NATIVE_DIR, "bcoskv",
+                                           "bcoskv.cpp")):
+            _lib_err = "stale binary (source hash mismatch)"
+            return None
         lib.bcoskv_open.restype = ctypes.c_void_p
         lib.bcoskv_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                     ctypes.c_uint64]

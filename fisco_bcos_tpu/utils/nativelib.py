@@ -1,0 +1,48 @@
+"""Native-library source-hash verification.
+
+The prebuilt ``native/build/lib*.so`` binaries are committed and
+auto-loaded; nothing else guarantees they match the checked-in C++
+sources. Since nevm/ncrypto carry consensus-critical semantics, a stale
+binary would silently change behavior that the tests then validate
+against itself. Each library therefore exports ``<name>_src_hash()``
+(sha256 of its source, stamped by native/Makefile); loaders call
+:func:`check_src_hash` and refuse a drifted binary unless
+``FBTPU_NATIVE_ALLOW_STALE=1``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+
+_ALLOW_STALE = "FBTPU_NATIVE_ALLOW_STALE"
+
+
+def check_src_hash(lib: ctypes.CDLL, name: str, src_path: str) -> bool:
+    """True if ``lib`` was built from the bytes currently at ``src_path``.
+
+    On mismatch (or an unstamped/old binary) returns False after printing
+    a loud warning — callers treat that as library-unavailable so the
+    pure-Python path runs instead — unless FBTPU_NATIVE_ALLOW_STALE=1.
+    """
+    try:
+        fn = getattr(lib, f"{name}_src_hash")
+    except AttributeError:
+        built = "unstamped"
+    else:
+        fn.restype = ctypes.c_char_p
+        built = (fn() or b"").decode()
+    try:
+        with open(src_path, "rb") as f:
+            want = hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return True  # source not shipped (binary-only install): trust
+    if built == want:
+        return True
+    import sys
+    print(f"[nativelib] {name}: binary/source hash mismatch "
+          f"(built={built[:16]}.. source={want[:16]}..) — "
+          f"{'ALLOWING (env override)' if os.environ.get(_ALLOW_STALE) == '1' else 'refusing stale binary, rebuild with `make -C native`'}",
+          file=sys.stderr, flush=True)
+    return os.environ.get(_ALLOW_STALE) == "1"

@@ -479,6 +479,14 @@ class Engine {
 
 extern "C" {
 
+#ifndef FBTPU_SRC_HASH
+#define FBTPU_SRC_HASH "unstamped"
+#endif
+// sha256 of the source this binary was built from (see native/Makefile);
+// Python loaders compare against the checked-in .cpp and refuse a
+// drifted binary so stale consensus-critical semantics fail loudly
+const char* bcoskv_src_hash(void) { return FBTPU_SRC_HASH; }
+
 void* bcoskv_open(const char* dir, uint64_t flush_bytes, uint64_t max_ssts) {
   auto* e = new bcoskv::Engine(dir, flush_bytes ? flush_bytes : (8u << 20),
                                max_ssts ? max_ssts : 8);

@@ -444,6 +444,15 @@ extern "C" {
 
 int ncrypto_available(void) { return 1; }
 
+#ifndef FBTPU_SRC_HASH
+#define FBTPU_SRC_HASH "unstamped"
+#endif
+// sha256 of the source this binary was built from (see native/Makefile);
+// Python loaders compare against the checked-in .cpp and refuse a
+// drifted binary so stale consensus-critical semantics fail loudly
+const char* ncrypto_src_hash(void) { return FBTPU_SRC_HASH; }
+
+
 // All arrays are count rows of 32 big-endian bytes; ok_out: count bytes.
 void ncrypto_ecdsa_verify_batch(int curve_id, uint64_t count,
                                 const uint8_t* es, const uint8_t* rs,

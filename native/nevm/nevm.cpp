@@ -412,6 +412,7 @@ struct Frame {
   explicit Frame(int64_t g) : gas(g) {}
 
   void use_gas(int64_t n) {
+    if (n < 0) throw EvmErr{"negative gas"};
     gas -= n;
     if (gas < 0) throw OutOfGas{};
   }
@@ -463,7 +464,20 @@ inline void addr_of(const U256& v, uint8_t out[20]) {
   memcpy(out, full + 12, 20);
 }
 
-inline uint64_t words32(uint64_t n) { return (n + 31) / 32; }
+// overflow-safe (n+31)/32: the naive form wraps to 0 for n > 2^64-32,
+// silently undercharging copy gas for adversarial sizes
+inline uint64_t words32(uint64_t n) { return n / 32 + (n % 32 != 0); }
+
+constexpr uint64_t MEM_CAP = 1ULL << 34;  // lockstep with Frame::extend
+
+// attacker-chosen size feeding a gas multiply: anything beyond the memory
+// cap can never be paid for or materialised — out-of-gas before any charge
+// or allocation, which also keeps per*size products inside int64
+// (lockstep with evm.py _gas_size)
+inline uint64_t checked_size(const U256& n_u) {
+  if (!n_u.fits_u64() || n_u.low64() > MEM_CAP) throw OutOfGas{};
+  return n_u.low64();
+}
 
 // code/calldata slice with Python's `buf[s:s+n].ljust(n, b"\0")` semantics
 std::string py_slice_pad(const uint8_t* buf, uint64_t len, const U256& s_u,
@@ -484,6 +498,15 @@ std::string py_slice_pad(const uint8_t* buf, uint64_t len, const U256& s_u,
 extern "C" {
 
 void nevm_free(uint8_t* p) { delete[] p; }
+
+#ifndef FBTPU_SRC_HASH
+#define FBTPU_SRC_HASH "unstamped"
+#endif
+// sha256 of the source this binary was built from (see native/Makefile);
+// Python loaders compare against the checked-in .cpp and refuse a
+// drifted binary so stale consensus-critical semantics fail loudly
+const char* nevm_src_hash(void) { return FBTPU_SRC_HASH; }
+
 
 // standalone hash entry points: the host-path CryptoSuite hashing
 // (tx/header hashes, address derivation) routes here when the library is
@@ -772,7 +795,7 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
         }
         case 0x20: {  // KECCAK256 (suite hash: keccak or sm3)
           U256 off = f.pop(), size = f.pop();
-          uint64_t n = size.fits_u64() ? size.low64() : ~0ULL;
+          uint64_t n = checked_size(size);
           f.use_gas(G_KECCAK + G_KECCAK_WORD * (int64_t)words32(n));
           std::string data = f.read_mem(off, size);
           uint8_t h[32];
@@ -817,9 +840,8 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           break;
         case 0x37: {  // CALLDATACOPY
           U256 d = f.pop(), s = f.pop(), n_u = f.pop();
-          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          uint64_t n = checked_size(n_u);
           f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
-          if (!n_u.fits_u64()) throw OutOfGas{};
           std::string blob = py_slice_pad(calldata, calldata_len, s, n);
           f.write_mem(d, (const uint8_t*)blob.data(), n);
           break;
@@ -830,9 +852,8 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           break;
         case 0x39: {  // CODECOPY
           U256 d = f.pop(), s = f.pop(), n_u = f.pop();
-          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          uint64_t n = checked_size(n_u);
           f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
-          if (!n_u.fits_u64()) throw OutOfGas{};
           std::string blob = py_slice_pad(code, code_len, s, n);
           f.write_mem(d, (const uint8_t*)blob.data(), n);
           break;
@@ -855,9 +876,8 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           uint8_t a20[20];
           addr_of(f.pop(), a20);
           U256 d = f.pop(), s = f.pop(), n_u = f.pop();
-          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          uint64_t n = checked_size(n_u);
           f.use_gas(G_EXTCODE + G_COPY_WORD * (int64_t)words32(n));
-          if (!n_u.fits_u64()) throw OutOfGas{};
           const uint8_t* c = nullptr;
           uint64_t clen = 0;
           hostcheck(host->get_code(host->ctx, a20, &c, &clen));
@@ -871,10 +891,10 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           break;
         case 0x3E: {  // RETURNDATACOPY
           U256 d = f.pop(), s = f.pop(), n_u = f.pop();
-          uint64_t n = n_u.fits_u64() ? n_u.low64() : ~0ULL;
+          uint64_t n = checked_size(n_u);
           f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
           // overflow-safe bounds: s + n > len without wrapping uint64
-          if (!s.fits_u64() || !n_u.fits_u64() ||
+          if (!s.fits_u64() ||
               s.low64() > f.ret.size() || n > f.ret.size() - s.low64())
             throw EvmErr{"returndata out of bounds"};
           f.write_mem(d, (const uint8_t*)f.ret.data() + s.low64(), n);
@@ -1029,10 +1049,9 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           U256 off = f.pop(), size = f.pop();
           uint8_t topics[4 * 32];
           for (int i = 0; i < ntopics; ++i) f.pop().to_be(topics + 32 * i);
-          uint64_t n = size.fits_u64() ? size.low64() : ~0ULL;
+          uint64_t n = checked_size(size);
           f.use_gas(G_LOG + G_LOG_TOPIC * ntopics +
                     G_LOG_DATA * (int64_t)n);
-          if (!size.fits_u64()) throw OutOfGas{};
           std::string data = f.read_mem(off, size);
           hostcheck(host->do_log(host->ctx, topics, ntopics,
                                  (const uint8_t*)data.data(), data.size()));
@@ -1044,7 +1063,7 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           U256 v = f.pop(), off = f.pop(), size = f.pop();
           uint8_t salt[32] = {0};
           if (op == 0xF5) f.pop().to_be(salt);
-          uint64_t n = size.fits_u64() ? size.low64() : ~0ULL;
+          uint64_t n = checked_size(size);
           f.use_gas(G_CREATE + G_INITCODE_WORD * (int64_t)words32(n));
           std::string init = f.read_mem(off, size);
           int64_t gas_child = f.gas - f.gas / 64;
@@ -1133,6 +1152,12 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
     return finish(3, "", 0, e.msg);
   } catch (HostErr&) {
     return finish(4, "", 0, "host error");
+  } catch (std::exception& e) {
+    // no C++ exception may ever cross the extern-C/ctypes boundary:
+    // std::terminate there aborts the whole node process
+    return finish(5, "", 0, e.what());
+  } catch (...) {
+    return finish(5, "", 0, "native internal error");
   }
 }
 

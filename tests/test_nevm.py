@@ -270,6 +270,67 @@ def test_returndatacopy_overflow_equivalent():
     assert not n.success and not p.success
 
 
+def test_copy_size_u64_wrap_oog_equivalent():
+    """CALLDATACOPY/CODECOPY/EXTCODECOPY with size in [2^64-31, 2^64-1]:
+    the naive (n+31)/32 wraps to 0 in uint64, undercharging gas and then
+    aborting the whole process via std::length_error across the FFI
+    boundary (single-tx node DoS + native/Python divergence). Both
+    interpreters must return out-of-gas."""
+    wrap = (1 << 64) - 1  # words32 wraps to 0 without the overflow fix
+    for op in (0x37, 0x39):  # CALLDATACOPY, CODECOPY
+        code = asm(push(wrap, 8), push(0, 1), push(0, 1), op)
+        n, p = run_both(code)
+        assert not n.success and not p.success
+        assert n.gas_left == 0 and p.gas_left == 0
+    # EXTCODECOPY pops the address first
+    code = asm(push(wrap, 8), push(0, 1), push(0, 1), push(0, 1), 0x3C)
+    n, p = run_both(code)
+    assert not n.success and not p.success
+
+
+def test_huge_size_gas_sites_oog_equivalent():
+    """Every attacker-chosen-size gas multiply (KECCAK256, LOG, CREATE,
+    RETURNDATACOPY) must OOG identically for sizes beyond the memory cap
+    — including the int64-overflow region (n >= 2^61) where the native
+    LOG charge was signed-overflow UB."""
+    huge = 1 << 61
+    cases = [
+        asm(push(huge, 8), push(0, 1), 0x20),             # KECCAK256
+        asm(push(huge, 8), push(0, 1), 0xA0),             # LOG0
+        asm(push(huge, 8), push(0, 1), push(0, 1), 0xF0),  # CREATE
+        asm(push(huge, 8), push(0, 1), push(0, 1), 0x3E),  # RETURNDATACOPY
+        # memory-cap in extend itself: MLOAD at off 2^34
+        asm(push(1 << 34, 8), 0x51),
+    ]
+    for code in cases:
+        n, p = run_both(code)
+        assert not n.success and not p.success, code.hex()
+        assert n.gas_left == 0 and p.gas_left == 0
+
+
+def test_stale_native_binary_refused(tmp_path, monkeypatch):
+    """A committed .so that drifts from the checked-in source must fail
+    loudly (refuse to load), not execute divergent consensus semantics."""
+    import ctypes
+
+    from fisco_bcos_tpu.utils.nativelib import check_src_hash
+
+    lib = ctypes.CDLL(os.environ.get(
+        "FBTPU_NEVM_LIB",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native", "build", "libnevm.so")))
+    real_src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "nevm", "nevm.cpp")
+    assert check_src_hash(lib, "nevm", real_src), \
+        "shipped binary should match shipped source"
+    drifted = tmp_path / "nevm.cpp"
+    drifted.write_bytes(open(real_src, "rb").read() + b"// drifted\n")
+    monkeypatch.delenv("FBTPU_NATIVE_ALLOW_STALE", raising=False)
+    assert not check_src_hash(lib, "nevm", str(drifted))
+    monkeypatch.setenv("FBTPU_NATIVE_ALLOW_STALE", "1")
+    assert check_src_hash(lib, "nevm", str(drifted))
+
+
 def test_block_execution_state_identical_across_interpreters():
     """Consensus safety for mixed fleets: executing the SAME block of
     contract txs with the native and Python interpreters must produce
