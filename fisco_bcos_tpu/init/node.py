@@ -67,7 +67,7 @@ class NodeConfig:
 class Node:
     def __init__(self, config: NodeConfig | None = None,
                  keypair=None, suite: CryptoSuite | None = None,
-                 gateway: Optional[Gateway] = None):
+                 gateway: Optional[Gateway] = None, storage=None):
         self.config = config or NodeConfig()
         cfg = self.config
         self.suite = suite or make_suite(
@@ -75,8 +75,12 @@ class Node:
             device_min_batch=cfg.device_min_batch,
             mesh_devices=cfg.crypto_mesh_devices)
         self.keypair = keypair or self.suite.generate_keypair()
-        self.storage = (WalStorage(cfg.storage_path) if cfg.storage_path
-                        else MemoryStorage())
+        # storage injection seam — the reference's StorageInitializer picks
+        # RocksDB vs TiKV (libinitializer/Initializer.cpp:145-261); callers
+        # pass e.g. a storage.sharded.ShardedStorage cluster for Max mode
+        self.storage = storage if storage is not None else (
+            WalStorage(cfg.storage_path) if cfg.storage_path
+            else MemoryStorage())
         self.ledger = Ledger(self.storage, self.suite)
         self.txpool = TxPool(self.suite, self.ledger, cfg.chain_id,
                              cfg.group_id, cfg.txpool_limit,

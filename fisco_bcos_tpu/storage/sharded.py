@@ -1,0 +1,415 @@
+"""Sharded distributed storage with Percolator-style two-phase commit.
+
+Reference counterpart: /root/reference/bcos-storage/bcos-storage/
+TiKVStorage.h:50-105 — Max mode commits blocks through a *distributed*
+transactional store: asyncPrepare stages the block's changeset across the
+storage cluster, a primary-keyed commit point decides the transaction, and
+crashed participants resolve their staged locks from that commit point.
+
+This module provides the same capability over the framework's own storage
+services:
+
+* :class:`DurablePrepareStorage` — shard-side wrapper making ``prepare``
+  durable (sidecar file, fsync'd before ack). A shard that crashes between
+  prepare and commit restarts with the staged changeset intact and reports
+  it via :meth:`pending` until the coordinator resolves it.
+* :class:`ShardServer` / :func:`make_shard_client` — the storage service
+  (services/storage_service.py) extended with the ``pending`` RPC.
+* :class:`ShardedStorage` — the coordinator: a drop-in
+  ``TransactionalStorage`` that hash-partitions keys over N shards,
+  fans scans out and merges, and drives 2PC with the TiKV commit-point
+  discipline: shard 0 is the primary; a block is committed iff the
+  primary's atomically-written commit-meta row exists with the staging
+  attempt's id. Recovery (:meth:`ShardedStorage.recover`) resolves any
+  shard's pending block from that row — commit on id match, rollback
+  otherwise.
+
+Commit-point argument (why this is crash-safe, mirroring Percolator):
+``prepare`` stages on the participating shards durably, tagged with a
+fresh attempt id; ``commit`` applies the primary first — its engine's 2PC
+writes the data AND the commit-meta row (value = attempt id) in one atomic
+record — then the secondaries. Once the primary returns, the block IS
+committed: secondary failures are remembered, never surfaced as commit
+failure (surfacing one would make the scheduler roll back a decided
+block), and converge through :meth:`recover`. Whatever subset of
+[coordinator, shards] crashes, every staged block is decided by one
+durable row on the primary.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+import threading
+import zlib
+from typing import Iterator, Optional
+
+from ..codec.wire import Reader, Writer
+from ..utils.log import LOG, badge
+from .interface import ChangeSet, Entry, TransactionalStorage
+
+#: primary-shard table holding one row per committed block (the commit point)
+COMMIT_META = "__commit_meta__"
+
+#: committed meta rows older than the newest KEEP are pruned (recovery only
+#: ever needs rows for blocks still pending on some shard)
+META_KEEP = 64
+
+_SIDE_HDR = struct.Struct("<IQ")
+_SIDECAR_RE = re.compile(r"^prepared_(\d+)\.bin$")
+
+
+def _meta_key(block_number: int) -> bytes:
+    return struct.pack(">Q", block_number)
+
+
+def _encode_staged(block_number: int, attempt: bytes,
+                   changes: ChangeSet) -> bytes:
+    from ..services.storage_service import _write_changeset
+
+    w = Writer()
+    w.i64(block_number).blob(attempt)
+    _write_changeset(w, changes)
+    return w.bytes()
+
+
+def _decode_staged(payload: bytes) -> tuple[int, bytes, ChangeSet]:
+    from ..services.storage_service import _read_changeset
+
+    r = Reader(payload)
+    block_number = r.i64()
+    attempt = r.blob()
+    return block_number, attempt, _read_changeset(r)
+
+
+class DurablePrepareStorage(TransactionalStorage):
+    """Make any local engine's ``prepare`` crash-durable.
+
+    The inner engines (WalStorage, native bcoskv) stage prepared
+    changesets in memory — fine single-node, where an unfinished block
+    simply re-executes. A 2PC *participant* must instead survive a crash
+    between prepare and commit with the staged writes intact, because the
+    transaction may already be decided elsewhere. Each prepare is written
+    to ``<dir>/prepared_<n>.bin`` (crc-framed, fsync'd) before ack;
+    restart re-injects it and lists it in :meth:`pending` together with
+    the staging attempt id.
+    """
+
+    def __init__(self, inner: TransactionalStorage, path: str):
+        self.inner = inner
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending: dict[int, bytes] = {}  # block -> attempt id
+        for fname in sorted(os.listdir(path)):
+            fp = os.path.join(path, fname)
+            if fname.endswith(".tmp"):
+                os.remove(fp)  # crash mid-prepare: never acked
+                continue
+            if not _SIDECAR_RE.match(fname):
+                continue
+            with open(fp, "rb") as f:
+                raw = f.read()
+            if len(raw) < _SIDE_HDR.size:
+                os.remove(fp)
+                continue
+            crc, ln = _SIDE_HDR.unpack_from(raw, 0)
+            payload = raw[_SIDE_HDR.size:_SIDE_HDR.size + ln]
+            if len(payload) != ln or zlib.crc32(payload) != crc:
+                os.remove(fp)
+                continue
+            n, attempt, cs = _decode_staged(payload)
+            self.inner.prepare(n, cs)
+            self._pending[n] = attempt
+
+    def _sidecar(self, block_number: int) -> str:
+        return os.path.join(self.path, f"prepared_{block_number}.bin")
+
+    def _drop_sidecar(self, block_number: int) -> None:
+        try:
+            os.remove(self._sidecar(block_number))
+        except FileNotFoundError:
+            pass
+
+    # -- TransactionalStorage ---------------------------------------------
+    def prepare(self, block_number: int, changes: ChangeSet,
+                attempt: bytes = b"") -> None:
+        payload = _encode_staged(block_number, attempt, changes)
+        tmp = self._sidecar(block_number) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_SIDE_HDR.pack(zlib.crc32(payload), len(payload)))
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._sidecar(block_number))
+        with self._lock:
+            self.inner.prepare(block_number, changes)
+            self._pending[block_number] = attempt
+
+    def commit(self, block_number: int) -> None:
+        with self._lock:
+            self.inner.commit(block_number)
+            self._pending.pop(block_number, None)
+        self._drop_sidecar(block_number)
+
+    def rollback(self, block_number: int) -> None:
+        with self._lock:
+            self.inner.rollback(block_number)
+            self._pending.pop(block_number, None)
+        self._drop_sidecar(block_number)
+
+    def pending(self) -> list[tuple[int, bytes]]:
+        """Durably-prepared, undecided blocks: [(number, attempt id)]."""
+        with self._lock:
+            return sorted(self._pending.items())
+
+    # -- plain delegation --------------------------------------------------
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        return self.inner.get(table, key)
+
+    def set(self, table: str, key: bytes, value: bytes) -> None:
+        self.inner.set(table, key, value)
+
+    def remove(self, table: str, key: bytes) -> None:
+        self.inner.remove(table, key)
+
+    def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
+        return self.inner.keys(table, prefix)
+
+    def get_batch(self, table: str, ks):
+        return self.inner.get_batch(table, ks)
+
+    def set_batch(self, table: str, items) -> None:
+        self.inner.set_batch(table, items)
+
+    def remove_batch(self, table: str, ks) -> None:
+        self.inner.remove_batch(table, ks)
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close:
+            close()
+
+
+class ShardServer:
+    """A storage shard as a service: StorageServer + ``prepare2``
+    (attempt-tagged durable prepare) + the ``pending`` RPC."""
+
+    def __init__(self, backend: DurablePrepareStorage,
+                 host: str = "127.0.0.1", port: int = 0):
+        from ..services.storage_service import StorageServer, _read_changeset
+
+        self._ss = StorageServer(backend, host, port)
+        self.backend = backend
+        self._read_changeset = _read_changeset
+        self._ss.server.register("pending", self._pending)
+        self._ss.server.register("prepare2", self._prepare2)
+
+    def _pending(self, r: Reader, w: Writer) -> None:
+        w.seq(self.backend.pending(),
+              lambda ww, item: ww.i64(item[0]).blob(item[1]))
+
+    def _prepare2(self, r: Reader, w: Writer) -> None:
+        number = r.i64()
+        attempt = r.blob()
+        self.backend.prepare(number, self._read_changeset(r),
+                             attempt=attempt)
+
+    @property
+    def port(self) -> int:
+        return self._ss.port
+
+    def start(self) -> None:
+        self._ss.start()
+
+    def stop(self) -> None:
+        self._ss.stop()
+
+
+def make_shard_client(host: str, port: int, timeout: float = 30.0):
+    """RemoteStorage extended with attempt-tagged prepare + ``pending``."""
+    from ..services.storage_service import RemoteStorage, _write_changeset
+
+    class ShardClient(RemoteStorage):
+        def prepare(self, block_number: int, changes: ChangeSet,
+                    attempt: bytes = b"") -> None:
+            self.client.call(
+                "prepare2",
+                lambda w: (w.i64(block_number), w.blob(attempt),
+                           _write_changeset(w, changes)))
+
+        def pending(self) -> list[tuple[int, bytes]]:
+            r = self.client.call("pending", None)
+            return [(it[0], it[1]) for it in
+                    r.seq(lambda rr: (rr.i64(), rr.blob()))]
+
+    return ShardClient(host, port, timeout)
+
+
+class ShardedStorage(TransactionalStorage):
+    """Coordinator over N shards (local DurablePrepareStorage instances or
+    ShardClients — anything with the TransactionalStorage + attempt-tagged
+    prepare + pending() surface). Shard 0 is the primary/commit point."""
+
+    def __init__(self, shards: list, recover: bool = True):
+        if not shards:
+            raise ValueError("need at least one shard")
+        self.shards = shards
+        self._lock = threading.Lock()
+        # per-staged-block coordinator state (participants / attempt id)
+        self._staged: dict[int, tuple[bytes, list[int]]] = {}
+        # blocks decided at the primary whose secondaries still need
+        # convergence (shard was unreachable at commit time)
+        self.unresolved: set[int] = set()
+        self._meta_floor: Optional[int] = None
+        if recover:
+            self.recover()
+
+    # -- routing -----------------------------------------------------------
+    def _shard_of(self, table: str, key: bytes) -> int:
+        if table == COMMIT_META:
+            return 0
+        h = zlib.crc32(table.encode() + b"\x00" + key)
+        return h % len(self.shards)
+
+    # -- reads / direct writes --------------------------------------------
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        return self.shards[self._shard_of(table, key)].get(table, key)
+
+    def set(self, table: str, key: bytes, value: bytes) -> None:
+        self.shards[self._shard_of(table, key)].set(table, key, value)
+
+    def remove(self, table: str, key: bytes) -> None:
+        self.shards[self._shard_of(table, key)].remove(table, key)
+
+    def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
+        seen = set()
+        for sh in self.shards:
+            seen.update(sh.keys(table, prefix))
+        return iter(sorted(seen))
+
+    def get_batch(self, table: str, ks) -> list:
+        ks = list(ks)
+        by_shard: dict[int, list[int]] = {}
+        for i, k in enumerate(ks):
+            by_shard.setdefault(self._shard_of(table, k), []).append(i)
+        out: list = [None] * len(ks)
+        for sid, idxs in by_shard.items():
+            vals = self.shards[sid].get_batch(table, [ks[i] for i in idxs])
+            for i, v in zip(idxs, vals):
+                out[i] = v
+        return out
+
+    def set_batch(self, table: str, items) -> None:
+        by_shard: dict[int, list] = {}
+        for k, v in items:
+            by_shard.setdefault(self._shard_of(table, k), []).append((k, v))
+        for sid, part in by_shard.items():
+            self.shards[sid].set_batch(table, part)
+
+    def remove_batch(self, table: str, ks) -> None:
+        by_shard: dict[int, list] = {}
+        for k in ks:
+            by_shard.setdefault(self._shard_of(table, k), []).append(k)
+        for sid, part in by_shard.items():
+            self.shards[sid].remove_batch(table, part)
+
+    # -- distributed 2PC ---------------------------------------------------
+    def _split(self, changes: ChangeSet) -> list[ChangeSet]:
+        parts: list[ChangeSet] = [dict() for _ in self.shards]
+        for (table, key), e in changes.items():
+            parts[self._shard_of(table, key)][(table, key)] = e
+        return parts
+
+    def prepare(self, block_number: int, changes: ChangeSet) -> None:
+        with self._lock:
+            attempt = os.urandom(8)
+            parts = self._split(changes)
+            # the primary's atomic commit record carries the commit point:
+            # block decided <=> this row exists with this attempt's id
+            parts[0][(COMMIT_META, _meta_key(block_number))] = Entry(attempt)
+            participants = [i for i, p in enumerate(parts) if p]
+            for i in participants:
+                self.shards[i].prepare(block_number, parts[i],
+                                       attempt=attempt)
+            self._staged[block_number] = (attempt, participants)
+
+    def commit(self, block_number: int) -> None:
+        with self._lock:
+            _, participants = self._staged.pop(
+                block_number, (b"", range(len(self.shards))))
+            # primary first: once this returns, the block IS committed.
+            # Secondary failures below are remembered for recover(), never
+            # surfaced — raising would make the scheduler roll back and
+            # retry a block the cluster has already decided.
+            self.shards[0].commit(block_number)
+            for i in participants:
+                if i == 0:
+                    continue
+                try:
+                    self.shards[i].commit(block_number)
+                except Exception:  # noqa: BLE001 — converges via recover()
+                    LOG.exception(badge("SHARD", "secondary-commit-failed",
+                                        shard=i, number=block_number))
+                    self.unresolved.add(block_number)
+            if not self.unresolved:
+                self._prune_meta(block_number)
+
+    def rollback(self, block_number: int) -> None:
+        with self._lock:
+            _, participants = self._staged.pop(
+                block_number, (b"", range(len(self.shards))))
+            for i in participants:
+                try:
+                    self.shards[i].rollback(block_number)
+                except Exception:  # noqa: BLE001 — converges via recover()
+                    LOG.exception(badge("SHARD", "shard-rollback-failed",
+                                        shard=i, number=block_number))
+                    self.unresolved.add(block_number)
+
+    def recover(self) -> list[tuple[int, int, bool]]:
+        """Resolve every shard's pending blocks from the primary commit
+        point. -> [(shard, block_number, committed)] decisions taken."""
+        decisions = []
+        with self._lock:
+            for sid, sh in enumerate(self.shards):
+                for n, attempt in sh.pending():
+                    meta = self.shards[0].get(COMMIT_META, _meta_key(n))
+                    committed = meta is not None and meta == attempt
+                    if committed:
+                        sh.commit(n)
+                    else:
+                        sh.rollback(n)
+                    decisions.append((sid, n, committed))
+            self.unresolved.clear()
+        return decisions
+
+    def _prune_meta(self, latest: int) -> None:
+        """Drop commit-meta rows no longer needed for recovery (everything
+        older than the newest META_KEEP); called with the lock held."""
+        cutoff = latest - META_KEEP
+        if cutoff <= 0:
+            return
+        if self._meta_floor is None:
+            try:
+                first = next(iter(self.shards[0].keys(COMMIT_META)), None)
+            except Exception:  # noqa: BLE001 — pruning is best-effort
+                return
+            self._meta_floor = (struct.unpack(">Q", first)[0]
+                                if first else cutoff)
+        if self._meta_floor >= cutoff:
+            return
+        try:
+            self.shards[0].remove_batch(
+                COMMIT_META,
+                [_meta_key(n) for n in range(self._meta_floor, cutoff)])
+            self._meta_floor = cutoff
+        except Exception:  # noqa: BLE001
+            LOG.exception(badge("SHARD", "meta-prune-failed"))
+
+    def close(self) -> None:
+        for sh in self.shards:
+            close = getattr(sh, "close", None)
+            if close:
+                close()

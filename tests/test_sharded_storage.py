@@ -1,0 +1,343 @@
+"""Distributed sharded storage: routing, 2PC, crash recovery, chain use.
+
+Reference counterpart: TiKVStorage.h:50-105 — Max mode's distributed
+transactional commit. The suite verifies the Percolator-style commit-point
+discipline end to end: durable prepare on shards, primary-decides commit,
+recovery converging crashed participants, and a PBFT chain committing
+blocks through a 3-shard cluster.
+"""
+
+import threading
+import time
+
+import pytest
+
+from fisco_bcos_tpu.storage.interface import Entry, EntryStatus
+from fisco_bcos_tpu.storage.sharded import (
+    COMMIT_META,
+    META_KEEP,
+    DurablePrepareStorage,
+    ShardServer,
+    ShardedStorage,
+    make_shard_client,
+)
+from fisco_bcos_tpu.storage.wal import WalStorage
+
+
+def make_local_cluster(tmp_path, n=3):
+    shards = [
+        DurablePrepareStorage(WalStorage(str(tmp_path / f"s{i}" / "wal")),
+                              str(tmp_path / f"s{i}" / "prep"))
+        for i in range(n)
+    ]
+    return ShardedStorage(shards)
+
+
+def cs(*items):
+    out = {}
+    for table, key, value in items:
+        out[(table, key)] = (Entry(b"", EntryStatus.DELETED)
+                             if value is None else Entry(value))
+    return out
+
+
+ROWS = [("t_acct", f"k{i:03d}".encode(), f"v{i}".encode())
+        for i in range(40)]
+
+
+def test_routing_and_scan_merge(tmp_path):
+    st = make_local_cluster(tmp_path)
+    st.set_batch("t_acct", [(k, v) for _, k, v in ROWS])
+    # every key readable through the coordinator
+    for _, k, v in ROWS:
+        assert st.get("t_acct", k) == v
+    # rows actually spread over all shards (not piled on one)
+    counts = [sum(1 for _ in sh.keys("t_acct")) for sh in st.shards]
+    assert all(c > 0 for c in counts), counts
+    assert sum(counts) == len(ROWS)
+    # merged scan is sorted + complete; prefix scans filter
+    assert list(st.keys("t_acct")) == sorted(k for _, k, _ in ROWS)
+    assert list(st.keys("t_acct", b"k00")) == [
+        k for _, k, _ in ROWS if k.startswith(b"k00")]
+    got = st.get_batch("t_acct", [k for _, k, _ in ROWS][::-1])
+    assert got == [v for _, _, v in ROWS][::-1]
+    st.close()
+
+
+def test_2pc_commit_and_rollback(tmp_path):
+    st = make_local_cluster(tmp_path)
+    st.prepare(7, cs(("t", b"a", b"1"), ("t", b"b", b"2"),
+                     ("t", b"c", b"3"), ("t", b"d", None)))
+    # nothing visible before commit
+    assert st.get("t", b"a") is None
+    st.commit(7)
+    assert [st.get("t", k) for k in (b"a", b"b", b"c", b"d")] == \
+        [b"1", b"2", b"3", None]
+    # commit point durable on the primary (value = attempt id)
+    meta = st.get(COMMIT_META, (7).to_bytes(8, "big"))
+    assert meta is not None and len(meta) == 8
+    st.prepare(8, cs(("t", b"a", b"X")))
+    st.rollback(8)
+    assert st.get("t", b"a") == b"1"
+    st.close()
+
+
+def test_crash_before_primary_commit_rolls_back(tmp_path):
+    st = make_local_cluster(tmp_path)
+    st.prepare(5, cs(*[("t", k, v) for _, k, v in ROWS[:10]]))
+    st.close()  # coordinator dies before ANY commit
+    st2 = make_local_cluster(tmp_path)  # restart: recover() runs in ctor
+    for _, k, _ in ROWS[:10]:
+        assert st2.get("t", k) is None
+    assert all(not sh.pending() for sh in st2.shards)
+    st2.close()
+
+
+def test_crash_after_primary_commit_completes(tmp_path):
+    st = make_local_cluster(tmp_path)
+    changes = cs(*[("t", k, v) for _, k, v in ROWS[:10]])
+    st.prepare(5, changes)
+    # simulate coordinator crash between primary and secondary commits
+    st.shards[0].commit(5)
+    st.close()
+    st2 = make_local_cluster(tmp_path)  # recover() runs in ctor
+    for _, k, v in ROWS[:10]:
+        assert st2.get("t", k) == v, k
+    assert all(not sh.pending() for sh in st2.shards)
+    st2.close()
+
+
+def test_durable_prepare_survives_restart(tmp_path):
+    inner = WalStorage(str(tmp_path / "wal"))
+    d = DurablePrepareStorage(inner, str(tmp_path / "prep"))
+    d.prepare(3, cs(("t", b"x", b"y")))
+    d.close()  # crash with staged block
+    d2 = DurablePrepareStorage(WalStorage(str(tmp_path / "wal")),
+                               str(tmp_path / "prep"))
+    assert d2.pending() == [(3, b"")]
+    d2.commit(3)  # decision arrives from recovery
+    assert d2.get("t", b"x") == b"y"
+    assert d2.pending() == []
+    d2.close()
+    # re-restart: nothing pending, data persisted
+    d3 = DurablePrepareStorage(WalStorage(str(tmp_path / "wal")),
+                               str(tmp_path / "prep"))
+    assert d3.pending() == [] and d3.get("t", b"x") == b"y"
+    d3.close()
+
+
+def test_torn_tmp_sidecar_cleaned_on_restart(tmp_path):
+    """A crash mid-prepare leaves prepared_<n>.bin.tmp; restart must NOT
+    treat it as a staged block (and must delete it)."""
+    d = DurablePrepareStorage(WalStorage(str(tmp_path / "wal")),
+                              str(tmp_path / "prep"))
+    d.prepare(4, cs(("t", b"x", b"y")))
+    d.close()
+    # fake a crash mid-prepare of block 9: valid-CRC .tmp never renamed
+    import os as _os
+    from fisco_bcos_tpu.storage.sharded import _SIDE_HDR, _encode_staged
+    import zlib as _zlib
+    payload = _encode_staged(9, b"deadbeef", cs(("t", b"z", b"w")))
+    with open(str(tmp_path / "prep" / "prepared_9.bin.tmp"), "wb") as f:
+        f.write(_SIDE_HDR.pack(_zlib.crc32(payload), len(payload)) + payload)
+    d2 = DurablePrepareStorage(WalStorage(str(tmp_path / "wal")),
+                               str(tmp_path / "prep"))
+    assert [n for n, _ in d2.pending()] == [4]
+    assert not _os.path.exists(str(tmp_path / "prep" / "prepared_9.bin.tmp"))
+    d2.close()
+
+
+def test_stale_attempt_rolled_back_not_committed(tmp_path):
+    """A shard staging attempt A must not be committed by recovery when the
+    primary's commit point records attempt B for the same height."""
+    st = make_local_cluster(tmp_path)
+    st.prepare(6, cs(("t", b"k1", b"old")))
+    attempt_a = dict(st.shards[1].pending()).get(6) or \
+        dict(st.shards[2].pending()).get(6) or \
+        dict(st.shards[0].pending())[6]
+    st.rollback(6)
+    # stage the same height again with different content; commit it
+    st.prepare(6, cs(("t", b"k1", b"new")))
+    st.commit(6)
+    assert st.get("t", b"k1") == b"new"
+    # resurrect a stale staging of height 6 on its owning shard
+    owner = st._shard_of("t", b"k1")
+    st.shards[owner].prepare(6, cs(("t", b"k1", b"old")),
+                             attempt=attempt_a)
+    decisions = st.recover()
+    assert (owner, 6, False) in decisions  # rolled back, not committed
+    assert st.get("t", b"k1") == b"new"
+    st.close()
+
+
+def test_commit_meta_pruned(tmp_path):
+    st = make_local_cluster(tmp_path)
+    n_blocks = META_KEEP + 20
+    for n in range(1, n_blocks + 1):
+        st.prepare(n, cs(("t", b"k%d" % n, b"v")))
+        st.commit(n)
+    metas = list(st.shards[0].keys(COMMIT_META))
+    assert len(metas) <= META_KEEP + 1, len(metas)
+    # newest rows retained for recovery
+    assert (n_blocks).to_bytes(8, "big") in metas
+    st.close()
+
+
+def test_socket_cluster_shard_killed_between_prepare_and_commit(tmp_path):
+    """The VERDICT's done-criterion: kill one shard between prepare and
+    commit, restart it, and verify block atomicity via recover()."""
+    def spawn(i):
+        backend = DurablePrepareStorage(
+            WalStorage(str(tmp_path / f"s{i}" / "wal")),
+            str(tmp_path / f"s{i}" / "prep"))
+        srv = ShardServer(backend)
+        srv.start()
+        return srv
+
+    servers = [spawn(i) for i in range(3)]
+    ports = [s.port for s in servers]
+    st = ShardedStorage([make_shard_client("127.0.0.1", p) for p in ports])
+
+    changes = cs(*[("t", k, v) for _, k, v in ROWS])
+    # find a victim secondary that actually owns rows
+    parts = st._split(changes)
+    victim = next(i for i in (1, 2) if parts[i])
+    st.prepare(11, changes)
+    servers[victim].stop()
+    servers[victim].backend.close()
+    # commit succeeds: the block is decided at the primary; the dead
+    # secondary is queued for convergence, NOT surfaced as failure
+    st.commit(11)
+    assert 11 in st.unresolved
+    assert st.get(COMMIT_META, (11).to_bytes(8, "big")) is not None
+
+    # restart the victim on the same directories
+    servers[victim] = spawn(victim)
+    st.shards[victim] = make_shard_client("127.0.0.1",
+                                          servers[victim].port)
+    decisions = st.recover()
+    assert (victim, 11, True) in decisions
+    for _, k, v in ROWS:
+        assert st.get("t", k) == v
+    st.close()
+    for s in servers:
+        s.stop()
+        s.backend.close()
+
+
+def test_four_node_pbft_chain_over_socket_shard_cluster(tmp_path):
+    """VERDICT r3 done-criterion: a 4-node PBFT chain committing through a
+    3-shard storage cluster (real sockets for the sharded node)."""
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+    from fisco_bcos_tpu.net.gateway import FakeGateway
+    from fisco_bcos_tpu.protocol import Transaction
+
+    servers = []
+    for i in range(3):
+        backend = DurablePrepareStorage(
+            WalStorage(str(tmp_path / f"s{i}" / "wal")),
+            str(tmp_path / f"s{i}" / "prep"))
+        srv = ShardServer(backend)
+        srv.start()
+        servers.append(srv)
+    sharded = ShardedStorage(
+        [make_shard_client("127.0.0.1", s.port) for s in servers])
+
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    keypairs = [suite.generate_keypair(bytes([i + 1]) * 16)
+                for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    nodes = []
+    for i, kp in enumerate(keypairs):
+        node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                               min_seal_time=0.0, view_timeout=2.0),
+                    keypair=kp, gateway=gateway,
+                    storage=sharded if i == 0 else None)
+        node.build_genesis(sealers)
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    try:
+        kp = suite.generate_keypair(b"shard-pbft-user")
+        tx = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call("register",
+                                 lambda w: w.blob(b"acct").u64(55)),
+            nonce="n1",
+            block_limit=nodes[0].ledger.current_number() + 100,
+        ).sign(suite, kp)
+        res = nodes[0].send_transaction(tx)
+        assert res.status == 0, res
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(n.ledger.current_number() >= 1 for n in nodes):
+                break
+            time.sleep(0.1)
+        assert all(n.ledger.current_number() >= 1 for n in nodes), \
+            [n.ledger.current_number() for n in nodes]
+        # the sharded node's committed header matches the plain nodes'
+        hashes = {n.ledger.header_by_number(1).hash(suite) for n in nodes}
+        assert len(hashes) == 1
+        rc = nodes[0].ledger.receipt(tx.hash(suite))
+        assert rc is not None and rc.status == 0
+        # block data really landed across the shard services
+        populated = sum(
+            1 for s in servers
+            if any(any(True for _ in s.backend.keys(t))
+                   for t in ("s_number_2_header", "s_hash_2_tx",
+                             "s_hash_2_receipt")))
+        assert populated >= 2
+    finally:
+        for node in nodes:
+            node.stop()
+        gateway.stop()
+        sharded.close()
+        for s in servers:
+            s.stop()
+            s.backend.close()
+
+
+def test_chain_commits_through_sharded_cluster(tmp_path):
+    """A node sealing real blocks with a 3-shard storage cluster as its
+    transactional backend: ledger schema, receipts and state all live
+    partitioned across shards."""
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol import Transaction
+
+    st = make_local_cluster(tmp_path)
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0),
+                storage=st)
+    node.start()
+    try:
+        kp = node.suite.generate_keypair(b"shard-user")
+        receipts = []
+        for i in range(3):
+            tx = Transaction(
+                to=pc.BALANCE_ADDRESS,
+                input=pc.encode_call(
+                    "register", lambda w: w.blob(b"acct%d" % i).u64(100)),
+                nonce=f"n{i}",
+                block_limit=node.ledger.current_number() + 100,
+            ).sign(node.suite, kp)
+            r = node.send_transaction(tx)
+            assert r.status == 0, r
+            rec = node.txpool.wait_for_receipt(r.tx_hash, 15)
+            assert rec is not None and rec.status == 0
+            receipts.append(rec)
+        assert node.ledger.current_number() >= 1
+        # data genuinely distributed: >1 shard holds rows
+        chain_tables = ("s_number_2_header", "s_hash_2_tx",
+                        "s_hash_2_receipt", "s_balance")
+        populated = sum(
+            1 for sh in st.shards
+            if any(any(True for _ in sh.inner.keys(t))
+                   for t in chain_tables))
+        assert populated >= 2
+    finally:
+        node.stop()
+        st.close()
