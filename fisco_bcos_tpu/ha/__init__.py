@@ -1,1 +1,2 @@
 from .election import FileLeaseElection, LeaderElection  # noqa: F401
+from .quorum import LeaseRegistryServer, QuorumLeaseElection  # noqa: F401

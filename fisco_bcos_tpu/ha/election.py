@@ -51,13 +51,14 @@ class LeaderElection:
         raise NotImplementedError
 
 
-class FileLeaseElection(LeaderElection):
-    def __init__(self, lease_path: str, member_id: str,
-                 lease_ttl: float = 3.0, heartbeat: float = 1.0):
-        self.path = lease_path
+class ElectionStateMachine(LeaderElection):
+    """Shared leader-flag / fence / callback plumbing for all backends, so
+    file-lease and quorum-lease behave identically behind the interface
+    (promotion fires on_elected; an involuntary demotion fires on_seized;
+    a clean shutdown demotes quiet)."""
+
+    def __init__(self, member_id: str):
         self.member_id = member_id
-        self.ttl = lease_ttl
-        self.heartbeat = heartbeat
         self._elected_cbs: list[Callable[[], None]] = []
         self._seized_cbs: list[Callable[[], None]] = []
         self._leader = False
@@ -66,12 +67,55 @@ class FileLeaseElection(LeaderElection):
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
-    # -- callbacks ----------------------------------------------------------
     def on_elected(self, cb: Callable[[], None]) -> None:
         self._elected_cbs.append(cb)
 
     def on_seized(self, cb: Callable[[], None]) -> None:
         self._seized_cbs.append(cb)
+
+    def _promote(self, fence: int) -> None:
+        with self._lock:
+            if self._stop.is_set():
+                return  # stopping: a late in-flight round must not win
+            self._leader = True
+            self._fence = fence
+        LOG.info(badge("ELECTION", "elected", member=self.member_id,
+                       fence=fence, backend=type(self).__name__))
+        for cb in self._elected_cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — callbacks are user code
+                LOG.exception(badge("ELECTION", "elected-cb-failed"))
+
+    def _demote(self, quiet: bool = False) -> None:
+        with self._lock:
+            was = self._leader
+            self._leader = False
+        if was and not quiet:
+            LOG.warning(badge("ELECTION", "seized", member=self.member_id,
+                              backend=type(self).__name__))
+            for cb in self._seized_cbs:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001
+                    LOG.exception(badge("ELECTION", "seized-cb-failed"))
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._leader
+
+    def fence_token(self) -> int:
+        with self._lock:
+            return self._fence
+
+
+class FileLeaseElection(ElectionStateMachine):
+    def __init__(self, lease_path: str, member_id: str,
+                 lease_ttl: float = 3.0, heartbeat: float = 1.0):
+        super().__init__(member_id)
+        self.path = lease_path
+        self.ttl = lease_ttl
+        self.heartbeat = heartbeat
 
     # -- lease file ---------------------------------------------------------
     def _read(self) -> tuple[Optional[str], float, int]:
@@ -149,30 +193,6 @@ class FileLeaseElection(LeaderElection):
             except OSError:
                 pass
 
-    def _promote(self, fence: int) -> None:
-        with self._lock:
-            self._leader = True
-            self._fence = fence
-        LOG.info(badge("ELECTION", "elected", member=self.member_id,
-                       fence=fence))
-        for cb in self._elected_cbs:
-            try:
-                cb()
-            except Exception:
-                LOG.exception(badge("ELECTION", "elected-cb-failed"))
-
-    def _demote(self, quiet: bool = False) -> None:
-        with self._lock:
-            was = self._leader
-            self._leader = False
-        if was and not quiet:
-            LOG.warning(badge("ELECTION", "seized", member=self.member_id))
-            for cb in self._seized_cbs:
-                try:
-                    cb()
-                except Exception:
-                    LOG.exception(badge("ELECTION", "seized-cb-failed"))
-
     # -- API ----------------------------------------------------------------
     def start(self) -> None:
         self._stop.clear()
@@ -186,14 +206,6 @@ class FileLeaseElection(LeaderElection):
             self._thread.join(timeout=self.ttl + 1)
             self._thread = None
 
-    def is_leader(self) -> bool:
-        with self._lock:
-            return self._leader
-
     def leader(self) -> Optional[str]:
         holder, expiry, _ = self._read()
         return holder if holder and expiry >= time.time() else None
-
-    def fence_token(self) -> int:
-        with self._lock:
-            return self._fence

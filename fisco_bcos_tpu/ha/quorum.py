@@ -1,0 +1,246 @@
+"""Cross-machine leader election: quorum leases over service RPC.
+
+Reference counterpart: /root/reference/bcos-leader-election/src/
+LeaderElection.h:30-92 — Max-mode HA elects a master through etcd:
+campaignLeader writes the leader key under a lease, KeepAlive renews it,
+and losing the lease triggers onSeized + re-campaign. The bundled
+FileLeaseElection (ha/election.py) needs a shared filesystem; this module
+removes that constraint the way etcd does — with a replicated lease
+registry — but built on the framework's own service RPC
+(services/rpc.py) instead of an external dependency.
+
+Protocol (Chubby-style quorum lease with Paxos-round fencing):
+
+* N independent :class:`LeaseRegistryServer` processes each hold
+  ``key -> (holder, expiry, fence)``, durably (atomic sidecar file), with
+  expiry on the *registry's* clock (clients never compare cross-machine
+  timestamps).
+* A candidate campaigns in two rounds: (1) read the fence from a majority,
+  compute proposal = max+1; (2) ``acquire`` on every registry — granted
+  iff the slot is free/expired/held-by-self AND the proposal is not below
+  the registry's fence (strictly above it for a takeover). Leadership =
+  grants from a strict majority; the leader's fence token is its proposal.
+* Monotonicity argument: leader B's majority intersects leader A's in at
+  least one registry whose fence A raised to F_A; B's round-1 majority
+  also intersects... B's proposal is granted only where proposal >= local
+  fence, and a *takeover* needs proposal > local fence, so B's token
+  exceeds the intersection registry's recorded F_A — fence tokens
+  strictly increase across leader changes, letting downstream storage
+  reject writes from a deposed leader (the reference gets the same from
+  etcd revisions).
+* Renewal is the same acquire with the unchanged proposal (allowed for
+  the current holder); losing quorum demotes immediately; a clean stop
+  releases the grants so successors need not wait out the TTL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+from ..codec.wire import Reader, Writer
+from ..services.rpc import ServiceClient, ServiceServer
+from ..utils.log import LOG, badge
+from .election import ElectionStateMachine
+
+
+class LeaseRegistryServer:
+    """One replica of the lease registry (the etcd stand-in)."""
+
+    def __init__(self, state_path: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.state_path = state_path
+        self._leases: dict[str, tuple[str, float, int]] = {}
+        self._lock = threading.Lock()
+        if state_path and os.path.exists(state_path):
+            try:
+                with open(state_path) as f:
+                    raw = json.load(f)
+                # expiries are wall-clock on THIS machine, valid across
+                # restart; fence durability is what actually matters
+                self._leases = {k: (h, e, fn) for k, (h, e, fn)
+                                in raw.items()}
+            except Exception:  # noqa: BLE001 — corrupt state: start fresh
+                LOG.exception(badge("ELECTION", "registry-state-corrupt",
+                                    path=state_path))
+        self.server = ServiceServer("lease-registry", host, port)
+        self.server.register("acquire", self._acquire)
+        self.server.register("release", self._release)
+        self.server.register("status", self._status)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def _persist(self) -> None:
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._leases, f)
+        os.replace(tmp, self.state_path)
+
+    # -- handlers ----------------------------------------------------------
+    def _acquire(self, r: Reader, w: Writer) -> None:
+        key, member = r.text(), r.text()
+        ttl, proposal = r.i64() / 1000.0, r.i64()
+        with self._lock:
+            holder, expiry, fence = self._leases.get(key, ("", 0.0, 0))
+            now = time.time()
+            held = bool(holder) and expiry > now and holder != member
+            ok = (not held) and (proposal >= fence) and \
+                (holder == member or proposal > fence or fence == 0)
+            if ok:
+                self._leases[key] = (member, now + ttl, proposal)
+                self._persist()
+                holder, fence = member, proposal
+            w.u8(1 if ok else 0).text(holder).i64(fence)
+
+    def _release(self, r: Reader, w: Writer) -> None:
+        key, member = r.text(), r.text()
+        with self._lock:
+            holder, _, fence = self._leases.get(key, ("", 0.0, 0))
+            if holder == member:
+                self._leases[key] = ("", 0.0, fence)
+                self._persist()
+            w.u8(1)
+
+    def _status(self, r: Reader, w: Writer) -> None:
+        key = r.text()
+        with self._lock:
+            holder, expiry, fence = self._leases.get(key, ("", 0.0, 0))
+            live = bool(holder) and expiry > time.time()
+            w.u8(1 if live else 0).text(holder if live else "").i64(fence)
+
+
+class QuorumLeaseElection(ElectionStateMachine):
+    """LeaderElection backend over a majority of lease registries."""
+
+    def __init__(self, registries: list[tuple[str, int]], member_id: str,
+                 key: str = "leader", lease_ttl: float = 3.0,
+                 heartbeat: float = 1.0, rpc_timeout: float = 1.0):
+        super().__init__(member_id)
+        self.key = key
+        self.ttl = lease_ttl
+        self.heartbeat = heartbeat
+        self._clients = [ServiceClient(h, p, rpc_timeout)
+                         for h, p in registries]
+        self._quorum = len(registries) // 2 + 1
+        # registry RPCs run concurrently: one slow/blackholed replica must
+        # not stretch the renewal round past the lease TTL
+        self._pool = ThreadPoolExecutor(
+            max_workers=len(self._clients),
+            thread_name_prefix=f"qelection-{member_id}")
+
+    # -- registry RPC wrappers (per-call failures = denials) ---------------
+    def _each_client(self, fn):
+        """Run fn(client) on every registry concurrently; exceptions
+        (unreachable replica) yield None."""
+        def safe(c):
+            try:
+                return fn(c)
+            except Exception:  # noqa: BLE001 — unreachable replica = deny
+                return None
+
+        return list(self._pool.map(safe, self._clients))
+
+    def _acquire_all(self, proposal: int) -> int:
+        def acquire(c):
+            r = c.call("acquire", lambda w: (
+                w.text(self.key), w.text(self.member_id),
+                w.i64(int(self.ttl * 1000)), w.i64(proposal)))
+            return bool(r.u8())
+
+        return sum(1 for ok in self._each_client(acquire) if ok)
+
+    def _statuses(self) -> list[tuple[bool, str, int]]:
+        def status(c):
+            r = c.call("status", lambda w: w.text(self.key))
+            return (bool(r.u8()), r.text(), r.i64())
+
+        return [s for s in self._each_client(status) if s is not None]
+
+    def _release_all(self) -> None:
+        self._each_client(
+            lambda c: c.call("release", lambda w: (w.text(self.key),
+                                                   w.text(self.member_id))))
+
+    # -- campaign loop -----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._round()
+            except Exception:  # noqa: BLE001 — keep campaigning
+                LOG.exception(badge("ELECTION", "round-failed",
+                                    member=self.member_id))
+            # leaders renew on a fixed beat; followers jitter their
+            # campaigns so lockstep candidates don't split grants forever
+            wait = self.heartbeat if self._leader else \
+                self.heartbeat * (0.5 + random.random())
+            self._stop.wait(wait)
+
+    def _round(self) -> None:
+        if self._leader:
+            granted = self._acquire_all(self._fence)  # renew
+            if granted < self._quorum:
+                self._demote()
+            return
+        statuses = self._statuses()
+        if len(statuses) < self._quorum:
+            return  # can't read a majority: stay follower
+        live_holders = {h for live, h, _ in statuses if live}
+        if live_holders - {self.member_id}:
+            return  # someone else visibly holds leases: don't contend yet
+        proposal = max(f for _, _, f in statuses) + 1
+        granted = self._acquire_all(proposal)
+        if granted >= self._quorum:
+            self._promote(proposal)
+        elif granted:
+            # two candidates split the grants: release ours so the next
+            # round isn't blocked behind the TTL (jittered retries below
+            # break the symmetry)
+            self._release_all()
+
+    # -- API ---------------------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"qelection-{self.member_id}")
+        self._thread.start()
+
+    def stop(self, release: bool = True) -> None:
+        """release=False simulates a crash: grants expire by TTL instead
+        of being released, so a successor must wait out the lease."""
+        self._stop.set()  # also gates _promote: no late in-flight win
+        if self._thread is not None:
+            self._thread.join(timeout=self.ttl + 1)
+            self._thread = None
+        if release and self._leader:
+            self._release_all()
+        # a clean, voluntary shutdown is not a seizure (same contract as
+        # FileLeaseElection's quiet demote on release)
+        self._demote(quiet=release)
+        self._pool.shutdown(wait=False)
+        for c in self._clients:
+            c.close()
+
+    def leader(self) -> Optional[str]:
+        counts: dict[str, int] = {}
+        for live, h, _ in self._statuses():
+            if live and h:
+                counts[h] = counts.get(h, 0) + 1
+        for h, n in counts.items():
+            if n >= self._quorum:
+                return h
+        return None

@@ -59,6 +59,8 @@ class ServiceServer:
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0):
         self.name = name
         self._methods: dict[str, Handler] = {}
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class _H(socketserver.BaseRequestHandler):
@@ -68,6 +70,9 @@ class ServiceServer:
                 except (ConnectionError, OSError):
                     pass  # abrupt client disconnects are routine (long-poll
                     # proxies close mid-park); not worth a traceback
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
 
             def _serve(self):
                 while True:
@@ -97,6 +102,15 @@ class ServiceServer:
             allow_reuse_address = True
             daemon_threads = True
 
+            def process_request(self, request, client_address):
+                # register synchronously in the accept loop (not in the
+                # handler thread): stop()'s shutdown() waits for this loop
+                # iteration, so no accepted connection can slip past the
+                # severing pass below
+                with outer._conns_lock:
+                    outer._conns.add(request)
+                super().process_request(request, client_address)
+
         self._server = _Srv((host, port), _H)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
@@ -114,6 +128,21 @@ class ServiceServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # sever established connections too: a stopped service must look
+        # like a killed process to its clients, not keep answering over
+        # persistent connections (HA failover depends on this)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(2)  # SHUT_RDWR
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class ServiceRemoteError(RuntimeError):

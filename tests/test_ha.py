@@ -1,0 +1,167 @@
+"""Cross-machine leader election: quorum leases over service RPC.
+
+Reference counterpart: bcos-leader-election/src/LeaderElection.h:30-92
+(etcd campaign/KeepAlive/onSeized). VERDICT r3 done-criterion: majority
+grant across 3 registry processes, fencing tokens monotone across
+failover, process-kill takeover — no shared filesystem anywhere.
+"""
+
+import time
+
+from fisco_bcos_tpu.ha.quorum import LeaseRegistryServer, QuorumLeaseElection
+
+TTL = 1.0
+HB = 0.2
+
+
+def wait_until(pred, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def spawn_registries(tmp_path, n=3):
+    regs = []
+    for i in range(n):
+        r = LeaseRegistryServer(state_path=str(tmp_path / f"reg{i}.json"))
+        r.start()
+        regs.append(r)
+    return regs, [("127.0.0.1", r.port) for r in regs]
+
+
+def make_candidate(addrs, member):
+    return QuorumLeaseElection(addrs, member, lease_ttl=TTL, heartbeat=HB,
+                               rpc_timeout=0.5)
+
+
+def test_single_candidate_elected(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    a = make_candidate(addrs, "node-a")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        assert a.fence_token() >= 1
+        assert a.leader() == "node-a"
+    finally:
+        a.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_crash_failover_with_fence_increase(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    a = make_candidate(addrs, "node-a")
+    b = make_candidate(addrs, "node-b")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        fence_a = a.fence_token()
+        b.start()
+        time.sleep(3 * HB)
+        assert not b.is_leader()  # can't steal a live lease
+        a.stop(release=False)  # CRASH: no release, leases must expire
+        assert wait_until(b.is_leader, timeout=TTL * 10)
+        assert b.fence_token() > fence_a  # fencing monotone across crash
+        assert b.leader() == "node-b"
+    finally:
+        b.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_clean_stop_fast_takeover(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    a = make_candidate(addrs, "node-a")
+    b = make_candidate(addrs, "node-b")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        b.start()
+        t0 = time.time()
+        a.stop()  # clean release
+        assert wait_until(b.is_leader, timeout=TTL * 10)
+        # released leases mean takeover well before a full TTL wait-out
+        assert time.time() - t0 < TTL * 6
+    finally:
+        b.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_minority_registry_down_leader_survives(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    a = make_candidate(addrs, "node-a")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        regs[2].stop()  # minority outage
+        time.sleep(TTL * 2)
+        assert a.is_leader()  # 2/3 renewals keep the lease
+    finally:
+        a.stop()
+        for r in regs[:2]:
+            r.stop()
+
+
+def test_majority_down_demotes_leader(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    a = make_candidate(addrs, "node-a")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        regs[1].stop()
+        regs[2].stop()
+        assert wait_until(lambda: not a.is_leader(), timeout=TTL * 10)
+    finally:
+        a.stop()
+        regs[0].stop()
+
+
+def test_no_dual_leadership_under_contention(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    cands = [make_candidate(addrs, f"node-{i}") for i in range(3)]
+    for c in cands:
+        c.start()
+    try:
+        assert wait_until(lambda: any(c.is_leader() for c in cands),
+                          timeout=TTL * 20)
+        # sample for a while: never more than one concurrent leader
+        deadline = time.time() + TTL * 3
+        while time.time() < deadline:
+            assert sum(1 for c in cands if c.is_leader()) <= 1
+            time.sleep(0.02)
+    finally:
+        for c in cands:
+            c.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_registry_restart_preserves_fence_monotonicity(tmp_path):
+    regs, addrs = spawn_registries(tmp_path)
+    a = make_candidate(addrs, "node-a")
+    a.start()
+    assert wait_until(a.is_leader)
+    fence_a = a.fence_token()
+    a.stop(release=False)
+    for r in regs:
+        r.stop()
+    # full registry-cluster restart from persisted state, same ports
+    regs2 = []
+    for i, (_, port) in enumerate(addrs):
+        r = LeaseRegistryServer(state_path=str(tmp_path / f"reg{i}.json"),
+                                port=port)
+        r.start()
+        regs2.append(r)
+    b = make_candidate(addrs, "node-b")
+    b.start()
+    try:
+        assert wait_until(b.is_leader, timeout=TTL * 10)
+        assert b.fence_token() > fence_a
+    finally:
+        b.stop()
+        for r in regs2:
+            r.stop()
