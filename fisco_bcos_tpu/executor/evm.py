@@ -10,7 +10,23 @@ independent from-spec interpreter:
     storage, memory, context, logs, CALL family, CREATE/CREATE2,
     RETURN/REVERT/SELFDESTRUCT);
   * gas metering (per-opcode base costs, quadratic memory expansion, word
-    copy costs, cold/warm SLOAD approximated flat, simplified SSTORE);
+    copy costs, EIP-2929 cold/warm access sets, EIP-2200 net SSTORE
+    metering with EIP-3529 refunds capped at gas_used/5, EIP-3651 warm
+    coinbase — see AccessSet; framework system contracts are pre-warmed
+    like classic precompiles);
+
+Intentional deviations from mainnet (consensus choices for THIS chain,
+mirrored bit-for-bit by native/nevm — tests/test_nevm.py enforces):
+  * PUSH reading past code end yields zero-padded immediates;
+  * JUMP lands at dest+1, so JUMPDEST's 1 gas is skipped on jumps;
+  * DMC cross-shard segments each open a fresh EIP-2929 context (warmth
+    does not travel across executor shards; deterministic by message
+    boundary);
+  * memory is hard-capped at 2^34 bytes (beyond it: out-of-gas before
+    any charge/allocation — mainnet relies on gas alone);
+  * intrinsic tx gas / calldata gas are not charged (block gas economics
+    are governed by the chain's own tx_count_limit / gas_limit configs);
+  * classic precompiles 6..9 (bn ops, blake2f) return empty success;
   * nested frames with per-frame state savepoints (revert unwinds exactly
     the frame's writes — same recoder discipline as the reference's
     executive stack, TransactionExecutive.cpp);
@@ -85,9 +101,14 @@ G_ZERO, G_BASE, G_VERYLOW, G_LOW, G_MID, G_HIGH = 0, 2, 3, 5, 8, 10
 G_KECCAK = 30
 G_KECCAK_WORD = 6
 G_COPY_WORD = 3
-G_SLOAD = 100
+G_SLOAD = 100  # warm access (EIP-2929 WARM_STORAGE_READ_COST)
+G_COLD_SLOAD = 2100  # EIP-2929 COLD_SLOAD_COST
+G_COLD_ACCOUNT = 2600  # EIP-2929 COLD_ACCOUNT_ACCESS_COST
 G_SSTORE_SET = 20000
-G_SSTORE_RESET = 2900
+G_SSTORE_RESET = 2900  # 5000 - COLD_SLOAD (Berlin)
+G_SSTORE_SENTRY = 2300  # EIP-2200: SSTORE needs gas > sentry
+R_SSTORE_CLEARS = 4800  # EIP-3529 clearing refund
+MAX_REFUND_QUOTIENT = 5  # EIP-3529: refund capped at gas_used/5
 G_LOG = 375
 G_LOG_TOPIC = 375
 G_LOG_DATA = 8
@@ -149,6 +170,117 @@ class Memory:
     def write(self, off: int, blob: bytes) -> None:
         self.extend(off, len(blob))
         self.data[off:off + len(blob)] = blob
+
+
+class AccessSet:
+    """Per-transaction warm/cold tracking + net SSTORE metering
+    (EIP-2929 access sets, EIP-2200 net metering with EIP-3529 refunds).
+
+    One instance lives for the whole outer transaction, shared by every
+    nested frame across BOTH interpreters — the native interpreter
+    (native/nevm) charges through host callbacks that land here, so the
+    metering logic exists exactly once. Reverted frames roll their
+    warmth/refund additions back via the journal (EIP-2929: "when a
+    context reverts, the access lists return to their previous state");
+    `original` values (pre-transaction storage) survive rollbacks by
+    definition and are kept.
+    """
+
+    __slots__ = ("addresses", "slots", "original", "refund", "_journal")
+
+    def __init__(self):
+        self.addresses: set[bytes] = set()
+        self.slots: set[tuple[bytes, bytes]] = set()
+        self.original: dict[tuple[bytes, bytes], int] = {}
+        self.refund = 0
+        self._journal: list = []  # ("a",addr) | ("s",key) | ("r",delta)
+
+    # -- journal (frame revert restores prior warmth + refund) -------------
+    def snapshot(self) -> int:
+        return len(self._journal)
+
+    def rollback_to(self, mark: int) -> None:
+        while len(self._journal) > mark:
+            kind, item = self._journal.pop()
+            if kind == "a":
+                self.addresses.discard(item)
+            elif kind == "s":
+                self.slots.discard(item)
+            else:
+                self.refund -= item
+
+    def _add_refund(self, delta: int) -> None:
+        self.refund += delta
+        self._journal.append(("r", delta))
+
+    # -- account access ----------------------------------------------------
+    def warm_address(self, addr: bytes) -> None:
+        if addr not in self.addresses:
+            self.addresses.add(addr)
+            self._journal.append(("a", addr))
+
+    def account_cost(self, addr: bytes) -> int:
+        """Full access cost: cold 2600 / warm 100 (BALANCE, EXTCODE*,
+        CALL-family target)."""
+        if addr in self.addresses:
+            return G_SLOAD
+        self.warm_address(addr)
+        return G_COLD_ACCOUNT
+
+    def account_surcharge(self, addr: bytes) -> int:
+        """Cold surcharge only: 2600 / 0 (SELFDESTRUCT heir)."""
+        if addr in self.addresses:
+            return 0
+        self.warm_address(addr)
+        return G_COLD_ACCOUNT
+
+    # -- storage access -----------------------------------------------------
+    def slot_cost(self, addr: bytes, slot: bytes) -> int:
+        """SLOAD: cold 2100 / warm 100."""
+        key = (addr, slot)
+        if key in self.slots:
+            return G_SLOAD
+        self.slots.add(key)
+        self._journal.append(("s", key))
+        return G_COLD_SLOAD
+
+    def sstore_gas(self, current: int, slot_original: int, new: int,
+                   addr: bytes, slot: bytes) -> int:
+        """Net-metered SSTORE cost; refund deltas applied internally.
+
+        `slot_original` is the value at transaction start (first-touch
+        snapshot taken by the caller via :meth:`note_original`)."""
+        key = (addr, slot)
+        cost = 0
+        if key not in self.slots:
+            cost += G_COLD_SLOAD
+            self.slots.add(key)
+            self._journal.append(("s", key))
+        if new == current:
+            return cost + G_SLOAD
+        if current == slot_original:
+            if slot_original != 0 and new == 0:
+                self._add_refund(R_SSTORE_CLEARS)
+            return cost + (G_SSTORE_SET if slot_original == 0
+                           else G_SSTORE_RESET)
+        # dirty slot (already written this tx)
+        if slot_original != 0:
+            if current == 0:
+                self._add_refund(-R_SSTORE_CLEARS)
+            if new == 0:
+                self._add_refund(R_SSTORE_CLEARS)
+        if new == slot_original:
+            if slot_original == 0:
+                self._add_refund(G_SSTORE_SET - G_SLOAD)
+            else:
+                # Berlin: RESET is already the cold-adjusted 2900; the
+                # restore credit is RESET - warm access = 2800
+                self._add_refund(G_SSTORE_RESET - G_SLOAD)
+        return cost + G_SLOAD
+
+    def note_original(self, addr: bytes, slot: bytes, current: int) -> int:
+        """Record (once) and return the slot's pre-transaction value."""
+        return self.original.setdefault((addr, slot), current)
 
 
 class Frame:
@@ -222,6 +354,9 @@ class EVM:
 
     def __init__(self, suite, registry=None, native: Optional[bool] = None):
         self.suite = suite
+        # per-transaction access set (EIP-2929 warm/cold + refunds),
+        # thread-local: the executor runs concurrent txs on one EVM
+        self._tls = threading.local()
         # framework precompiles (Table/Consensus/...) visible to EVM CALLs
         self.registry = registry or {}
         # DMC seam: when set, internal CALL/STATICCALL targets the hook may
@@ -276,6 +411,39 @@ class EVM:
         return True
 
     # -- entry points ------------------------------------------------------
+    # -- per-tx access context (EIP-2929) ----------------------------------
+    def access(self) -> AccessSet:
+        acc = getattr(self._tls, "access", None)
+        if acc is None:
+            acc = self._tls.access = AccessSet()
+        return acc
+
+    def begin_tx_access(self, origin: bytes, target: bytes,
+                        coinbase: bytes = b"") -> AccessSet:
+        """Fresh per-transaction access set, pre-warmed per EIP-2929
+        (origin, target, classic precompiles 1..9, framework system
+        contracts) + EIP-3651 (coinbase)."""
+        acc = self._tls.access = AccessSet()
+        acc.warm_address(origin)
+        if target:
+            acc.warm_address(target)
+        if len(coinbase) == 20:  # EIP-3651 (zero-addr default included)
+            acc.warm_address(coinbase)
+        for i in range(1, 10):
+            acc.warm_address(b"\x00" * 19 + bytes([i]))
+        for addr in self.registry:
+            acc.warm_address(addr)
+        return acc
+
+    def take_refund(self, gas_used: int) -> int:
+        """EIP-3529-capped refund for the finished tx; clears the
+        context so the next tx on this thread starts cold."""
+        acc = getattr(self._tls, "access", None)
+        self._tls.access = None
+        if acc is None or acc.refund <= 0:
+            return 0
+        return min(acc.refund, gas_used // MAX_REFUND_QUOTIENT)
+
     def execute_message(self, state: StateStorage, env: TxEnv, caller: bytes,
                         to: bytes, value: int, data: bytes, gas: int,
                         depth: int = 0, static: bool = False) -> EVMResult:
@@ -287,7 +455,11 @@ class EVM:
                                      depth)
             if ext is not None:
                 return ext
+        if depth == 0:
+            self.begin_tx_access(env.origin, to, env.coinbase)
+        acc = self.access()
         sp = state.savepoint()
+        sp_acc = acc.snapshot()
         if not static and not self.transfer(state, caller, to, value):
             state.rollback_to(sp)
             return EVMResult(False, gas_left=gas, error="insufficient balance")
@@ -302,12 +474,13 @@ class EVM:
         if not code:
             state.release(sp)
             return EVMResult(True, gas_left=gas)  # plain transfer
-        res = self._run(state, env, code, caller, to, value, data, gas,
-                        depth, static)
+        res = self._run_in_message(state, env, code, caller, to, value, data,
+                                   gas, depth, static)
         if res.success:
             state.release(sp)
         else:
             state.rollback_to(sp)
+            acc.rollback_to(sp_acc)  # EIP-2929: reverted frames cool again
         return res
 
     def create(self, state: StateStorage, env: TxEnv, caller: bytes,
@@ -329,22 +502,31 @@ class EVM:
                 b"\xff" + caller + salt.to_bytes(32, "big") + h)[12:]
         if self.get_code(state, new_addr):
             return EVMResult(False, gas_left=0, error="address collision")
+        if depth == 0:
+            self.begin_tx_access(env.origin, new_addr, env.coinbase)
+        acc = self.access()
         sp = state.savepoint()
+        sp_acc = acc.snapshot()
+        acc.warm_address(new_addr)  # EIP-2929: created address is warm
         if not self.transfer(state, caller, new_addr, value):
             state.rollback_to(sp)
+            acc.rollback_to(sp_acc)
             return EVMResult(False, gas_left=gas, error="insufficient balance")
-        res = self._run(state, env, initcode, caller, new_addr, value, b"",
-                        gas, depth, False)
+        res = self._run_in_message(state, env, initcode, caller, new_addr,
+                                   value, b"", gas, depth, False)
         if not res.success:
             state.rollback_to(sp)
+            acc.rollback_to(sp_acc)
             return res
         deployed = res.output
         if len(deployed) > MAX_CODE_SIZE:
             state.rollback_to(sp)
+            acc.rollback_to(sp_acc)
             return EVMResult(False, gas_left=0, error="code too large")
         code_gas = 200 * len(deployed)
         if res.gas_left < code_gas:
             state.rollback_to(sp)
+            acc.rollback_to(sp_acc)
             return EVMResult(False, gas_left=0, error="code deposit gas")
         state.set(T_CODE, new_addr, deployed)
         state.release(sp)
@@ -443,9 +625,22 @@ class EVM:
                              gas_left=gas - cost, error="revert")
 
     # -- the interpreter loop ----------------------------------------------
+    def _run_in_message(self, *args) -> EVMResult:
+        """_run for frames whose access context execute_message/create
+        already manages (bypasses the direct-call reset below)."""
+        self._tls.in_message = True
+        try:
+            return self._run(*args)
+        finally:
+            self._tls.in_message = False
+
     def _run(self, state: StateStorage, env: TxEnv, code: bytes,
              caller: bytes, address: bytes, value: int, calldata: bytes,
              gas: int, depth: int, static: bool) -> EVMResult:
+        if depth == 0 and not getattr(self._tls, "in_message", False):
+            # direct frame execution (tests, tools): independent tx context
+            self.begin_tx_access(env.origin, address, env.coinbase)
+        acc = self.access()
         jumpdests = _analyze_jumpdests(code)
         if self.native:
             from . import nevm
@@ -590,8 +785,9 @@ class EVM:
                     f.use_gas(G_BASE)
                     f.push(int.from_bytes(address, "big"))
                 elif op == 0x31:  # BALANCE
-                    f.use_gas(G_BALANCE)
-                    f.push(self.balance_of(state, _addr_bytes(f.pop())))
+                    a = _addr_bytes(f.pop())
+                    f.use_gas(acc.account_cost(a))
+                    f.push(self.balance_of(state, a))
                 elif op == 0x32:  # ORIGIN
                     f.use_gas(G_BASE)
                     f.push(int.from_bytes(env.origin, "big"))
@@ -626,12 +822,13 @@ class EVM:
                     f.use_gas(G_BASE)
                     f.push(env.gas_price)
                 elif op == 0x3B:  # EXTCODESIZE
-                    f.use_gas(G_EXTCODE)
-                    f.push(len(self.get_code(state, _addr_bytes(f.pop()))))
+                    a = _addr_bytes(f.pop())
+                    f.use_gas(acc.account_cost(a))
+                    f.push(len(self.get_code(state, a)))
                 elif op == 0x3C:  # EXTCODECOPY
                     a = _addr_bytes(f.pop())
                     d, s, n = f.pop(), f.pop(), f.pop()
-                    f.use_gas(G_EXTCODE
+                    f.use_gas(acc.account_cost(a)
                               + G_COPY_WORD * ((_gas_size(n) + 31) // 32))
                     c = self.get_code(state, a)
                     f.mem.write(d, c[s:s + n].ljust(n, b"\x00"))
@@ -646,8 +843,9 @@ class EVM:
                         raise EVMError("returndata out of bounds")
                     f.mem.write(d, f.ret[s:s + n])
                 elif op == 0x3F:  # EXTCODEHASH
-                    f.use_gas(G_EXTCODE)
-                    c = self.get_code(state, _addr_bytes(f.pop()))
+                    a = _addr_bytes(f.pop())
+                    f.use_gas(acc.account_cost(a))
+                    c = self.get_code(state, a)
                     f.push(int.from_bytes(self.suite.hash(c), "big") if c else 0)
                 elif op == 0x40:  # BLOCKHASH (not tracked: zero)
                     f.use_gas(20)
@@ -691,23 +889,29 @@ class EVM:
                     f.use_gas(G_VERYLOW)
                     off, v = f.pop(), f.pop()
                     f.mem.write(off, bytes([v & 0xFF]))
-                elif op == 0x54:  # SLOAD
-                    f.use_gas(G_SLOAD)
-                    raw = state.get(T_STORE, store_key(f.pop()))
+                elif op == 0x54:  # SLOAD (EIP-2929 cold/warm)
+                    slot_b = f.pop().to_bytes(32, "big")
+                    f.use_gas(acc.slot_cost(address, slot_b))
+                    raw = state.get(T_STORE, address + slot_b)
                     f.push(int.from_bytes(raw, "big") if raw else 0)
-                elif op == 0x55:  # SSTORE
+                elif op == 0x55:  # SSTORE (EIP-2200 net + EIP-3529)
                     if static:
                         raise EVMError("SSTORE in static call")
+                    if f.gas <= G_SSTORE_SENTRY:
+                        raise OutOfGas("sstore sentry")
                     slot, v = f.pop(), f.pop()
+                    slot_b = slot.to_bytes(32, "big")
                     key = store_key(slot)
-                    old = state.get(T_STORE, key)
-                    if v == 0:
-                        f.use_gas(G_SSTORE_RESET if old else G_SLOAD)
-                        if old:
+                    raw = state.get(T_STORE, key)
+                    current = int.from_bytes(raw, "big") if raw else 0
+                    orig = acc.note_original(address, slot_b, current)
+                    f.use_gas(acc.sstore_gas(current, orig, v,
+                                             address, slot_b))
+                    if v != current:
+                        if v == 0:
                             state.remove(T_STORE, key)
-                    else:
-                        f.use_gas(G_SSTORE_SET if not old else G_SSTORE_RESET)
-                        state.set(T_STORE, key, v.to_bytes(32, "big"))
+                        else:
+                            state.set(T_STORE, key, v.to_bytes(32, "big"))
                 elif op == 0x56:  # JUMP
                     f.use_gas(G_MID)
                     d = f.pop()
@@ -772,7 +976,9 @@ class EVM:
                     out_off, out_size = f.pop(), f.pop()
                     if static and v and op == 0xF1:
                         raise EVMError("value call in static context")
-                    f.use_gas(G_CALL + (G_CALLVALUE if v else 0))
+                    to_b = _addr_bytes(to_i)
+                    f.use_gas(acc.account_cost(to_b)
+                              + (G_CALLVALUE if v else 0))
                     args = f.mem.read(in_off, in_size)
                     f.mem.extend(out_off, out_size)
                     avail = f.gas - f.gas // 64
@@ -780,7 +986,6 @@ class EVM:
                     f.use_gas(gas_child)
                     if v:
                         gas_child += G_CALLSTIPEND
-                    to_b = _addr_bytes(to_i)
                     if op == 0xF1:  # CALL
                         res = self.execute_message(
                             state, env, address, to_b, v, args, gas_child,
@@ -817,8 +1022,9 @@ class EVM:
                 elif op == 0xFF:  # SELFDESTRUCT
                     if static:
                         raise EVMError("SELFDESTRUCT in static call")
-                    f.use_gas(G_SELFDESTRUCT)
                     heir = _addr_bytes(f.pop())
+                    f.use_gas(G_SELFDESTRUCT
+                              + acc.account_surcharge(heir))
                     bal = self.balance_of(state, address)
                     if bal:
                         self.set_balance(state, address, 0)
@@ -841,11 +1047,14 @@ class EVM:
             return EVMResult(False, gas_left=gas, error="call depth")
         if not code:
             return EVMResult(True, gas_left=gas)
+        acc = self.access()
         sp = state.savepoint()
-        res = self._run(state, env, code, caller, address, value, data, gas,
-                        depth, static)
+        sp_acc = acc.snapshot()
+        res = self._run_in_message(state, env, code, caller, address, value,
+                                   data, gas, depth, static)
         if res.success:
             state.release(sp)
         else:
             state.rollback_to(sp)
+            acc.rollback_to(sp_acc)
         return res

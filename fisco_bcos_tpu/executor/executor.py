@@ -108,6 +108,8 @@ class TransactionExecutor:
         self.registry = dict(PRECOMPILED_REGISTRY if registry is None else registry)
         from .evm import EVM
         self.evm = EVM(suite, registry=self.registry)
+        # parallel-annotation cache: address -> (abi bytes, {sel: nparams})
+        self._parallel_cache: dict[bytes, tuple[bytes, dict[bytes, int]]] = {}
 
     # -- single transaction ------------------------------------------------
     def execute_transaction(self, tx: Transaction, state: StateStorage,
@@ -199,8 +201,9 @@ class TransactionExecutor:
         """Contract deployment (empty `to`, input = EVM initcode)."""
         env = self._env(sender, block_number, timestamp, gas_limit)
         res = self.evm.create(state, env, sender, 0, tx.input, gas_limit)
-        rc = Receipt(block_number=block_number,
-                     gas_used=gas_limit - res.gas_left)
+        gas_used = gas_limit - res.gas_left
+        gas_used -= self.evm.take_refund(gas_used)  # EIP-3529 cap inside
+        rc = Receipt(block_number=block_number, gas_used=gas_used)
         if res.success:
             rc.contract_address = res.create_address
             rc.logs = res.logs
@@ -219,8 +222,10 @@ class TransactionExecutor:
         env = self._env(sender, block_number, timestamp, gas_limit)
         res = self.evm.execute_message(state, env, sender, tx.to, 0,
                                        tx.input, gas_limit)
-        rc = Receipt(block_number=block_number,
-                     gas_used=gas_limit - res.gas_left, output=res.output)
+        gas_used = gas_limit - res.gas_left
+        gas_used -= self.evm.take_refund(gas_used)  # EIP-3529 cap inside
+        rc = Receipt(block_number=block_number, gas_used=gas_used,
+                     output=res.output)
         if res.success:
             rc.logs = res.logs
         else:
@@ -361,18 +366,21 @@ class TransactionExecutor:
                 for tx in txs]
 
     # -- DAG block (conflict-free waves) -----------------------------------
-    def plan_dag(self, txs: Sequence[Transaction]) -> list[list[int]]:
+    def plan_dag(self, txs: Sequence[Transaction],
+                 state: Optional[StateStorage] = None) -> list[list[int]]:
         """Group tx indices into topological waves by critical-field overlap.
 
         The reference derives critical fields from parallel-contract
-        annotations (CriticalFields.h:45, TxDAG2.h:34). Here precompiles
-        declare them via a dry probe: we ask each handler for conflict keys by
-        parsing call data (no state mutation). Unknown/conflicting txs fall
-        into singleton waves in order."""
+        annotations (CriticalFields.h:45, TxDAG2.h:34). Here EVERY
+        precompile can declare its own via ``Precompile.conflict_keys``
+        (a dry parse of call data, no state mutation), and EVM contracts
+        opt in through the parallel-ABI annotation (see
+        ``_evm_parallel_keys`` — the reference's ParallelConfig scheme).
+        Unknown/opaque txs fall into singleton waves in order."""
         last_wave_of_key: dict[bytes, int] = {}
         waves: list[list[int]] = []
         for i, tx in enumerate(txs):
-            keys = self._conflict_keys(tx)
+            keys = self._conflict_keys(tx, state)
             if keys is None:
                 # opaque: serialize against everything before and after it
                 w = len(waves)
@@ -391,30 +399,73 @@ class TransactionExecutor:
                 last_wave_of_key[k] = w
         return waves
 
-    def _conflict_keys(self, tx: Transaction) -> Optional[list[bytes]]:
-        """Static conflict analysis for known precompiles; None = opaque."""
-        from ..codec.wire import Reader
+    def _conflict_keys(self, tx: Transaction,
+                       state: Optional[StateStorage] = None
+                       ) -> Optional[list[bytes]]:
+        """Static conflict analysis; None = opaque (serialize)."""
         handler = self.registry.get(tx.to)
-        if handler is None:
-            return None
+        if handler is not None:
+            try:
+                return handler.conflict_keys(tx.input)
+            except Exception:
+                return None
+        if state is not None and tx.to:
+            return self._evm_parallel_keys(tx, state)
+        return None
+
+    def _evm_parallel_keys(self, tx: Transaction, state: StateStorage
+                           ) -> Optional[list[bytes]]:
+        """Parallel-contract annotation for EVM txs: an ABI function entry
+        carrying ``"parallel": N`` declares that two calls conflict iff
+        they share any of the first N (static) argument words — the
+        reference's ParallelConfigPrecompiled registration scheme
+        (bcos-executor/src/dag/CriticalFields.h:45-60, critical fields =
+        leading params of registered methods). Keys are address||argword
+        so different annotated methods touching the same account still
+        conflict with each other."""
         try:
-            r = Reader(tx.input)
-            method = r.text()
-            if handler.name == "balance":
-                if method == "transfer":
-                    a, b = r.blob(), r.blob()
-                    return [b"bal/" + a, b"bal/" + b]
-                if method == "register":
-                    return [b"bal/" + r.blob()]
-                if method == "balanceOf":
-                    return [b"bal/" + r.blob()]
-            if handler.name == "kv_table" and method in ("set", "get"):
-                t = r.text()
-                k = r.blob() if method in ("set", "get") else b""
-                return [t.encode() + b"/" + k]
+            raw = state.get(self.T_ABI, tx.to)
+            if not raw:
+                return None
+            sel = tx.input[:4]
+            if len(sel) != 4:
+                return None
+            sel_map = self._parallel_selectors(tx.to, raw)
+            n = sel_map.get(sel)
+            if not n:
+                return None
+            keys = [tx.to + tx.input[4 + 32 * i:4 + 32 * (i + 1)]
+                    for i in range(n)]
+            if any(len(k) != 52 for k in keys):
+                return None  # calldata shorter than declared params
+            return keys
         except Exception:
             return None
-        return None
+
+    def _parallel_selectors(self, address: bytes, raw_abi: bytes
+                            ) -> dict[bytes, int]:
+        """{selector: parallel-param-count} for a contract's annotated
+        functions, cached per (address, abi bytes) so block planning does
+        one JSON parse + selector-hash pass per contract, not per tx."""
+        cached = self._parallel_cache.get(address)
+        if cached is not None and cached[0] == raw_abi:
+            return cached[1]
+        import json
+
+        from ..codec import abi as abi_mod
+
+        sel_map: dict[bytes, int] = {}
+        for e in json.loads(raw_abi):
+            if e.get("type") != "function" or not e.get("parallel"):
+                continue
+            sig = e["name"] + "(" + ",".join(
+                i["type"] for i in e.get("inputs", [])) + ")"
+            sel_map[abi_mod.selector(sig, self.suite.hash)] = \
+                int(e["parallel"])
+        if len(self._parallel_cache) >= 256:
+            self._parallel_cache.pop(next(iter(self._parallel_cache)))
+        self._parallel_cache[address] = (raw_abi, sel_map)
+        return sel_map
 
     def execute_block_dag(self, txs: Sequence[Transaction],
                           state: StateStorage, block_number: int,
@@ -422,7 +473,7 @@ class TransactionExecutor:
         """Execute in conflict-free waves. Within a wave order is irrelevant
         by construction, so results equal the serial schedule."""
         t0 = time.monotonic()
-        waves = self.plan_dag(txs)
+        waves = self.plan_dag(txs, state)
         receipts: list[Optional[Receipt]] = [None] * len(txs)
         for wave in waves:
             for i in wave:

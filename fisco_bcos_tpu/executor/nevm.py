@@ -63,6 +63,18 @@ _CREATE = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32,
                            ctypes.POINTER(ctypes.c_uint8))
 _SELFDESTRUCT = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_uint8))
+_ACCESS_ACCT = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_int32,
+                                ctypes.POINTER(ctypes.c_int64))
+_SLOAD_COST = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.POINTER(ctypes.c_int64))
+_SSTORE_GAS = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.c_int32,
+                               ctypes.POINTER(ctypes.c_int64))
 
 
 class _NevmHost(ctypes.Structure):
@@ -76,6 +88,9 @@ class _NevmHost(ctypes.Structure):
         ("do_call", _CALL),
         ("do_create", _CREATE),
         ("selfdestruct", _SELFDESTRUCT),
+        ("access_account", _ACCESS_ACCT),
+        ("sload_cost", _SLOAD_COST),
+        ("sstore_gas", _SSTORE_GAS),
     ]
 
 
@@ -202,11 +217,16 @@ class _Host:
         self.c_call = _CALL(self._call)
         self.c_create = _CREATE(self._create)
         self.c_selfdestruct = _SELFDESTRUCT(self._selfdestruct)
+        self.c_access_account = _ACCESS_ACCT(self._access_account)
+        self.c_sload_cost = _SLOAD_COST(self._sload_cost)
+        self.c_sstore_gas = _SSTORE_GAS(self._sstore_gas)
         self.table = _NevmHost(
             ctx=None, sload=self.c_sload, sstore=self.c_sstore,
             balance=self.c_balance, get_code=self.c_get_code,
             do_log=self.c_log, do_call=self.c_call,
-            do_create=self.c_create, selfdestruct=self.c_selfdestruct)
+            do_create=self.c_create, selfdestruct=self.c_selfdestruct,
+            access_account=self.c_access_account,
+            sload_cost=self.c_sload_cost, sstore_gas=self.c_sstore_gas)
 
     def bind(self, evm, state, env, caller, address, value, depth, static):
         self.evm = evm
@@ -239,6 +259,43 @@ class _Host:
             ctypes.memmove(out, raw.rjust(32, b"\x00"), 32)
             return 1
         except BaseException as exc:  # noqa: BLE001 — surfaced to caller
+            self.exc = exc
+            return -1
+
+    def _access_account(self, _ctx, addr, surcharge_only, cost_out):
+        try:
+            a = _bytes_at(addr, 20)
+            acc = self.evm.access()
+            cost_out[0] = (acc.account_surcharge(a) if surcharge_only
+                           else acc.account_cost(a))
+            return 0
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
+
+    def _sload_cost(self, _ctx, slot, cost_out):
+        try:
+            cost_out[0] = self.evm.access().slot_cost(
+                self.address, _bytes_at(slot, 32))
+            return 0
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
+
+    def _sstore_gas(self, _ctx, slot, val, val_zero, cost_out):
+        try:
+            slot_b = _bytes_at(slot, 32)
+            raw = self.state.get(self._evm_mod.T_STORE,
+                                 self.address + slot_b)
+            current = int.from_bytes(raw, "big") if raw else 0
+            new = 0 if val_zero else int.from_bytes(_bytes_at(val, 32),
+                                                    "big")
+            acc = self.evm.access()
+            orig = acc.note_original(self.address, slot_b, current)
+            cost_out[0] = acc.sstore_gas(current, orig, new,
+                                         self.address, slot_b)
+            return 0
+        except BaseException as exc:  # noqa: BLE001
             self.exc = exc
             return -1
 

@@ -94,6 +94,17 @@ class Precompile:
     def touch(ctx: CallContext, *keys: bytes) -> None:
         ctx.criticals.extend(keys)
 
+    def conflict_keys(self, input_: bytes) -> Optional[list]:
+        """Static critical-field analysis for DAG planning — parse call
+        data WITHOUT touching state and return the conflict keys this
+        call would contend on, or None if unknown (the planner then
+        serializes the tx). Keys live in one global namespace; prefix
+        with a contract-specific tag. Reference:
+        bcos-executor/src/dag/CriticalFields.h:45-60 — the reference
+        derives these from parallel-contract annotations; here each
+        precompile declares its own."""
+        return None
+
 
 def encode_call(method: str, build: Callable[[Writer], None] | None = None) -> bytes:
     w = Writer()
@@ -120,6 +131,19 @@ class BalancePrecompile(Precompile):
             "transfer": self._transfer,
             "balanceOf": self._balance_of,
         }
+
+    def conflict_keys(self, input_: bytes) -> Optional[list]:
+        try:
+            r = Reader(input_)
+            method = r.text()
+            if method == "transfer":
+                return [T_BALANCE.encode() + r.blob(),
+                        T_BALANCE.encode() + r.blob()]
+            if method in ("register", "balanceOf"):
+                return [T_BALANCE.encode() + r.blob()]
+        except Exception:
+            pass
+        return None
 
     @staticmethod
     def _get(ctx: CallContext, account: bytes) -> int:
@@ -173,6 +197,19 @@ class KVTablePrecompile(Precompile):
             "set": self._set,
             "get": self._get,
         }
+
+    def conflict_keys(self, input_: bytes) -> Optional[list]:
+        try:
+            r = Reader(input_)
+            method = r.text()
+            if method in ("set", "get"):
+                table = T_USER_PREFIX + r.text()
+                return [table.encode() + b"/" + r.blob()]
+            if method == "createTable":
+                return [(T_USER_PREFIX + r.text()).encode()]
+        except Exception:
+            pass
+        return None
 
     def _create(self, ctx: CallContext, r: Reader, w: Writer) -> None:
         table = T_USER_PREFIX + r.text()

@@ -216,6 +216,11 @@ class ShardExecutor:
             try:
                 env = self.executor._env(msg.caller, block_number,
                                          timestamp, msg.gas)
+                # each cross-shard segment is its own EIP-2929 context:
+                # message boundaries are deterministic across nodes,
+                # thread-local warmth from earlier segments is not
+                self.executor.evm.begin_tx_access(msg.caller, msg.to,
+                                                  env.coinbase)
                 return self.executor.evm.execute_message(
                     ov, env, msg.caller, msg.to, msg.value, msg.data,
                     msg.gas, depth=1, static=msg.static)

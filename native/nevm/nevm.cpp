@@ -355,6 +355,17 @@ typedef struct {
                        const uint8_t** out, uint64_t* out_len,
                        uint8_t addr_out[20]);
   int32_t (*selfdestruct)(void*, const uint8_t heir[20]);
+  // EIP-2929 access-set callbacks: the metering logic lives Python-side
+  // (one AccessSet per outer tx shared across native+Python frames);
+  // these return the gas to charge. surcharge_only: SELFDESTRUCT heir
+  // (0 warm / 2600 cold) vs full access cost (100 warm / 2600 cold).
+  int32_t (*access_account)(void*, const uint8_t addr[20],
+                            int32_t surcharge_only, int64_t* cost_out);
+  int32_t (*sload_cost)(void*, const uint8_t slot[32], int64_t* cost_out);
+  // net-metered SSTORE gas (EIP-2200/3529); refunds tracked host-side
+  int32_t (*sstore_gas)(void*, const uint8_t slot[32],
+                        const uint8_t val[32], int32_t val_zero,
+                        int64_t* cost_out);
 } NevmHost;
 
 typedef struct {
@@ -393,6 +404,7 @@ constexpr int64_t G_BASE = 2, G_VERYLOW = 3, G_LOW = 5, G_MID = 8,
                   G_CALLVALUE = 9000, G_CALLSTIPEND = 2300, G_EXP = 10,
                   G_EXP_BYTE = 50, G_MEMORY = 3, G_BALANCE = 100,
                   G_EXTCODE = 100, G_SELFDESTRUCT = 5000,
+                  G_SSTORE_SENTRY = 2300,
                   G_INITCODE_WORD = 2;
 
 struct OutOfGas {};
@@ -807,10 +819,12 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           f.use_gas(G_BASE);
           f.push(U256::from_be(address, 20));
           break;
-        case 0x31: {  // BALANCE
-          f.use_gas(G_BALANCE);
+        case 0x31: {  // BALANCE (EIP-2929 cold/warm)
           uint8_t a20[20], out[32];
           addr_of(f.pop(), a20);
+          int64_t ac = 0;
+          hostcheck(host->access_account(host->ctx, a20, 0, &ac));
+          f.use_gas(ac);
           hostcheck(host->balance(host->ctx, a20, out));
           f.push(U256::from_be(out, 32));
           break;
@@ -863,9 +877,11 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           f.push(U256::from_u64(env->gas_price));
           break;
         case 0x3B: {  // EXTCODESIZE
-          f.use_gas(G_EXTCODE);
           uint8_t a20[20];
           addr_of(f.pop(), a20);
+          int64_t ac = 0;
+          hostcheck(host->access_account(host->ctx, a20, 0, &ac));
+          f.use_gas(ac);
           const uint8_t* c = nullptr;
           uint64_t n = 0;
           hostcheck(host->get_code(host->ctx, a20, &c, &n));
@@ -877,7 +893,9 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           addr_of(f.pop(), a20);
           U256 d = f.pop(), s = f.pop(), n_u = f.pop();
           uint64_t n = checked_size(n_u);
-          f.use_gas(G_EXTCODE + G_COPY_WORD * (int64_t)words32(n));
+          int64_t ac = 0;
+          hostcheck(host->access_account(host->ctx, a20, 0, &ac));
+          f.use_gas(ac + G_COPY_WORD * (int64_t)words32(n));
           const uint8_t* c = nullptr;
           uint64_t clen = 0;
           hostcheck(host->get_code(host->ctx, a20, &c, &clen));
@@ -901,9 +919,11 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           break;
         }
         case 0x3F: {  // EXTCODEHASH
-          f.use_gas(G_EXTCODE);
           uint8_t a20[20];
           addr_of(f.pop(), a20);
+          int64_t ac = 0;
+          hostcheck(host->access_account(host->ctx, a20, 0, &ac));
+          f.use_gas(ac);
           const uint8_t* c = nullptr;
           uint64_t n = 0;
           hostcheck(host->get_code(host->ctx, a20, &c, &n));
@@ -982,26 +1002,28 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           f.write_mem(off, &b, 1);
           break;
         }
-        case 0x54: {  // SLOAD
-          f.use_gas(G_SLOAD);
+        case 0x54: {  // SLOAD (EIP-2929 cold/warm)
           uint8_t slot[32], out[32] = {0};
           f.pop().to_be(slot);
+          int64_t sc = 0;
+          hostcheck(host->sload_cost(host->ctx, slot, &sc));
+          f.use_gas(sc);
           int32_t exists = hostcheck(host->sload(host->ctx, slot, out));
           f.push(exists ? U256::from_be(out, 32) : U256());
           break;
         }
-        case 0x55: {  // SSTORE
+        case 0x55: {  // SSTORE (EIP-2200 net metering + EIP-3529)
           if (static_flag) throw EvmErr{"SSTORE in static call"};
+          if (f.gas <= G_SSTORE_SENTRY) throw OutOfGas{};
           U256 slot_u = f.pop(), v = f.pop();
           uint8_t slot[32], val[32];
           slot_u.to_be(slot);
           v.to_be(val);
           int vz = v.is_zero();
-          int32_t old = hostcheck(host->sstore(host->ctx, slot, val, vz));
-          if (vz)
-            f.use_gas(old ? G_SSTORE_RESET : G_SLOAD);
-          else
-            f.use_gas(old ? G_SSTORE_RESET : G_SSTORE_SET);
+          int64_t sc = 0;
+          hostcheck(host->sstore_gas(host->ctx, slot, val, vz, &sc));
+          f.use_gas(sc);
+          hostcheck(host->sstore(host->ctx, slot, val, vz));
           break;
         }
         case 0x56: {  // JUMP
@@ -1095,7 +1117,11 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
           U256 out_off = f.pop(), out_size = f.pop();
           if (static_flag && !v.is_zero() && op == 0xF1)
             throw EvmErr{"value call in static context"};
-          f.use_gas(G_CALL + (v.is_zero() ? 0 : G_CALLVALUE));
+          uint8_t to20c[20];
+          addr_of(to, to20c);
+          int64_t ac = 0;
+          hostcheck(host->access_account(host->ctx, to20c, 0, &ac));
+          f.use_gas(ac + (v.is_zero() ? 0 : G_CALLVALUE));
           std::string args = f.read_mem(in_off, in_size);
           f.extend(out_off, out_size);
           int64_t avail = f.gas - f.gas / 64;
@@ -1133,11 +1159,13 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
         }
         case 0xFE:
           throw EvmErr{"invalid opcode 0xfe"};
-        case 0xFF: {  // SELFDESTRUCT
+        case 0xFF: {  // SELFDESTRUCT (cold-heir surcharge)
           if (static_flag) throw EvmErr{"SELFDESTRUCT in static call"};
-          f.use_gas(G_SELFDESTRUCT);
           uint8_t heir[20];
           addr_of(f.pop(), heir);
+          int64_t ac = 0;
+          hostcheck(host->access_account(host->ctx, heir, 1, &ac));
+          f.use_gas(G_SELFDESTRUCT + ac);
           hostcheck(host->selfdestruct(host->ctx, heir));
           return finish(0, "", f.gas, nullptr);
         }
