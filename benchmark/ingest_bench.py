@@ -2,9 +2,18 @@
 """Txpool ingest benchmark — the BASELINE.json "TxValidator ingest: 50k-tx
 block" config (reference hot path: TransactionSync.cpp:516-537 tbb batch
 verify; txpool.verify_worker_num). Measures end-to-end batch submit:
-decode -> batch ecrecover (device) -> pool insert.
+decode -> batch ecrecover/verify (device) -> pool insert.
 
-Usage: python benchmark/ingest_bench.py [-n 50000] [--backend auto|host]
+Modes:
+  plain (default): one suite, -n txs, single ingest measurement.
+  --mixed:         BASELINE row 4 — n/2 secp256k1 + n/2 SM2 txs (a secp
+                   chain node and an SM chain node sharing the host/
+                   device), --trials ingest repetitions into FRESH pools,
+                   block-verify latency reported as p50/p95.
+
+Usage:
+  python benchmark/ingest_bench.py [-n 50000] [--backend auto|host]
+  python benchmark/ingest_bench.py --mixed [-n 50000] [--trials 3]
 """
 
 from __future__ import annotations
@@ -18,51 +27,119 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _sign_one(args):
+    """Worker: build + sign one tx batch slice (spawn-pool friendly)."""
+    sm, seed, lo, hi = args
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.protocol import Transaction
+
+    suite = make_suite(sm, backend="host")
+    kp = suite.generate_keypair(seed)
+    out = []
+    for i in range(lo, hi):
+        tx = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call("balanceOf",
+                                 lambda w: w.blob(b"a%d" % i)),
+            nonce="%s%d" % ("s" if sm else "e", i),
+            block_limit=100).sign(suite, kp)
+        out.append(tx.encode())
+    return out
+
+
+def _sign_batch(sm: bool, n: int, workers: int) -> list[bytes]:
+    seed = b"ingest-sm" if sm else b"ingest-secp"
+    if workers <= 1 or n < 256:
+        return _sign_one((sm, seed, 0, n))
+    import multiprocessing
+    from concurrent.futures import ProcessPoolExecutor
+
+    ctx = multiprocessing.get_context("spawn")
+    step = (n + workers - 1) // workers
+    chunks = [(sm, seed, lo, min(lo + step, n))
+              for lo in range(0, n, step)]
+    with ProcessPoolExecutor(workers, mp_context=ctx) as ex:
+        parts = list(ex.map(_sign_one, chunks))
+    return [raw for part in parts for raw in part]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", type=int, default=50_000)
     ap.add_argument("--backend", default="auto",
                     choices=["auto", "host", "device"])
+    ap.add_argument("--mixed", action="store_true")
+    ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--sign-workers", type=int, default=os.cpu_count() or 4)
     args = ap.parse_args()
 
-    from concurrent.futures import ProcessPoolExecutor
-
-    from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.init.node import Node, NodeConfig
-
-    node = Node(NodeConfig(crypto_backend=args.backend, min_seal_time=3600))
-    node.build_genesis()
-    suite = node.suite
-    kp = suite.generate_keypair(b"ingest")
-
-    # host-side signing is not the benchmark; parallelise it
     from fisco_bcos_tpu.protocol import Transaction
-    from fisco_bcos_tpu.executor import precompiled as pc
 
-    def mk(i):
-        return Transaction(
-            to=pc.BALANCE_ADDRESS,
-            input=pc.encode_call("balanceOf",
-                                 lambda w: w.blob(b"a%d" % i)),
-            nonce="n%d" % i, block_limit=100).sign(suite, kp)
+    if not args.mixed:
+        node = Node(NodeConfig(crypto_backend=args.backend,
+                               min_seal_time=3600))
+        node.build_genesis()
+        t0 = time.perf_counter()
+        raws = _sign_batch(False, args.n, args.sign_workers)
+        # wire round-trip: decode drops the signer's cached sender so
+        # ingest really performs ecrecover, as for network arrivals
+        txs = [Transaction.decode(r) for r in raws]
+        sign_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        results = node.txpool.submit_batch(txs)
+        dt = time.perf_counter() - t0
+        ok = sum(1 for r in results if int(r.status) == 0)
+        print(json.dumps({
+            "metric": f"txpool_ingest_{args.n}",
+            "value": round(args.n / dt, 1),
+            "unit": "txs/sec",
+            "accepted": ok,
+            "sign_prep_s": round(sign_s, 1),
+        }))
+        return
 
+    # -- mixed secp+SM2 (BASELINE row 4) ----------------------------------
+    half = args.n // 2
     t0 = time.perf_counter()
-    txs = [mk(i) for i in range(args.n)]
-    # wire round-trip: drop the signer's cached sender so ingest really
-    # performs ecrecover, as it would for txs arriving from the network
-    txs = [Transaction.decode(t.encode()) for t in txs]
+    secp_raws = _sign_batch(False, half, args.sign_workers)
+    sm_raws = _sign_batch(True, half, args.sign_workers)
     sign_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    results = node.txpool.submit_batch(txs)
-    dt = time.perf_counter() - t0
-    ok = sum(1 for r in results if int(r.status) == 0)
+    latencies = []
+    accepted = 0
+    for _ in range(args.trials):
+        # fresh pools per trial: same txs are virgin again
+        secp_node = Node(NodeConfig(crypto_backend=args.backend,
+                                    min_seal_time=3600))
+        secp_node.build_genesis()
+        sm_node = Node(NodeConfig(sm_crypto=True,
+                                  crypto_backend=args.backend,
+                                  min_seal_time=3600))
+        sm_node.build_genesis()
+        secp_txs = [Transaction.decode(r) for r in secp_raws]
+        sm_txs = [Transaction.decode(r) for r in sm_raws]
+        t0 = time.perf_counter()
+        r1 = secp_node.txpool.submit_batch(secp_txs)
+        r2 = sm_node.txpool.submit_batch(sm_txs)
+        latencies.append(time.perf_counter() - t0)
+        accepted = sum(1 for r in (*r1, *r2) if int(r.status) == 0)
+
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p95 = latencies[min(len(latencies) - 1,
+                        int(len(latencies) * 0.95))]
     print(json.dumps({
-        "metric": f"txpool_ingest_{args.n}",
-        "value": round(args.n / dt, 1),
+        "metric": f"txpool_ingest_mixed_{args.n}",
+        "value": round(args.n / p50, 1),
         "unit": "txs/sec",
-        "accepted": ok,
+        "p50_s": round(p50, 3),
+        "p95_s": round(p95, 3),
+        "trials": args.trials,
+        "secp_txs": half,
+        "sm2_txs": half,
+        "accepted": accepted,
         "sign_prep_s": round(sign_s, 1),
     }))
 

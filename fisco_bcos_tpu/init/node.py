@@ -88,8 +88,11 @@ class Node:
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool)
+        from ..tool.timesync import NodeTimeMaintenance
+        self.timesync = NodeTimeMaintenance()
         self.sealer = Sealer(self.txpool, self.suite, self._on_proposal,
-                             cfg.tx_count_limit, cfg.min_seal_time)
+                             cfg.tx_count_limit, cfg.min_seal_time,
+                             clock_ms=self.timesync.aligned_time_ms)
         self._commit_lock = threading.Lock()
         self.consensus = None  # bound by PBFT wiring in start()
         self.front: Optional[FrontService] = None
@@ -101,7 +104,8 @@ class Node:
             self.front = FrontService(self.keypair.pub_bytes, gateway)
             self.txsync = TransactionSync(self.front, self.txpool, self.suite)
             self.blocksync = BlockSync(self.front, self.ledger,
-                                       self.scheduler, self.suite)
+                                       self.scheduler, self.suite,
+                                       timesync=self.timesync)
             from ..net.amop import AMOPService
             self.amop = AMOPService(self.front)
             from ..lightnode import LightNodeServer

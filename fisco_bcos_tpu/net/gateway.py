@@ -141,8 +141,10 @@ class FakeGateway(Gateway):
             else:
                 self._partitioned.discard(node_id)
 
-    def set_filter(self, fn: Optional[Callable[[bytes, bytes, bytes], bool]]
-                   ) -> None:
+    def set_filter(self, fn: Optional[
+            Callable[[bytes, bytes, bytes], "bool | float | int"]]) -> None:
+        """fn returns a fault verdict — see send(): True deliver, falsy
+        drop, float t delay t seconds, int n>1 deliver n duplicates."""
         self._filter = fn
 
     # -- transport ---------------------------------------------------------
@@ -157,13 +159,39 @@ class FakeGateway(Gateway):
                     or dst not in self._fronts):
                 return False
             q = self._queues.get(dst)
-        flt = self._filter
-        if flt is not None and not flt(src, dst, data):
-            return False
         if q is None:
             return False
+        # fault-injection verdicts (network chaos for consensus soaks —
+        # the runtime analogue the reference only has as test mocks,
+        # MockDeadLockExecutor.h):
+        #   True deliver | False drop | float t: deliver after t seconds |
+        #   int n>1: deliver n duplicates (bool checked before int!)
+        flt = self._filter
+        verdict = True if flt is None else flt(src, dst, data)
+        if verdict is True:
+            q.put((src, data))
+            return True
+        if not verdict:
+            # False, None, 0, 0.0 — preserves the original falsy-drop
+            # contract (a filter that forgets to return must fail CLOSED)
+            return False
+        if isinstance(verdict, float):
+            t = threading.Timer(verdict, q.put, args=((src, data),))
+            t.daemon = True
+            t.start()
+            return True
+        if isinstance(verdict, int) and verdict > 1:
+            for _ in range(verdict):
+                q.put((src, data))
+            return True
         q.put((src, data))
         return True
+
+    @staticmethod
+    def module_of(data: bytes) -> int:
+        """ModuleID of a front-packed frame (for module-targeted faults)."""
+        import struct as _struct
+        return _struct.unpack(">H", data[:2])[0] if len(data) >= 2 else -1
 
     def broadcast(self, src: bytes, data: bytes) -> None:
         for dst in self.peers(src):

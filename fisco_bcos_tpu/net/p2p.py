@@ -14,7 +14,7 @@ Frames: u32 length | payload. The first frame each way is a handshake
 carrying the magic, protocol version, and the sender's node ID (pubkey);
 afterwards frames are typed:
 
-  DATA  u8 kind=0 | u8 flags (bit0: zlib) | u8 ttl | u16 len src | u16 len
+  DATA  u8 kind=0 | u8 flags (bit0: zlib, bit1: zstd) | u8 ttl | u16 len src | u16 len
         dst | payload — routed hop by hop to `dst`, decompressed and handed
         to `front.on_network_message(src, payload)` at the destination.
   ROUTE u8 kind=1 | u16 count | count * (u16 len node | u8 distance) — the
@@ -36,19 +36,29 @@ import struct
 import threading
 import time
 import zlib
+
+try:  # zstd frame compression (libp2p/P2PMessageV2.h uses zstd); zlib
+    # remains the decode fallback for mixed-version meshes
+    import zstandard as _zstd
+    _ZC = _zstd.ZstdCompressor(level=3)
+except Exception:  # pragma: no cover — environment without zstandard
+    _zstd = None
+    _ZC = None
 from typing import Optional
 
 from ..utils.log import LOG, badge
 from .gateway import Gateway
 
 MAGIC = b"FBTP"
-VERSION = 2
+VERSION = 3  # v3: capability byte in the hello (zstd negotiation)
+CAP_ZSTD = 1
 MAX_FRAME = 128 * 1024 * 1024
 MAX_SEND_QUEUE = 64 * 1024 * 1024  # per-session outbound byte budget
 MAX_TTL = 16
 MAX_DISTANCE = 8  # drop longer advertised paths (count-to-infinity guard)
 KIND_DATA, KIND_ROUTE = 0, 1
-FLAG_COMPRESSED = 1
+FLAG_COMPRESSED = 1       # zlib (legacy peers)
+FLAG_ZSTD = 2             # zstd, the reference's P2PMessageV2 codec
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -294,8 +304,18 @@ class P2PGateway(Gateway):
         with self._lock:
             return sorted(set(self._sessions) | set(self._router.reachable()))
 
+    def _recompute_codec_locked(self) -> None:
+        """zstd is used only when EVERY live session negotiated CAP_ZSTD —
+        broadcast compresses once, so the codec is the mesh-wide lowest
+        common denominator (recomputed on session up/down)."""
+        self._use_zstd = (_ZC is not None and bool(self._sessions) and
+                          all(getattr(s, "caps", 0) & CAP_ZSTD
+                              for s in self._sessions.values()))
+
     def _encode_payload(self, data: bytes) -> tuple[int, bytes]:
         if len(data) >= self.compress_threshold:
+            if getattr(self, "_use_zstd", False):
+                return FLAG_ZSTD, _ZC.compress(data)
             return FLAG_COMPRESSED, zlib.compress(data, 6)
         return 0, data
 
@@ -366,18 +386,20 @@ class P2PGateway(Gateway):
         t.start()
         self._threads.append(t)
 
-    def _handshake(self, sock: socket.socket) -> Optional[bytes]:
-        hello = MAGIC + bytes([VERSION]) + self.node_id
+    def _handshake(self, sock: socket.socket
+                   ) -> Optional[tuple[bytes, int]]:
+        caps = CAP_ZSTD if _ZC is not None else 0
+        hello = MAGIC + bytes([VERSION, caps]) + self.node_id
         _send_frame(sock, hello)
         frame = _recv_frame(sock)
-        if frame is None or len(frame) < 5 or frame[:4] != MAGIC:
+        if frame is None or len(frame) < 6 or frame[:4] != MAGIC:
             return None
         if frame[4] != VERSION:
             return None
-        return frame[5:]
+        return frame[6:], frame[5]
 
     def _install(self, peer_id: bytes, sock: socket.socket,
-                 outbound: bool) -> bool:
+                 outbound: bool, caps: int = 0) -> bool:
         """One session per pair, deterministic direction: the smaller node id
         dials, the larger accepts — no replacement livelock on simultaneous
         connects (Service.cpp keeps one session per peer the same way)."""
@@ -393,9 +415,11 @@ class P2PGateway(Gateway):
             if peer_id in self._sessions:
                 return False  # duplicate dial; first session wins
             sess = _Session(peer_id, sock, self._drop_session)
+            sess.caps = caps
             self._sessions[peer_id] = sess
             self._router.neighbor_up(peer_id)
             self._topo_version += 1
+            self._recompute_codec_locked()
         self._spawn(lambda: self._read_loop(sess, sock),
                     f"p2p-read-{peer_id[:4].hex()}")
         LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
@@ -427,6 +451,7 @@ class P2PGateway(Gateway):
                 self._sessions.pop(peer_id, None)
                 self._router.neighbor_down(peer_id)
                 self._topo_version += 1
+                self._recompute_codec_locked()
                 stale = None
         if stale is not None:
             stale.close()  # silence the dead session; topology unchanged
@@ -448,11 +473,13 @@ class P2PGateway(Gateway):
                 except OSError:  # ssl.SSLError AND smtls.SMTLSError — a
                     continue     # garbage dial must not kill the acceptor
             try:
-                peer_id = self._handshake(sock)
+                hs = self._handshake(sock)
             except OSError:
                 continue
+            peer_id, caps = hs if hs else (None, 0)
             if peer_id is None or not self._install(peer_id, sock,
-                                                    outbound=False):
+                                                    outbound=False,
+                                                    caps=caps):
                 try:
                     sock.close()
                 except OSError:
@@ -475,13 +502,15 @@ class P2PGateway(Gateway):
                     if self.client_ssl is not None:
                         sock = self.client_ssl.wrap_socket(
                             sock, server_hostname=host)
-                    peer_id = self._handshake(sock)
+                    hs = self._handshake(sock)
+                    peer_id, caps = hs if hs else (None, 0)
                     if peer_id is not None:
                         with self._lock:
                             self._peer_by_addr[(host, port)] = peer_id
                     if (peer_id is None
                             or not self._install(peer_id, sock,
-                                                 outbound=True)):
+                                                 outbound=True,
+                                                 caps=caps)):
                         sock.close()
                 except OSError:
                     continue
@@ -546,7 +575,19 @@ class P2PGateway(Gateway):
                     LOG.warning(badge("P2P", "no-route",
                                       dst=dst[:8].hex(), ttl=ttl))
             return
-        if flags & FLAG_COMPRESSED:
+        if flags & FLAG_ZSTD:
+            if _zstd is None:
+                LOG.warning(badge("P2P", "zstd-frame-unsupported",
+                                  src=src[:8].hex()))
+                return
+            try:  # bounded: max_output_size stops decompression bombs
+                payload = _zstd.ZstdDecompressor().decompress(
+                    payload, max_output_size=MAX_FRAME)
+            except _zstd.ZstdError:
+                LOG.warning(badge("P2P", "bad-zstd-frame-dropped",
+                                  src=src[:8].hex()))
+                return
+        elif flags & FLAG_COMPRESSED:
             # bounded inflate: a 128 MB cap stops zlib bombs cold
             d = zlib.decompressobj()
             payload = d.decompress(payload, MAX_FRAME)

@@ -8,6 +8,14 @@ and NodeConfig.cpp:355-459 (cert section). CPython's `ssl` module cannot
 speak GB/T 38636 TLCP, so this module implements the same trust shape as an
 application-layer channel:
 
+COMPATIBILITY NOTE: the wire format is NOT GB/T 38636 (TLCP); it will not
+interoperate with TASSL/GMSSL peers. Both ends of every link must run this
+framework (all node/SDK transports here do). The trust model, dual-cert
+discipline and algorithm suite (SM2/SM3/SM4) match the reference; the
+record framing is this module's own, with fail-closed semantics verified
+by tests/test_smtls_adversarial.py (truncation, splicing, reflection,
+reorder, injection, oversize).
+
 * **Dual-cert credentials** — every endpoint holds a SIGN keypair (proves
   identity) and a separate ENC keypair (participates in key agreement),
   each wrapped in a minimal SM2-signed certificate chained to a shared CA.
@@ -217,12 +225,20 @@ def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
+class SMTLSClosed(SMTLSError):
+    """Clean connection close (EOF at a record boundary) — the only
+    framing condition `SMSocket.recv` maps to b'' EOF semantics;
+    protocol violations (oversized/truncated records) raise."""
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise SMTLSError("peer closed during SM-TLS exchange")
+            if not buf:
+                raise SMTLSClosed("peer closed SM-TLS connection")
+            raise SMTLSError("truncated SM-TLS record")
         buf += chunk
     return buf
 
@@ -231,7 +247,11 @@ def _recv_frame(sock: socket.socket) -> bytes:
     (length,) = struct.unpack(">I", _recv_exact(sock, 4))
     if length > _MAX_RECORD:
         raise SMTLSError("oversized SM-TLS record")
-    return _recv_exact(sock, length)
+    try:
+        return _recv_exact(sock, length)
+    except SMTLSClosed:
+        # EOF after the header is a torn record, not a clean close
+        raise SMTLSError("truncated SM-TLS record") from None
 
 
 class SMSocket:
@@ -274,8 +294,8 @@ class SMSocket:
         if not self._rbuf:
             try:
                 rec = _recv_frame(self._sock)
-            except SMTLSError:
-                return b""  # EOF semantics for the caller's read loop
+            except SMTLSClosed:
+                return b""  # clean close: EOF for the caller's read loop
             if len(rec) < 40:
                 raise SMTLSError("short SM-TLS record")
             seq, ct, tag = rec[:8], rec[8:-32], rec[-32:]

@@ -29,10 +29,14 @@ class Sealer(Worker):
     def __init__(self, txpool: TxPool, suite,
                  submit_proposal: Callable[[Block], bool],
                  max_txs_per_block: int = 1000,
-                 min_seal_time: float = 0.5):
+                 min_seal_time: float = 0.5,
+                 clock_ms: Callable[[], int] | None = None):
         super().__init__("sealer", idle_wait=0.05)
         self.txpool = txpool
         self.suite = suite
+        # proposal timestamp source: peer-median-aligned when wired to
+        # NodeTimeMaintenance (tool/timesync.py), local UTC otherwise
+        self.clock_ms = clock_ms or (lambda: int(time.time() * 1000))
         self.submit_proposal = submit_proposal
         self.max_txs_per_block = max_txs_per_block
         self.min_seal_time = min_seal_time
@@ -72,8 +76,7 @@ class Sealer(Worker):
         if not txs:
             return
         self._first_pending_at = None
-        header = BlockHeader(number=number,
-                             timestamp=int(time.time() * 1000))
+        header = BlockHeader(number=number, timestamp=self.clock_ms())
         block = Block(header=header, transactions=list(txs),
                       tx_hashes=list(hashes))
         with self._lock:

@@ -9,7 +9,9 @@ commit seals are batch-verified before replay
 here ONE `suite.verify_batch` call across all seals of all fetched blocks).
 
 Wire payloads (module BlockSync):
-  push:     status  = i64 number | blob latest_hash
+  push:     status  = i64 number | blob latest_hash | i64 utc_ms
+            (utc_ms feeds NodeTimeMaintenance, tool/timesync.py — the
+            reference's NodeTimeMaintenance.cpp rides the same gossip)
   request:  range   = i64 from | i64 to
   response: blocks  = seq<blob block-encoding (full txs)>
 """
@@ -34,32 +36,51 @@ MAX_BLOCKS_PER_REQUEST = 32
 
 class BlockSync(Worker):
     def __init__(self, front: FrontService, ledger, scheduler, suite,
-                 status_interval: float = 1.0):
+                 status_interval: float = 1.0, timesync=None):
         super().__init__("block-sync", idle_wait=0.1)
         self.front = front
         self.ledger = ledger
         self.scheduler = scheduler
         self.suite = suite
+        self.timesync = timesync  # tool.timesync.NodeTimeMaintenance
         self.status_interval = status_interval
-        self._peers: dict[bytes, int] = {}  # peer -> latest number
+        # peer -> (latest number, monotonic last-seen); silent peers are
+        # pruned so a departed node can't pin the download target or the
+        # timesync median forever
+        self._peers: dict[bytes, tuple[int, float]] = {}
         self._lock = threading.Lock()
         self._last_status = 0.0
         self._inflight = False
         front.register_module(ModuleID.BlockSync, self._on_message)
 
     # -- worker ------------------------------------------------------------
+    PEER_TTL_INTERVALS = 10  # silent for 10 status periods -> forgotten
+
     def execute_worker(self) -> None:
         now = time.monotonic()
         if now - self._last_status >= self.status_interval:
             self._last_status = now
             self.broadcast_status()
+            self._prune_peers(now)
         self._maybe_download()
+
+    def _prune_peers(self, now: float) -> None:
+        ttl = self.status_interval * self.PEER_TTL_INTERVALS
+        with self._lock:
+            dead = [p for p, (_, seen) in self._peers.items()
+                    if now - seen > ttl]
+            for p in dead:
+                del self._peers[p]
+        for p in dead:
+            if self.timesync is not None:
+                self.timesync.forget_peer(p)
 
     def broadcast_status(self) -> None:
         n = self.ledger.current_number()
         h = self.ledger.header_by_number(n)
         payload = (Writer().i64(n)
-                   .blob(h.hash(self.suite) if h else b"").bytes())
+                   .blob(h.hash(self.suite) if h else b"")
+                   .i64(int(time.time() * 1000)).bytes())
         self.front.broadcast(ModuleID.BlockSync, payload)
 
     def _maybe_download(self) -> None:
@@ -67,7 +88,8 @@ class BlockSync(Worker):
             return
         current = self.ledger.current_number()
         with self._lock:
-            ahead = [(p, n) for p, n in self._peers.items() if n > current]
+            ahead = [(p, n) for p, (n, _) in self._peers.items()
+                     if n > current]
         if not ahead:
             return
         peer, peer_number = max(ahead, key=lambda x: x[1])
@@ -166,13 +188,19 @@ class BlockSync(Worker):
             return
         r = Reader(payload)
         number = r.i64()
+        if self.timesync is not None:
+            try:
+                r.blob()  # latest_hash
+                self.timesync.update_peer_time(src, r.i64())
+            except Exception:
+                pass  # pre-timesync peers: status without a clock field
         with self._lock:
-            self._peers[src] = number
+            self._peers[src] = (number, time.monotonic())
         if number > self.ledger.current_number():
             self.wakeup()
 
     def status(self) -> dict:
         with self._lock:
-            peers = {p.hex()[:16]: n for p, n in self._peers.items()}
+            peers = {p.hex()[:16]: n for p, (n, _) in self._peers.items()}
         return {"blockNumber": self.ledger.current_number(),
                 "peers": peers}

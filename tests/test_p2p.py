@@ -168,3 +168,40 @@ def test_peer_acl_allow_and_deny():
     finally:
         for gw in (gw0, gw1, gw2):
             gw.stop()
+
+
+def test_zstd_codec_negotiation():
+    """zstd frames are used only when EVERY session negotiated CAP_ZSTD;
+    a single legacy peer downgrades the mesh to zlib (no frame loss)."""
+    import fisco_bcos_tpu.net.p2p as p2p_mod
+    from fisco_bcos_tpu.net.p2p import FLAG_COMPRESSED, FLAG_ZSTD, P2PGateway
+
+    suite = make_suite(backend="host")
+    kps = [suite.generate_keypair(bytes([i + 90]) * 16) for i in range(2)]
+    gws = [P2PGateway(kp.pub_bytes, compress_threshold=64) for kp in kps]
+
+    class _F:
+        def on_network_message(self, src, data):
+            pass
+
+    for gw, kp in zip(gws, kps):
+        gw.register_front(kp.pub_bytes, _F())
+    gws[0].add_peer(gws[1].host, gws[1].port)
+    gws[1].add_peer(gws[0].host, gws[0].port)
+    try:
+        assert wait_until(lambda: all(len(g._sessions) == 1 for g in gws))
+        # both sides advertised CAP_ZSTD (zstandard importable here)
+        flag, payload = gws[0]._encode_payload(b"z" * 512)
+        assert flag == FLAG_ZSTD
+        assert p2p_mod._zstd.ZstdDecompressor().decompress(
+            payload, max_output_size=1 << 16) == b"z" * 512
+        # simulate one legacy peer: clear its negotiated capability
+        with gws[0]._lock:
+            for s in gws[0]._sessions.values():
+                s.caps = 0
+            gws[0]._recompute_codec_locked()
+        flag, payload = gws[0]._encode_payload(b"z" * 512)
+        assert flag == FLAG_COMPRESSED  # zlib fallback, still compressed
+    finally:
+        for g in gws:
+            g.stop()

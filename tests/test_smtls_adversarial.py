@@ -1,0 +1,222 @@
+"""Adversarial SM-TLS record/handshake tests (VERDICT r3 #9).
+
+Active-attacker scenarios against net/smtls.py beyond the existing
+tamper/replay suite: truncation, splicing, reflection, reordering,
+mid-stream handshake injection, oversized records, and downgrade-shaped
+mischief. The channel must fail CLOSED (SMTLSError or EOF) in every case
+— never deliver attacker-influenced plaintext.
+
+Compatibility note (documented in net/smtls.py): this is a from-scratch
+GMSSL-style protocol, not GB/T 38636 TLCP on the wire; it does not
+interoperate with TASSL peers.
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from fisco_bcos_tpu.net.smtls import (
+    CertificateAuthority,
+    SMTLSContext,
+    SMTLSError,
+)
+
+HANDSHAKE_FRAMES = 2  # hello + transcript signature, each direction
+
+
+def handshake_through_mitm(mutator=None):
+    """Client <-> MITM <-> server. The MITM forwards handshake frames
+    untouched, then hands control of the raw sockets to `mutator` (or
+    just keeps forwarding). Returns (client, server, mitm_c, mitm_s,
+    pump_thread)."""
+    ca = CertificateAuthority(seed=b"adv" * 8)
+    srv_ctx = SMTLSContext(ca.pub, ca.issue("server"))
+    cli_ctx = SMTLSContext(ca.pub, ca.issue("client"))
+    c_inner, mitm_c = socket.socketpair()
+    mitm_s, s_inner = socket.socketpair()
+
+    def read_frame(src):
+        head = src.recv(4)
+        if len(head) < 4:
+            raise OSError("closed")
+        (ln,) = struct.unpack(">I", head)
+        body = b""
+        while len(body) < ln:
+            chunk = src.recv(ln - len(body))
+            if not chunk:
+                raise OSError("closed")
+            body += chunk
+        return head + body
+
+    state = {}
+
+    def pump():
+        try:
+            for _ in range(HANDSHAKE_FRAMES):
+                for src, dst in ((mitm_c, mitm_s), (mitm_s, mitm_c)):
+                    dst.sendall(read_frame(src))
+            if mutator is not None:
+                mutator(mitm_c, mitm_s, read_frame)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    res = {}
+
+    def srv():
+        try:
+            res["sock"] = srv_ctx.wrap_socket(s_inner, server_side=True)
+        except SMTLSError as exc:
+            res["err"] = exc
+
+    st = threading.Thread(target=srv, daemon=True)
+    st.start()
+    client = cli_ctx.wrap_socket(c_inner, server_side=False)
+    st.join(10)
+    state.update(res)
+    return client, state.get("sock"), mitm_c, mitm_s, t
+
+
+def test_truncated_record_yields_eof_not_plaintext():
+    """Cutting a record mid-body and closing must surface as EOF/error,
+    never partial attacker-chosen plaintext."""
+    def mutate(mitm_c, mitm_s, read_frame):
+        frame = read_frame(mitm_c)
+        mitm_s.sendall(frame[:len(frame) // 2])  # half a record
+        mitm_s.close()
+
+    c, s, *_ = handshake_through_mitm(mutate)
+    c.sendall(b"top secret payload")
+    # server sees EOF (b"") or an explicit error — never data
+    try:
+        got = s.recv(64)
+        assert got == b""
+    except SMTLSError:
+        pass
+    c.close()
+    s.close()
+
+
+def test_spliced_records_rejected():
+    """Two captured records spliced into one frame: the MAC covers
+    seq||ct, so any re-framing of honest bytes must fail."""
+    def mutate(mitm_c, mitm_s, read_frame):
+        f1 = read_frame(mitm_c)
+        f2 = read_frame(mitm_c)
+        body = f1[4:] + f2[4:]
+        mitm_s.sendall(struct.pack(">I", len(body)) + body)
+
+    c, s, *_ = handshake_through_mitm(mutate)
+    c.sendall(b"record one")
+    c.sendall(b"record two")
+    with pytest.raises(SMTLSError):
+        s.recv(64)
+    c.close()
+    s.close()
+
+
+def test_reflection_rejected():
+    """Echoing a peer's own record back at it must fail: send/recv keys
+    are role-bound, so a reflected record's MAC cannot verify."""
+    def mutate(mitm_c, mitm_s, read_frame):
+        frame = read_frame(mitm_c)  # client's data record
+        mitm_c.sendall(frame)       # reflect to the CLIENT
+
+    c, s, *_ = handshake_through_mitm(mutate)
+    c.sendall(b"bounce me")
+    with pytest.raises(SMTLSError):
+        c.recv(64)
+    c.close()
+    s.close()
+
+
+def test_reordered_records_rejected():
+    """Delivering record 2 before record 1 violates the sequence binding
+    (replay/reorder protection)."""
+    def mutate(mitm_c, mitm_s, read_frame):
+        f1 = read_frame(mitm_c)
+        f2 = read_frame(mitm_c)
+        mitm_s.sendall(f2)
+        mitm_s.sendall(f1)
+
+    c, s, *_ = handshake_through_mitm(mutate)
+    c.sendall(b"first")
+    c.sendall(b"second")
+    with pytest.raises(SMTLSError):
+        s.recv(64)
+    c.close()
+    s.close()
+
+
+def test_mid_stream_hello_injection_rejected():
+    """Renegotiation-shaped garbage: a fresh handshake hello injected
+    into an established channel is just an unauthenticated record."""
+    def mutate(mitm_c, mitm_s, read_frame):
+        ca2 = CertificateAuthority(seed=b"evil" * 8)
+        ctx2 = SMTLSContext(ca2.pub, ca2.issue("mallory"))
+        from fisco_bcos_tpu.crypto import refimpl
+        eph_sk, eph_pub = refimpl.keygen(refimpl.SM2P256V1, b"e" * 16)
+        hello = ctx2._hello(b"\x41" * 32, eph_pub)
+        mitm_s.sendall(struct.pack(">I", len(hello)) + hello)
+
+    c, s, *_ = handshake_through_mitm(mutate)
+    with pytest.raises(SMTLSError):
+        s.recv(64)
+    c.close()
+    s.close()
+
+
+def test_oversized_record_header_rejected():
+    """A length header beyond the record cap must be refused before any
+    allocation (no memory bomb)."""
+    def mutate(mitm_c, mitm_s, read_frame):
+        mitm_s.sendall(struct.pack(">I", (16 * 1024 * 1024) + 1))
+        mitm_s.sendall(b"\x00" * 64)
+
+    c, s, *_ = handshake_through_mitm(mutate)
+    with pytest.raises(SMTLSError):
+        s.recv(64)
+    c.close()
+    s.close()
+
+
+def test_handshake_frame_truncation_fails_closed():
+    """Truncating the FIRST handshake frame (downgrade-style interference)
+    aborts the handshake on at least one side; no channel half-opens."""
+    ca = CertificateAuthority(seed=b"dg" * 8)
+    srv_ctx = SMTLSContext(ca.pub, ca.issue("server"))
+    cli_ctx = SMTLSContext(ca.pub, ca.issue("client"))
+    c_inner, mitm_c = socket.socketpair()
+    mitm_s, s_inner = socket.socketpair()
+
+    def pump():
+        try:
+            head = mitm_c.recv(4)
+            (ln,) = struct.unpack(">I", head)
+            body = b""
+            while len(body) < ln:
+                body += mitm_c.recv(ln - len(body))
+            mitm_s.sendall(head + body[:ln // 3])
+            mitm_s.close()
+            mitm_c.close()
+        except OSError:
+            pass
+
+    threading.Thread(target=pump, daemon=True).start()
+    res = {}
+
+    def srv():
+        try:
+            res["sock"] = srv_ctx.wrap_socket(s_inner, server_side=True)
+        except (SMTLSError, OSError) as exc:
+            res["err"] = exc
+
+    st = threading.Thread(target=srv, daemon=True)
+    st.start()
+    with pytest.raises((SMTLSError, OSError)):
+        cli_ctx.wrap_socket(c_inner, server_side=False)
+    st.join(10)
+    assert "sock" not in res  # server never produced a usable channel

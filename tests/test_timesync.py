@@ -1,0 +1,93 @@
+"""Peer clock-skew maintenance (NodeTimeMaintenance.cpp analogue)."""
+
+import time
+
+from fisco_bcos_tpu.tool.timesync import (
+    MAX_TIME_OFFSET_MS,
+    MIN_TIME_OFFSET_MS,
+    NodeTimeMaintenance,
+    utc_ms,
+)
+
+
+def test_median_offset_alignment():
+    tm = NodeTimeMaintenance()
+    now = utc_ms()
+    # three peers: +10min, +12min, -2min -> median +10min
+    tm.update_peer_time(b"p1", now + 600_000, local_time_ms=now)
+    tm.update_peer_time(b"p2", now + 720_000, local_time_ms=now)
+    tm.update_peer_time(b"p3", now - 120_000, local_time_ms=now)
+    assert tm.median_offset_ms() == 600_000
+    aligned = tm.aligned_time_ms()
+    assert abs(aligned - (utc_ms() + 600_000)) < 2_000
+
+
+def test_small_jitter_ignored():
+    tm = NodeTimeMaintenance()
+    now = utc_ms()
+    tm.update_peer_time(b"p1", now + 500_000, local_time_ms=now)
+    # sub-threshold wobble: estimate unchanged
+    tm.update_peer_time(b"p1", now + 500_000 + MIN_TIME_OFFSET_MS - 1,
+                        local_time_ms=now)
+    assert tm.median_offset_ms() == 500_000
+    # above-threshold move: estimate updates
+    tm.update_peer_time(b"p1", now + 500_000 + MIN_TIME_OFFSET_MS + 1000,
+                        local_time_ms=now)
+    assert tm.median_offset_ms() == 500_000 + MIN_TIME_OFFSET_MS + 1000
+
+
+def test_single_drifter_does_not_move_median():
+    tm = NodeTimeMaintenance()
+    now = utc_ms()
+    for i, p in enumerate((b"a", b"b", b"c", b"d")):
+        tm.update_peer_time(p, now + i, local_time_ms=now)
+    tm.update_peer_time(b"evil", now + MAX_TIME_OFFSET_MS * 3,
+                        local_time_ms=now)
+    assert tm.median_offset_ms() < 1_000  # robust to one far-off peer
+
+
+def test_forget_peer():
+    tm = NodeTimeMaintenance()
+    now = utc_ms()
+    tm.update_peer_time(b"p1", now + 900_000, local_time_ms=now)
+    assert tm.median_offset_ms() == 900_000
+    tm.forget_peer(b"p1")
+    assert tm.median_offset_ms() == 0
+
+
+def test_status_gossip_feeds_timesync():
+    """Two gateway-connected nodes exchange sync status; each learns the
+    other's clock and the sealer's clock source follows the median."""
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+    from fisco_bcos_tpu.net.gateway import FakeGateway
+
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    kps = [suite.generate_keypair(bytes([i + 71]) * 16) for i in range(2)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in kps]
+    nodes = []
+    for kp in kps:
+        n = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                            min_seal_time=0.0), keypair=kp,
+                 gateway=gateway)
+        n.build_genesis(sealers)
+        nodes.append(n)
+    for n in nodes:
+        n.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(len(n.timesync._offsets) >= 1 for n in nodes):
+                break
+            time.sleep(0.1)
+        assert all(len(n.timesync._offsets) >= 1 for n in nodes)
+        # same-machine clocks: offsets near zero, sealer clock sane
+        for n in nodes:
+            assert abs(n.timesync.median_offset_ms()) < 5_000
+            assert abs(n.sealer.clock_ms() - utc_ms()) < 5_000
+    finally:
+        for n in nodes:
+            n.stop()
+        gateway.stop()

@@ -42,6 +42,40 @@ def log(msg: str) -> None:
     print(f"[{_now()}] {msg}", flush=True)
 
 
+def _run_ingest(args) -> dict | None:
+    """After a successful kernel sweep: capture BASELINE row 4 (50k mixed
+    secp+SM2 ingest) on the same healthy window; merge into the last-good
+    record. Bounded; failures are non-fatal."""
+    try:
+        n = int(os.environ.get("SWEEP_INGEST_N", "50000"))
+        r = subprocess.run(
+            [sys.executable, "-u",
+             os.path.join(_REPO, "benchmark", "ingest_bench.py"),
+             "--mixed", "-n", str(n)],
+            cwd=_REPO, timeout=2400, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        if r.returncode != 0:
+            log(f"ingest bench failed rc={r.returncode}:\n"
+                f"{(r.stdout or '')[-800:]}")
+            return None
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        rec = json.loads(line)
+        import bench as bench_mod
+
+        def merge(lg):
+            lg.setdefault("configs", {})[rec["metric"]] = {
+                **rec, "measured_at":
+                    time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+            return lg
+
+        bench_mod.update_last_good(merge)
+        return rec
+    except Exception as exc:  # noqa: BLE001 — never kill the watcher
+        log(f"ingest bench error: {type(exc).__name__}: {exc}")
+        return None
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--probe-interval", type=float, default=180.0)
@@ -86,6 +120,9 @@ def main() -> None:
                         state["sweeps_ok"] += 1
                         last_sweep_ok_at = time.time()
                         log(f"sweep OK:\n{tail}")
+                        self_ingest = _run_ingest(args)
+                        if self_ingest:
+                            log(f"ingest OK: {self_ingest}")
                     else:
                         state["sweeps_failed"] += 1
                         log(f"sweep FAILED rc={r.returncode}:\n{tail}")
