@@ -88,9 +88,12 @@ class PBFTEngine(Worker):
     def __init__(self, suite, keypair, front: FrontService, txpool, sealer,
                  scheduler, ledger, leader_period: int = 1,
                  view_timeout: float = 3.0, txsync=None,
-                 full_proposals: bool = False, persist: bool = True):
+                 full_proposals: bool = False, persist: bool = True,
+                 clock_ms=None):
         super().__init__("pbft", idle_wait=0.02)
         self.suite = suite
+        # aligned clock source (tool/timesync.py median); raw UTC fallback
+        self.clock_ms = clock_ms or (lambda: int(time.time() * 1000))
         self.keypair = keypair
         self.front = front
         self.txpool = txpool
@@ -398,7 +401,9 @@ class PBFTEngine(Worker):
         header.sealer = self.index
         header.sealer_list = list(self.nodes)
         if not carried:
-            header.timestamp = max(header.timestamp, int(time.time() * 1000))
+            # floor at the ALIGNED clock: raw local time here would undo
+            # the sealer's median alignment exactly when our clock is fast
+            header.timestamp = max(header.timestamp, self.clock_ms())
         # bind the tx set into the proposal identity before any roots exist
         header.txs_root = self.suite.merkle_root(
             block.tx_hashes or [t.hash(self.suite) for t in block.transactions])

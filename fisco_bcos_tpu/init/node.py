@@ -179,7 +179,8 @@ class Node:
                 self.sealer, self.scheduler, self.ledger,
                 leader_period=self.config.leader_period,
                 view_timeout=self.config.view_timeout,
-                txsync=self.txsync)
+                txsync=self.txsync,
+                clock_ms=self.timesync.aligned_time_ms)
         self.consensus.start()
         self.sealer.start()
 

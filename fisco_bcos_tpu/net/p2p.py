@@ -38,7 +38,7 @@ import time
 import zlib
 
 try:  # zstd frame compression (libp2p/P2PMessageV2.h uses zstd); zlib
-    # remains the decode fallback for mixed-version meshes
+    # remains the fallback for peers without the zstandard module
     import zstandard as _zstd
     _ZC = _zstd.ZstdCompressor(level=3)
 except Exception:  # pragma: no cover — environment without zstandard
@@ -50,7 +50,11 @@ from ..utils.log import LOG, badge
 from .gateway import Gateway
 
 MAGIC = b"FBTP"
-VERSION = 3  # v3: capability byte in the hello (zstd negotiation)
+# v3: capability byte in the hello (zstd negotiation). The handshake is
+# strictly version-gated: a mesh upgrades wire versions flag-day style
+# (mixed-VERSION peers cannot connect); the zlib fallback below covers
+# same-version peers whose environment lacks the zstandard module.
+VERSION = 3
 CAP_ZSTD = 1
 MAX_FRAME = 128 * 1024 * 1024
 MAX_SEND_QUEUE = 64 * 1024 * 1024  # per-session outbound byte budget
@@ -305,12 +309,19 @@ class P2PGateway(Gateway):
             return sorted(set(self._sessions) | set(self._router.reachable()))
 
     def _recompute_codec_locked(self) -> None:
-        """zstd is used only when EVERY live session negotiated CAP_ZSTD —
-        broadcast compresses once, so the codec is the mesh-wide lowest
-        common denominator (recomputed on session up/down)."""
-        self._use_zstd = (_ZC is not None and bool(self._sessions) and
-                          all(getattr(s, "caps", 0) & CAP_ZSTD
-                              for s in self._sessions.values()))
+        """zstd is used only when every DIRECT session negotiated
+        CAP_ZSTD and no peer is multi-hop (transit forwards frames
+        unmodified, so a distant peer's capability is unknown — the
+        mesh-wide lowest common denominator must include them; full-mesh
+        consortium deployments keep zstd, line/star topologies degrade
+        to zlib). Recomputed on session AND route changes."""
+        if _ZC is None or not self._sessions:
+            self._use_zstd = False
+            return
+        direct_ok = {p for p, s in self._sessions.items()
+                     if getattr(s, "caps", 0) & CAP_ZSTD}
+        reachable = set(self._sessions) | set(self._router.reachable())
+        self._use_zstd = reachable <= direct_ok
 
     def _encode_payload(self, data: bytes) -> tuple[int, bytes]:
         if len(data) >= self.compress_threshold:
@@ -543,6 +554,7 @@ class P2PGateway(Gateway):
                 changed = self._router.update_vector(peer_id, vector)
                 if changed:
                     self._topo_version += 1
+                    self._recompute_codec_locked()  # reachability changed
             if changed:
                 self._advertise_routes()
             return

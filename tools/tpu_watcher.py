@@ -42,29 +42,30 @@ def log(msg: str) -> None:
     print(f"[{_now()}] {msg}", flush=True)
 
 
-def _run_ingest(args) -> dict | None:
-    """After a successful kernel sweep: capture BASELINE row 4 (50k mixed
-    secp+SM2 ingest) on the same healthy window; merge into the last-good
-    record. Bounded; failures are non-fatal."""
+def _run_bench(script: str, argv: list[str], key: str,
+               timeout: float) -> dict | None:
+    """Run a benchmark script on the healthy window, parse its one JSON
+    line, merge it into BENCH_LAST_GOOD.json under `key`. Bounded;
+    failures are logged, never fatal."""
     try:
-        n = int(os.environ.get("SWEEP_INGEST_N", "50000"))
         r = subprocess.run(
             [sys.executable, "-u",
-             os.path.join(_REPO, "benchmark", "ingest_bench.py"),
-             "--mixed", "-n", str(n)],
-            cwd=_REPO, timeout=2400, stdout=subprocess.PIPE,
+             os.path.join(_REPO, "benchmark", script), *argv],
+            cwd=_REPO, timeout=timeout, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         if r.returncode != 0:
-            log(f"ingest bench failed rc={r.returncode}:\n"
+            log(f"{script} failed rc={r.returncode}:\n"
                 f"{(r.stdout or '')[-800:]}")
             return None
-        line = [ln for ln in r.stdout.splitlines()
-                if ln.startswith("{")][-1]
-        rec = json.loads(line)
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        if not lines:
+            log(f"{script}: no JSON line in output")
+            return None
+        rec = json.loads(lines[-1])
         import bench as bench_mod
 
         def merge(lg):
-            lg.setdefault("configs", {})[rec["metric"]] = {
+            lg.setdefault("configs", {})[key] = {
                 **rec, "measured_at":
                     time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
             return lg
@@ -72,8 +73,20 @@ def _run_ingest(args) -> dict | None:
         bench_mod.update_last_good(merge)
         return rec
     except Exception as exc:  # noqa: BLE001 — never kill the watcher
-        log(f"ingest bench error: {type(exc).__name__}: {exc}")
+        log(f"{script} error: {type(exc).__name__}: {exc}")
         return None
+
+
+def _run_profile() -> dict | None:
+    """Per-kernel scan-step breakdown (VERDICT r3 #1)."""
+    return _run_bench("profile_kernels.py", ["--json"], "profile", 1800)
+
+
+def _run_ingest() -> dict | None:
+    """BASELINE row 4: 50k mixed secp+SM2 ingest."""
+    n = os.environ.get("SWEEP_INGEST_N", "50000")
+    return _run_bench("ingest_bench.py", ["--mixed", "-n", n],
+                      f"txpool_ingest_mixed_{n}", 2400)
 
 
 def main() -> None:
@@ -120,7 +133,10 @@ def main() -> None:
                         state["sweeps_ok"] += 1
                         last_sweep_ok_at = time.time()
                         log(f"sweep OK:\n{tail}")
-                        self_ingest = _run_ingest(args)
+                        prof = _run_profile()
+                        if prof:
+                            log(f"profile OK: {prof}")
+                        self_ingest = _run_ingest()
                         if self_ingest:
                             log(f"ingest OK: {self_ingest}")
                     else:
