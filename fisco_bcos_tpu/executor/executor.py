@@ -16,6 +16,7 @@ TPU call (ops.merkle), bit-identical on the host fallback.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional, Sequence
 
@@ -110,6 +111,7 @@ class TransactionExecutor:
         self.evm = EVM(suite, registry=self.registry)
         # parallel-annotation cache: address -> (abi bytes, {sel: nparams})
         self._parallel_cache: dict[bytes, tuple[bytes, dict[bytes, int]]] = {}
+        self._dag_pool: Optional[tuple] = None  # cached wave thread pool
 
     # -- single transaction ------------------------------------------------
     def execute_transaction(self, tx: Transaction, state: StateStorage,
@@ -469,19 +471,77 @@ class TransactionExecutor:
 
     def execute_block_dag(self, txs: Sequence[Transaction],
                           state: StateStorage, block_number: int,
-                          timestamp: int) -> list[Receipt]:
+                          timestamp: int,
+                          workers: Optional[int] = None) -> list[Receipt]:
         """Execute in conflict-free waves. Within a wave order is irrelevant
-        by construction, so results equal the serial schedule."""
+        by construction, so results equal the serial schedule.
+
+        Waves with >1 tx run CONCURRENTLY on a thread pool (the
+        reference's tbb wave execution, TransactionExecutor.cpp:143):
+        each tx gets its own overlay over the block state, and overlays
+        merge back in tx order — disjoint by the planner's guarantee, so
+        the merge order is cosmetic. With the native frame interpreter
+        the ctypes calls release the GIL, so waves genuinely use
+        multiple cores; workers=1 (or single-tx waves) keeps the serial
+        fast path."""
         t0 = time.monotonic()
         waves = self.plan_dag(txs, state)
+        if workers is None:
+            try:  # ops knob (e.g. pin to 1 on oversubscribed hosts);
+                # tolerant parse: a bad value must not kill block execution
+                workers = int(os.environ.get("FBTPU_DAG_WORKERS", "0"))
+            except ValueError:
+                workers = 0
+            workers = workers or min(8, os.cpu_count() or 1)
         receipts: list[Optional[Receipt]] = [None] * len(txs)
-        for wave in waves:
-            for i in wave:
-                receipts[i] = self.execute_transaction(
-                    txs[i], state, block_number, timestamp)
+        pool = None
+        if workers > 1 and any(len(w) > 1 for w in waves):
+            pool = self._wave_pool(workers)
+        try:
+            for wave in waves:
+                if pool is None or len(wave) == 1:
+                    for i in wave:
+                        receipts[i] = self.execute_transaction(
+                            txs[i], state, block_number, timestamp)
+                    continue
+
+                def run_one(i: int):
+                    overlay = StateStorage(state)
+                    rc = self.execute_transaction(
+                        txs[i], overlay, block_number, timestamp)
+                    return i, rc, overlay.changeset()
+
+                for i, rc, cs in pool.map(run_one, wave):
+                    receipts[i] = rc
+                    for (table, key), entry in cs.items():
+                        if entry.deleted:
+                            state.remove(table, key)
+                        else:
+                            state.set(table, key, entry.value)
+        except BaseException:
+            if pool is not None:
+                # abandon queued wave tasks so orphaned workers don't keep
+                # touching a state the caller is about to discard; the
+                # cached pool is finished, a future block gets a fresh one
+                pool.shutdown(wait=False, cancel_futures=True)
+                self._dag_pool = None
+            raise
         metric("executor.dag", n=len(txs), waves=len(waves),
-               ms=int((time.monotonic() - t0) * 1000))
+               workers=workers, ms=int((time.monotonic() - t0) * 1000))
         return [r for r in receipts]
+
+    def _wave_pool(self, workers: int):
+        """Cached wave thread pool (per-block spawn/teardown stays off the
+        consensus-critical path); resized on a workers change."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool, size = self._dag_pool or (None, 0)
+        if pool is None or size != workers:
+            if pool is not None:
+                pool.shutdown(wait=False)
+            pool = ThreadPoolExecutor(workers, thread_name_prefix="dag")
+            self._dag_pool = (pool, workers)
+        return pool
 
     # -- contract metadata (getCode/getABI RPC; EVM deploy writes these;
     # table layout owned by evm.py — single definition) --------------------

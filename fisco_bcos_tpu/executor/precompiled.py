@@ -205,8 +205,11 @@ class KVTablePrecompile(Precompile):
             if method in ("set", "get"):
                 table = T_USER_PREFIX + r.text()
                 return [table.encode() + b"/" + r.blob()]
-            if method == "createTable":
-                return [(T_USER_PREFIX + r.text()).encode()]
+            # createTable stays OPAQUE (full barrier): set/get read the
+            # table's __meta__ row, which per-key conflict keys don't
+            # cover — a same-wave createTable+set would race. Matches
+            # the reference, where only registered parallel methods are
+            # DAG-scheduled at all.
         except Exception:
             pass
         return None

@@ -146,3 +146,48 @@ def test_mixed_block_dag_equals_serial():
     assert [(r.status, r.gas_used, r.output) for r in dag_receipts] == \
         [(r.status, r.gas_used, r.output) for r in serial_receipts]
     assert sorted(st1.changeset().items()) == sorted(st2.changeset().items())
+
+
+def test_parallel_wave_execution_equals_serial():
+    """Thread-pooled wave execution (per-tx overlays merged back) must be
+    bit-identical to workers=1 serial execution — receipts AND state."""
+    contract = b"\x55" * 20
+
+    def build(ex, st, kp):
+        st.set("s_code", contract, SET_ACCT_CODE)
+        st.set(ex.T_ABI, contract, PARALLEL_ABI.encode())
+        txs = [balance_tx(kp, f"pr{i}", "register", b"P%d" % i, 100)
+               for i in range(6)]
+        txs += [evm_tx(kp, f"pe{i}", contract, i + 1, i * 10)
+                for i in range(6)]
+        txs += [balance_tx(kp, "pt", "transfer", b"P0", b"P1", 5)]
+        return txs
+
+    results = []
+    for workers in (1, 4):
+        ex, st, kp = fresh()
+        txs = build(ex, st, kp)
+        rcs = ex.execute_block_dag(txs, st, 1, 0, workers=workers)
+        results.append((
+            [(r.status, r.gas_used, r.output) for r in rcs],
+            sorted(st.changeset().items()),
+        ))
+    assert results[0] == results[1]
+
+
+def test_create_table_then_set_same_block():
+    """createTable must act as a barrier: a set to the just-created table
+    later in the same block sees it, parallel or serial."""
+    for workers in (1, 4):
+        ex, st, kp = fresh()
+        txs = [make_tx(SUITE, kp, pc.KV_TABLE_ADDRESS,
+                       pc.encode_call("createTable",
+                                      lambda w: w.text("tnew")), "ct"),
+               kv_tx(kp, "cs1", "tnew", b"k1", b"v1"),
+               kv_tx(kp, "cs2", "tnew", b"k2", b"v2")]
+        rcs = ex.execute_block_dag(txs, st, 1, 0, workers=workers)
+        assert [r.status for r in rcs] == [0, 0, 0], \
+            [(r.status, r.message) for r in rcs]
+        assert st.get("u_tnew", b"k1") == b"v1"
+        waves = ex.plan_dag(txs, st)
+        assert waves[0] == [0]  # createTable is a barrier wave
