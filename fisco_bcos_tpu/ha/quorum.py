@@ -219,6 +219,15 @@ class QuorumLeaseElection(ElectionStateMachine):
                                         name=f"qelection-{self.member_id}")
         self._thread.start()
 
+    def abdicate(self) -> None:
+        """Give up current leadership (release grants, demote quietly)
+        but KEEP campaigning — used when the elected party cannot
+        actually take up its duties (e.g. activation failed) so another
+        replica, or a later retry here, can win instead of this process
+        zombie-holding the lease."""
+        self._release_all()
+        self._demote(quiet=True)
+
     def stop(self, release: bool = True) -> None:
         """release=False simulates a crash: grants expire by TTL instead
         of being released, so a successor must wait out the lease."""

@@ -1,0 +1,144 @@
+"""Max-mode composition: sharded storage + quorum-elected hot standby.
+
+Reference counterpart: Max deployments (README.md:17-21) run every module
+as a service, commit through distributed TiKV storage
+(bcos-storage/bcos-storage/TiKVStorage.h:50-105) and elect ONE active
+master via etcd leases (bcos-leader-election/src/LeaderElection.h:30-92,
+SchedulerManager term switching). This module is that composition with
+the framework's own machinery:
+
+* :func:`start_storage_shard` / :func:`start_lease_registry` — the
+  storage-cluster and election-registry processes (one call each per
+  process; Max runs 3+ of each on separate hosts).
+* :class:`MaxNode` — a node replica that holds chain state ONLY in the
+  shared shard cluster and campaigns for the master lease. The ELECTED
+  replica constructs and starts the actual Node (so a standby never
+  binds the network identity); on seizure it stops the node and keeps
+  campaigning. Because all replicas commit through the same cluster,
+  a failover continues the chain exactly where the dead master left it
+  — the chain itself is the checkpoint (SURVEY §5).
+
+Failover discipline: activation happens on the election thread via
+on_elected; deactivation on_seized. `never_both_active` is guaranteed by
+the quorum lease (no dual leadership) — tests/test_max_node.py races two
+replicas through a crash to verify end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..ha.quorum import LeaseRegistryServer, QuorumLeaseElection
+from ..init.node import Node, NodeConfig
+from ..storage.sharded import (
+    DurablePrepareStorage,
+    ShardServer,
+    ShardedStorage,
+    make_shard_client,
+)
+from ..storage.wal import WalStorage
+from ..utils.log import LOG, badge
+
+
+def start_storage_shard(data_dir: str, host: str = "127.0.0.1",
+                        port: int = 0) -> ShardServer:
+    """One storage-cluster member: durable-prepare WAL engine behind the
+    shard service. Returns the started server (`.port` for registry)."""
+    backend = DurablePrepareStorage(WalStorage(f"{data_dir}/wal"),
+                                    f"{data_dir}/prep")
+    srv = ShardServer(backend, host, port)
+    srv.start()
+    return srv
+
+
+def start_lease_registry(state_path: Optional[str] = None,
+                         host: str = "127.0.0.1",
+                         port: int = 0) -> LeaseRegistryServer:
+    """One election-registry member (the etcd stand-in)."""
+    srv = LeaseRegistryServer(state_path=state_path, host=host, port=port)
+    srv.start()
+    return srv
+
+
+class MaxNode:
+    """A hot-standby node replica over a shared shard cluster."""
+
+    def __init__(self, cfg: NodeConfig, shard_addrs: list[tuple[str, int]],
+                 registry_addrs: list[tuple[str, int]], member_id: str,
+                 keypair=None, gateway=None, lease_ttl: float = 3.0,
+                 heartbeat: float = 1.0):
+        self.cfg = cfg
+        self.shard_addrs = list(shard_addrs)
+        self.keypair = keypair
+        self.gateway = gateway
+        self.member_id = member_id
+        self.node: Optional[Node] = None
+        self._lock = threading.Lock()
+        self.election = QuorumLeaseElection(
+            registry_addrs, member_id,
+            key=f"{cfg.chain_id}/{cfg.group_id}/master",
+            lease_ttl=lease_ttl, heartbeat=heartbeat)
+        self.election.on_elected(self._activate)
+        self.election.on_seized(self._deactivate)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Begin campaigning; the node itself starts only when elected."""
+        self.election.start()
+
+    def stop(self, release: bool = True) -> None:
+        # deactivate BEFORE releasing the leases: a standby must not win
+        # the freed lease while this node is still sealing/committing
+        # (the release path would otherwise open a dual-active window)
+        if release:
+            self._deactivate()
+        self.election.stop(release=release)
+        self._deactivate()
+
+    def is_active(self) -> bool:
+        with self._lock:
+            return self.node is not None and self.node._started
+
+    # -- election callbacks ------------------------------------------------
+    def _activate(self) -> None:
+        with self._lock:
+            if self.node is not None:
+                return
+            fence = self.election.fence_token()
+            LOG.info(badge("MAX", "master-activating",
+                           member=self.member_id, fence=fence))
+            try:
+                # the coordinator recovers any in-doubt block left by the
+                # previous master before this node reads the chain head;
+                # its fence token makes every 2PC op refuse a deposed
+                # master's stale writes shard-side (StaleFenceError)
+                sharded = ShardedStorage(
+                    [make_shard_client(h, p) for h, p in self.shard_addrs],
+                    fence=fence)
+                self.node = Node(self.cfg, keypair=self.keypair,
+                                 gateway=self.gateway, storage=sharded)
+                self.node.start()
+            except Exception:
+                LOG.exception(badge("MAX", "activation-failed",
+                                    member=self.member_id))
+                node, self.node = self.node, None
+                if node is not None:
+                    try:
+                        node.stop()
+                    except Exception:  # noqa: BLE001
+                        pass
+                # give up the lease so another replica (or a later retry
+                # here) can serve, instead of zombie-holding leadership
+                self.election.abdicate()
+
+    def _deactivate(self) -> None:
+        with self._lock:
+            node, self.node = self.node, None
+        if node is not None:
+            LOG.warning(badge("MAX", "master-deactivating",
+                              member=self.member_id))
+            node.stop()
+            close = getattr(node.storage, "close", None)
+            if close:
+                close()

@@ -83,6 +83,13 @@ def _decode_staged(payload: bytes) -> tuple[int, bytes, ChangeSet]:
     return block_number, attempt, _read_changeset(r)
 
 
+class StaleFenceError(RuntimeError):
+    """2PC op carried a fence token below the shard's highest-seen: the
+    caller is a deposed master whose writes must not land (the etcd-
+    revision fencing the reference gets for free; here tokens come from
+    ha/quorum.py's strictly-increasing proposals)."""
+
+
 class DurablePrepareStorage(TransactionalStorage):
     """Make any local engine's ``prepare`` crash-durable.
 
@@ -102,6 +109,14 @@ class DurablePrepareStorage(TransactionalStorage):
         os.makedirs(path, exist_ok=True)
         self._lock = threading.Lock()
         self._pending: dict[int, bytes] = {}  # block -> attempt id
+        # highest fence token seen on any 2PC op, durable across restart
+        # (a rebooted shard must still refuse a deposed master)
+        self._fence_path = os.path.join(path, "fence")
+        try:
+            with open(self._fence_path) as f:
+                self._highest_fence = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            self._highest_fence = 0
         for fname in sorted(os.listdir(path)):
             fp = os.path.join(path, fname)
             if fname.endswith(".tmp"):
@@ -123,6 +138,20 @@ class DurablePrepareStorage(TransactionalStorage):
             self.inner.prepare(n, cs)
             self._pending[n] = attempt
 
+    def _check_fence(self, fence: int) -> None:
+        """Called with the lock held. fence 0 = unfenced deployment (no
+        HA masters); once any positive fence is seen, lower-or-unfenced
+        2PC ops are refused."""
+        if fence < self._highest_fence:
+            raise StaleFenceError(
+                f"fence {fence} < shard high-water {self._highest_fence}")
+        if fence > self._highest_fence:
+            self._highest_fence = fence
+            tmp = self._fence_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(fence))
+            os.replace(tmp, self._fence_path)
+
     def _sidecar(self, block_number: int) -> str:
         return os.path.join(self.path, f"prepared_{block_number}.bin")
 
@@ -134,7 +163,9 @@ class DurablePrepareStorage(TransactionalStorage):
 
     # -- TransactionalStorage ---------------------------------------------
     def prepare(self, block_number: int, changes: ChangeSet,
-                attempt: bytes = b"") -> None:
+                attempt: bytes = b"", fence: int = 0) -> None:
+        with self._lock:
+            self._check_fence(fence)
         payload = _encode_staged(block_number, attempt, changes)
         tmp = self._sidecar(block_number) + ".tmp"
         with open(tmp, "wb") as f:
@@ -147,14 +178,16 @@ class DurablePrepareStorage(TransactionalStorage):
             self.inner.prepare(block_number, changes)
             self._pending[block_number] = attempt
 
-    def commit(self, block_number: int) -> None:
+    def commit(self, block_number: int, fence: int = 0) -> None:
         with self._lock:
+            self._check_fence(fence)
             self.inner.commit(block_number)
             self._pending.pop(block_number, None)
         self._drop_sidecar(block_number)
 
-    def rollback(self, block_number: int) -> None:
+    def rollback(self, block_number: int, fence: int = 0) -> None:
         with self._lock:
+            self._check_fence(fence)
             self.inner.rollback(block_number)
             self._pending.pop(block_number, None)
         self._drop_sidecar(block_number)
@@ -205,6 +238,8 @@ class ShardServer:
         self._read_changeset = _read_changeset
         self._ss.server.register("pending", self._pending)
         self._ss.server.register("prepare2", self._prepare2)
+        self._ss.server.register("commit2", self._commit2)
+        self._ss.server.register("rollback2", self._rollback2)
 
     def _pending(self, r: Reader, w: Writer) -> None:
         w.seq(self.backend.pending(),
@@ -213,8 +248,15 @@ class ShardServer:
     def _prepare2(self, r: Reader, w: Writer) -> None:
         number = r.i64()
         attempt = r.blob()
+        fence = r.i64()
         self.backend.prepare(number, self._read_changeset(r),
-                             attempt=attempt)
+                             attempt=attempt, fence=fence)
+
+    def _commit2(self, r: Reader, w: Writer) -> None:
+        self.backend.commit(r.i64(), fence=r.i64())
+
+    def _rollback2(self, r: Reader, w: Writer) -> None:
+        self.backend.rollback(r.i64(), fence=r.i64())
 
     @property
     def port(self) -> int:
@@ -233,11 +275,19 @@ def make_shard_client(host: str, port: int, timeout: float = 30.0):
 
     class ShardClient(RemoteStorage):
         def prepare(self, block_number: int, changes: ChangeSet,
-                    attempt: bytes = b"") -> None:
+                    attempt: bytes = b"", fence: int = 0) -> None:
             self.client.call(
                 "prepare2",
                 lambda w: (w.i64(block_number), w.blob(attempt),
-                           _write_changeset(w, changes)))
+                           w.i64(fence), _write_changeset(w, changes)))
+
+        def commit(self, block_number: int, fence: int = 0) -> None:
+            self.client.call("commit2",
+                             lambda w: (w.i64(block_number), w.i64(fence)))
+
+        def rollback(self, block_number: int, fence: int = 0) -> None:
+            self.client.call("rollback2",
+                             lambda w: (w.i64(block_number), w.i64(fence)))
 
         def pending(self) -> list[tuple[int, bytes]]:
             r = self.client.call("pending", None)
@@ -252,10 +302,12 @@ class ShardedStorage(TransactionalStorage):
     ShardClients — anything with the TransactionalStorage + attempt-tagged
     prepare + pending() surface). Shard 0 is the primary/commit point."""
 
-    def __init__(self, shards: list, recover: bool = True):
+    def __init__(self, shards: list, recover: bool = True,
+                 fence: int = 0):
         if not shards:
             raise ValueError("need at least one shard")
         self.shards = shards
+        self.fence = fence  # HA master token (ha/quorum.py); 0 = unfenced
         self._lock = threading.Lock()
         # per-staged-block coordinator state (participants / attempt id)
         self._staged: dict[int, tuple[bytes, list[int]]] = {}
@@ -332,7 +384,7 @@ class ShardedStorage(TransactionalStorage):
             participants = [i for i, p in enumerate(parts) if p]
             for i in participants:
                 self.shards[i].prepare(block_number, parts[i],
-                                       attempt=attempt)
+                                       attempt=attempt, fence=self.fence)
             self._staged[block_number] = (attempt, participants)
 
     def commit(self, block_number: int) -> None:
@@ -343,12 +395,12 @@ class ShardedStorage(TransactionalStorage):
             # Secondary failures below are remembered for recover(), never
             # surfaced — raising would make the scheduler roll back and
             # retry a block the cluster has already decided.
-            self.shards[0].commit(block_number)
+            self.shards[0].commit(block_number, fence=self.fence)
             for i in participants:
                 if i == 0:
                     continue
                 try:
-                    self.shards[i].commit(block_number)
+                    self.shards[i].commit(block_number, fence=self.fence)
                 except Exception:  # noqa: BLE001 — converges via recover()
                     LOG.exception(badge("SHARD", "secondary-commit-failed",
                                         shard=i, number=block_number))
@@ -362,7 +414,8 @@ class ShardedStorage(TransactionalStorage):
                 block_number, (b"", range(len(self.shards))))
             for i in participants:
                 try:
-                    self.shards[i].rollback(block_number)
+                    self.shards[i].rollback(block_number,
+                                            fence=self.fence)
                 except Exception:  # noqa: BLE001 — converges via recover()
                     LOG.exception(badge("SHARD", "shard-rollback-failed",
                                         shard=i, number=block_number))
@@ -378,9 +431,9 @@ class ShardedStorage(TransactionalStorage):
                     meta = self.shards[0].get(COMMIT_META, _meta_key(n))
                     committed = meta is not None and meta == attempt
                     if committed:
-                        sh.commit(n)
+                        sh.commit(n, fence=self.fence)
                     else:
-                        sh.rollback(n)
+                        sh.rollback(n, fence=self.fence)
                     decisions.append((sid, n, committed))
             self.unresolved.clear()
         return decisions

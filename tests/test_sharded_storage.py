@@ -341,3 +341,44 @@ def test_chain_commits_through_sharded_cluster(tmp_path):
     finally:
         node.stop()
         st.close()
+
+
+def test_fencing_rejects_deposed_master(tmp_path):
+    """A deposed master (lower fence) must be refused shard-side on every
+    2PC op, even across a shard restart (fence is durable)."""
+    import pytest as _pytest
+
+    from fisco_bcos_tpu.storage.sharded import StaleFenceError
+
+    shards = [
+        DurablePrepareStorage(WalStorage(str(tmp_path / f"s{i}" / "wal")),
+                              str(tmp_path / f"s{i}" / "prep"))
+        for i in range(3)
+    ]
+    old_master = ShardedStorage(shards, fence=1)
+    old_master.prepare(1, cs(("t", b"a", b"old")))
+    old_master.commit(1)
+
+    new_master = ShardedStorage(shards, fence=2)  # failover: higher token
+    new_master.prepare(2, cs(("t", b"a", b"new")))
+    new_master.commit(2)
+
+    # the deposed master resumes from a pause and tries to write
+    with _pytest.raises(StaleFenceError):
+        old_master.prepare(3, cs(("t", b"a", b"stale")))
+    assert new_master.get("t", b"a") == b"new"
+
+    # shard restart keeps the high-water fence
+    for sh in shards:
+        sh.close()
+    shards2 = [
+        DurablePrepareStorage(WalStorage(str(tmp_path / f"s{i}" / "wal")),
+                              str(tmp_path / f"s{i}" / "prep"))
+        for i in range(3)
+    ]
+    old2 = ShardedStorage(shards2, fence=1, recover=False)
+    with _pytest.raises(StaleFenceError):
+        old2.prepare(4, cs(("t", b"b", b"stale")))
+    new2 = ShardedStorage(shards2, fence=2)
+    assert new2.get("t", b"a") == b"new"
+    new2.close()

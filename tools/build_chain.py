@@ -100,6 +100,33 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
     return info
 
 
+def build_max_cluster(out_dir: str, n_shards: int = 3,
+                      n_registries: int = 3,
+                      shard_base_port: int = 21100,
+                      registry_base_port: int = 21200,
+                      host: str = "127.0.0.1") -> dict:
+    """Generate the Max-mode shared-services layout: a sharded storage
+    cluster + lease registries (the TiKV + etcd plane). Boot each member
+    with fisco_bcos_tpu.services.max_node.start_storage_shard /
+    start_lease_registry, and node replicas with MaxNode against
+    max_cluster.json's endpoints."""
+    shards, registries = [], []
+    for i in range(n_shards):
+        d = os.path.join(out_dir, "shards", f"shard{i}")
+        os.makedirs(d, exist_ok=True)
+        shards.append({"dir": d, "host": host,
+                       "port": shard_base_port + i})
+    regs_dir = os.path.join(out_dir, "registries")
+    os.makedirs(regs_dir, exist_ok=True)
+    for i in range(n_registries):
+        registries.append({"state": os.path.join(regs_dir, f"reg{i}.json"),
+                           "host": host, "port": registry_base_port + i})
+    cluster = {"shards": shards, "registries": registries}
+    with open(os.path.join(out_dir, "max_cluster.json"), "w") as f:
+        json.dump(cluster, f, indent=2)
+    return cluster
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--nodes", type=int, default=4)
@@ -115,6 +142,11 @@ def main() -> None:
                     help="issue dual-cert SM-TLS credentials per node")
     ap.add_argument("--encrypt-key", default=None,
                     help="passphrase to encrypt node keys at rest")
+    ap.add_argument("--mode", default="air", choices=["air", "max"],
+                    help="max adds the shared shard cluster + lease "
+                         "registries layout (max_cluster.json)")
+    ap.add_argument("--shards", type=int, default=3)
+    ap.add_argument("--registries", type=int, default=3)
     args = ap.parse_args()
     info = build_chain(
         args.output, args.nodes, sm_crypto=args.sm,
@@ -122,6 +154,10 @@ def main() -> None:
         group_id=args.group_id, rpc_base_port=args.rpc_base_port,
         metrics_base_port=args.metrics_base_port, sm_tls=args.sm_tls,
         encrypt_passphrase=args.encrypt_key.encode() if args.encrypt_key else None)
+    if args.mode == "max":
+        info["max_cluster"] = build_max_cluster(
+            args.output, n_shards=args.shards,
+            n_registries=args.registries)
     print(json.dumps(info, indent=2))
 
 
