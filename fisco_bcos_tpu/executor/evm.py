@@ -26,7 +26,9 @@ mirrored bit-for-bit by native/nevm — tests/test_nevm.py enforces):
     any charge/allocation — mainnet relies on gas alone);
   * intrinsic tx gas / calldata gas are not charged (block gas economics
     are governed by the chain's own tx_count_limit / gas_limit configs);
-  * classic precompiles 6..9 (bn ops, blake2f) return empty success;
+  * bn128 PAIRING (address 8) is unsupported: the vacuous empty-input
+    check returns true, any real pairing input fails loudly (bn128
+    add/mul and blake2f ARE implemented — precompile_classic.py);
   * nested frames with per-frame state savepoints (revert unwinds exactly
     the frame's writes — same recoder discipline as the reference's
     executive stack, TransactionExecutive.cpp);
@@ -600,9 +602,42 @@ class EVM:
                 out = pow(b_, e_, m_) if m_ else 0
                 return EVMResult(True, output=out.to_bytes(ml, "big") if ml else b"",
                                  gas_left=gas - cost)
+            if which in (6, 7, 8, 9):
+                from . import precompile_classic as pcc
+            if which in (6, 7):  # alt_bn128 add / mul (EIP-196/1108)
+                cost = pcc.G_BNADD if which == 6 else pcc.G_BNMUL
+                if gas < cost:
+                    return EVMResult(False, gas_left=0, error="oog")
+                try:
+                    out = (pcc.bn128_add(data) if which == 6
+                           else pcc.bn128_mul(data))
+                except pcc.PrecompileInputError as exc:
+                    return EVMResult(False, gas_left=0,
+                                     error=f"bn128: {exc}")
+                return EVMResult(True, output=out, gas_left=gas - cost)
+            if which == 8:  # bn128 pairing: NOT implemented (deviations
+                # list) — vacuous empty-input check answered, anything
+                # else fails loudly instead of lying
+                if gas < pcc.G_PAIRING_BASE:
+                    return EVMResult(False, gas_left=0, error="oog")
+                if len(data) == 0:
+                    return EVMResult(True, output=(1).to_bytes(32, "big"),
+                                     gas_left=gas - pcc.G_PAIRING_BASE)
+                return EVMResult(False, gas_left=0,
+                                 error="bn128 pairing unsupported")
+            if which == 9:  # blake2f (EIP-152)
+                try:  # gas gate BEFORE any compression work (DoS guard)
+                    cost = pcc.blake2f_cost(data)
+                except pcc.PrecompileInputError as exc:
+                    return EVMResult(False, gas_left=0,
+                                     error=f"blake2f: {exc}")
+                if gas < cost:
+                    return EVMResult(False, gas_left=0, error="oog")
+                out, _ = pcc.blake2f(data)
+                return EVMResult(True, output=out, gas_left=gas - cost)
         except Exception as exc:
             return EVMResult(False, gas_left=0, error=f"precompile: {exc}")
-        return None  # 6..9 (bn ops/blake2f) unsupported -> treated as empty
+        return None  # unreachable for 1..9; kept for safety
 
     def _system_contract(self, state, env, to: bytes, data: bytes,
                          gas: int) -> EVMResult:

@@ -18,9 +18,13 @@ the framework's own machinery:
   a failover continues the chain exactly where the dead master left it
   — the chain itself is the checkpoint (SURVEY §5).
 
-Failover discipline: activation happens on the election thread via
-on_elected; deactivation on_seized. `never_both_active` is guaranteed by
-the quorum lease (no dual leadership) — tests/test_max_node.py races two
+Failover discipline: on_elected spawns activation on its OWN thread (so
+lease renewals never stall behind a slow recovery/boot — a lapsed lease
+mid-activation would mint a second master); the activation result is
+adopted only if leadership still holds. Deactivation runs on_seized and
+before any lease release on clean shutdown. The quorum lease prevents
+dual leadership, and shard-side fence tokens reject a deposed master's
+in-flight writes even across pauses — tests/test_max_node.py races two
 replicas through a crash to verify end to end.
 """
 
@@ -74,6 +78,7 @@ class MaxNode:
         self.gateway = gateway
         self.member_id = member_id
         self.node: Optional[Node] = None
+        self._activating = False
         self._lock = threading.Lock()
         self.election = QuorumLeaseElection(
             registry_addrs, member_id,
@@ -102,35 +107,61 @@ class MaxNode:
 
     # -- election callbacks ------------------------------------------------
     def _activate(self) -> None:
+        # run OFF the election thread: activation (recovery + node boot)
+        # can outlast the lease TTL, and blocking the campaign loop would
+        # stop renewals — the lease would lapse mid-activation and a
+        # standby could go active concurrently
+        threading.Thread(target=self._activate_impl, daemon=True,
+                         name=f"max-activate-{self.member_id}").start()
+
+    def _activate_impl(self) -> None:
         with self._lock:
-            if self.node is not None:
+            if self.node is not None or self._activating:
                 return
-            fence = self.election.fence_token()
-            LOG.info(badge("MAX", "master-activating",
-                           member=self.member_id, fence=fence))
-            try:
-                # the coordinator recovers any in-doubt block left by the
-                # previous master before this node reads the chain head;
-                # its fence token makes every 2PC op refuse a deposed
-                # master's stale writes shard-side (StaleFenceError)
-                sharded = ShardedStorage(
-                    [make_shard_client(h, p) for h, p in self.shard_addrs],
-                    fence=fence)
-                self.node = Node(self.cfg, keypair=self.keypair,
-                                 gateway=self.gateway, storage=sharded)
-                self.node.start()
-            except Exception:
-                LOG.exception(badge("MAX", "activation-failed",
-                                    member=self.member_id))
-                node, self.node = self.node, None
-                if node is not None:
-                    try:
-                        node.stop()
-                    except Exception:  # noqa: BLE001
-                        pass
-                # give up the lease so another replica (or a later retry
-                # here) can serve, instead of zombie-holding leadership
-                self.election.abdicate()
+            self._activating = True
+        fence = self.election.fence_token()
+        LOG.info(badge("MAX", "master-activating",
+                       member=self.member_id, fence=fence))
+        sharded = None
+        node = None
+        adopted = False
+        try:
+            # the coordinator recovers any in-doubt block left by the
+            # previous master before this node reads the chain head; its
+            # fence token makes every 2PC op refuse a deposed master's
+            # stale writes shard-side (StaleFenceError)
+            sharded = ShardedStorage(
+                [make_shard_client(h, p) for h, p in self.shard_addrs],
+                fence=fence)
+            node = Node(self.cfg, keypair=self.keypair,
+                        gateway=self.gateway, storage=sharded)
+            node.start()
+            with self._lock:
+                self._activating = False
+                if self.election.is_leader() and self.node is None:
+                    self.node = node
+                    adopted = True
+        except Exception:
+            LOG.exception(badge("MAX", "activation-failed",
+                                member=self.member_id))
+            with self._lock:
+                self._activating = False
+            # give up the lease so another replica (or a later retry
+            # here) can serve, instead of zombie-holding leadership
+            self.election.abdicate()
+        if not adopted:
+            # failed, or leadership was lost while we were booting:
+            # tear everything down (no socket/thread leaks)
+            if node is not None:
+                try:
+                    node.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            if sharded is not None:
+                try:
+                    sharded.close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _deactivate(self) -> None:
         with self._lock:

@@ -150,6 +150,9 @@ class DurablePrepareStorage(TransactionalStorage):
             tmp = self._fence_path + ".tmp"
             with open(tmp, "w") as f:
                 f.write(str(fence))
+                f.flush()
+                os.fsync(f.fileno())  # must survive power loss: a rolled-
+                # back fence would re-admit a deposed master
             os.replace(tmp, self._fence_path)
 
     def _sidecar(self, block_number: int) -> str:
@@ -164,17 +167,19 @@ class DurablePrepareStorage(TransactionalStorage):
     # -- TransactionalStorage ---------------------------------------------
     def prepare(self, block_number: int, changes: ChangeSet,
                 attempt: bytes = b"", fence: int = 0) -> None:
+        payload = _encode_staged(block_number, attempt, changes)
+        # fence check and staging stay under ONE lock hold: releasing
+        # between them would let a deposed master that passed the check
+        # land a stale sidecar after a newer master raised the fence
         with self._lock:
             self._check_fence(fence)
-        payload = _encode_staged(block_number, attempt, changes)
-        tmp = self._sidecar(block_number) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(_SIDE_HDR.pack(zlib.crc32(payload), len(payload)))
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._sidecar(block_number))
-        with self._lock:
+            tmp = self._sidecar(block_number) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(_SIDE_HDR.pack(zlib.crc32(payload), len(payload)))
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._sidecar(block_number))
             self.inner.prepare(block_number, changes)
             self._pending[block_number] = attempt
 

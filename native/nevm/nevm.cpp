@@ -1151,11 +1151,16 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
         }
         case 0xF3: {  // RETURN
           U256 off = f.pop(), size = f.pop();
-          return finish(0, f.read_mem(off, size), f.gas, nullptr);
+          // sequence the read BEFORE f.gas is observed: read_mem charges
+          // memory expansion, and C++ argument evaluation order is
+          // unspecified (caught by differential fuzz: 9 gas divergence)
+          std::string out = f.read_mem(off, size);
+          return finish(0, out, f.gas, nullptr);
         }
         case 0xFD: {  // REVERT
           U256 off = f.pop(), size = f.pop();
-          return finish(1, f.read_mem(off, size), f.gas, "revert");
+          std::string out = f.read_mem(off, size);
+          return finish(1, out, f.gas, "revert");
         }
         case 0xFE:
           throw EvmErr{"invalid opcode 0xfe"};

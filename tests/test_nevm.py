@@ -390,3 +390,16 @@ def test_random_bytecode_differential_fuzz():
         code = bytes(int(rng.choice(pool)) for _ in range(n))
         run_both(code, calldata=bytes(rng.integers(0, 256, 8, np.uint8)),
                  gas=50_000)
+
+
+def test_return_revert_memory_expansion_gas_equivalent():
+    """RETURN/REVERT whose output window EXPANDS memory must charge the
+    expansion in the reported gas_left on both interpreters (caught by
+    differential fuzz: C++ argument evaluation order read f.gas before
+    read_mem charged it)."""
+    for op in (0xF3, 0xFD):
+        code = asm(push(90, 1), push(0, 1), op)  # return/revert mem[0:90]
+        n, p = run_both(code, gas=10_000)
+        assert n.gas_left == p.gas_left
+        # expansion to 3 words costs 3*3 + 0 = 9: visible in gas_left
+        assert 10_000 - n.gas_left == 3 + 3 + 9

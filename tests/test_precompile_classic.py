@@ -1,0 +1,146 @@
+"""Classic precompiles 6/7/9 (bn128 add/mul, blake2f) + pairing policy.
+
+Validation strategy: blake2f against hashlib.blake2b (an independent
+implementation of the same function); bn128 against algebraic identities
+(2G via add == 2G via mul, P + (-P) = O, order*G = O, commutativity)
+rather than memorized vectors.
+"""
+
+import hashlib
+
+import pytest
+
+from fisco_bcos_tpu.executor import precompile_classic as pcc
+from fisco_bcos_tpu.executor.evm import EVM
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.storage.state import StateStorage
+from tests.test_nevm import ENV
+
+SUITE = make_suite(backend="host")
+G1 = (1, 2)  # bn128 generator
+
+
+def w32(*vals: int) -> bytes:
+    return b"".join(v.to_bytes(32, "big") for v in vals)
+
+
+def point(out: bytes) -> tuple[int, int]:
+    return (int.from_bytes(out[:32], "big"),
+            int.from_bytes(out[32:], "big"))
+
+
+def test_bn128_add_mul_identities():
+    # 2G via ECADD(G, G) == 2G via ECMUL(G, 2)
+    two_g_add = point(pcc.bn128_add(w32(*G1, *G1)))
+    two_g_mul = point(pcc.bn128_mul(w32(*G1, 2)))
+    assert two_g_add == two_g_mul != (0, 0)
+    # commutativity: G + 2G == 2G + G == 3G
+    three_a = point(pcc.bn128_add(w32(*G1, *two_g_add)))
+    three_b = point(pcc.bn128_add(w32(*two_g_add, *G1)))
+    assert three_a == three_b == point(pcc.bn128_mul(w32(*G1, 3)))
+    # inverse: P + (-P) = O  (-P = (x, p - y))
+    neg_g = (G1[0], pcc.BN_P - G1[1])
+    assert point(pcc.bn128_add(w32(*G1, *neg_g))) == (0, 0)
+    # order annihilates: n*G = O; (n+1)*G = G
+    assert point(pcc.bn128_mul(w32(*G1, pcc.BN_N))) == (0, 0)
+    assert point(pcc.bn128_mul(w32(*G1, pcc.BN_N + 1))) == G1
+    # infinity handling
+    assert point(pcc.bn128_add(w32(0, 0, *G1))) == G1
+    assert point(pcc.bn128_mul(w32(0, 0, 55))) == (0, 0)
+    # short input is zero-padded per spec (ECADD of G and O)
+    assert point(pcc.bn128_add(w32(*G1))) == G1
+
+
+def test_bn128_invalid_points_rejected():
+    with pytest.raises(pcc.PrecompileInputError):
+        pcc.bn128_add(w32(1, 3, *G1))  # (1,3) not on curve
+    with pytest.raises(pcc.PrecompileInputError):
+        pcc.bn128_mul(w32(pcc.BN_P, 2, 1))  # x >= p
+
+
+def _blake2f_input(rounds: int, h: list[int], m: bytes, t0: int, t1: int,
+                   final: bool) -> bytes:
+    return (rounds.to_bytes(4, "big")
+            + b"".join(x.to_bytes(8, "little") for x in h)
+            + m.ljust(128, b"\x00")
+            + t0.to_bytes(8, "little") + t1.to_bytes(8, "little")
+            + (b"\x01" if final else b"\x00"))
+
+
+def test_blake2f_matches_hashlib_blake2b():
+    """One compression of 'abc' with the standard parameter block must
+    reproduce hashlib.blake2b(b'abc') — an independent implementation."""
+    h = list(pcc._IV)
+    h[0] ^= 0x01010040  # digest_length=64, fanout=1, depth=1
+    out, cost = pcc.blake2f(_blake2f_input(12, h, b"abc", 3, 0, True))
+    assert cost == 12
+    assert out == hashlib.blake2b(b"abc").digest()
+
+
+def test_blake2f_multi_block_matches_hashlib():
+    msg = bytes(range(256))  # two 128-byte blocks
+    h = list(pcc._IV)
+    h[0] ^= 0x01010040
+    out1, _ = pcc.blake2f(_blake2f_input(12, h, msg[:128], 128, 0, False))
+    h2 = [int.from_bytes(out1[8 * i:8 * (i + 1)], "little")
+          for i in range(8)]
+    out2, _ = pcc.blake2f(_blake2f_input(12, h2, msg[128:], 256, 0, True))
+    assert out2 == hashlib.blake2b(msg).digest()
+
+
+def test_blake2f_input_validation():
+    with pytest.raises(pcc.PrecompileInputError):
+        pcc.blake2f(b"\x00" * 212)  # short
+    bad = bytearray(_blake2f_input(1, list(pcc._IV), b"", 0, 0, True))
+    bad[212] = 2
+    with pytest.raises(pcc.PrecompileInputError):
+        pcc.blake2f(bytes(bad))
+
+
+def addr(n: int) -> bytes:
+    return n.to_bytes(20, "big")
+
+
+def call_pre(which: int, data: bytes, gas: int = 100_000):
+    evm = EVM(SUITE, native=False)
+    st = StateStorage(MemoryStorage())
+    return evm.execute_message(st, ENV, b"\x22" * 20, addr(which), 0,
+                               data, gas)
+
+
+def test_evm_dispatch_and_gas():
+    res = call_pre(6, w32(*G1, *G1))
+    assert res.success and point(res.output) == point(
+        pcc.bn128_mul(w32(*G1, 2)))
+    assert res.gas_left == 100_000 - pcc.G_BNADD
+    res = call_pre(7, w32(*G1, 5))
+    assert res.success and res.gas_left == 100_000 - pcc.G_BNMUL
+    h = list(pcc._IV)
+    h[0] ^= 0x01010040
+    res = call_pre(9, _blake2f_input(12, h, b"abc", 3, 0, True))
+    assert res.success and res.output == hashlib.blake2b(b"abc").digest()
+    assert res.gas_left == 100_000 - 12
+    # invalid input consumes all gas (EIP-196 semantics)
+    res = call_pre(6, w32(1, 3, *G1))
+    assert not res.success and res.gas_left == 0
+
+
+def test_pairing_policy():
+    res = call_pre(8, b"")
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 1
+    res = call_pre(8, b"\x00" * 192)
+    assert not res.success and "pairing" in res.error
+
+
+def test_blake2f_huge_rounds_gas_gated_fast():
+    """rounds = 2^32-1 with insufficient gas must fail in O(1) — the gas
+    gate runs BEFORE any compression work (DoS guard)."""
+    import time as _time
+
+    data = (0xFFFFFFFF).to_bytes(4, "big") + b"\x00" * 208 + b"\x01"
+    t0 = _time.monotonic()
+    res = call_pre(9, data, gas=50_000)
+    assert _time.monotonic() - t0 < 1.0
+    assert not res.success and res.error == "oog"
