@@ -8,7 +8,9 @@ independent from-spec interpreter:
 
   * full opcode set through Shanghai (PUSH0, arithmetic/bitwise/keccak,
     storage, memory, context, logs, CALL family, CREATE/CREATE2,
-    RETURN/REVERT/SELFDESTRUCT);
+    RETURN/REVERT/SELFDESTRUCT) plus Cancun's TLOAD/TSTORE (EIP-1153,
+    per-tx transient storage with frame-revert semantics) and MCOPY
+    (EIP-5656, memmove);
   * gas metering (per-opcode base costs, quadratic memory expansion, word
     copy costs, EIP-2929 cold/warm access sets, EIP-2200 net SSTORE
     metering with EIP-3529 refunds capped at gas_used/5, EIP-3651 warm
@@ -188,14 +190,18 @@ class AccessSet:
     definition and are kept.
     """
 
-    __slots__ = ("addresses", "slots", "original", "refund", "_journal")
+    __slots__ = ("addresses", "slots", "original", "refund", "transient",
+                 "_journal")
 
     def __init__(self):
         self.addresses: set[bytes] = set()
         self.slots: set[tuple[bytes, bytes]] = set()
         self.original: dict[tuple[bytes, bytes], int] = {}
         self.refund = 0
-        self._journal: list = []  # ("a",addr) | ("s",key) | ("r",delta)
+        # EIP-1153 transient storage: per-TRANSACTION, reverts with the
+        # frame journal, discarded at tx end (never touches the trie)
+        self.transient: dict[tuple[bytes, bytes], int] = {}
+        self._journal: list = []  # ("a",addr)|("s",key)|("r",d)|("t",k,old)
 
     # -- journal (frame revert restores prior warmth + refund) -------------
     def snapshot(self) -> int:
@@ -203,17 +209,37 @@ class AccessSet:
 
     def rollback_to(self, mark: int) -> None:
         while len(self._journal) > mark:
-            kind, item = self._journal.pop()
+            entry = self._journal.pop()
+            kind = entry[0]
             if kind == "a":
-                self.addresses.discard(item)
+                self.addresses.discard(entry[1])
             elif kind == "s":
-                self.slots.discard(item)
+                self.slots.discard(entry[1])
+            elif kind == "t":
+                _, key, old = entry
+                if old == 0:
+                    self.transient.pop(key, None)
+                else:
+                    self.transient[key] = old
             else:
-                self.refund -= item
+                self.refund -= entry[1]
 
     def _add_refund(self, delta: int) -> None:
         self.refund += delta
         self._journal.append(("r", delta))
+
+    # -- transient storage (EIP-1153) --------------------------------------
+    def tload(self, addr: bytes, slot: bytes) -> int:
+        return self.transient.get((addr, slot), 0)
+
+    def tstore(self, addr: bytes, slot: bytes, value: int) -> None:
+        key = (addr, slot)
+        old = self.transient.get(key, 0)
+        self._journal.append(("t", key, old))
+        if value == 0:
+            self.transient.pop(key, None)
+        else:
+            self.transient[key] = value
 
     # -- account access ----------------------------------------------------
     def warm_address(self, addr: bytes) -> None:
@@ -971,6 +997,23 @@ class EVM:
                     f.push(f.gas)
                 elif op == 0x5B:  # JUMPDEST
                     f.use_gas(1)
+                elif op == 0x5C:  # TLOAD (EIP-1153)
+                    f.use_gas(G_SLOAD)
+                    slot_b = f.pop().to_bytes(32, "big")
+                    f.push(acc.tload(address, slot_b))
+                elif op == 0x5D:  # TSTORE (EIP-1153)
+                    if static:
+                        raise EVMError("TSTORE in static call")
+                    f.use_gas(G_SLOAD)
+                    slot, v = f.pop(), f.pop()
+                    acc.tstore(address, slot.to_bytes(32, "big"), v)
+                elif op == 0x5E:  # MCOPY (EIP-5656), memmove semantics
+                    d, s, n = f.pop(), f.pop(), f.pop()
+                    f.use_gas(G_VERYLOW
+                              + G_COPY_WORD * ((_gas_size(n) + 31) // 32))
+                    if n:
+                        blob = f.mem.read(s, n)  # charges src expansion
+                        f.mem.write(d, blob)     # charges dst expansion
                 elif 0xA0 <= op <= 0xA4:  # LOG0..LOG4
                     if static:
                         raise EVMError("LOG in static call")

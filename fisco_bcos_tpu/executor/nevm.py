@@ -75,6 +75,12 @@ _SSTORE_GAS = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
                                ctypes.POINTER(ctypes.c_uint8),
                                ctypes.c_int32,
                                ctypes.POINTER(ctypes.c_int64))
+_TLOAD = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
+                          ctypes.POINTER(ctypes.c_uint8),
+                          ctypes.POINTER(ctypes.c_uint8))
+_TSTORE = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_uint8),
+                           ctypes.POINTER(ctypes.c_uint8))
 
 
 class _NevmHost(ctypes.Structure):
@@ -91,6 +97,8 @@ class _NevmHost(ctypes.Structure):
         ("access_account", _ACCESS_ACCT),
         ("sload_cost", _SLOAD_COST),
         ("sstore_gas", _SSTORE_GAS),
+        ("tload", _TLOAD),
+        ("tstore", _TSTORE),
     ]
 
 
@@ -220,13 +228,16 @@ class _Host:
         self.c_access_account = _ACCESS_ACCT(self._access_account)
         self.c_sload_cost = _SLOAD_COST(self._sload_cost)
         self.c_sstore_gas = _SSTORE_GAS(self._sstore_gas)
+        self.c_tload = _TLOAD(self._tload)
+        self.c_tstore = _TSTORE(self._tstore)
         self.table = _NevmHost(
             ctx=None, sload=self.c_sload, sstore=self.c_sstore,
             balance=self.c_balance, get_code=self.c_get_code,
             do_log=self.c_log, do_call=self.c_call,
             do_create=self.c_create, selfdestruct=self.c_selfdestruct,
             access_account=self.c_access_account,
-            sload_cost=self.c_sload_cost, sstore_gas=self.c_sstore_gas)
+            sload_cost=self.c_sload_cost, sstore_gas=self.c_sstore_gas,
+            tload=self.c_tload, tstore=self.c_tstore)
 
     def bind(self, evm, state, env, caller, address, value, depth, static):
         self.evm = evm
@@ -294,6 +305,25 @@ class _Host:
             orig = acc.note_original(self.address, slot_b, current)
             cost_out[0] = acc.sstore_gas(current, orig, new,
                                          self.address, slot_b)
+            return 0
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
+
+    def _tload(self, _ctx, slot, out):
+        try:
+            v = self.evm.access().tload(self.address, _bytes_at(slot, 32))
+            ctypes.memmove(out, v.to_bytes(32, "big"), 32)
+            return 0
+        except BaseException as exc:  # noqa: BLE001
+            self.exc = exc
+            return -1
+
+    def _tstore(self, _ctx, slot, val):
+        try:
+            self.evm.access().tstore(
+                self.address, _bytes_at(slot, 32),
+                int.from_bytes(_bytes_at(val, 32), "big"))
             return 0
         except BaseException as exc:  # noqa: BLE001
             self.exc = exc

@@ -366,6 +366,9 @@ typedef struct {
   int32_t (*sstore_gas)(void*, const uint8_t slot[32],
                         const uint8_t val[32], int32_t val_zero,
                         int64_t* cost_out);
+  // EIP-1153 transient storage (per-tx, host-side AccessSet)
+  int32_t (*tload)(void*, const uint8_t slot[32], uint8_t out[32]);
+  int32_t (*tstore)(void*, const uint8_t slot[32], const uint8_t val[32]);
 } NevmHost;
 
 typedef struct {
@@ -1061,6 +1064,33 @@ int32_t nevm_execute(const NevmHost* host, const NevmEnv* env,
         case 0x5B:  // JUMPDEST
           f.use_gas(1);
           break;
+        case 0x5C: {  // TLOAD (EIP-1153)
+          f.use_gas(G_SLOAD);
+          uint8_t slot[32], out[32] = {0};
+          f.pop().to_be(slot);
+          hostcheck(host->tload(host->ctx, slot, out));
+          f.push(U256::from_be(out, 32));
+          break;
+        }
+        case 0x5D: {  // TSTORE (EIP-1153)
+          if (static_flag) throw EvmErr{"TSTORE in static call"};
+          f.use_gas(G_SLOAD);
+          uint8_t slot[32], val[32];
+          f.pop().to_be(slot);
+          f.pop().to_be(val);
+          hostcheck(host->tstore(host->ctx, slot, val));
+          break;
+        }
+        case 0x5E: {  // MCOPY (EIP-5656), memmove semantics
+          U256 d = f.pop(), s = f.pop(), n_u = f.pop();
+          uint64_t n = checked_size(n_u);
+          f.use_gas(G_VERYLOW + G_COPY_WORD * (int64_t)words32(n));
+          if (n) {
+            std::string blob = f.read_mem(s, n_u);
+            f.write_mem(d, (const uint8_t*)blob.data(), n);
+          }
+          break;
+        }
         case 0xA0:
         case 0xA1:
         case 0xA2:

@@ -1,0 +1,106 @@
+"""Cancun opcodes: TLOAD/TSTORE (EIP-1153) + MCOPY (EIP-5656), enforced
+on BOTH interpreters via the parity harness."""
+
+import pytest
+
+from fisco_bcos_tpu.executor import nevm
+from fisco_bcos_tpu.executor.evm import EVM, G_SLOAD, T_CODE
+from tests.test_nevm import (
+    ADDR,
+    ENV,
+    SUITE,
+    _fresh_state,
+    asm,
+    push,
+    ret_top,
+    run_both,
+)
+
+pytestmark = pytest.mark.skipif(
+    not nevm.available(), reason="libnevm.so not built")
+
+
+def test_tstore_tload_roundtrip():
+    code = asm(push(0x1234, 2), push(7, 1), 0x5D,   # TSTORE slot7
+               push(7, 1), 0x5C) + ret_top()         # TLOAD slot7
+    n, p = run_both(code)
+    assert n.success and int.from_bytes(n.output, "big") == 0x1234
+
+
+def test_tload_unset_is_zero_and_cheap():
+    n1, _ = run_both(asm(push(9, 1), 0x5C) + ret_top(), gas=10_000)
+    assert int.from_bytes(n1.output, "big") == 0
+    # flat 100 gas, never cold (EIP-1153): a second TLOAD costs exactly
+    # push(3) + 100 + pop(2) more — no cold surcharge anywhere
+    n2, _ = run_both(asm(push(9, 1), 0x5C, 0x50, push(9, 1), 0x5C)
+                     + ret_top(), gas=10_000)
+    assert n1.gas_left - n2.gas_left == 3 + G_SLOAD + 2
+
+
+def test_tstore_static_context_fails():
+    code = asm(push(1, 1), push(7, 1), 0x5D)
+    n, p = run_both(code, static=True)
+    assert not n.success and not p.success
+
+
+def test_transient_not_persisted_and_not_shared():
+    """TSTORE leaves no trace in persistent storage; a fresh tx sees 0."""
+    code = asm(push(0xAA, 1), push(1, 1), 0x5D, 0x00)
+    probe = asm(push(1, 1), 0x5C) + ret_top()
+    for native in (True, False):
+        st = _fresh_state(code)
+        evm = EVM(SUITE, native=native)
+        res = evm.execute_message(st, ENV, b"\x22" * 20, ADDR, 0, b"",
+                                  1_000_000)
+        assert res.success
+        persisted = [k for k in st.changeset() if k[0] != "s_code"]
+        assert not persisted  # nothing persisted beyond the fixture code
+        # next tx: transient state must be gone
+        st.set(T_CODE, ADDR, probe)
+        res2 = evm.execute_message(st, ENV, b"\x22" * 20, ADDR, 0, b"",
+                                   1_000_000)
+        assert int.from_bytes(res2.output, "big") == 0
+
+
+def test_revert_rolls_back_transient():
+    """EIP-1153: a reverted frame's transient writes roll back. CALLCODE
+    runs inner code against our context; its revert must restore our
+    transient slot."""
+    inner_addr = b"\x66" * 20
+    inner = asm(push(0xBB, 1), push(3, 1), 0x5D,      # TSTORE slot3 = BB
+                push(0, 1), push(0, 1), 0xFD)          # REVERT
+    outer = asm(push(0x11, 1), push(3, 1), 0x5D,       # TSTORE slot3 = 11
+                push(0, 1), push(0, 1), push(0, 1), push(0, 1), push(0, 1),
+                push(int.from_bytes(inner_addr, "big")), push(50_000, 4),
+                0xF2, 0x50,                            # CALLCODE (reverts)
+                push(3, 1), 0x5C) + ret_top()          # TLOAD slot3
+    n, p = run_both(outer, extra=[("s_code", inner_addr, inner)])
+    assert n.success
+    assert int.from_bytes(n.output, "big") == 0x11  # 0xBB rolled back
+
+
+def test_mcopy_semantics_and_overlap():
+    # write pattern at 0..32, MCOPY to 16 (overlapping, memmove), return
+    code = asm(push(0x1122334455667788, 8), push(0, 1), 0x52,  # MSTORE@0
+               push(32, 1), push(0, 1), push(16, 1), 0x5E,     # MCOPY 16<-0
+               push(32, 1), push(16, 1), 0xF3)                 # ret mem[16:48]
+    n, p = run_both(code)
+    assert n.success
+    # mem[16:48] must equal the ORIGINAL mem[0:32] (memmove semantics)
+    expect = (b"\x00" * 24 + (0x1122334455667788).to_bytes(8, "big")
+              ).ljust(32, b"\x00")[:32]
+    assert n.output == expect
+
+
+def test_mcopy_gas_and_expansion():
+    # MCOPY expanding destination memory charges expansion on both sides
+    code = asm(push(32, 1), push(0, 1), push(256, 2), 0x5E, 0x00)
+    n, p = run_both(code, gas=10_000)
+    assert n.success and n.gas_left == p.gas_left
+
+
+def test_mcopy_huge_size_oog():
+    code = asm(push(1 << 40, 6), push(0, 1), push(0, 1), 0x5E)
+    n, p = run_both(code, gas=100_000)
+    assert not n.success and not p.success
+    assert n.gas_left == 0 and p.gas_left == 0
