@@ -104,6 +104,9 @@ class NativeStorage(TransactionalStorage):
         if lib is None:
             raise RuntimeError(f"bcoskv unavailable: {_lib_err}")
         self._lib = lib
+        # the engine creates only the leaf directory; nested deployment
+        # layouts (e.g. Max shard dirs) need the parents too
+        os.makedirs(path, exist_ok=True)
         self._h = lib.bcoskv_open(path.encode(), flush_bytes, max_ssts)
         if not self._h:
             raise RuntimeError(f"bcoskv_open failed for {path}")
@@ -137,6 +140,29 @@ class NativeStorage(TransactionalStorage):
         ck = self._ck(table, key)
         with self._lock:
             self._lib.bcoskv_del(self._h, ck, len(ck))
+
+    def tables(self) -> list[str]:
+        """Distinct table names (empty-prefix engine scan over composite
+        keys) — operator tooling (storage_tool stats/tables)."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        with self._lock:
+            self._lib.bcoskv_scan(self._h, b"", 0, ctypes.byref(out),
+                                  ctypes.byref(n))
+            packed = ctypes.string_at(out, n.value)
+            self._lib.bcoskv_free(out)
+        names = set()
+        (count,) = struct.unpack_from("<I", packed, 0)
+        off = 4
+        for _ in range(count):
+            (kl,) = struct.unpack_from("<I", packed, off)
+            off += 4
+            composite = packed[off:off + kl]
+            off += kl
+            sep = composite.find(_SEP)
+            if sep > 0:
+                names.add(composite[:sep].decode(errors="replace"))
+        return sorted(names)
 
     def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
         pre = self._ck(table, prefix)

@@ -202,6 +202,13 @@ class DurablePrepareStorage(TransactionalStorage):
         with self._lock:
             return sorted(self._pending.items())
 
+    def tables(self) -> list[str]:
+        t = getattr(self.inner, "tables", None)
+        if t is None:
+            raise NotImplementedError(
+                f"{type(self.inner).__name__} cannot enumerate tables")
+        return t()
+
     # -- plain delegation --------------------------------------------------
     def get(self, table: str, key: bytes) -> Optional[bytes]:
         return self.inner.get(table, key)
@@ -245,6 +252,7 @@ class ShardServer:
         self._ss.server.register("prepare2", self._prepare2)
         self._ss.server.register("commit2", self._commit2)
         self._ss.server.register("rollback2", self._rollback2)
+        self._ss.server.register("tables", self._tables)
 
     def _pending(self, r: Reader, w: Writer) -> None:
         w.seq(self.backend.pending(),
@@ -256,6 +264,9 @@ class ShardServer:
         fence = r.i64()
         self.backend.prepare(number, self._read_changeset(r),
                              attempt=attempt, fence=fence)
+
+    def _tables(self, r: Reader, w: Writer) -> None:
+        w.seq(self.backend.tables(), lambda ww, t: ww.text(t))
 
     def _commit2(self, r: Reader, w: Writer) -> None:
         self.backend.commit(r.i64(), fence=r.i64())
@@ -298,6 +309,10 @@ def make_shard_client(host: str, port: int, timeout: float = 30.0):
             r = self.client.call("pending", None)
             return [(it[0], it[1]) for it in
                     r.seq(lambda rr: (rr.i64(), rr.blob()))]
+
+        def tables(self) -> list[str]:
+            r = self.client.call("tables", None)
+            return r.seq(lambda rr: rr.text())
 
     return ShardClient(host, port, timeout)
 
@@ -345,6 +360,14 @@ class ShardedStorage(TransactionalStorage):
         for sh in self.shards:
             seen.update(sh.keys(table, prefix))
         return iter(sorted(seen))
+
+    def tables(self) -> list[str]:
+        """Cluster-wide table names: union over shards (same fan-out and
+        merge discipline as keys())."""
+        names: set[str] = set()
+        for sh in self.shards:
+            names.update(sh.tables())
+        return sorted(names)
 
     def get_batch(self, table: str, ks) -> list:
         ks = list(ks)

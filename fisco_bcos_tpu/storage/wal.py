@@ -157,6 +157,11 @@ class WalStorage(TransactionalStorage):
             for k in ks:
                 rows.pop(k, None)
 
+    def tables(self) -> list[str]:
+        """Live table names (operator tooling: storage_tool stats)."""
+        with self._lock:
+            return sorted(self._tables)
+
     def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
         with self._lock:
             ks = sorted(k for k in self._tables.get(table, {})

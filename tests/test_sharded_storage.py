@@ -382,3 +382,36 @@ def test_fencing_rejects_deposed_master(tmp_path):
     new2 = ShardedStorage(shards2, fence=2)
     assert new2.get("t", b"a") == b"new"
     new2.close()
+
+
+def test_native_lsm_engine_behind_shards(tmp_path):
+    """The native C++ LSM engine (bcoskv) works as a shard backend behind
+    DurablePrepareStorage — Max mode on the native runtime."""
+    from fisco_bcos_tpu.storage import native as native_mod
+
+    if native_mod._load() is None:
+        pytest.skip("libbcoskv.so not built")
+    shards = [
+        DurablePrepareStorage(
+            native_mod.NativeStorage(str(tmp_path / f"s{i}" / "kv")),
+            str(tmp_path / f"s{i}" / "prep"))
+        for i in range(3)
+    ]
+    st = ShardedStorage(shards)
+    st.prepare(1, cs(*[("t", k, v) for _, k, v in ROWS[:12]]))
+    st.commit(1)
+    for _, k, v in ROWS[:12]:
+        assert st.get("t", k) == v
+    # crash one shard between prepare and commit; native engine restarts
+    st.prepare(2, cs(("t", b"zz", b"late")))
+    st.shards[0].commit(2, fence=0)
+    victim = st._shard_of("t", b"zz")
+    if victim != 0:
+        st.shards[victim].close()
+        shards[victim] = DurablePrepareStorage(
+            native_mod.NativeStorage(str(tmp_path / f"s{victim}" / "kv")),
+            str(tmp_path / f"s{victim}" / "prep"))
+        st.shards[victim] = shards[victim]
+    st.recover()
+    assert st.get("t", b"zz") == b"late"
+    st.close()

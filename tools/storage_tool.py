@@ -26,7 +26,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from fisco_bcos_tpu.storage.wal import WalStorage  # noqa: E402
 
 
-def _open(path: str) -> WalStorage:
+def _open(path: str):
+    """`path` is a WAL directory, OR a max_cluster.json whose live shard
+    services the tool inspects through the sharded coordinator (Max-mode
+    deployments have no single on-disk directory to open)."""
+    if os.path.isfile(path) and path.endswith(".json"):
+        from fisco_bcos_tpu.storage.sharded import (
+            ShardedStorage, make_shard_client)
+
+        with open(path) as f:
+            cluster = json.load(f)
+        return ShardedStorage(
+            [make_shard_client(s["host"], s["port"])
+             for s in cluster["shards"]], recover=False)
     if not os.path.isdir(path):
         raise SystemExit(f"no storage directory at {path}")
     return WalStorage(path)
@@ -54,19 +66,24 @@ def main() -> None:
     st = _open(args.path)
     try:
         if args.cmd == "tables":
-            print(json.dumps(sorted(st._tables)))
+            print(json.dumps(st.tables()))
         elif args.cmd == "stats":
-            out = {t: {"rows": len(rows),
-                       "bytes": sum(len(k) + len(v)
-                                    for k, v in rows.items())}
-                   for t, rows in sorted(st._tables.items())}
+            out = {}
+            for t in st.tables():
+                ks = list(st.keys(t))
+                vs = st.get_batch(t, ks)  # batched: one RPC per shard
+                out[t] = {"rows": len(ks),
+                          "bytes": sum(len(k) + len(v or b"")
+                                       for k, v in zip(ks, vs))}
             print(json.dumps(out, indent=1))
         elif args.cmd == "scan":
             prefix = bytes.fromhex(args.prefix) if args.prefix else b""
-            for k in st.keys(args.table, prefix):
-                if args.values:
-                    print(k.hex(), (st.get(args.table, k) or b"").hex())
-                else:
+            ks = list(st.keys(args.table, prefix))
+            if args.values:
+                for k, v in zip(ks, st.get_batch(args.table, ks)):
+                    print(k.hex(), (v or b"").hex())
+            else:
+                for k in ks:
                     print(k.hex())
         elif args.cmd == "get":
             v = st.get(args.table, bytes.fromhex(args.key))
@@ -81,6 +98,8 @@ def main() -> None:
             st.remove(args.table, bytes.fromhex(args.key))
             print("ok")
         elif args.cmd == "compact":
+            if not hasattr(st, "compact"):
+                raise SystemExit("compact: local WAL storage only")
             st.compact()
             print("ok")
     finally:
