@@ -349,3 +349,48 @@ def test_observer_promoted_to_sealer_live(tmp_path):
         assert len(hdr.signature_list) >= 4  # n=5 -> quorum = 5 - 1 = 4
     finally:
         stop_cluster(gateway, nodes)
+
+
+def test_four_node_sm_crypto_consensus(tmp_path):
+    """国密 chain through full consensus: SM2 consensus-message signatures,
+    SM2 tx recovery at ingest, SM3 Merkle roots in committed headers —
+    the ProtocolInitializer's SM suite selection exercised end to end
+    (the reference's createSMCryptoSuite path)."""
+    suite = make_suite(True, backend="host")
+    gateway = FakeGateway()
+    keypairs = [suite.generate_keypair(bytes([i + 51]) * 16)
+                for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    nodes = []
+    for kp in keypairs:
+        node = Node(NodeConfig(consensus="pbft", sm_crypto=True,
+                               crypto_backend="host", min_seal_time=0.0,
+                               view_timeout=3.0),
+                    keypair=kp, gateway=gateway)
+        node.build_genesis(sealers)
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    try:
+        kp = suite.generate_keypair(b"sm-user")
+        tx = make_tx(suite, kp, nonce="sm1")
+        res = nodes[0].send_transaction(tx)
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes)), \
+            [n.ledger.current_number() for n in nodes]
+        headers = [n.ledger.header_by_number(1) for n in nodes]
+        assert len({h.hash(suite) for h in headers}) == 1
+        h = headers[0]
+        assert len(h.signature_list) >= 3
+        for idx, seal in h.signature_list:
+            assert suite.verify(h.sealer_list[idx], h.hash(suite), seal)
+        # the tx root is an SM3 Merkle (bit-parity with the host oracle)
+        from fisco_bcos_tpu.ops import merkle as merkle_ops
+        want = merkle_ops.merkle_levels_host(
+            [tx.hash(suite)], alg="sm3")[-1][0]
+        assert h.txs_root == want
+        rc = nodes[2].ledger.receipt(tx.hash(suite))
+        assert rc is not None and rc.status == 0
+    finally:
+        stop_cluster(gateway, nodes)

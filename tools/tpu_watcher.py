@@ -89,6 +89,14 @@ def _run_ingest() -> dict | None:
                       f"txpool_ingest_mixed_{n}", 2400)
 
 
+def _run_chain_tps() -> dict | None:
+    """BASELINE row 5: live 4-node PBFT chain TPS. Host-CPU-bound, so the
+    bench host's cores (not this 1-core dev container) set the number."""
+    n = os.environ.get("SWEEP_CHAIN_N", "6000")
+    return _run_bench("chain_bench.py", ["-n", n, "--backend", "auto"],
+                      "chain_tps_4node", 1800)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--probe-interval", type=float, default=180.0)
@@ -139,6 +147,9 @@ def main() -> None:
                         self_ingest = _run_ingest()
                         if self_ingest:
                             log(f"ingest OK: {self_ingest}")
+                        tps = _run_chain_tps()
+                        if tps:
+                            log(f"chain TPS OK: {tps}")
                     else:
                         state["sweeps_failed"] += 1
                         log(f"sweep FAILED rc={r.returncode}:\n{tail}")
