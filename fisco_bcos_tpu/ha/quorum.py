@@ -53,7 +53,7 @@ class LeaseRegistryServer:
     """One replica of the lease registry (the etcd stand-in)."""
 
     def __init__(self, state_path: Optional[str] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, tls_ctx=None):
         self.state_path = state_path
         self._leases: dict[str, tuple[str, float, int]] = {}
         self._lock = threading.Lock()
@@ -68,7 +68,8 @@ class LeaseRegistryServer:
             except Exception:  # noqa: BLE001 — corrupt state: start fresh
                 LOG.exception(badge("ELECTION", "registry-state-corrupt",
                                     path=state_path))
-        self.server = ServiceServer("lease-registry", host, port)
+        self.server = ServiceServer("lease-registry", host, port,
+                                    tls_ctx=tls_ctx)
         self.server.register("acquire", self._acquire)
         self.server.register("release", self._release)
         self.server.register("status", self._status)
@@ -129,12 +130,13 @@ class QuorumLeaseElection(ElectionStateMachine):
 
     def __init__(self, registries: list[tuple[str, int]], member_id: str,
                  key: str = "leader", lease_ttl: float = 3.0,
-                 heartbeat: float = 1.0, rpc_timeout: float = 1.0):
+                 heartbeat: float = 1.0, rpc_timeout: float = 1.0,
+                 tls_ctx=None):
         super().__init__(member_id)
         self.key = key
         self.ttl = lease_ttl
         self.heartbeat = heartbeat
-        self._clients = [ServiceClient(h, p, rpc_timeout)
+        self._clients = [ServiceClient(h, p, rpc_timeout, tls_ctx=tls_ctx)
                          for h, p in registries]
         self._quorum = len(registries) // 2 + 1
         # registry RPCs run concurrently: one slow/blackholed replica must

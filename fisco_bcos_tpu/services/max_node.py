@@ -46,21 +46,22 @@ from ..utils.log import LOG, badge
 
 
 def start_storage_shard(data_dir: str, host: str = "127.0.0.1",
-                        port: int = 0) -> ShardServer:
+                        port: int = 0, tls_ctx=None) -> ShardServer:
     """One storage-cluster member: durable-prepare WAL engine behind the
     shard service. Returns the started server (`.port` for registry)."""
     backend = DurablePrepareStorage(WalStorage(f"{data_dir}/wal"),
                                     f"{data_dir}/prep")
-    srv = ShardServer(backend, host, port)
+    srv = ShardServer(backend, host, port, tls_ctx=tls_ctx)
     srv.start()
     return srv
 
 
 def start_lease_registry(state_path: Optional[str] = None,
                          host: str = "127.0.0.1",
-                         port: int = 0) -> LeaseRegistryServer:
+                         port: int = 0, tls_ctx=None) -> LeaseRegistryServer:
     """One election-registry member (the etcd stand-in)."""
-    srv = LeaseRegistryServer(state_path=state_path, host=host, port=port)
+    srv = LeaseRegistryServer(state_path=state_path, host=host, port=port,
+                              tls_ctx=tls_ctx)
     srv.start()
     return srv
 
@@ -71,19 +72,20 @@ class MaxNode:
     def __init__(self, cfg: NodeConfig, shard_addrs: list[tuple[str, int]],
                  registry_addrs: list[tuple[str, int]], member_id: str,
                  keypair=None, gateway=None, lease_ttl: float = 3.0,
-                 heartbeat: float = 1.0):
+                 heartbeat: float = 1.0, tls_ctx=None):
         self.cfg = cfg
         self.shard_addrs = list(shard_addrs)
         self.keypair = keypair
         self.gateway = gateway
         self.member_id = member_id
+        self.tls_ctx = tls_ctx  # SM-TLS/ssl context for BOTH Max planes
         self.node: Optional[Node] = None
         self._activating = False
         self._lock = threading.Lock()
         self.election = QuorumLeaseElection(
             registry_addrs, member_id,
             key=f"{cfg.chain_id}/{cfg.group_id}/master",
-            lease_ttl=lease_ttl, heartbeat=heartbeat)
+            lease_ttl=lease_ttl, heartbeat=heartbeat, tls_ctx=tls_ctx)
         self.election.on_elected(self._activate)
         self.election.on_seized(self._deactivate)
 
@@ -131,7 +133,8 @@ class MaxNode:
             # fence token makes every 2PC op refuse a deposed master's
             # stale writes shard-side (StaleFenceError)
             sharded = ShardedStorage(
-                [make_shard_client(h, p) for h, p in self.shard_addrs],
+                [make_shard_client(h, p, tls_ctx=self.tls_ctx)
+                 for h, p in self.shard_addrs],
                 fence=fence)
             node = Node(self.cfg, keypair=self.keypair,
                         gateway=self.gateway, storage=sharded)

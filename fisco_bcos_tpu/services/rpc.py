@@ -54,29 +54,53 @@ def _recv_frame(sock: socket.socket):
 
 
 class ServiceServer:
-    """Threaded TCP server dispatching named methods."""
+    """Threaded TCP server dispatching named methods.
 
-    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0):
+    ``tls_ctx`` (anything with ``wrap_socket(sock, server_side=...)`` —
+    ssl.SSLContext or net.smtls.SMTLSContext) secures the service plane:
+    Max-mode shard/registry traffic crosses machines, and SM-TLS gives it
+    the same mutual-auth channel as the P2P gateway."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 tls_ctx=None):
         self.name = name
         self._methods: dict[str, Handler] = {}
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        self._tls = tls_ctx
         outer = self
 
         class _H(socketserver.BaseRequestHandler):
             def handle(self):
+                chan = self.request
                 try:
-                    self._serve()
+                    if outer._tls is not None:
+                        chan = outer._tls.wrap_socket(self.request,
+                                                      server_side=True)
+                        # track the WRAPPED channel: ssl.SSLContext
+                        # detaches the raw fd, so severing the raw socket
+                        # in stop() would be a no-op and leak the TLS fd
+                        with outer._conns_lock:
+                            outer._conns.discard(self.request)
+                            outer._conns.add(chan)
+                    self._serve(chan)
                 except (ConnectionError, OSError):
                     pass  # abrupt client disconnects are routine (long-poll
-                    # proxies close mid-park); not worth a traceback
+                    # proxies close mid-park); not worth a traceback —
+                    # failed TLS handshakes land here too (untrusted peer)
                 finally:
                     with outer._conns_lock:
+                        outer._conns.discard(chan)
                         outer._conns.discard(self.request)
+                    if chan is not self.request:
+                        try:
+                            chan.close()
+                        except OSError:
+                            pass
 
-            def _serve(self):
+            def _serve(self, chan):
                 while True:
-                    frame = _recv_frame(self.request)
+                    frame = _recv_frame(chan)
                     if frame is None:
                         return
                     seq, kind, method, payload = frame
@@ -88,14 +112,14 @@ class ServiceServer:
                         if fn is None:
                             raise KeyError(f"unknown method {method!r}")
                         fn(Reader(payload), w)
-                        _send_frame(self.request, seq, KIND_RESPONSE, method,
+                        _send_frame(chan, seq, KIND_RESPONSE, method,
                                     w.bytes())
                     except Exception as exc:  # noqa: BLE001 — RPC boundary
                         LOG.exception(badge("SVC", "handler-failed",
                                             service=outer.name, method=method))
                         ew = Writer()
                         ew.text(f"{type(exc).__name__}: {exc}")
-                        _send_frame(self.request, seq, KIND_ERROR, method,
+                        _send_frame(chan, seq, KIND_ERROR, method,
                                     ew.bytes())
 
         class _Srv(socketserver.ThreadingTCPServer):
@@ -135,10 +159,12 @@ class ServiceServer:
             conns = list(self._conns)
             self._conns.clear()
         for sock in conns:
-            try:
-                sock.shutdown(2)  # SHUT_RDWR
-            except OSError:
-                pass
+            shut = getattr(sock, "shutdown", None)
+            if shut is not None:  # SMSocket has close only
+                try:
+                    shut(2)  # SHUT_RDWR
+                except OSError:
+                    pass
             try:
                 sock.close()
             except OSError:
@@ -152,17 +178,21 @@ class ServiceRemoteError(RuntimeError):
 class ServiceClient:
     """Synchronous client with one pooled connection (thread-safe)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 tls_ctx=None):
         self.addr = (host, port)
         self.timeout = timeout
+        self.tls_ctx = tls_ctx  # see ServiceServer: SM-TLS/ssl context
         self._seq = itertools.count(1)
         self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = None
+        self._sock = None
 
-    def _connect(self) -> socket.socket:
+    def _connect(self):
         if self._sock is None:
             s = socket.create_connection(self.addr, timeout=self.timeout)
             s.settimeout(self.timeout)
+            if self.tls_ctx is not None:
+                s = self.tls_ctx.wrap_socket(s, server_side=False)
             self._sock = s
         return self._sock
 

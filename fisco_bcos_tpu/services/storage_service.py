@@ -38,9 +38,9 @@ def _read_changeset(r: Reader) -> ChangeSet:
 
 class StorageServer:
     def __init__(self, backend: TransactionalStorage,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, tls_ctx=None):
         self.backend = backend
-        self.server = ServiceServer("storage", host, port)
+        self.server = ServiceServer("storage", host, port, tls_ctx=tls_ctx)
         s = self.server
         s.register("get", self._get)
         s.register("set", self._set)
@@ -95,8 +95,9 @@ class StorageServer:
 
 
 class RemoteStorage(TransactionalStorage):
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.client = ServiceClient(host, port, timeout)
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 tls_ctx=None):
+        self.client = ServiceClient(host, port, timeout, tls_ctx=tls_ctx)
 
     def get(self, table: str, key: bytes) -> Optional[bytes]:
         r = self.client.call("get", lambda w: w.text(table).blob(key))

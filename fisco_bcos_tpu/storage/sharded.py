@@ -242,10 +242,10 @@ class ShardServer:
     (attempt-tagged durable prepare) + the ``pending`` RPC."""
 
     def __init__(self, backend: DurablePrepareStorage,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, tls_ctx=None):
         from ..services.storage_service import StorageServer, _read_changeset
 
-        self._ss = StorageServer(backend, host, port)
+        self._ss = StorageServer(backend, host, port, tls_ctx=tls_ctx)
         self.backend = backend
         self._read_changeset = _read_changeset
         self._ss.server.register("pending", self._pending)
@@ -285,7 +285,8 @@ class ShardServer:
         self._ss.stop()
 
 
-def make_shard_client(host: str, port: int, timeout: float = 30.0):
+def make_shard_client(host: str, port: int, timeout: float = 30.0,
+                      tls_ctx=None):
     """RemoteStorage extended with attempt-tagged prepare + ``pending``."""
     from ..services.storage_service import RemoteStorage, _write_changeset
 
@@ -314,7 +315,7 @@ def make_shard_client(host: str, port: int, timeout: float = 30.0):
             r = self.client.call("tables", None)
             return r.seq(lambda rr: rr.text())
 
-    return ShardClient(host, port, timeout)
+    return ShardClient(host, port, timeout, tls_ctx=tls_ctx)
 
 
 class ShardedStorage(TransactionalStorage):

@@ -103,3 +103,49 @@ def test_max_failover_continues_chain(tmp_path):
             s.backend.close()
         for r in regs:
             r.stop()
+
+
+def test_max_failover_over_smtls(tmp_path):
+    """The full Max composition (shards, registries, replicas) on the
+    SM-TLS service plane, through an election + one block commit."""
+    from fisco_bcos_tpu.net.smtls import CertificateAuthority, SMTLSContext
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.protocol import Transaction
+
+    ca = CertificateAuthority(seed=b"mx-tls" * 6)
+
+    def ctx(name):
+        return SMTLSContext(ca.pub, ca.issue(name))
+
+    shards = [start_storage_shard(str(tmp_path / f"s{i}"),
+                                  tls_ctx=ctx(f"shard{i}"))
+              for i in range(3)]
+    regs = [start_lease_registry(str(tmp_path / f"r{i}.json"),
+                                 tls_ctx=ctx(f"reg{i}"))
+            for i in range(3)]
+    m = MaxNode(NodeConfig(crypto_backend="host", min_seal_time=0.0),
+                [("127.0.0.1", s.port) for s in shards],
+                [("127.0.0.1", r.port) for r in regs],
+                "tls-replica", lease_ttl=TTL, heartbeat=HB,
+                tls_ctx=ctx("tls-replica"))
+    m.start()
+    try:
+        assert wait_until(m.is_active)
+        suite = m.node.suite
+        kp = suite.generate_keypair(b"mx-tls-user")
+        tx = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call("register",
+                                 lambda w: w.blob(b"sec").u64(7)),
+            nonce="s1", block_limit=100).sign(suite, kp)
+        rec = m.node.txpool.wait_for_receipt(
+            m.node.send_transaction(tx).tx_hash, 15)
+        assert rec is not None and rec.status == 0
+        assert m.node.ledger.current_number() >= 1
+    finally:
+        m.stop()
+        for s in shards:
+            s.stop()
+            s.backend.close()
+        for r in regs:
+            r.stop()

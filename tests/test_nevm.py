@@ -403,3 +403,26 @@ def test_return_revert_memory_expansion_gas_equivalent():
         assert n.gas_left == p.gas_left
         # expansion to 3 words costs 3*3 + 0 = 9: visible in gas_left
         assert 10_000 - n.gas_left == 3 + 3 + 9
+
+
+@pytest.mark.slow
+def test_deep_differential_fuzz_storage_and_calls():
+    """Richer-pool differential fuzz: storage/access/CALL-family/CREATE/
+    SELFDESTRUCT opcodes at tight gas budgets. This pool caught a real
+    native divergence (RETURN/REVERT memory-expansion gas lost to C++
+    argument evaluation order) that the basic fuzz missed for 3 rounds."""
+    import numpy as np
+
+    rng = np.random.default_rng(20260730)
+    pool = (list(range(0x00, 0x20)) + list(range(0x30, 0x60)) +
+            [0x54, 0x55, 0x54, 0x55, 0x31, 0x3B, 0x3C, 0x3F,
+             0x5C, 0x5D, 0x5E,
+             0x60, 0x61, 0x62, 0x7F, 0x80, 0x81, 0x90, 0x91,
+             0xA0, 0xA1, 0xF1, 0xF2, 0xF4, 0xFA, 0xF0, 0xFF,
+             0xF3, 0xFD, 0x5B, 0x56, 0x57, 0x20])
+    for trial in range(400):
+        n = int(rng.integers(1, 96))
+        code = bytes(int(rng.choice(pool)) for _ in range(n))
+        gas = int(rng.choice([2500, 10_000, 60_000, 400_000]))
+        run_both(code, calldata=bytes(rng.integers(0, 256, 16, np.uint8)),
+                 gas=gas)

@@ -113,3 +113,72 @@ def test_remote_executor_block_execution(tmp_path):
         storage_srv.stop()
         exec_storage.close()
         storage_srv.backend.close()
+
+
+def test_service_plane_over_smtls(tmp_path):
+    """Max cross-machine planes (shards, lease registries) secured with
+    the SM-TLS dual-cert channel: trusted clients work end to end,
+    untrusted CAs are refused at the handshake."""
+    from fisco_bcos_tpu.net.smtls import CertificateAuthority, SMTLSContext
+    from fisco_bcos_tpu.storage.interface import Entry
+    from fisco_bcos_tpu.storage.sharded import (
+        DurablePrepareStorage, ShardServer, ShardedStorage,
+        make_shard_client)
+    from fisco_bcos_tpu.storage.wal import WalStorage
+
+    ca = CertificateAuthority(seed=b"svc" * 8)
+    servers = []
+    for i in range(3):
+        backend = DurablePrepareStorage(
+            WalStorage(str(tmp_path / f"s{i}" / "wal")),
+            str(tmp_path / f"s{i}" / "prep"))
+        srv = ShardServer(backend, tls_ctx=SMTLSContext(
+            ca.pub, ca.issue(f"shard{i}")))
+        srv.start()
+        servers.append(srv)
+    st = ShardedStorage([
+        make_shard_client("127.0.0.1", s.port,
+                          tls_ctx=SMTLSContext(ca.pub, ca.issue("coord")))
+        for s in servers])
+    st.prepare(1, {("t", b"secret"): Entry(b"payload")})
+    st.commit(1)
+    assert st.get("t", b"secret") == b"payload"
+
+    # untrusted CA: the handshake fails, no RPC goes through
+    evil = CertificateAuthority(seed=b"evil" * 8)
+    bad = make_shard_client("127.0.0.1", servers[0].port,
+                            tls_ctx=SMTLSContext(evil.pub,
+                                                 evil.issue("mallory")))
+    with pytest.raises(Exception):
+        bad.get("t", b"secret")
+    bad.close()
+
+    # elections over the same secured plane
+    from fisco_bcos_tpu.ha.quorum import (LeaseRegistryServer,
+                                          QuorumLeaseElection)
+    regs = [LeaseRegistryServer(
+        state_path=str(tmp_path / f"r{i}.json"),
+        tls_ctx=SMTLSContext(ca.pub, ca.issue(f"reg{i}")))
+        for i in range(3)]
+    for r in regs:
+        r.start()
+    el = QuorumLeaseElection(
+        [("127.0.0.1", r.port) for r in regs], "tls-node",
+        lease_ttl=1.0, heartbeat=0.2, rpc_timeout=1.0,
+        tls_ctx=SMTLSContext(ca.pub, ca.issue("tls-node")))
+    el.start()
+    try:
+        import time
+
+        deadline = time.time() + 15
+        while not el.is_leader() and time.time() < deadline:
+            time.sleep(0.05)
+        assert el.is_leader()
+    finally:
+        el.stop()
+        for r in regs:
+            r.stop()
+        st.close()
+        for s in servers:
+            s.stop()
+            s.backend.close()
