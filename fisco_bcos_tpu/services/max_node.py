@@ -71,14 +71,17 @@ class MaxNode:
 
     def __init__(self, cfg: NodeConfig, shard_addrs: list[tuple[str, int]],
                  registry_addrs: list[tuple[str, int]], member_id: str,
-                 keypair=None, gateway=None, lease_ttl: float = 3.0,
-                 heartbeat: float = 1.0, tls_ctx=None):
+                 keypair=None, suite=None, gateway=None,
+                 lease_ttl: float = 3.0, heartbeat: float = 1.0,
+                 tls_ctx=None, genesis_sealers=None):
         self.cfg = cfg
         self.shard_addrs = list(shard_addrs)
         self.keypair = keypair
+        self.suite = suite  # reused across activations (failover latency)
         self.gateway = gateway
         self.member_id = member_id
         self.tls_ctx = tls_ctx  # SM-TLS/ssl context for BOTH Max planes
+        self.genesis_sealers = genesis_sealers  # chain genesis (config boot)
         self.node: Optional[Node] = None
         self._activating = False
         self._lock = threading.Lock()
@@ -136,8 +139,23 @@ class MaxNode:
                 [make_shard_client(h, p, tls_ctx=self.tls_ctx)
                  for h, p in self.shard_addrs],
                 fence=fence)
-            node = Node(self.cfg, keypair=self.keypair,
+            node = Node(self.cfg, keypair=self.keypair, suite=self.suite,
                         gateway=self.gateway, storage=sharded)
+            if self.genesis_sealers:
+                from ..ledger.ledger import ConsensusNode
+                if node.ledger.current_number() < 0:
+                    node.build_genesis([ConsensusNode(pk)
+                                        for pk in self.genesis_sealers])
+                else:
+                    # same refuse-to-boot guard as tool.config.load_node:
+                    # a cluster holding a DIFFERENT chain's genesis must
+                    # fail fast, not get extended by a mis-pointed replica
+                    g0 = node.ledger.header_by_number(0)
+                    if g0 is None or \
+                            set(g0.sealer_list) != set(self.genesis_sealers):
+                        raise RuntimeError(
+                            "cluster chain genesis does not match this "
+                            "replica's genesis config — refusing to serve")
             node.start()
             with self._lock:
                 self._activating = False

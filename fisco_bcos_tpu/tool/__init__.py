@@ -1,1 +1,2 @@
-from .config import ChainConfig, load_node, save_node_config  # noqa: F401
+from .config import (ChainConfig, load_max_node, load_node,  # noqa: F401
+                     save_node_config)

@@ -199,10 +199,9 @@ def load_smtls_context(node_dir: str,
     return SMTLSContext(ca_pub, Credential.decode(blob))
 
 
-def load_node(node_dir: str, gateway=None,
-              storage_passphrase: Optional[bytes] = None) -> Node:
-    """Boot a Node from a config directory (genesis applied on first start,
-    validated against the existing ledger otherwise)."""
+def _load_node_parts(node_dir: str,
+                     storage_passphrase: Optional[bytes] = None):
+    """-> (cfg, chain, suite, keypair) from a config directory."""
     with open(os.path.join(node_dir, "config.ini")) as f:
         cfg = node_config_from_ini(f.read(), base_dir=node_dir)
     with open(os.path.join(node_dir, "genesis")) as f:
@@ -218,10 +217,19 @@ def load_node(node_dir: str, gateway=None,
             key_bytes = f.read()
     from ..crypto.suite import make_suite
     suite = make_suite(cfg.sm_crypto, backend=cfg.crypto_backend,
-                       device_min_batch=cfg.device_min_batch)
+                       device_min_batch=cfg.device_min_batch,
+                       mesh_devices=cfg.crypto_mesh_devices)
     kp = suite.keypair_from_secret(int.from_bytes(key_bytes, "big"))
     cfg.tx_count_limit = chain.block_tx_count_limit
     cfg.leader_period = chain.leader_period
+    return cfg, chain, suite, kp
+
+
+def load_node(node_dir: str, gateway=None,
+              storage_passphrase: Optional[bytes] = None) -> Node:
+    """Boot a Node from a config directory (genesis applied on first start,
+    validated against the existing ledger otherwise)."""
+    cfg, chain, suite, kp = _load_node_parts(node_dir, storage_passphrase)
     node = Node(cfg, keypair=kp, suite=suite, gateway=gateway)
     if node.ledger.current_number() < 0:
         node.build_genesis([ConsensusNode(pk) for pk in chain.sealers]
@@ -241,3 +249,28 @@ def load_node(node_dir: str, gateway=None,
                 "genesis consensus_node_list does not match the existing "
                 "ledger's genesis block — refusing to boot")
     return node
+
+
+def load_max_node(node_dir: str, cluster_path: str, member_id: str,
+                  gateway=None, storage_passphrase: Optional[bytes] = None,
+                  tls_ctx=None, lease_ttl: float = 3.0,
+                  heartbeat: float = 1.0):
+    """Boot a Max-mode replica from a build_chain --mode max layout:
+    node identity/config from `node_dir`, shard + registry endpoints from
+    `cluster_path` (max_cluster.json). The returned MaxNode campaigns on
+    start(); the chain lives in the shared shard cluster."""
+    import json as _json
+
+    from ..services.max_node import MaxNode
+
+    cfg, chain, suite, kp = _load_node_parts(node_dir, storage_passphrase)
+    cfg.storage_path = None  # state lives in the cluster, not on disk
+    with open(cluster_path) as f:
+        cluster = _json.load(f)
+    return MaxNode(
+        cfg,
+        [(s["host"], s["port"]) for s in cluster["shards"]],
+        [(r["host"], r["port"]) for r in cluster["registries"]],
+        member_id, keypair=kp, suite=suite, gateway=gateway,
+        lease_ttl=lease_ttl, heartbeat=heartbeat, tls_ctx=tls_ctx,
+        genesis_sealers=list(chain.sealers))

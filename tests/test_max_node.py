@@ -149,3 +149,115 @@ def test_max_failover_over_smtls(tmp_path):
             s.backend.close()
         for r in regs:
             r.stop()
+
+
+def test_load_max_node_from_generated_layout(tmp_path):
+    """build_chain --mode max layout boots end to end via load_max_node:
+    two replicas from node dirs + max_cluster.json, failover included."""
+    import importlib.util as _ilu
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = _ilu.spec_from_file_location(
+        "fbtpu_build_chain", _os.path.join(repo, "tools", "build_chain.py"))
+    bc = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    info = bc.build_chain(str(tmp_path), 1, consensus="solo")
+    bc.build_max_cluster(str(tmp_path), n_shards=3, n_registries=3)
+
+    import json as _json
+
+    from fisco_bcos_tpu.tool import load_max_node
+
+    cluster = _json.loads((tmp_path / "max_cluster.json").read_text())
+    shards = [start_storage_shard(s["dir"]) for s in cluster["shards"]]
+    regs = [start_lease_registry(r["state"]) for r in cluster["registries"]]
+    # rewrite endpoints with the actually-bound ephemeral ports
+    cluster["shards"] = [{"host": "127.0.0.1", "port": s.port}
+                         for s in shards]
+    cluster["registries"] = [{"host": "127.0.0.1", "port": r.port}
+                             for r in regs]
+    (tmp_path / "max_cluster.json").write_text(_json.dumps(cluster))
+
+    node_dir = str(tmp_path / "node0")
+    a = load_max_node(node_dir, str(tmp_path / "max_cluster.json"), "ra",
+                      lease_ttl=TTL, heartbeat=HB)
+    b = load_max_node(node_dir, str(tmp_path / "max_cluster.json"), "rb",
+                      lease_ttl=TTL, heartbeat=HB)
+    a.start()
+    b.start()
+    try:
+        assert wait_until(lambda: a.is_active() or b.is_active())
+        active, standby = (a, b) if a.is_active() else (b, a)
+        from fisco_bcos_tpu.executor import precompiled as pc
+        from fisco_bcos_tpu.protocol import Transaction
+
+        suite = active.node.suite
+        kp = suite.generate_keypair(b"cfg-user")
+        tx = Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call("register",
+                                 lambda w: w.blob(b"cfg").u64(4)),
+            nonce="c1", block_limit=100).sign(suite, kp)
+        rec = active.node.txpool.wait_for_receipt(
+            active.node.send_transaction(tx).tx_hash, 15)
+        assert rec is not None and rec.status == 0
+        h = active.node.ledger.current_number()
+        active.stop(release=False)  # crash
+        assert wait_until(standby.is_active, timeout=TTL * 12)
+        assert standby.node.ledger.current_number() >= h
+    finally:
+        for m in (a, b):
+            try:
+                m.stop()
+            except Exception:
+                pass
+        for s in shards:
+            s.stop()
+            s.backend.close()
+        for r in regs:
+            r.stop()
+
+
+def test_mispointed_cluster_refused(tmp_path):
+    """A replica whose genesis config disagrees with the chain already in
+    the cluster must refuse to serve (and abdicate), not extend it."""
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+
+    shards = [start_storage_shard(str(tmp_path / f"s{i}"))
+              for i in range(3)]
+    regs = [start_lease_registry(str(tmp_path / f"r{i}.json"))
+            for i in range(3)]
+    shard_addrs = [("127.0.0.1", s.port) for s in shards]
+    reg_addrs = [("127.0.0.1", r.port) for r in regs]
+    suite = make_suite(backend="host")
+    chain_a = suite.generate_keypair(b"chain-a-sealer")
+    chain_b = suite.generate_keypair(b"chain-b-sealer")
+    cfg = NodeConfig(crypto_backend="host", min_seal_time=0.0)
+
+    # replica 1 builds chain A's genesis in the cluster
+    m1 = MaxNode(cfg, shard_addrs, reg_addrs, "m1", keypair=chain_a,
+                 lease_ttl=TTL, heartbeat=HB,
+                 genesis_sealers=[chain_a.pub_bytes])
+    m1.start()
+    try:
+        assert wait_until(m1.is_active)
+    finally:
+        m1.stop()
+
+    # replica 2 arrives configured for a DIFFERENT chain: must refuse
+    m2 = MaxNode(cfg, shard_addrs, reg_addrs, "m2", keypair=chain_b,
+                 lease_ttl=TTL, heartbeat=HB,
+                 genesis_sealers=[chain_b.pub_bytes])
+    m2.start()
+    try:
+        time.sleep(TTL * 4)  # several election+activation attempts
+        assert not m2.is_active()
+    finally:
+        m2.stop()
+        for s in shards:
+            s.stop()
+            s.backend.close()
+        for r in regs:
+            r.stop()
