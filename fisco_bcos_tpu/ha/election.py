@@ -24,6 +24,7 @@ import time
 from typing import Callable, Optional
 
 from ..utils.log import LOG, badge
+from ..utils.metrics import REGISTRY
 
 
 class LeaderElection:
@@ -79,6 +80,12 @@ class ElectionStateMachine(LeaderElection):
                 return  # stopping: a late in-flight round must not win
             self._leader = True
             self._fence = fence
+            # gauges written under the lock: a racing demote must not be
+            # overwritten by a stale promote's 1
+            REGISTRY.set_gauge("bcos_election_is_leader", 1,
+                               {"member": self.member_id})
+            REGISTRY.set_gauge("bcos_election_fence", fence,
+                               {"member": self.member_id})
         LOG.info(badge("ELECTION", "elected", member=self.member_id,
                        fence=fence, backend=type(self).__name__))
         for cb in self._elected_cbs:
@@ -91,6 +98,9 @@ class ElectionStateMachine(LeaderElection):
         with self._lock:
             was = self._leader
             self._leader = False
+            if was:
+                REGISTRY.set_gauge("bcos_election_is_leader", 0,
+                                   {"member": self.member_id})
         if was and not quiet:
             LOG.warning(badge("ELECTION", "seized", member=self.member_id,
                               backend=type(self).__name__))

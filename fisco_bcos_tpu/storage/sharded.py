@@ -47,6 +47,7 @@ from typing import Iterator, Optional
 
 from ..codec.wire import Reader, Writer
 from ..utils.log import LOG, badge
+from ..utils.metrics import REGISTRY
 from .interface import ChangeSet, Entry, TransactionalStorage
 
 #: primary-shard table holding one row per committed block (the commit point)
@@ -434,6 +435,9 @@ class ShardedStorage(TransactionalStorage):
                     LOG.exception(badge("SHARD", "secondary-commit-failed",
                                         shard=i, number=block_number))
                     self.unresolved.add(block_number)
+            REGISTRY.set_gauge("bcos_shard_unresolved_blocks",
+                               len(self.unresolved))
+            REGISTRY.inc("bcos_shard_commits")
             if not self.unresolved:
                 self._prune_meta(block_number)
 
@@ -449,6 +453,8 @@ class ShardedStorage(TransactionalStorage):
                     LOG.exception(badge("SHARD", "shard-rollback-failed",
                                         shard=i, number=block_number))
                     self.unresolved.add(block_number)
+            REGISTRY.set_gauge("bcos_shard_unresolved_blocks",
+                               len(self.unresolved))
 
     def recover(self) -> list[tuple[int, int, bool]]:
         """Resolve every shard's pending blocks from the primary commit
@@ -465,6 +471,9 @@ class ShardedStorage(TransactionalStorage):
                         sh.rollback(n, fence=self.fence)
                     decisions.append((sid, n, committed))
             self.unresolved.clear()
+        REGISTRY.set_gauge("bcos_shard_unresolved_blocks", 0)
+        if decisions:
+            REGISTRY.inc("bcos_shard_recoveries", len(decisions))
         return decisions
 
     def _prune_meta(self, latest: int) -> None:

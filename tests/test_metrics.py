@@ -57,3 +57,50 @@ def test_prometheus_single_type_line_per_name():
     text = reg.prometheus_text()
     assert text.count("# TYPE rpc_total counter") == 1
     assert 'rpc_total{method="a"}' in text and 'rpc_total{method="b"}' in text
+
+
+def test_election_and_shard_gauges(tmp_path):
+    """The Max components publish operator gauges to the shared registry
+    (same plane the /metrics endpoint scrapes)."""
+    from fisco_bcos_tpu.ha.quorum import (LeaseRegistryServer,
+                                          QuorumLeaseElection)
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    regs = [LeaseRegistryServer() for _ in range(3)]
+    for r in regs:
+        r.start()
+    el = QuorumLeaseElection([("127.0.0.1", r.port) for r in regs],
+                             "metrics-node", lease_ttl=1.0, heartbeat=0.2,
+                             rpc_timeout=0.5)
+    el.start()
+    try:
+        import time as _t
+        deadline = _t.time() + 15
+        while not el.is_leader() and _t.time() < deadline:
+            _t.sleep(0.05)
+        assert el.is_leader()
+        text = REGISTRY.prometheus_text()
+        assert 'bcos_election_is_leader{member="metrics-node"} 1' in text
+        assert 'bcos_election_fence{member="metrics-node"}' in text
+    finally:
+        el.stop()
+        for r in regs:
+            r.stop()
+    text = REGISTRY.prometheus_text()
+    assert 'bcos_election_is_leader{member="metrics-node"} 0' in text
+    # shard-plane series: drive one commit through a local cluster
+    from fisco_bcos_tpu.storage.interface import Entry
+    from fisco_bcos_tpu.storage.sharded import (DurablePrepareStorage,
+                                                ShardedStorage)
+    from fisco_bcos_tpu.storage.wal import WalStorage
+
+    shards = [DurablePrepareStorage(WalStorage(str(tmp_path / f"g{i}/w")),
+                                    str(tmp_path / f"g{i}/p"))
+              for i in range(2)]
+    st = ShardedStorage(shards)
+    st.prepare(1, {("t", b"k"): Entry(b"v")})
+    st.commit(1)
+    st.close()
+    text = REGISTRY.prometheus_text()
+    assert "bcos_shard_commits" in text
+    assert "bcos_shard_unresolved_blocks 0" in text
