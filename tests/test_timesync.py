@@ -55,9 +55,8 @@ def test_forget_peer():
     assert tm.median_offset_ms() == 0
 
 
-def test_status_gossip_feeds_timesync():
-    """Two gateway-connected nodes exchange sync status; each learns the
-    other's clock and the sealer's clock source follows the median."""
+def _two_node_gossip_pair(seed_base: int):
+    """(gateway, [node, node]) wired over a FakeGateway, started."""
     from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.init.node import Node, NodeConfig
     from fisco_bcos_tpu.ledger.ledger import ConsensusNode
@@ -65,7 +64,8 @@ def test_status_gossip_feeds_timesync():
 
     suite = make_suite(backend="host")
     gateway = FakeGateway()
-    kps = [suite.generate_keypair(bytes([i + 71]) * 16) for i in range(2)]
+    kps = [suite.generate_keypair(bytes([i + seed_base]) * 16)
+           for i in range(2)]
     sealers = [ConsensusNode(kp.pub_bytes) for kp in kps]
     nodes = []
     for kp in kps:
@@ -76,6 +76,13 @@ def test_status_gossip_feeds_timesync():
         nodes.append(n)
     for n in nodes:
         n.start()
+    return gateway, nodes
+
+
+def test_status_gossip_feeds_timesync():
+    """Two gateway-connected nodes exchange sync status; each learns the
+    other's clock and the sealer's clock source follows the median."""
+    gateway, nodes = _two_node_gossip_pair(71)
     try:
         deadline = time.time() + 15
         while time.time() < deadline:
@@ -90,4 +97,44 @@ def test_status_gossip_feeds_timesync():
     finally:
         for n in nodes:
             n.stop()
+        gateway.stop()
+
+
+def test_silent_peer_pruned_from_sync_and_median():
+    """A departed peer stops pinning the sync download target and drops
+    out of the timesync median after PEER_TTL_INTERVALS silent periods."""
+    gateway, nodes = _two_node_gossip_pair(81)
+    for n in nodes:
+        # fast status cadence so the prune TTL elapses quickly
+        n.blocksync.status_interval = 0.1
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(len(n.blocksync._peers) >= 1 for n in nodes):
+                break
+            time.sleep(0.05)
+        assert all(len(n.blocksync._peers) >= 1 for n in nodes)
+        assert all(len(n.timesync._offsets) >= 1 for n in nodes)
+        # "crash" node 1: stop gossip; node 0 must forget it
+        nodes[1].stop()
+        ttl = nodes[0].blocksync.status_interval * \
+            nodes[0].blocksync.PEER_TTL_INTERVALS
+        deadline = time.time() + ttl * 10 + 10
+        while time.time() < deadline:
+            # wait on the MEDIAN too: forget_peer recomputes it after the
+            # offsets pop, so polling offsets alone races the recompute
+            if (len(nodes[0].blocksync._peers) == 0
+                    and len(nodes[0].timesync._offsets) == 0
+                    and nodes[0].timesync.median_offset_ms() == 0):
+                break
+            time.sleep(0.1)
+        assert len(nodes[0].blocksync._peers) == 0
+        assert len(nodes[0].timesync._offsets) == 0
+        assert nodes[0].timesync.median_offset_ms() == 0
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
         gateway.stop()
