@@ -28,6 +28,9 @@ mirrored bit-for-bit by native/nevm — tests/test_nevm.py enforces):
     any charge/allocation — mainnet relies on gas alone);
   * intrinsic tx gas / calldata gas are not charged (block gas economics
     are governed by the chain's own tx_count_limit / gas_limit configs);
+  * SELFDESTRUCT follows EIP-6780 (Cancun): the balance moves at the
+    opcode; same-transaction creations are deleted (code, storage,
+    residual balance burned) at END of transaction;
   * bn128 PAIRING (address 8) is unsupported: the vacuous empty-input
     check returns true, any real pairing input fails loudly (bn128
     add/mul and blake2f ARE implemented — precompile_classic.py);
@@ -191,7 +194,7 @@ class AccessSet:
     """
 
     __slots__ = ("addresses", "slots", "original", "refund", "transient",
-                 "_journal")
+                 "created", "destroyed", "_journal")
 
     def __init__(self):
         self.addresses: set[bytes] = set()
@@ -201,7 +204,15 @@ class AccessSet:
         # EIP-1153 transient storage: per-TRANSACTION, reverts with the
         # frame journal, discarded at tx end (never touches the trie)
         self.transient: dict[tuple[bytes, bytes], int] = {}
+        # EIP-6780: contracts CREATEd in this tx (full SELFDESTRUCT
+        # allowed); reverts with the frame journal like warmth
+        self.created: set[bytes] = set()
+        # destructions are DEFERRED to end of transaction (canonical
+        # Cancun: later same-tx frames still see the code; the account —
+        # including any residual balance — is deleted at tx end)
+        self.destroyed: set[bytes] = set()
         self._journal: list = []  # ("a",addr)|("s",key)|("r",d)|("t",k,old)
+        #                           |("c",addr)
 
     # -- journal (frame revert restores prior warmth + refund) -------------
     def snapshot(self) -> int:
@@ -221,12 +232,29 @@ class AccessSet:
                     self.transient.pop(key, None)
                 else:
                     self.transient[key] = old
+            elif kind == "c":
+                self.created.discard(entry[1])
+            elif kind == "d":
+                self.destroyed.discard(entry[1])
             else:
                 self.refund -= entry[1]
 
     def _add_refund(self, delta: int) -> None:
         self.refund += delta
         self._journal.append(("r", delta))
+
+    def mark_created(self, addr: bytes) -> None:
+        """EIP-6780: record a same-transaction CREATE."""
+        if addr not in self.created:
+            self.created.add(addr)
+            self._journal.append(("c", addr))
+
+    def mark_destroyed(self, addr: bytes) -> None:
+        """EIP-6780: schedule end-of-tx account deletion (journaled: a
+        reverting frame cancels it)."""
+        if addr not in self.destroyed:
+            self.destroyed.add(addr)
+            self._journal.append(("d", addr))
 
     # -- transient storage (EIP-1153) --------------------------------------
     def tload(self, addr: bytes, slot: bytes) -> int:
@@ -439,6 +467,32 @@ class EVM:
         return True
 
     # -- entry points ------------------------------------------------------
+    def do_selfdestruct(self, state, address: bytes, heir: bytes) -> None:
+        """EIP-6780 SELFDESTRUCT: the balance moves to the heir now; the
+        account (code, storage, residual balance) is deleted at END of
+        transaction, and only when the contract was created in this same
+        transaction — later frames in the tx still see the code, and a
+        self-heir's balance ends up burned by the deferred deletion.
+        Shared by both interpreters (the native side routes through the
+        selfdestruct host callback)."""
+        bal = self.balance_of(state, address)
+        if bal:
+            self.transfer(state, address, heir, bal)
+        if address in self.access().created:
+            self.access().mark_destroyed(address)
+
+    def _finalize_destructions(self, state) -> None:
+        """Apply deferred EIP-6780 deletions at top-frame success."""
+        acc = getattr(self._tls, "access", None)
+        if acc is None or not acc.destroyed:
+            return
+        for addr in acc.destroyed:
+            state.remove(T_CODE, addr)
+            for k in list(state.keys(T_STORE, addr)):
+                state.remove(T_STORE, k)
+            if self.balance_of(state, addr):
+                self.set_balance(state, addr, 0)  # burned
+
     # -- per-tx access context (EIP-2929) ----------------------------------
     def access(self) -> AccessSet:
         acc = getattr(self._tls, "access", None)
@@ -505,6 +559,8 @@ class EVM:
         res = self._run_in_message(state, env, code, caller, to, value, data,
                                    gas, depth, static)
         if res.success:
+            if depth == 0:
+                self._finalize_destructions(state)
             state.release(sp)
         else:
             state.rollback_to(sp)
@@ -536,6 +592,7 @@ class EVM:
         sp = state.savepoint()
         sp_acc = acc.snapshot()
         acc.warm_address(new_addr)  # EIP-2929: created address is warm
+        acc.mark_created(new_addr)  # EIP-6780: full selfdestruct allowed
         if not self.transfer(state, caller, new_addr, value):
             state.rollback_to(sp)
             acc.rollback_to(sp_acc)
@@ -557,6 +614,8 @@ class EVM:
             acc.rollback_to(sp_acc)
             return EVMResult(False, gas_left=0, error="code deposit gas")
         state.set(T_CODE, new_addr, deployed)
+        if depth == 0:
+            self._finalize_destructions(state)
         state.release(sp)
         return EVMResult(True, output=b"", gas_left=res.gas_left - code_gas,
                          logs=res.logs, create_address=new_addr)
@@ -1103,12 +1162,7 @@ class EVM:
                     heir = _addr_bytes(f.pop())
                     f.use_gas(G_SELFDESTRUCT
                               + acc.account_surcharge(heir))
-                    bal = self.balance_of(state, address)
-                    if bal:
-                        self.set_balance(state, address, 0)
-                        self.set_balance(
-                            state, heir, self.balance_of(state, heir) + bal)
-                    state.remove(T_CODE, address)
+                    self.do_selfdestruct(state, address, heir)
                     return EVMResult(True, b"", f.gas, logs)
                 else:
                     raise EVMError(f"unknown opcode 0x{op:02x}")

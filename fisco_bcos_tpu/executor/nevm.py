@@ -437,14 +437,8 @@ class _Host:
 
     def _selfdestruct(self, _ctx, heir):
         try:
-            e = self.evm
-            heir_b = _bytes_at(heir, 20)
-            bal = e.balance_of(self.state, self.address)
-            if bal:
-                e.set_balance(self.state, self.address, 0)
-                e.set_balance(self.state, heir_b,
-                              e.balance_of(self.state, heir_b) + bal)
-            self.state.remove(self._evm_mod.T_CODE, self.address)
+            self.evm.do_selfdestruct(self.state, self.address,
+                                     _bytes_at(heir, 20))
             return 0
         except BaseException as exc:  # noqa: BLE001
             self.exc = exc

@@ -104,3 +104,106 @@ def test_mcopy_huge_size_oog():
     n, p = run_both(code, gas=100_000)
     assert not n.success and not p.success
     assert n.gas_left == 0 and p.gas_left == 0
+
+
+SD_RUNTIME = bytes([0x73]) + b"\x99" * 20 + bytes([0xFF])  # SELFDESTRUCT(0x99..)
+# writes storage slot5=1, then SELFDESTRUCT(0x99..)
+SD_STORE_RUNTIME = (bytes([0x60, 0x01, 0x60, 0x05, 0x55])
+                    + bytes([0x73]) + b"\x99" * 20 + bytes([0xFF]))
+SD_INIT = (bytes([0x60, len(SD_RUNTIME), 0x60, 0x0c, 0x60, 0x00, 0x39,
+                  0x60, len(SD_RUNTIME), 0x60, 0x00, 0xF3]) + SD_RUNTIME)
+
+# parent: CREATE(calldata initcode), CALL the child, return its address
+PARENT = bytes([
+    0x36, 0x60, 0x00, 0x60, 0x00, 0x37,      # CALLDATACOPY(0,0,size)
+    0x36, 0x60, 0x00, 0x60, 0x00, 0xF0,      # CREATE -> [addr]
+    0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+    0x85,                                     # DUP6 -> addr
+    0x61, 0xFF, 0xFF, 0xF1, 0x50,             # CALL, POP status
+    0x60, 0x00, 0x52, 0x60, 0x20, 0x60, 0x00, 0xF3])
+
+
+def test_eip6780_same_tx_create_selfdestruct_destroys():
+    """A contract created and self-destructed in ONE transaction is fully
+    destroyed (code + storage gone), on both interpreters."""
+    for native in (True, False):
+        st = _fresh_state(PARENT)
+        evm = EVM(SUITE, native=native)
+        res = evm.execute_message(st, ENV, b"\x22" * 20, ADDR, 0, SD_INIT,
+                                  1_000_000)
+        assert res.success, res
+        child = res.output[12:32]
+        assert len(child) == 20 and child != b"\x00" * 20
+        assert evm.get_code(st, child) == b""  # destroyed
+        evm.take_refund(0)
+
+
+def _initcode_for(runtime: bytes) -> bytes:
+    return (bytes([0x60, len(runtime), 0x60, 0x0c, 0x60, 0x00, 0x39,
+                   0x60, len(runtime), 0x60, 0x00, 0xF3]) + runtime)
+
+
+def test_eip6780_destroys_storage_and_burns_residual():
+    """Deferred deletion wipes the destroyed contract's STORAGE too, and
+    any residual balance is burned at end of tx (heir == self)."""
+    from fisco_bcos_tpu.executor.evm import T_STORE
+
+    self_heir_runtime = (bytes([0x60, 0x01, 0x60, 0x05, 0x55])  # SSTORE
+                         + bytes([0x30, 0xFF]))  # SELFDESTRUCT(ADDRESS)
+    for native in (True, False):
+        st = _fresh_state(PARENT)
+        evm = EVM(SUITE, native=native)
+        res = evm.execute_message(st, ENV, b"\x22" * 20, ADDR, 0,
+                                  _initcode_for(self_heir_runtime),
+                                  1_000_000)
+        assert res.success, res
+        child = res.output[12:32]
+        assert evm.get_code(st, child) == b""
+        # storage of the destroyed contract is gone
+        assert list(st.keys(T_STORE, child)) == []
+        # the self-heired balance was burned, not resurrected
+        assert evm.balance_of(st, child) == 0
+        evm.take_refund(0)
+
+
+def test_eip6780_late_frames_still_see_code():
+    """Destruction is deferred to END of tx: a later frame in the same
+    tx still observes the child's code (EXTCODESIZE != 0)."""
+    # parent: CREATE(child), CALL child (selfdestructs), then
+    # EXTCODESIZE(child) -> return it
+    parent = bytes([
+        0x36, 0x60, 0x00, 0x60, 0x00, 0x37,
+        0x36, 0x60, 0x00, 0x60, 0x00, 0xF0,       # CREATE -> [addr]
+        0x80,                                      # DUP1 [addr, addr]
+        0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+        0x86,                                      # DUP7 -> addr
+        0x61, 0xFF, 0xFF, 0xF1, 0x50,              # CALL, POP
+        0x3B,                                      # EXTCODESIZE(addr)
+        0x60, 0x00, 0x52, 0x60, 0x20, 0x60, 0x00, 0xF3])
+    for native in (True, False):
+        st = _fresh_state(parent)
+        evm = EVM(SUITE, native=native)
+        res = evm.execute_message(st, ENV, b"\x22" * 20, ADDR, 0,
+                                  _initcode_for(SD_RUNTIME), 1_000_000)
+        assert res.success, res
+        # mid-tx view: code still present (size == len(SD_RUNTIME))
+        assert int.from_bytes(res.output, "big") == len(SD_RUNTIME)
+        evm.take_refund(0)
+
+
+def test_eip6780_preexisting_contract_survives():
+    """A PRE-EXISTING contract that self-destructs keeps its code (only
+    the balance moves) — Cancun semantics, both interpreters."""
+    target = b"\x44" * 20
+    for native in (True, False):
+        st = _fresh_state()
+        st.set(T_CODE, target, SD_RUNTIME)
+        evm = EVM(SUITE, native=native)
+        evm.set_balance(st, target, 777)
+        res = evm.execute_message(st, ENV, b"\x22" * 20, target, 0, b"",
+                                  200_000)
+        assert res.success, res
+        assert evm.get_code(st, target) == SD_RUNTIME  # code survives
+        assert evm.balance_of(st, target) == 0
+        assert evm.balance_of(st, b"\x99" * 20) == 777  # heir credited
+        evm.take_refund(0)
