@@ -37,13 +37,13 @@ def _mk_batch(params, refimpl, batch, with_pub):
 
 
 def bench_kernel(name, fn, args_dev, batch, iters=3):
-    import numpy as np
+    import bench as bench_mod
     out = fn(*args_dev)
-    (out[0] if isinstance(out, tuple) else out).block_until_ready()
+    bench_mod.sync_device(out)  # block_until_ready is a no-op on axon
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args_dev)
-    (out[0] if isinstance(out, tuple) else out).block_until_ready()
+    bench_mod.sync_device(out)
     dt = (time.perf_counter() - t0) / iters
     return {"kernel": name, "batch": batch, "sigs_per_sec": round(batch / dt, 1),
             "ms": round(dt * 1000, 2)}

@@ -72,11 +72,11 @@ def main() -> None:
     def timed(fn, *a):
         g = jax.jit(fn)
         out = g(*a)
-        jax.block_until_ready(out)
+        bench_mod.sync_device(out)  # block_until_ready is a no-op on axon
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = g(*a)
-        jax.block_until_ready(out)
+        bench_mod.sync_device(out)
         return (time.perf_counter() - t0) / args.iters
 
     rows: dict[str, float] = {}
@@ -88,7 +88,7 @@ def main() -> None:
     rows["select_const"] = timed(
         lambda d: ec._take_const(cv.g_table, d), dig)
     tq2 = jax.jit(lambda x, y: ec._q_window_affine(cv, x, y))(qxr, qyr)
-    jax.block_until_ready(tq2)
+    bench_mod.sync_device(tq2)
     rows["select_batch"] = timed(lambda t, d: ec._take_batch(t, d), tq2, dig)
     rows["table_build"] = timed(
         lambda x, y: ec._q_window_affine(cv, x, y), qxr, qyr)
