@@ -490,8 +490,16 @@ class EVM:
             state.remove(T_CODE, addr)
             for k in list(state.keys(T_STORE, addr)):
                 state.remove(T_STORE, k)
-            if self.balance_of(state, addr):
-                self.set_balance(state, addr, 0)  # burned
+            # full account deletion: balance (already routed to the heir or
+            # burned), nonce, and any residual records must all vanish so a
+            # later CREATE2 redeploy at this address starts from a truly
+            # empty account (child CREATE addresses derive from nonce 0);
+            # existence-guarded so no-op tombstones don't amplify KeyPage
+            # writes at 2PC prepare
+            if state.get(T_BAL, addr) is not None:
+                state.remove(T_BAL, addr)
+            if state.get(T_NONCE, addr) is not None:
+                state.remove(T_NONCE, addr)
 
     # -- per-tx access context (EIP-2929) ----------------------------------
     def access(self) -> AccessSet:

@@ -166,6 +166,29 @@ def test_eip6780_destroys_storage_and_burns_residual():
         evm.take_refund(0)
 
 
+def test_eip6780_deletion_removes_nonce_and_balance_records():
+    """Full account deletion: after a same-tx create+selfdestruct, the
+    account's NONCE and BALANCE records are REMOVED (not zero-valued
+    entries), so a CREATE2 redeploy at that address restarts at nonce 0
+    and no dead-account rows leak into the changeset."""
+    from fisco_bcos_tpu.executor.evm import T_BAL, T_NONCE
+
+    # child: CREATE(0,0,0) (bumps own nonce record), SELFDESTRUCT(self)
+    child_runtime = bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0xF0, 0x50,
+                           0x30, 0xFF])
+    for native in (True, False):
+        st = _fresh_state(PARENT)
+        evm = EVM(SUITE, native=native)
+        res = evm.execute_message(st, ENV, b"\x22" * 20, ADDR, 0,
+                                  _initcode_for(child_runtime), 1_000_000)
+        assert res.success, res
+        child = res.output[12:32]
+        assert evm.get_code(st, child) == b""
+        assert list(st.keys(T_NONCE, child)) == []
+        assert list(st.keys(T_BAL, child)) == []
+        evm.take_refund(0)
+
+
 def test_eip6780_late_frames_still_see_code():
     """Destruction is deferred to END of tx: a later frame in the same
     tx still observes the child's code (EXTCODESIZE != 0)."""
