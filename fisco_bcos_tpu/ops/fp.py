@@ -49,6 +49,27 @@ LIMB_RADIX = 1 << LIMB_BITS
 MASK = np.uint32(LIMB_RADIX - 1)
 BITS = NLIMBS * LIMB_BITS  # 256
 
+_PALLAS_CACHE: list = []
+
+
+def _use_pallas() -> bool:
+    """Pallas-fused multiplies: on for TPU backends, off on CPU (the
+    interpreter there is slower than plain XLA), overridable with
+    FBTPU_PALLAS=0/1. Resolved once at first use (backend init is when
+    the platform is known and stable)."""
+    if not _PALLAS_CACHE:
+        import os
+
+        flag = os.environ.get("FBTPU_PALLAS", "")
+        if flag in ("0", "1"):
+            _PALLAS_CACHE.append(flag == "1")
+        else:
+            try:
+                _PALLAS_CACHE.append(jax.devices()[0].platform == "tpu")
+            except Exception:
+                _PALLAS_CACHE.append(False)
+    return _PALLAS_CACHE[0]
+
 __all__ = ["NLIMBS", "LIMB_BITS", "BITS", "SolinasField", "MontField",
            "to_limbs", "from_limbs_np", "window_digits", "is_zero", "eq",
            "select", "add_limbs", "sub_limbs"]
@@ -221,13 +242,50 @@ def window_digits(a, w: int):
 # ---------------------------------------------------------------------------
 
 class _FieldBase:
-    """Shared modulus plumbing. Subclasses define the mul domain."""
+    """Shared modulus plumbing. Subclasses define the mul domain.
+
+    `mul` dispatches to the pallas-fused kernel (ops.pallas_fp) for
+    lane-major shapes on TPU — one HBM round-trip per multiply instead of
+    the XLA outer-product path's reshape-relayout storm; the XLA `mul_xla`
+    body remains the fallback (CPU tests, odd shapes, pallas disabled).
+    """
 
     def __init__(self, n: int, name: str):
         self.name = name
         self.n_int = n
         self.limbs = to_limbs(n)
         assert 2 * n > 1 << BITS, "modulus must exceed 2^255"
+
+    def mul(self, a, b):
+        if _use_pallas():
+            from . import pallas_fp
+
+            a, b = jnp.asarray(a), jnp.asarray(b)
+            # single-column constant operand (to_rep/from_rep): dedicated
+            # kernel — broadcasting it to [16, B] first would materialize
+            # an HBM-sized input per multiply
+            if (a.ndim == 2 and b.ndim == 2 and b.shape == (NLIMBS, 1)
+                    and pallas_fp.pallas_ok(a.shape)):
+                return pallas_fp.mul_const(self, a, b)
+            if (b.ndim == 2 and a.ndim == 2 and a.shape == (NLIMBS, 1)
+                    and pallas_fp.pallas_ok(b.shape)):
+                return pallas_fp.mul_const(self, b, a)
+            if a.shape != b.shape:
+                shape = jnp.broadcast_shapes(a.shape, b.shape)
+                a = jnp.broadcast_to(a, shape)
+                b = jnp.broadcast_to(b, shape)
+            if pallas_fp.pallas_ok(a.shape[-2:]):
+                if a.ndim == 2:
+                    return pallas_fp.mul(self, a, b)
+                # stacked [..., 16, B]: collapse the leading (major) axes —
+                # layout-safe, the lane-minor batch axis is untouched
+                lead = a.shape[:-2]
+                k = int(np.prod(lead))
+                out = pallas_fp.mul_stacked(
+                    self, a.reshape((k,) + a.shape[-2:]),
+                    b.reshape((k,) + b.shape[-2:]))
+                return out.reshape(lead + a.shape[-2:])
+        return self.mul_xla(a, b)
 
     # hashable-by-value so fields can be jit static args
     def __hash__(self):
@@ -369,7 +427,7 @@ class SolinasField(_FieldBase):
             out = out + _pad(contrib, sh, NLIMBS - ntop - sh)
         return out
 
-    def mul(self, a, b):
+    def mul_xla(self, a, b):
         cols = mul_wide(a, b)  # 32 redundant cols < 2^21
         low, high = cols[..., :NLIMBS, :], cols[..., NLIMBS:, :]
         # fold 1: value = L + H*c; coef*H[k] < 2^11 * 2^21 = 2^32.
@@ -414,7 +472,7 @@ class MontField(_FieldBase):
         self.nprime = to_limbs((-pow(n, -1, 1 << BITS)) % (1 << BITS))
         self.one_m = to_limbs(self.r_int)
 
-    def mul(self, a, b):
+    def mul_xla(self, a, b):
         """REDC(a*b) for canonical Montgomery-domain inputs (< n)."""
         n = _col(self.limbs)
         z_cols = mul_wide(a, b)
