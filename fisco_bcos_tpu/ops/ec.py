@@ -316,6 +316,19 @@ def shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     field rep. 64-step scan, 4-bit windows for both scalars; the Q table
     is batch-normalized to affine so both adds per step are mixed adds.
     """
+    if (fp._use_pallas() and k1.shape[-1] % 128 == 0
+            and (cv.a_is_zero or cv.a_is_minus3)):
+        from . import pallas_ec
+
+        gts = jnp.asarray(cv.g_table)[None]
+        d1 = fp.window_digits(k1, WINDOW)[..., ::-1, :]
+        d2 = fp.window_digits(k2, WINDOW)[..., ::-1, :]
+        digs_all = jnp.stack([d1, d2])
+        negs = jnp.zeros((2, k1.shape[-1]), jnp.uint32)
+        q_planes = jnp.stack([qx_r, qy_r])[None]
+        return pallas_ec.ladder(cv.fp, cv.a_is_zero, cv.a_is_minus3,
+                                NDIGITS, gts, digs_all, negs, q_planes)
+
     tq2 = _q_window_affine(cv, qx_r, qy_r)  # [TBL, 2, L, B]
 
     d1 = fp.window_digits(k1, WINDOW)[..., ::-1, :]  # [64, B] MSB-first
@@ -394,14 +407,29 @@ def glv_shamir_mult(cv: Curve, k1, k2, qx_r, qy_r):
     a1, s1, a2, s2 = _glv_split_device(cv, k1)
     b1, t1, b2, t2 = _glv_split_device(cv, k2)
 
+    def digs(m):
+        d = fp.window_digits(m, WINDOW)[..., :GLV_DIGITS, :]
+        return d[..., ::-1, :]  # MSB-first
+
+    if (fp._use_pallas() and k1.shape[-1] % 128 == 0
+            and (cv.a_is_zero or cv.a_is_minus3)):
+        from . import pallas_ec
+
+        beta = fp._col(cv.beta_rep)
+        qlx = f.mul(qx_r, beta)
+        gts = jnp.stack([jnp.asarray(cv.g_table),
+                         jnp.asarray(cv.g_table_endo)])
+        digs_all = jnp.stack([digs(a1), digs(b1), digs(a2), digs(b2)])
+        negs = jnp.stack([s1, t1, s2, t2]).astype(jnp.uint32)
+        q_planes = jnp.stack([jnp.stack([qx_r, qy_r]),
+                              jnp.stack([qlx, qy_r])])
+        return pallas_ec.ladder(f, cv.a_is_zero, cv.a_is_minus3,
+                                GLV_DIGITS, gts, digs_all, negs, q_planes)
+
     # per-element tables, batch-normalized affine; phi applies beta to x
     tq2 = _q_window_affine(cv, qx_r, qy_r)  # [TBL, 2, L, B]
     beta = jnp.broadcast_to(fp._col(cv.beta_rep), tq2[:, 0].shape)
     tql2 = jnp.stack([f.mul(tq2[:, 0], beta), tq2[:, 1]], axis=1)
-
-    def digs(m):
-        d = fp.window_digits(m, WINDOW)[..., :GLV_DIGITS, :]
-        return d[..., ::-1, :]  # MSB-first
 
     da1, da2, db1, db2 = digs(a1), digs(a2), digs(b1), digs(b2)
 

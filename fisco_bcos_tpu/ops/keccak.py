@@ -80,6 +80,17 @@ def _round(hi, lo, rc_hi, rc_lo):
     """One Keccak round on stacked lanes [..., 25]."""
     H = [hi[..., i] for i in range(25)]
     L = [lo[..., i] for i in range(25)]
+    H, L = round_lists(H, L, rc_hi, rc_lo)
+    return jnp.stack(H, axis=-1), jnp.stack(L, axis=-1)
+
+
+def round_lists(H, L, rc_hi, rc_lo):
+    """One Keccak round on 25 (hi, lo) lane arrays of any uniform shape.
+
+    List-based so fused Pallas kernels (ops.pallas_merkle) can inline it on
+    row-sliced state without the lane-axis stack/unstack.
+    """
+    H, L = list(H), list(L)
     # theta
     CH = [H[x] ^ H[x + 5] ^ H[x + 10] ^ H[x + 15] ^ H[x + 20] for x in range(5)]
     CL = [L[x] ^ L[x + 5] ^ L[x + 10] ^ L[x + 15] ^ L[x + 20] for x in range(5)]
@@ -105,7 +116,7 @@ def _round(hi, lo, rc_hi, rc_lo):
     # iota
     H[0] = H[0] ^ rc_hi
     L[0] = L[0] ^ rc_lo
-    return jnp.stack(H, axis=-1), jnp.stack(L, axis=-1)
+    return H, L
 
 
 def keccak_f(hi, lo):

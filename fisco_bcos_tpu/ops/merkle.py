@@ -99,6 +99,12 @@ def merkle_root(leaves, alg: str = "keccak256") -> jax.Array:
         leaves = jnp.concatenate(
             [leaves, jnp.zeros((nbucket - n, DIGEST), jnp.uint8)], axis=0
         )
+    from . import fp
+    if fp._use_pallas() and nbucket <= 65536:  # leaves stay VMEM-resident
+        # whole tree in one fused kernel: the XLA level loop pays the
+        # backend's per-op latency thousands of times per root
+        from . import pallas_merkle
+        return pallas_merkle.merkle_root_fused(leaves, jnp.int32(n), alg)
     return _merkle_root_bucketed(leaves, jnp.int32(n), alg)
 
 

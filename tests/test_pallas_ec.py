@@ -1,0 +1,89 @@
+"""Fused-ladder building blocks: bit-parity with the XLA point ops.
+
+The value-level Jacobian ops used inside the fused ladder kernel must
+match ops.ec's complete-by-selection ops exactly — same field, same
+selection semantics. Full-ladder parity is covered by a slower
+offline harness (interpret mode) and by the device sweep's verify
+assertions on real TPU; here CI pins the per-op contracts cheaply.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+
+from fisco_bcos_tpu.crypto import refimpl
+from fisco_bcos_tpu.ops import ec, fp, pallas_ec, pallas_fp
+
+B = 128
+CV = ec.SECP256K1
+F = CV.fp
+
+
+@pytest.fixture(scope="module")
+def points():
+    rng = np.random.default_rng(21)
+    pts = [refimpl.ec_mul(refimpl.SECP256K1,
+                          int.from_bytes(rng.bytes(32), "big")
+                          % refimpl.SECP256K1.n,
+                          (refimpl.SECP256K1.gx, refimpl.SECP256K1.gy))
+           for _ in range(8)]
+    xs = np.stack([fp.to_limbs(pts[i % 8][0]) for i in range(B)], axis=1)
+    ys = np.stack([fp.to_limbs(pts[i % 8][1]) for i in range(B)], axis=1)
+    xr, yr = np.asarray(F.to_rep(xs)), np.asarray(F.to_rep(ys))
+    one = np.asarray(F.one_rep(xr.shape))
+    return np.stack([xr, yr, one])
+
+
+def _run(body, *arrays):
+    consts = pallas_fp.field_consts(F)
+
+    def kernel(c_ref, *refs):
+        fc = pallas_ec.FieldCtx(F, c_ref[:, 0:1])
+        out_ref = refs[-1]
+        ins = [r[:, :, :] for r in refs[:-1]]
+        out_ref[:, :, :] = body(fc, *ins)
+
+    return np.asarray(pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((3, 16, B), jnp.uint32),
+        interpret=True)(consts, *arrays))
+
+
+def test_vjac_double_matches(points):
+    got = _run(lambda fc, p: pallas_ec.vjac_double(fc, p, True, False),
+               points)
+    want = np.asarray(ec.jac_double(CV, jnp.asarray(points)))
+    assert (got == want).all()
+
+
+def test_vjac_add_doubling_case(points):
+    got = _run(lambda fc, p, q: pallas_ec.vjac_add(fc, p, q, True, False),
+               points, points.copy())
+    want = np.asarray(ec.jac_add(CV, jnp.asarray(points),
+                                 jnp.asarray(points)))
+    assert (got == want).all()
+
+
+def test_vjac_add_generic_and_infinity(points):
+    q2 = np.asarray(ec.jac_double(CV, jnp.asarray(points)))
+    got = _run(lambda fc, p, q: pallas_ec.vjac_add(fc, p, q, True, False),
+               points, q2)
+    want = np.asarray(ec.jac_add(CV, jnp.asarray(points), jnp.asarray(q2)))
+    assert (got == want).all()
+
+    inf = np.zeros_like(points)
+    got = _run(lambda fc, p, q: pallas_ec.vjac_add(fc, p, q, True, False),
+               points, inf)
+    assert (got == points).all()  # P + inf = P
+
+
+def test_take_tables_match(points):
+    rng = np.random.default_rng(3)
+    dig = rng.integers(0, 16, (B,), dtype=np.uint32)
+    gx, gy = pallas_ec._take_const_table(jnp.asarray(CV.g_table),
+                                         jnp.asarray(dig))
+    wx, wy = ec._take_const(CV.g_table, jnp.asarray(dig))
+    assert (np.asarray(gx) == np.asarray(wx)).all()
+    assert (np.asarray(gy) == np.asarray(wy)).all()
