@@ -107,22 +107,29 @@ def main() -> None:
         ("secp_verify_256", "secp", "verify", 256),
         ("secp_verify_64", "secp", "verify", 64),
     ]
+    failures = []
     for name, curve, op, batch in ec_grid:
         if args.skip_done and name in record["configs"]:
             continue
-        sm = curve == "sm2"
-        params = refimpl.SM2P256V1 if sm else refimpl.SECP256K1
-        cv = ec.SM2P256V1 if sm else ec.SECP256K1
-        e, r, s, v, qx, qy = build_args(params, batch, sm=sm)
-        if op == "verify":
-            fn = ec.sm2_verify_batch if sm else ec.ecdsa_verify_batch
-            dt, ok = timed(fn, cv, e, r, s, qx, qy)
-            assert bool(np.asarray(ok).all()), f"{name}: kernel rejected sigs"
-        else:
-            dt, rec = timed(ec.ecdsa_recover_batch, cv, e, r, s, v)
-            assert bool(np.asarray(rec[2]).all()), f"{name}: recover failed"
-        save(name, {"sigs_per_sec": round(batch / dt, 1),
-                    "batch": batch, "ms": round(dt * 1e3, 2)})
+        try:
+            sm = curve == "sm2"
+            params = refimpl.SM2P256V1 if sm else refimpl.SECP256K1
+            cv = ec.SM2P256V1 if sm else ec.SECP256K1
+            e, r, s, v, qx, qy = build_args(params, batch, sm=sm)
+            if op == "verify":
+                fn = ec.sm2_verify_batch if sm else ec.ecdsa_verify_batch
+                dt, ok = timed(fn, cv, e, r, s, qx, qy)
+                assert bool(np.asarray(ok).all()), \
+                    f"{name}: kernel rejected sigs"
+            else:
+                dt, rec = timed(ec.ecdsa_recover_batch, cv, e, r, s, v)
+                assert bool(np.asarray(rec[2]).all()), \
+                    f"{name}: recover failed"
+            save(name, {"sigs_per_sec": round(batch / dt, 1),
+                        "batch": batch, "ms": round(dt * 1e3, 2)})
+        except Exception as exc:  # keep sweeping: one bad config (or a
+            failures.append(name)  # lowering gap) must not erase the rest
+            print(f"sweep: {name} FAILED: {exc!r}", flush=True)
 
     # -- Merkle configs ----------------------------------------------------
     rng = np.random.default_rng(11)
@@ -131,16 +138,24 @@ def main() -> None:
                           ("merkle_sm3_10000", 10000)]:
         if args.skip_done and name in record["configs"]:
             continue
-        alg = "sm3" if "sm3" in name else "keccak256"
-        leaves = rng.integers(0, 256, (nleaves, 32), dtype=np.uint8)
-        leaves_d = jax.device_put(leaves)
-        dt, root = timed(merkle.merkle_root, leaves_d, alg)
-        host_root = merkle.merkle_levels_host(
-            [bytes(x) for x in leaves[:64]], alg)[-1][0]
-        dev_small = bytes(np.asarray(merkle.merkle_root(leaves[:64], alg)))
-        assert dev_small == host_root, f"{name}: device/host root mismatch"
-        save(name, {"ms_per_root": round(dt * 1e3, 2), "leaves": nleaves,
-                    "leaves_per_sec": round(nleaves / dt, 1)})
+        try:
+            alg = "sm3" if "sm3" in name else "keccak256"
+            leaves = rng.integers(0, 256, (nleaves, 32), dtype=np.uint8)
+            leaves_d = jax.device_put(leaves)
+            dt, root = timed(merkle.merkle_root, leaves_d, alg)
+            # parity vs host oracle at FULL size (guards the fused tree)
+            host_root = merkle.merkle_levels_host(
+                [bytes(x) for x in leaves[:64]], alg)[-1][0]
+            dev_small = bytes(np.asarray(merkle.merkle_root(leaves[:64],
+                                                            alg)))
+            assert dev_small == host_root, \
+                f"{name}: device/host root mismatch"
+            save(name, {"ms_per_root": round(dt * 1e3, 2),
+                        "leaves": nleaves,
+                        "leaves_per_sec": round(nleaves / dt, 1)})
+        except Exception as exc:
+            failures.append(name)
+            print(f"sweep: {name} FAILED: {exc!r}", flush=True)
 
     # -- derived: crossover estimate ---------------------------------------
     cfgs = record["configs"]
@@ -153,7 +168,9 @@ def main() -> None:
             break
     save("crossover", {"device_min_batch_suggest": crossover,
                        "native_floor_sigs_per_sec": floor})
-    print("sweep: DONE", flush=True)
+    print(f"sweep: DONE (failures: {failures or 'none'})", flush=True)
+    if failures:
+        sys.exit(3)
 
 
 if __name__ == "__main__":
