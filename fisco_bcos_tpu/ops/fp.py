@@ -224,6 +224,16 @@ def geq(a, b):
     return brw == 0
 
 
+def msb_digits(e: int, window: int = 4) -> np.ndarray:
+    """Static exponent -> MSB-first window digits (shared by the XLA
+    pow_const scan and the fused pallas kernel so the encodings cannot
+    diverge)."""
+    nd = max(1, (e.bit_length() + window - 1) // window)
+    return np.array(
+        [(e >> (window * i)) & ((1 << window) - 1) for i in range(nd)][::-1],
+        dtype=np.int32)
+
+
 def window_digits(a, w: int):
     """[..., 16, B] -> [..., 256//w, B] little-endian w-bit digits."""
     assert LIMB_BITS % w == 0
@@ -335,10 +345,15 @@ class _FieldBase:
         """a^e in the internal domain; e is a compile-time int."""
         if e == 0:
             return self.one_rep(a.shape)
-        nd = (e.bit_length() + window - 1) // window
-        digits = np.array(
-            [(e >> (window * i)) & ((1 << window) - 1) for i in range(nd)][::-1],
-            dtype=np.int32)
+        if _use_pallas():
+            from . import pallas_fp
+
+            a = jnp.asarray(a)
+            if pallas_fp.pallas_ok(a.shape):
+                # the XLA form is ~5 multiplies x 64 scan steps of per-op
+                # dispatch; the fused kernel is ONE pallas call
+                return pallas_fp.pow_const(self, a, e)
+        digits = msb_digits(e, window)
 
         def tbl_step(prev, _):
             nxt = self.mul(prev, a)

@@ -264,3 +264,87 @@ def mul_stacked(field: "fp._FieldBase", a, b, interpret: bool = False):
     blk = _pick_blk(B)
     return _mul_call_stacked(field, K, B, blk, _auto_interpret(interpret))(
         jnp.asarray(field_consts(field)), a, b)
+
+
+# ---------------------------------------------------------------------------
+# fused fixed-exponent power (recover's sqrt, Fermat inversions)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _pow_call(field: "fp._FieldBase", nd: int, B: int, blk: int,
+              interpret: bool):
+    """a^e with e delivered as `nd` 4-bit SMEM digits (MSB-first).
+
+    The XLA pow_const is a 64-step scan of ~5 multiplies — ~320 per-op
+    dispatches per call on this backend. Here: window table (16 entries)
+    built in-kernel, then one fori_loop; a single pallas call.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    solinas = isinstance(field, fp.SolinasField)
+    W = 4
+
+    def kernel(digs_ref, c_ref, a_ref, o_ref):
+        a = a_ref[:, :]
+        limbs_col = c_ref[:, 0:1]
+        if solinas:
+            mul = lambda x, y: solinas_mul_body(field, x, y, limbs_col)
+        else:
+            npc = c_ref[:, 1:2]
+            mul = lambda x, y: mont_mul_body(field, x, y, limbs_col, npc)
+        # window table [16, 16, blk]: entry k = a^k (entry 0 = 1)
+        if solinas:
+            one = (jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
+                   == 0).astype(jnp.uint32)
+        else:
+            one = jnp.broadcast_to(c_ref[:, 2:3], a.shape)
+        entries = [one, a]
+        for _ in range((1 << W) - 2):
+            entries.append(mul(entries[-1], a))
+        table = jnp.stack(entries, axis=0)
+
+        def body(i, acc):
+            for _ in range(W):
+                acc = mul(acc, acc)
+            d = digs_ref[i]
+            factor = jax.lax.dynamic_index_in_dim(table, d, axis=0,
+                                                  keepdims=False)
+            return mul(acc, factor)
+
+        d0 = digs_ref[0]
+        init = jax.lax.dynamic_index_in_dim(table, d0, axis=0,
+                                            keepdims=False)
+        acc = jax.lax.fori_loop(1, nd, body, init)
+        o_ref[:, :] = acc
+
+    ncols = 2 if solinas else 3
+    spec = pl.BlockSpec((NLIMBS, blk), lambda i: (0, i))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32),
+        grid=(B // blk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((NLIMBS, ncols), lambda i: (0, 0)),
+            spec,
+        ],
+        out_specs=spec,
+        interpret=interpret,
+    )
+
+
+def pow_const(field: "fp._FieldBase", a, e: int, interpret: bool = False):
+    """Fused a^e (internal domain) for e > 0; caller gates `pallas_ok`."""
+    digits = fp.msb_digits(e, 4)  # kernel window W = 4
+    nd = len(digits)
+    B = a.shape[-1]
+    blk = _pick_blk(B)
+    if isinstance(field, fp.SolinasField):
+        consts = field_consts(field)
+    else:
+        consts = np.zeros((NLIMBS, 3), np.uint32)
+        consts[:, :2] = field_consts(field)
+        consts[:, 2] = field.one_m
+    return _pow_call(field, nd, B, blk, _auto_interpret(interpret))(
+        jnp.asarray(digits), jnp.asarray(consts), a)

@@ -89,6 +89,28 @@ def test_mul_const_column(field):
     assert (want == got).all()
 
 
+@pytest.mark.parametrize("field", FIELDS[:2], ids=lambda f: f.name)
+def test_pow_const_fused(field):
+    """Fused exponentiation matches the XLA scan path (small exponents in
+    CI; the (p+1)/4 sqrt exponent is covered by the offline harness and
+    the device sweep's recover assertions)."""
+    rng = np.random.default_rng(17)
+    a = _rand_cols(rng, 128, field.n_int)
+    if isinstance(field, fp.MontField):
+        a = np.asarray(field.to_rep(a))
+    prior = list(fp._PALLAS_CACHE)
+    try:
+        for e in (1, 2, 3, 0x1234, 0xFFFF):
+            fp._PALLAS_CACHE[:] = [False]
+            want = np.asarray(field.pow_const(a, e))
+            fp._PALLAS_CACHE[:] = []
+            got = np.asarray(pallas_fp.pow_const(field, a, e,
+                                                 interpret=True))
+            assert (want == got).all(), hex(e)
+    finally:
+        fp._PALLAS_CACHE[:] = prior
+
+
 def test_host_value_parity():
     """Pallas product agrees with Python big-int arithmetic, not just the
     XLA path (guards against a shared systematic error)."""
