@@ -101,6 +101,21 @@ def main() -> None:
     rows["verify_e2e"] = timed(
         lambda *a: ec.ecdsa_verify_batch(cv, *a), e, r, s, qx, qy)
 
+    # fused-kernel units (pallas path; fall back silently if disabled)
+    if fp._use_pallas():
+        from fisco_bcos_tpu.ops import pallas_fp
+
+        rows["pl_mul"] = timed(lambda a, b: pallas_fp.mul(f, a, b),
+                               qxr, qyr)
+        rows["pl_pow_sqrt"] = timed(
+            lambda a: pallas_fp.pow_const(f, a, (f.n_int + 1) // 4), qxr)
+        rows["glv_split"] = timed(
+            lambda k: jnp.stack(ec._glv_split_device(cv, k)[::2]), u1)
+        from fisco_bcos_tpu.ops import merkle as _mk
+        leaves = jnp.asarray(np.random.default_rng(9).integers(
+            0, 256, (10000, 32), dtype=np.uint8))
+        rows["merkle_10k"] = timed(lambda l: _mk.merkle_root(l), leaves)
+
     # ladder cost model at WINDOW=4/GLV_DIGITS=34: does measured time
     # match the sum of its parts? (mismatch => fusion/layout overhead)
     model = (ec.GLV_DIGITS * ec.WINDOW * rows["jac_double"]
