@@ -207,6 +207,11 @@ def keccak256_varlen(blocks_u8: jax.Array, nvalid: jax.Array) -> jax.Array:
     """Variable-length batch: [B, maxblocks, RATE_BYTES] pre-padded blocks,
     nvalid[B] = per-message block count. Messages shorter than maxblocks
     mask out the trailing permutations. Returns [B, 32] digests."""
+    from . import fp as _fp
+    if _fp._use_pallas() and blocks_u8.ndim == 3 and blocks_u8.shape[0]:
+        from . import pallas_hash
+
+        return pallas_hash.keccak256_varlen_fused(blocks_u8, nvalid)
     return _keccak256_varlen_impl(blocks_u8, nvalid, blocks_u8.shape[-2])
 
 
