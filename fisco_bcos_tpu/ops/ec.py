@@ -506,6 +506,24 @@ def _tx(a):
     return jnp.transpose(a)
 
 
+_FUSED_VERIFY_CACHE: list = []
+
+
+def _use_fused_verify() -> bool:
+    """Opt-in for the single-kernel verify (ops.pallas_verify) until its
+    device lowering is validated; flip the default once the sweep has
+    asserted it on real TPU. Resolved ONCE at first use (the check runs
+    at trace time inside the jitted verify, so a late env flip would
+    otherwise be frozen out by the jit cache unpredictably — set
+    FBTPU_FUSED_VERIFY before the first verify call)."""
+    if not _FUSED_VERIFY_CACHE:
+        import os
+
+        _FUSED_VERIFY_CACHE.append(
+            os.environ.get("FBTPU_FUSED_VERIFY") == "1" and fp._use_pallas())
+    return _FUSED_VERIFY_CACHE[0]
+
+
 @functools.partial(jax.jit, static_argnums=0)
 def ecdsa_verify_batch(cv: Curve, e, r, s, qx, qy):
     """Batched ECDSA verify. All args [B, NLIMBS] uint32; -> bool[B].
@@ -514,6 +532,11 @@ def ecdsa_verify_batch(cv: Curve, e, r, s, qx, qy):
     r, s: signature scalars; qx, qy: affine public key (field canonical).
     """
     e, r, s, qx, qy = map(_tx, (e, r, s, qx, qy))
+    if (_use_fused_verify() and cv.has_endo
+            and e.shape[-1] % 128 == 0):
+        from . import pallas_verify
+
+        return pallas_verify.ecdsa_verify_fused(cv, e, r, s, qx, qy)
     f, fn_ = cv.fp, cv.fn
     ok = _scalar_checks(fn_, r, s)
     pl = fp._col(f.limbs)

@@ -55,10 +55,12 @@ class FieldCtx:
     [16, 1] modulus columns read from the kernel's const input.
     """
 
-    def __init__(self, field: "fp._FieldBase", limbs_col, nprime_col=None):
+    def __init__(self, field: "fp._FieldBase", limbs_col, nprime_col=None,
+                 one_col=None):
         self.field = field
         self.limbs_col = limbs_col
         self.nprime_col = nprime_col
+        self.one_col = one_col  # Montgomery-domain 1 (Mont fields only)
         self.solinas = isinstance(field, fp.SolinasField)
 
     def mul(self, a, b):
@@ -198,42 +200,39 @@ def _take_jac_table(tq, dig):
 # the fused ladder kernel
 # ---------------------------------------------------------------------------
 
-def _ladder_kernel_body(field, curve_flags, nsteps, n_pairs,
-                        c_ref, gts_ref, digs_ref, negs_ref, q_ref, o_ref):
-    """Shared kernel body.
+def field_one(f: FieldCtx, shape):
+    """Field-rep 1 of the given [16, B] shape: plain 1 for Solinas (iota
+    mask — .at[].set is a scatter Mosaic rejects), Montgomery R mod n
+    (the ctx's one_col) otherwise."""
+    if f.solinas:
+        return (jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                == 0).astype(U32)
+    return jnp.broadcast_to(f.one_col, shape)
+
+
+def ladder_values(f: FieldCtx, curve_flags, nsteps, n_pairs,
+                  gts, digs, negs, q_planes):
+    """The ladder on VALUES (callable from any kernel).
 
     n_pairs: 1 (plain Shamir: G+Q) or 2 (GLV: G, phiG, Q, phiQ).
-    c_ref:   [16, 2] modulus limbs | n'
-    gts_ref: [n_pairs, TBL, 2*NLIMBS] constant affine G tables
-    digs_ref:[2*n_pairs, nsteps, B] MSB-first window digits, rows
-             INTERLEAVED per pair: [g, q] (n_pairs=1) or
-             [g, q, g_endo, q_endo] (n_pairs=2) — pair p reads rows
-             2p (constant-table plane) and 2p+1 (per-element plane)
-    negs_ref:[2*n_pairs, B] sign flags (uint32 0/1), same row order as
-             digs_ref
-    q_ref:   [n_pairs, 2, 16, B] affine Q (and beta*Q) in field rep
-    o_ref:   [3, 16, B] accumulator out
+    gts:  [n_pairs, TBL, 2*NLIMBS] constant affine G tables
+    digs: [2*n_pairs, nsteps, B] MSB-first window digits, rows
+          INTERLEAVED per pair: [g, q] (n_pairs=1) or
+          [g, q, g_endo, q_endo] (n_pairs=2) — pair p reads rows
+          2p (constant-table plane) and 2p+1 (per-element plane)
+    negs: [2*n_pairs, B] sign flags (uint32 0/1), same row order
+    q_planes: [n_pairs, 2, 16, B] affine Q (and beta*Q) in field rep
+    -> packed Jacobian accumulator [3, 16, B].
     """
     a_is_zero, a_is_minus3 = curve_flags
-    f = FieldCtx(field, c_ref[:, 0:1],
-                 None if isinstance(field, fp.SolinasField) else c_ref[:, 1:2])
-    B = q_ref.shape[-1]
-
-    # field-rep 1 for the Z of affine lifts: plain 1 for Solinas (iota
-    # mask — .at[].set is a scatter Mosaic rejects), Montgomery R mod n
-    # delivered as c_ref column 2 otherwise
-    if isinstance(field, fp.SolinasField):
-        row0 = (jax.lax.broadcasted_iota(jnp.int32, (NLIMBS, B), 0)
-                == 0).astype(U32)
-        one_col = row0
-    else:
-        one_col = jnp.broadcast_to(c_ref[:, 2:3], (NLIMBS, B))
+    B = q_planes.shape[-1]
+    one_col = field_one(f, (NLIMBS, B))
 
     # per-element Jacobian window tables, built with 14 adds each
     tables = []
     for p in range(n_pairs):
-        qx = q_ref[p, 0]
-        qy = q_ref[p, 1]
+        qx = q_planes[p, 0]
+        qy = q_planes[p, 1]
         q1 = _pack(qx, qy, one_col)
         entries = [jnp.zeros_like(q1), q1]
         for _ in range(TBL - 2):
@@ -251,23 +250,22 @@ def _ladder_kernel_body(field, curve_flags, nsteps, n_pairs,
         for p in range(n_pairs):
             # constant G-plane add (affine entry, lifted to Jacobian)
             dg = jax.lax.dynamic_index_in_dim(
-                digs_ref[2 * p], r, axis=0, keepdims=False)
-            gx, gy = _take_const_table(gts_ref[p], dg)
-            gy = fp.select(negs_ref[2 * p] == 1, f.neg(gy), gy)
+                digs[2 * p], r, axis=0, keepdims=False)
+            gx, gy = _take_const_table(gts[p], dg)
+            gy = fp.select(negs[2 * p] == 1, f.neg(gy), gy)
             lift = _pack(gx, gy, one_col)
             lift = _psel(dg == 0, jnp.zeros_like(lift), lift)  # skip -> inf
             acc = vjac_add(f, acc, lift, a_is_zero, a_is_minus3)
             # per-element Q-plane add
             dq = jax.lax.dynamic_index_in_dim(
-                digs_ref[2 * p + 1], r, axis=0, keepdims=False)
+                digs[2 * p + 1], r, axis=0, keepdims=False)
             qe = _take_jac_table(tables[p], dq)
-            qe = neg_y(qe, negs_ref[2 * p + 1])
+            qe = neg_y(qe, negs[2 * p + 1])
             acc = vjac_add(f, acc, qe, a_is_zero, a_is_minus3)
         return acc
 
     init = jnp.zeros((3, NLIMBS, B), U32)
-    acc = jax.lax.fori_loop(0, nsteps, step, init)
-    o_ref[:, :, :] = acc
+    return jax.lax.fori_loop(0, nsteps, step, init)
 
 
 @functools.lru_cache(maxsize=None)
@@ -277,11 +275,15 @@ def _ladder_call(field: "fp._FieldBase", a_is_zero: bool, a_is_minus3: bool,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    solinas = isinstance(field, fp.SolinasField)
+
     def kernel(c_ref, gts_ref, digs_ref, negs_ref, q_ref, o_ref):
-        _ladder_kernel_body(field, (a_is_zero, a_is_minus3), nsteps,
-                            n_pairs, c_ref[:, :], gts_ref[:, :, :],
-                            digs_ref[:, :, :], negs_ref[:, :],
-                            q_ref[:, :, :, :], o_ref)
+        f = FieldCtx(field, c_ref[:, 0:1],
+                     None if solinas else c_ref[:, 1:2],
+                     None if solinas else c_ref[:, 2:3])
+        o_ref[:, :, :] = ladder_values(
+            f, (a_is_zero, a_is_minus3), nsteps, n_pairs, gts_ref[:, :, :],
+            digs_ref[:, :, :], negs_ref[:, :], q_ref[:, :, :, :])
 
     ncols = 3 if not isinstance(field, fp.SolinasField) else 2
     return pl.pallas_call(
@@ -307,13 +309,11 @@ LADDER_BLK = 256
 
 def ladder(field, a_is_zero, a_is_minus3, nsteps, gts, digs, negs, q_planes,
            interpret: bool = False):
-    """Run the fused ladder. Shapes as in `_ladder_kernel_body`; returns
+    """Run the fused ladder. Shapes as in `ladder_values`; returns
     the packed Jacobian accumulator [3, 16, B]."""
     n_pairs = gts.shape[0]
     B = q_planes.shape[-1]
-    blk = LADDER_BLK
-    while B % blk:
-        blk //= 2
+    blk = pallas_fp._pick_blk(B, LADDER_BLK)
     if isinstance(field, fp.SolinasField):
         consts = pallas_fp.field_consts(field)
     else:

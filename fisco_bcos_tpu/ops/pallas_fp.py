@@ -153,14 +153,17 @@ def _mul_call(field: "fp._FieldBase", B: int, blk: int, interpret: bool):
     )
 
 
-def _pick_blk(B: int) -> int:
-    """Largest block size <= BLK that DIVIDES B — a grid of B//blk full
-    blocks covers every lane (a floor-divided grid would silently drop the
-    tail: B=640 with blk=512 left lanes 512-639 uncomputed)."""
-    for blk in (BLK, 256, 128):
-        if B % blk == 0:
-            return blk
-    raise ValueError(f"B={B} not a multiple of 128")
+def _pick_blk(B: int, cap: int = BLK) -> int:
+    """Largest power-of-two block size <= cap that DIVIDES B — a grid of
+    B//blk full blocks covers every lane (a floor-divided grid would
+    silently drop the tail: B=640 with blk=512 left lanes 512-639
+    uncomputed). Shared by every pallas module."""
+    blk = min(cap, B)
+    while blk > 1 and B % blk:
+        blk //= 2
+    if B % blk:
+        raise ValueError(f"B={B} has no power-of-two block <= {cap}")
+    return blk
 
 
 def pallas_ok(shape) -> bool:
@@ -270,6 +273,27 @@ def mul_stacked(field: "fp._FieldBase", a, b, interpret: bool = False):
 # fused fixed-exponent power (recover's sqrt, Fermat inversions)
 # ---------------------------------------------------------------------------
 
+def pow_digits_values(mul, one, a, digs_ref, nd: int, W: int = 4):
+    """Windowed a^e on VALUES, exponent as `nd` MSB-first W-bit digits in
+    an SMEM ref (callable from any kernel): window table built with
+    2^W - 2 multiplies, then fori over the digits."""
+    entries = [one, a]
+    for _ in range((1 << W) - 2):
+        entries.append(mul(entries[-1], a))
+    table = jnp.stack(entries, axis=0)
+
+    def body(i, acc):
+        for _ in range(W):
+            acc = mul(acc, acc)
+        d = digs_ref[i]
+        factor = jax.lax.dynamic_index_in_dim(table, d, axis=0,
+                                              keepdims=False)
+        return mul(acc, factor)
+
+    init = jax.lax.dynamic_index_in_dim(table, digs_ref[0], axis=0,
+                                        keepdims=False)
+    return jax.lax.fori_loop(1, nd, body, init)
+
 @functools.lru_cache(maxsize=None)
 def _pow_call(field: "fp._FieldBase", nd: int, B: int, blk: int,
               interpret: bool):
@@ -283,40 +307,19 @@ def _pow_call(field: "fp._FieldBase", nd: int, B: int, blk: int,
     from jax.experimental.pallas import tpu as pltpu
 
     solinas = isinstance(field, fp.SolinasField)
-    W = 4
 
     def kernel(digs_ref, c_ref, a_ref, o_ref):
         a = a_ref[:, :]
         limbs_col = c_ref[:, 0:1]
         if solinas:
             mul = lambda x, y: solinas_mul_body(field, x, y, limbs_col)
-        else:
-            npc = c_ref[:, 1:2]
-            mul = lambda x, y: mont_mul_body(field, x, y, limbs_col, npc)
-        # window table [16, 16, blk]: entry k = a^k (entry 0 = 1)
-        if solinas:
             one = (jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
                    == 0).astype(jnp.uint32)
         else:
+            npc = c_ref[:, 1:2]
+            mul = lambda x, y: mont_mul_body(field, x, y, limbs_col, npc)
             one = jnp.broadcast_to(c_ref[:, 2:3], a.shape)
-        entries = [one, a]
-        for _ in range((1 << W) - 2):
-            entries.append(mul(entries[-1], a))
-        table = jnp.stack(entries, axis=0)
-
-        def body(i, acc):
-            for _ in range(W):
-                acc = mul(acc, acc)
-            d = digs_ref[i]
-            factor = jax.lax.dynamic_index_in_dim(table, d, axis=0,
-                                                  keepdims=False)
-            return mul(acc, factor)
-
-        d0 = digs_ref[0]
-        init = jax.lax.dynamic_index_in_dim(table, d0, axis=0,
-                                            keepdims=False)
-        acc = jax.lax.fori_loop(1, nd, body, init)
-        o_ref[:, :] = acc
+        o_ref[:, :] = pow_digits_values(mul, one, a, digs_ref, nd)
 
     ncols = 2 if solinas else 3
     spec = pl.BlockSpec((NLIMBS, blk), lambda i: (0, i))

@@ -25,7 +25,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from . import keccak as _keccak
 from . import sm3 as _sm3
@@ -93,10 +92,8 @@ def _lane_pad(blocks_u8, nvalid):
 
 
 def _pick_hash_blk(B: int) -> int:
-    blk = min(BLK, B)
-    while B % blk:
-        blk //= 2
-    return blk
+    from .pallas_fp import _pick_blk
+    return _pick_blk(B, BLK)
 
 
 def keccak256_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
