@@ -565,6 +565,12 @@ def ecdsa_recover_batch(cv: Curve, e, r, s, v):
     plus validity mask [B].
     """
     e, r, s = map(_tx, (e, r, s))
+    if (_use_fused_verify() and cv.has_endo
+            and e.shape[-1] % 128 == 0):
+        from . import pallas_verify
+
+        qx, qy, ok = pallas_verify.ecdsa_recover_fused(cv, e, r, s, v)
+        return jnp.transpose(qx), jnp.transpose(qy), ok
     f, fn_ = cv.fp, cv.fn
     ok = _scalar_checks(fn_, r, s) & (v < 4)
     pl = fp._col(f.limbs)

@@ -154,15 +154,17 @@ def _mul_call(field: "fp._FieldBase", B: int, blk: int, interpret: bool):
 
 
 def _pick_blk(B: int, cap: int = BLK) -> int:
-    """Largest power-of-two block size <= cap that DIVIDES B — a grid of
+    """Largest 128-multiple block size <= cap that DIVIDES B — a grid of
     B//blk full blocks covers every lane (a floor-divided grid would
     silently drop the tail: B=640 with blk=512 left lanes 512-639
-    uncomputed). Shared by every pallas module."""
+    uncomputed), and the 128 floor keeps the product-tree inversion's
+    halving splits balanced. Shared by every pallas module; raises for
+    batches that are not lane-aligned (callers gate on B % 128 == 0)."""
     blk = min(cap, B)
-    while blk > 1 and B % blk:
+    while blk > 128 and B % blk:
         blk //= 2
-    if B % blk:
-        raise ValueError(f"B={B} has no power-of-two block <= {cap}")
+    if blk < 128 or B % blk:
+        raise ValueError(f"B={B} is not a 128-lane multiple (cap {cap})")
     return blk
 
 

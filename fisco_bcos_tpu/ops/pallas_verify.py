@@ -64,29 +64,31 @@ class _MontCtx(FieldCtx):
         return self.mul(a, one)
 
     def inv_tree(self, a, digs_ref, nd):
-        """Elementwise a^-1 (Montgomery domain) over the block lanes:
-        product tree + ONE Fermat power on the root (exponent digits in
-        SMEM). Zero lanes pass through as zero, as in fp.inv_batch."""
-        zero = fp.is_zero(a)
-        one = (jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
-               == 0).astype(U32)
-        one_m = jnp.broadcast_to(self.one_col, a.shape)
-        safe = fp.select(zero, one_m, a)
-        levels = []
-        cur = safe
-        while cur.shape[-1] > 1:
-            w = cur.shape[-1] // 2
-            left, right = cur[..., :w], cur[..., w:]
-            levels.append((left, right))
-            cur = self.mul(left, right)
-        root_one = one_m[..., :1]
-        invp = pallas_fp.pow_digits_values(
-            lambda x, y: self.mul(x, y), root_one, cur, digs_ref, nd)
-        for left, right in reversed(levels):
-            inv_l = self.mul(invp, right)
-            inv_r = self.mul(invp, left)
-            invp = jnp.concatenate([inv_l, inv_r], axis=-1)
-        return fp.select(zero, jnp.zeros_like(a), invp)
+        return inv_tree_values(self, a, digs_ref, nd)
+
+
+def inv_tree_values(f: FieldCtx, a, digs_ref, nd):
+    """Elementwise a^-1 (internal domain) over the block lanes: product
+    tree + ONE Fermat power on the root (exponent digits in SMEM). Zero
+    lanes pass through as zero, as in fp.inv_batch. Works for both field
+    kinds (the domain 1 comes from pallas_ec.field_one)."""
+    zero = fp.is_zero(a)
+    one_d = pallas_ec.field_one(f, a.shape)
+    safe = fp.select(zero, one_d, a)
+    levels = []
+    cur = safe
+    while cur.shape[-1] > 1:
+        w = cur.shape[-1] // 2
+        left, right = cur[..., :w], cur[..., w:]
+        levels.append((left, right))
+        cur = f.mul(left, right)
+    invp = pallas_fp.pow_digits_values(
+        lambda x, y: f.mul(x, y), one_d[..., :1], cur, digs_ref, nd)
+    for left, right in reversed(levels):
+        inv_l = f.mul(invp, right)
+        inv_r = f.mul(invp, left)
+        invp = jnp.concatenate([inv_l, inv_r], axis=-1)
+    return fp.select(zero, jnp.zeros_like(a), invp)
 
 
 def _glv_split_values(fn: _MontCtx, c_ref, k):
@@ -123,6 +125,31 @@ def _glv_split_values(fn: _MontCtx, c_ref, k):
     return m1, n1, m2, n2
 
 
+
+def _glv_ladder(f: FieldCtx, fn: "_MontCtx", c_ref, gts_ref, nsteps,
+                u1, u2, qx, qy):
+    """Shared scalars-to-ladder plumbing for verify and recover: GLV-split
+    both scalars, build the interleaved digit/negs planes, and run
+    ladder_values. qx/qy are canonical field-rep affine Q coordinates."""
+    a1, s1, a2, s2 = _glv_split_values(fn, c_ref, u1)
+    b1, t1, b2, t2 = _glv_split_values(fn, c_ref, u2)
+
+    def digs(m):
+        d = fp.window_digits(m, WINDOW)[..., :nsteps, :]
+        return d[..., ::-1, :]
+
+    digs_all = jnp.stack([digs(a1), digs(b1), digs(a2), digs(b2)], axis=0)
+    negs = jnp.stack([s1.astype(U32), t1.astype(U32),
+                      s2.astype(U32), t2.astype(U32)], axis=0)
+    beta = jnp.broadcast_to(c_ref[:, _C_BETA:_C_BETA + 1], qx.shape)
+    qlx = f.mul(qx, beta)
+    q_planes = jnp.stack([jnp.stack([qx, qy]),
+                          jnp.stack([qlx, qy])], axis=0)
+    return pallas_ec.ladder_values(f, (True, False), nsteps, 2,
+                                   gts_ref[:, :, :], digs_all, negs,
+                                   q_planes)
+
+
 def _verify_kernel_body(field_p, field_n, nsteps,
                         invdigs_ref, c_ref, gts_ref, e_ref, r_ref, s_ref,
                         qx_ref, qy_ref, ok_ref):
@@ -157,24 +184,7 @@ def _verify_kernel_body(field_p, field_n, nsteps,
     u1 = fn.from_rep(fn.mul(fn.to_rep(e), w))
     u2 = fn.from_rep(fn.mul(fn.to_rep(r), w))
 
-    a1, s1, a2, s2 = _glv_split_values(fn, c_ref, u1)
-    b1, t1, b2, t2 = _glv_split_values(fn, c_ref, u2)
-
-    def digs(m):
-        d = fp.window_digits(m, WINDOW)[..., :nsteps, :]
-        return d[..., ::-1, :]
-
-    digs_all = jnp.stack([digs(a1), digs(b1), digs(a2), digs(b2)], axis=0)
-    # ladder_values wants [rows, nsteps, B]
-    negs = jnp.stack([s1.astype(U32), t1.astype(U32),
-                      s2.astype(U32), t2.astype(U32)], axis=0)
-    beta = jnp.broadcast_to(c_ref[:, _C_BETA:_C_BETA + 1], qxr.shape)
-    qlx = f.mul(qxr, beta)
-    q_planes = jnp.stack([jnp.stack([qxr, qyr]),
-                          jnp.stack([qlx, qyr])], axis=0)
-    acc = pallas_ec.ladder_values(f, (True, False), nsteps, 2,
-                                  gts_ref[:, :, :], digs_all, negs,
-                                  q_planes)
+    acc = _glv_ladder(f, fn, c_ref, gts_ref, nsteps, u1, u2, qxr, qyr)
     X, _, Z = acc[0], acc[1], acc[2]
     ok &= ~fp.is_zero(Z)
 
@@ -253,7 +263,123 @@ def ecdsa_verify_fused(cv, e, r, s, qx, qy, interpret: bool = False):
     blk = pallas_fp._pick_blk(B, BLK)
     inv_digits = fp.msb_digits(cv.fn.n_int - 2, 4)
     out = _verify_call(cv.fp, cv.fn, _ec.GLV_DIGITS, len(inv_digits), B,
-                       blk, interpret)(
+                       blk, pallas_fp._auto_interpret(interpret))(
         jnp.asarray(inv_digits), jnp.asarray(consts), jnp.asarray(gts),
         e, r, s, qx, qy)
     return out[0].astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# fused end-to-end recover (the txpool's per-transaction hot op)
+# ---------------------------------------------------------------------------
+
+def _recover_kernel_body(field_p, field_n, nsteps, sqrt_ref, invn_ref,
+                         invp_ref, c_ref, gts_ref, e_ref, r_ref, s_ref,
+                         v_ref, qx_ref, qy_ref, ok_ref):
+    f = FieldCtx(field_p, c_ref[:, _C_P:_C_P + 1])
+    fn = _MontCtx(field_n, c_ref[:, _C_N:_C_N + 1],
+                  c_ref[:, _C_NPRIME:_C_NPRIME + 1],
+                  c_ref[:, _C_ONEM:_C_ONEM + 1],
+                  c_ref[:, _C_R2:_C_R2 + 1])
+    e, r, s = e_ref[:, :], r_ref[:, :], s_ref[:, :]
+    v = v_ref[0, :]
+    nl = fn.limbs_col
+    pl_ = f.limbs_col
+
+    ok = ((~fp.is_zero(r)) & (~fp.is_zero(s))
+          & (~fp.geq(r, jnp.broadcast_to(nl, r.shape)))
+          & (~fp.geq(s, jnp.broadcast_to(nl, s.shape)))
+          & (v < 4))
+
+    # x = r + (v >> 1) * n, must stay below p
+    hi_bit = ((v >> 1) & 1) == 1
+    addend = fp.select(hi_bit, jnp.broadcast_to(nl, r.shape),
+                       jnp.zeros_like(r))
+    xr, carry = fp.add_limbs(r, addend)
+    ok &= (carry == 0) & (~fp.geq(xr, jnp.broadcast_to(pl_, xr.shape)))
+    xr = fp.select(ok, xr, jnp.zeros_like(xr))
+
+    def reduce_p(a):
+        d, brw = fp.sub_limbs(a, jnp.broadcast_to(pl_, a.shape))
+        return fp.select(brw == 0, d, a)
+
+    xm = reduce_p(xr)
+    b_col = jnp.broadcast_to(c_ref[:, _C_B:_C_B + 1], xm.shape)
+    ysq = f.add(f.mul(f.sqr(xm), xm), b_col)
+    one_p = pallas_ec.field_one(f, xm.shape)
+    y = pallas_fp.pow_digits_values(lambda a, b: f.mul(a, b), one_p, ysq,
+                                    sqrt_ref, sqrt_ref.shape[0])
+    ok &= fp.eq(f.sqr(y), ysq)
+    flip = (y[0, :] & 1) != (v & 1)  # Solinas from_rep is identity
+    ym = fp.select(flip, f.neg(y), y)
+
+    rinv = fn.inv_tree(fn.to_rep(r), invn_ref, invn_ref.shape[0])
+    u1 = fn.from_rep(fn.mul(fn.neg(fn.to_rep(e)), rinv))  # -e/r mod n
+    u2 = fn.from_rep(fn.mul(fn.to_rep(s), rinv))  # s/r mod n
+
+    acc = _glv_ladder(f, fn, c_ref, gts_ref, nsteps, u1, u2, xm, ym)
+    X, Y, Z = acc[0], acc[1], acc[2]
+    ok &= ~fp.is_zero(Z)
+
+    zinv = inv_tree_values(f, Z, invp_ref, invp_ref.shape[0])
+    zi2 = f.sqr(zinv)
+    qx = f.mul(X, zi2)  # Solinas from_rep is identity
+    qy = f.mul(Y, f.mul(zi2, zinv))
+    qx_ref[:, :] = fp.select(ok, qx, jnp.zeros_like(qx))
+    qy_ref[:, :] = fp.select(ok, qy, jnp.zeros_like(qy))
+    ok_ref[0, :] = ok.astype(U32)
+
+
+@functools.lru_cache(maxsize=None)
+def _recover_call(field_p, field_n, nsteps: int, B: int, blk: int,
+                  interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(sqrt_ref, invn_ref, invp_ref, c_ref, gts_ref, e_ref,
+               r_ref, s_ref, v_ref, qx_ref, qy_ref, ok_ref):
+        _recover_kernel_body(field_p, field_n, nsteps, sqrt_ref, invn_ref,
+                             invp_ref, c_ref[:, :], gts_ref[:, :, :],
+                             e_ref, r_ref, s_ref, v_ref, qx_ref, qy_ref,
+                             ok_ref)
+
+    spec = pl.BlockSpec((NLIMBS, blk), lambda i: (0, i))
+    lane = pl.BlockSpec((1, blk), lambda i: (0, i))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((NLIMBS, B), U32),
+            jax.ShapeDtypeStruct((NLIMBS, B), U32),
+            jax.ShapeDtypeStruct((1, B), U32),
+        ),
+        grid=(B // blk,),
+        in_specs=[
+            smem, smem, smem,
+            pl.BlockSpec((NLIMBS, 13), lambda i: (0, 0)),
+            pl.BlockSpec((2, TBL, 2 * NLIMBS), lambda i: (0, 0, 0)),
+            spec, spec, spec, lane,
+        ],
+        out_specs=(spec, spec, lane),
+        interpret=interpret,
+    )
+
+
+def ecdsa_recover_fused(cv, e, r, s, v, interpret: bool = False):
+    """Full public-key recovery, one pallas call. e/r/s lane-major
+    [16, B] canonical, v [B] uint32; returns (qx, qy, ok) lane-major."""
+    from . import ec as _ec
+
+    assert cv.has_endo, "fused recover is the GLV (secp256k1) form"
+    consts, gts = _secp_consts()
+    B = e.shape[-1]
+    blk = pallas_fp._pick_blk(B, BLK)
+    sqrt_digits = fp.msb_digits((cv.params.p + 1) // 4, 4)
+    invn_digits = fp.msb_digits(cv.fn.n_int - 2, 4)
+    invp_digits = fp.msb_digits(cv.fp.n_int - 2, 4)
+    qx, qy, okv = _recover_call(cv.fp, cv.fn, _ec.GLV_DIGITS, B, blk,
+                                pallas_fp._auto_interpret(interpret))(
+        jnp.asarray(sqrt_digits), jnp.asarray(invn_digits),
+        jnp.asarray(invp_digits), jnp.asarray(consts), jnp.asarray(gts),
+        e, r, s, jnp.asarray(v, U32)[None, :])
+    return qx, qy, okv[0].astype(bool)

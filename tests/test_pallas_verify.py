@@ -51,11 +51,21 @@ def test_fused_verify_gated_off_by_default(monkeypatch):
         ec._FUSED_VERIFY_CACHE.clear()
 
 
+def _mont_ctx(c_ref):
+    V = pallas_verify
+    return V._MontCtx(
+        ec.SECP256K1.fn,
+        c_ref[:, V._C_N:V._C_N + 1],
+        c_ref[:, V._C_NPRIME:V._C_NPRIME + 1],
+        c_ref[:, V._C_ONEM:V._C_ONEM + 1],
+        c_ref[:, V._C_R2:V._C_R2 + 1])
+
+
 @pytest.mark.skipif("FBTPU_SLOW_TESTS" not in os.environ,
                     reason="interpret-mode kernel pieces take minutes; "
                            "run with FBTPU_SLOW_TESTS=1 (device sweep "
                            "asserts the full composition on TPU)")
-def test_inv_tree_and_glv_split_parity():
+def test_inv_tree_parity():
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -71,9 +81,7 @@ def test_inv_tree_and_glv_split_parity():
     inv_digits = fp.msb_digits(cv.fn.n_int - 2, 4)
 
     def kernel(digs_ref, c_ref, a_ref, o_ref):
-        fn = pallas_verify._MontCtx(
-            cv.fn, c_ref[:, 3:4], c_ref[:, 4:5], c_ref[:, 6:7],
-            c_ref[:, 5:6])
+        fn = _mont_ctx(c_ref)
         o_ref[:, :] = fn.inv_tree(fn.to_rep(a_ref[:, :]), digs_ref,
                                   digs_ref.shape[0])
 
@@ -85,3 +93,40 @@ def test_inv_tree_and_glv_split_parity():
         interpret=True)(jnp.asarray(inv_digits), jnp.asarray(consts), arr))
     want = np.asarray(cv.fn.inv_batch(cv.fn.to_rep(jnp.asarray(arr))))
     assert (got == want).all()
+
+
+@pytest.mark.skipif("FBTPU_SLOW_TESTS" not in os.environ,
+                    reason="see test_inv_tree_parity")
+def test_glv_split_parity():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    cv = ec.SECP256K1
+    rng = np.random.default_rng(47)
+    B = 8
+    kvals = [int.from_bytes(rng.bytes(32), "big") % cv.fn.n_int
+             for _ in range(B)]
+    karr = np.stack([fp.to_limbs(v) for v in kvals], axis=1)
+    consts, _ = pallas_verify._secp_consts()
+
+    def kernel(c_ref, k_ref, o_ref):
+        fn = _mont_ctx(c_ref)
+        m1, n1, m2, n2 = pallas_verify._glv_split_values(fn, c_ref,
+                                                         k_ref[:, :])
+        o_ref[0] = m1
+        o_ref[1] = m2
+        o_ref[2] = jnp.broadcast_to(n1[None, :].astype(jnp.uint32),
+                                    m1.shape)
+        o_ref[3] = jnp.broadcast_to(n2[None, :].astype(jnp.uint32),
+                                    m2.shape)
+
+    got = np.asarray(pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((4, 16, B), jnp.uint32),
+        interpret=True)(jnp.asarray(consts), karr))
+    w1, wn1, w2, wn2 = ec._glv_split_device(cv, jnp.asarray(karr))
+    assert (got[0] == np.asarray(w1)).all()
+    assert (got[1] == np.asarray(w2)).all()
+    assert (got[2][0].astype(bool) == np.asarray(wn1)).all()
+    assert (got[3][0].astype(bool) == np.asarray(wn2)).all()
