@@ -121,10 +121,21 @@ def main() -> None:
                 dt, ok = timed(fn, cv, e, r, s, qx, qy)
                 assert bool(np.asarray(ok).all()), \
                     f"{name}: kernel rejected sigs"
+                # negative: a tampered digest must be rejected (guards a
+                # kernel defect that weakens a check into always-true)
+                e_bad = np.asarray(e).copy()
+                e_bad[0, 0] ^= 1
+                okb = np.asarray(fn(cv, e_bad, r, s, qx, qy))
+                assert (not okb[0]) and bool(okb[1:].all()), \
+                    f"{name}: tampered sig accepted"
             else:
                 dt, rec = timed(ec.ecdsa_recover_batch, cv, e, r, s, v)
                 assert bool(np.asarray(rec[2]).all()), \
                     f"{name}: recover failed"
+                # value-level: recovered keys must equal the signers'
+                assert (np.asarray(rec[0]) == np.asarray(qx)).all() and \
+                       (np.asarray(rec[1]) == np.asarray(qy)).all(), \
+                    f"{name}: recovered wrong public keys"
             save(name, {"sigs_per_sec": round(batch / dt, 1),
                         "batch": batch, "ms": round(dt * 1e3, 2)})
         except Exception as exc:  # keep sweeping: one bad config (or a

@@ -618,6 +618,11 @@ def sm2_verify_batch(cv: Curve, e, r, s, qx, qy):
     [B, NLIMBS]; -> bool[B].
     """
     e, r, s, qx, qy = map(_tx, (e, r, s, qx, qy))
+    if (_use_fused_verify() and cv.a_is_minus3
+            and e.shape[-1] % 128 == 0):
+        from . import pallas_verify
+
+        return pallas_verify.sm2_verify_fused(cv, e, r, s, qx, qy)
     f, fn_ = cv.fp, cv.fn
     ok = _scalar_checks(fn_, r, s)
     pl = fp._col(f.limbs)

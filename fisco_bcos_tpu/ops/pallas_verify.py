@@ -383,3 +383,129 @@ def ecdsa_recover_fused(cv, e, r, s, v, interpret: bool = False):
         jnp.asarray(invp_digits), jnp.asarray(consts), jnp.asarray(gts),
         e, r, s, jnp.asarray(v, U32)[None, :])
     return qx, qy, okv[0].astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# fused SM2 verify (GB/T 32918): R' = e + x(s*G + (r+s)*Q) == r
+# ---------------------------------------------------------------------------
+
+# SM2 consts block column layout ([16, 10])
+_S_P, _S_PNP, _S_PONE, _S_PR2, _S_A, _S_B, _S_N, _S_NNP, _S_NR2, \
+    _S_NONE = range(10)
+
+
+def _sm2_verify_kernel_body(field_p, field_n, nsteps, c_ref, gts_ref,
+                            e_ref, r_ref, s_ref, qx_ref, qy_ref, ok_ref):
+    f = _MontCtx(field_p, c_ref[:, _S_P:_S_P + 1],
+                 c_ref[:, _S_PNP:_S_PNP + 1],
+                 c_ref[:, _S_PONE:_S_PONE + 1],
+                 c_ref[:, _S_PR2:_S_PR2 + 1])
+    fn = _MontCtx(field_n, c_ref[:, _S_N:_S_N + 1],
+                  c_ref[:, _S_NNP:_S_NNP + 1],
+                  c_ref[:, _S_NONE:_S_NONE + 1],
+                  c_ref[:, _S_NR2:_S_NR2 + 1])
+    e, r, s = e_ref[:, :], r_ref[:, :], s_ref[:, :]
+    qx, qy = qx_ref[:, :], qy_ref[:, :]
+    nl = fn.limbs_col
+    pl_ = f.limbs_col
+
+    ok = ((~fp.is_zero(r)) & (~fp.is_zero(s))
+          & (~fp.geq(r, jnp.broadcast_to(nl, r.shape)))
+          & (~fp.geq(s, jnp.broadcast_to(nl, s.shape))))
+    ok &= ((~fp.geq(qx, jnp.broadcast_to(pl_, qx.shape)))
+           & (~fp.geq(qy, jnp.broadcast_to(pl_, qy.shape))))
+    qxr, qyr = f.to_rep(qx), f.to_rep(qy)
+    a_col = jnp.broadcast_to(c_ref[:, _S_A:_S_A + 1], qx.shape)
+    b_col = jnp.broadcast_to(c_ref[:, _S_B:_S_B + 1], qx.shape)
+    rhs = f.add(f.add(f.mul(f.sqr(qxr), qxr), f.mul(a_col, qxr)), b_col)
+    ok &= fp.eq(f.sqr(qyr), rhs)
+    ok &= ~(fp.is_zero(qx) & fp.is_zero(qy))
+
+    rc = fn.reduce_loose(r)
+    sc = fn.reduce_loose(s)
+    t = fn.add(rc, sc)
+    ok &= ~fp.is_zero(t)
+
+    def digs(m):
+        d = fp.window_digits(m, WINDOW)[..., :nsteps, :]
+        return d[..., ::-1, :]
+
+    digs_all = jnp.stack([digs(sc), digs(t)], axis=0)
+    negs = jnp.zeros((2,) + sc.shape[-1:], U32)
+    q_planes = jnp.stack([jnp.stack([qxr, qyr])], axis=0)
+    acc = pallas_ec.ladder_values(f, (False, True), nsteps, 1,
+                                  gts_ref[:, :, :], digs_all, negs,
+                                  q_planes)
+    X, _, Z = acc[0], acc[1], acc[2]
+    ok &= ~fp.is_zero(Z)
+
+    # x1 mod n == (r - e) mod n, inversion-free (ec._x_matches_mod_n)
+    e_red = fn.reduce_loose(e)
+    c = fn.sub(rc, e_red)
+    zz = f.sqr(Z)
+    m1 = fp.eq(X, f.mul(f.to_rep(c), zz))
+    rpn, carry = fp.add_limbs(c, jnp.broadcast_to(nl, c.shape))
+    lt_p = (carry == 0) & (~fp.geq(rpn, jnp.broadcast_to(pl_, rpn.shape)))
+    cand2 = fp.select(lt_p, rpn, jnp.zeros_like(rpn))
+    m2 = lt_p & fp.eq(X, f.mul(f.to_rep(cand2), zz))
+    ok &= (m1 | m2)
+    ok_ref[0, :] = ok.astype(U32)
+
+
+@functools.lru_cache(maxsize=None)
+def _sm2_verify_call(field_p, field_n, nsteps: int, B: int, blk: int,
+                     interpret: bool):
+    from jax.experimental import pallas as pl
+
+    def kernel(c_ref, gts_ref, e_ref, r_ref, s_ref, qx_ref, qy_ref,
+               ok_ref):
+        _sm2_verify_kernel_body(field_p, field_n, nsteps, c_ref[:, :],
+                                gts_ref[:, :, :], e_ref, r_ref, s_ref,
+                                qx_ref, qy_ref, ok_ref)
+
+    spec = pl.BlockSpec((NLIMBS, blk), lambda i: (0, i))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, B), U32),
+        grid=(B // blk,),
+        in_specs=[
+            pl.BlockSpec((NLIMBS, 10), lambda i: (0, 0)),
+            pl.BlockSpec((1, TBL, 2 * NLIMBS), lambda i: (0, 0, 0)),
+            spec, spec, spec, spec, spec,
+        ],
+        out_specs=pl.BlockSpec((1, blk), lambda i: (0, i)),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sm2_consts():
+    from . import ec as _ec
+
+    cv = _ec.SM2P256V1
+    c = np.zeros((NLIMBS, 10), np.uint32)
+    c[:, _S_P] = cv.fp.limbs
+    c[:, _S_PNP] = cv.fp.nprime
+    c[:, _S_PONE] = cv.fp.one_m
+    c[:, _S_PR2] = cv.fp.r2
+    c[:, _S_A] = cv.a_rep
+    c[:, _S_B] = cv.b_rep
+    c[:, _S_N] = cv.fn.limbs
+    c[:, _S_NNP] = cv.fn.nprime
+    c[:, _S_NR2] = cv.fn.r2
+    c[:, _S_NONE] = cv.fn.one_m
+    return c, cv.g_table[None]
+
+
+def sm2_verify_fused(cv, e, r, s, qx, qy, interpret: bool = False):
+    """Full SM2 verify, one pallas call. Inputs lane-major [16, B]."""
+    from . import ec as _ec
+
+    assert cv is _ec.SM2P256V1, "consts block is the SM2 curve's"
+    consts, gts = _sm2_consts()
+    B = e.shape[-1]
+    blk = pallas_fp._pick_blk(B, BLK)
+    out = _sm2_verify_call(cv.fp, cv.fn, _ec.NDIGITS, B, blk,
+                           pallas_fp._auto_interpret(interpret))(
+        jnp.asarray(consts), jnp.asarray(gts), e, r, s, qx, qy)
+    return out[0].astype(bool)

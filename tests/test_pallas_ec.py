@@ -79,6 +79,40 @@ def test_vjac_add_generic_and_infinity(points):
     assert (got == points).all()  # P + inf = P
 
 
+def test_sm2_point_ops_match():
+    """The a = -3 branch of vjac_double/vjac_add (SM2, Montgomery base
+    field) against the XLA ops — the secp tests only cover a = 0."""
+    cv = ec.SM2P256V1
+    f = cv.fp
+    rng = np.random.default_rng(29)
+    pts = [refimpl.ec_mul(refimpl.SM2P256V1,
+                          int.from_bytes(rng.bytes(32), "big")
+                          % refimpl.SM2P256V1.n,
+                          (refimpl.SM2P256V1.gx, refimpl.SM2P256V1.gy))
+           for _ in range(4)]
+    xs = np.stack([fp.to_limbs(pts[i % 4][0]) for i in range(B)], axis=1)
+    ys = np.stack([fp.to_limbs(pts[i % 4][1]) for i in range(B)], axis=1)
+    xr, yr = np.asarray(f.to_rep(xs)), np.asarray(f.to_rep(ys))
+    P = np.stack([xr, yr, np.asarray(f.one_rep(xr.shape))])
+    consts = pallas_fp.field_consts(f)
+    one_m = np.zeros((16, 1), np.uint32)
+    one_m[:, 0] = f.one_m
+
+    def kernel(c_ref, one_ref, p_ref, q_ref, o_ref):
+        fc = pallas_ec.FieldCtx(f, c_ref[:, 0:1], c_ref[:, 1:2],
+                                one_ref[:, 0:1])
+        o_ref[:, :, :] = pallas_ec.vjac_add(
+            fc, p_ref[:, :, :], q_ref[:, :, :], False, True)
+
+    q2 = np.asarray(ec.jac_double(cv, jnp.asarray(P)))
+    got = np.asarray(pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((3, 16, B), jnp.uint32),
+        interpret=True)(consts, one_m, P, q2))
+    want = np.asarray(ec.jac_add(cv, jnp.asarray(P), jnp.asarray(q2)))
+    assert (got == want).all()
+
+
 def test_take_tables_match(points):
     rng = np.random.default_rng(3)
     dig = rng.integers(0, 16, (B,), dtype=np.uint32)
