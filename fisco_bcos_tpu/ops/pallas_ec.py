@@ -321,5 +321,5 @@ def ladder(field, a_is_zero, a_is_minus3, nsteps, gts, digs, negs, q_planes,
         consts[:, :2] = pallas_fp.field_consts(field)
         consts[:, 2] = field.one_m  # Montgomery-domain 1 for affine lifts
     return _ladder_call(field, a_is_zero, a_is_minus3, nsteps, n_pairs, B,
-                        blk, interpret)(
+                        blk, pallas_fp._auto_interpret(interpret))(
         jnp.asarray(consts), gts, digs, negs, q_planes)

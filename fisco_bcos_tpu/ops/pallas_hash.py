@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from . import keccak as _keccak
+from . import pallas_fp
 from . import sm3 as _sm3
 from .pallas_merkle import _keccak_rounds, _sm3_compress_values
 
@@ -92,8 +93,7 @@ def _lane_pad(blocks_u8, nvalid):
 
 
 def _pick_hash_blk(B: int) -> int:
-    from .pallas_fp import _pick_blk
-    return _pick_blk(B, BLK)
+    return pallas_fp._pick_blk(B, BLK)
 
 
 def keccak256_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
@@ -105,7 +105,8 @@ def keccak256_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
     bh = jnp.transpose(bh, (1, 2, 0))  # [nb, 17, B'] lane-major
     bl = jnp.transpose(bl, (1, 2, 0))
     Bp = bh.shape[-1]
-    out = _keccak_call(nblocks, Bp, _pick_hash_blk(Bp), interpret)(
+    out = _keccak_call(nblocks, Bp, _pick_hash_blk(Bp),
+                       pallas_fp._auto_interpret(interpret))(
         jnp.asarray(_keccak._RC_HI), jnp.asarray(_keccak._RC_LO),
         bh, bl, jnp.asarray(nvalid, jnp.int32)[None, :])
     hi, lo = out[:4, :B], out[4:, :B]
@@ -150,6 +151,7 @@ def sm3_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
     w = _sm3.bytes_to_be_words(blocks_u8)  # [B', nb, 16]
     w = jnp.transpose(w, (1, 2, 0))  # [nb, 16, B']
     Bp = w.shape[-1]
-    out = _sm3_call(nblocks, Bp, _pick_hash_blk(Bp), interpret)(
+    out = _sm3_call(nblocks, Bp, _pick_hash_blk(Bp),
+                    pallas_fp._auto_interpret(interpret))(
         w, jnp.asarray(nvalid, jnp.int32)[None, :])
     return _sm3.be_words_to_bytes(jnp.transpose(out[:, :B]))

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import keccak as _keccak
+from . import pallas_fp
 from . import sm3 as _sm3
 
 WIDTH = 16
@@ -228,6 +229,6 @@ def merkle_root_fused(leaves_padded, n: "jax.Array | int",
     nvec = jnp.asarray([n], jnp.int32)
     rc_hi = jnp.asarray(_keccak._RC_HI)
     rc_lo = jnp.asarray(_keccak._RC_LO)
-    out = _tree_call(nbucket, alg, interpret)(
+    out = _tree_call(nbucket, alg, pallas_fp._auto_interpret(interpret))(
         nvec, rc_hi, rc_lo, jnp.asarray(leaves_padded, jnp.uint8))
     return out[0]
