@@ -87,7 +87,12 @@ class CryptoSuite:
           "sm" (SM2 + SM3, 国密 chain) — mirrors chain.sm_crypto selection
           (ProtocolInitializer.cpp:102/:110).
     backend: "device" | "host" | "auto". "auto" uses the device kernels at or
-          above `device_min_batch` and the host oracle below it.
+          above `device_min_batch` and the host oracle below it. The 512
+          default comes from the r4 forced-sync sweep: at 1k the device
+          does 17k sigs/s vs the native host floor's 5.4k/s, while below
+          ~256 the per-call device latency (~45-60 ms on the tunneled
+          bench host) loses to the host floor; the sweep's crossover row
+          refines this per deployment.
     mesh_devices: shard device batches over up to this many local chips
           (a `jax.sharding.Mesh` "dp" axis — the ICI analogue of the
           reference's txpool.verify_worker_num tbb fan-out). 0/None =
@@ -96,7 +101,7 @@ class CryptoSuite:
     """
 
     def __init__(self, kind: str = "ecdsa", backend: str = "auto",
-                 device_min_batch: int = 64,
+                 device_min_batch: int = 512,
                  mesh_devices: int | None = None):
         if kind not in ("ecdsa", "sm"):
             raise ValueError(f"unknown crypto suite kind: {kind}")

@@ -52,7 +52,7 @@ class NodeConfig:
     min_seal_time: float = 0.05
     consensus: str = "solo"  # solo | pbft
     crypto_backend: str = "auto"  # device | host | auto
-    device_min_batch: int = 64
+    device_min_batch: int = 512
     # shard device crypto batches over up to N local chips (0 = off);
     # the ICI analogue of txpool.verify_worker_num (NodeConfig.cpp:486)
     crypto_mesh_devices: int = 0

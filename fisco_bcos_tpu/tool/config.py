@@ -130,7 +130,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         tx_count_limit=cp.getint("consensus", "tx_count_limit",
                                  fallback=1000),
         crypto_backend=cp.get("crypto", "backend", fallback="auto"),
-        device_min_batch=cp.getint("crypto", "device_min_batch", fallback=64),
+        device_min_batch=cp.getint("crypto", "device_min_batch", fallback=512),
         crypto_mesh_devices=cp.getint("crypto", "mesh_devices", fallback=0),
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
         rpc_port=int(port_s) if port_s else None,
