@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Profile the single-node block pipeline: seal -> execute -> commit.
+
+Isolates the per-stage host cost of one N-tx block on ONE node (no
+consensus, no gossip) so the chain-TPS work targets the real hot spots.
+Run with --profile to get a cProfile breakdown of the execute+commit path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=1000)
+    ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--sm", action="store_true")
+    args = ap.parse_args()
+
+    from benchmark.chain_bench import _build_workload
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+    from fisco_bcos_tpu.net.gateway import FakeGateway
+    from fisco_bcos_tpu.protocol import Block, BlockHeader, Transaction
+
+    suite = make_suite(args.sm, backend="host")
+    kp = suite.generate_keypair(b"\x01" * 16)
+    node = Node(NodeConfig(consensus="pbft", sm_crypto=args.sm,
+                           crypto_backend="host", min_seal_time=0.0,
+                           tx_count_limit=args.n),
+                keypair=kp, gateway=FakeGateway())
+    node.build_genesis([ConsensusNode(kp.pub_bytes)])
+
+    t0 = time.perf_counter()
+    wire = _build_workload(args.sm, args.n, block_limit=100)
+    t_sign = time.perf_counter() - t0
+
+    txs = [Transaction.decode(raw) for raw in wire]
+    t0 = time.perf_counter()
+    node.txpool.submit_batch(txs)
+    t_submit = time.perf_counter() - t0
+
+    header = BlockHeader(number=1, timestamp=int(time.time() * 1000))
+    block = Block(header=header, transactions=list(txs))
+
+    prof = cProfile.Profile() if args.profile else None
+    if prof:
+        prof.enable()
+    t0 = time.perf_counter()
+    result = node.scheduler.execute_block(block)
+    t_exec = time.perf_counter() - t0
+    assert result is not None
+    t0 = time.perf_counter()
+    ok = node.scheduler.commit_block(result.header)
+    t_commit = time.perf_counter() - t0
+    assert ok
+    if prof:
+        prof.disable()
+
+    n = args.n
+    print(f"sign:    {t_sign:8.3f}s  ({1e3*t_sign/n:6.3f} ms/tx)")
+    print(f"submit:  {t_submit:8.3f}s  ({1e3*t_submit/n:6.3f} ms/tx)")
+    print(f"execute: {t_exec:8.3f}s  ({1e3*t_exec/n:6.3f} ms/tx)")
+    print(f"commit:  {t_commit:8.3f}s  ({1e3*t_commit/n:6.3f} ms/tx)")
+    print(f"exec+commit rate: {n/(t_exec+t_commit):,.0f} tx/s (1 node)")
+    if prof:
+        s = io.StringIO()
+        pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(40)
+        print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

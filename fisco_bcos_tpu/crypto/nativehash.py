@@ -69,3 +69,16 @@ def keccak256() -> Optional[Callable[[bytes], bytes]]:
 def sm3() -> Optional[Callable[[bytes], bytes]]:
     """-> native sm3(data)->digest, or None when unavailable."""
     return _wrap("sm3")
+
+
+def host_hash(alg: str) -> Callable[[bytes], bytes]:
+    """Host-path hash for `alg` ("keccak256" | "sm3"): native when the
+    library is loadable, pure-Python refimpl otherwise. The single place
+    the native-or-oracle fallback policy lives."""
+    from . import refimpl
+
+    if alg == "keccak256":
+        return keccak256() or refimpl.keccak256
+    if alg == "sm3":
+        return sm3() or refimpl.sm3
+    raise ValueError(f"unknown hash alg {alg!r}")

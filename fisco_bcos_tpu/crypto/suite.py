@@ -117,13 +117,13 @@ class CryptoSuite:
             self.curve = ec.SECP256K1
             self.params = refimpl.SECP256K1
             self.hash_name = "keccak256"
-            self._host_hash = nativehash.keccak256() or refimpl.keccak256
+            self._host_hash = nativehash.host_hash("keccak256")
             self.signature_size = 65  # r(32) | s(32) | v(1)
         else:
             self.curve = ec.SM2P256V1
             self.params = refimpl.SM2P256V1
             self.hash_name = "sm3"
-            self._host_hash = nativehash.sm3() or refimpl.sm3
+            self._host_hash = nativehash.host_hash("sm3")
             self.signature_size = 128  # r(32) | s(32) | pub(64), SignatureDataWithPub.h
 
     # -- identity ----------------------------------------------------------

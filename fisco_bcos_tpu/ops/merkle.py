@@ -112,12 +112,16 @@ def merkle_root(leaves, alg: str = "keccak256") -> jax.Array:
 # host-side reference + proofs (low-volume path: Ledger.cpp:759-844 proofs)
 # ---------------------------------------------------------------------------
 
-def _hash_host(data: bytes, alg: str) -> bytes:
-    from ..crypto import refimpl
+_HOST_HASH: dict = {}
 
-    if alg == "keccak256":
-        return refimpl.keccak256(data)
-    return refimpl.sm3(data)
+
+def _hash_host(data: bytes, alg: str) -> bytes:
+    fn = _HOST_HASH.get(alg)
+    if fn is None:
+        from ..crypto import nativehash
+
+        fn = _HOST_HASH[alg] = nativehash.host_hash(alg)
+    return fn(data)
 
 
 def merkle_levels_host(leaves: list[bytes], alg: str = "keccak256") -> list[list[bytes]]:
