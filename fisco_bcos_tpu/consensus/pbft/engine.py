@@ -89,7 +89,7 @@ class PBFTEngine(Worker):
                  scheduler, ledger, leader_period: int = 1,
                  view_timeout: float = 3.0, txsync=None,
                  full_proposals: bool = False, persist: bool = True,
-                 clock_ms=None):
+                 clock_ms=None, waterline: int = 8):
         super().__init__("pbft", idle_wait=0.02)
         self.suite = suite
         # aligned clock source (tool/timesync.py median); raw UTC fallback
@@ -108,6 +108,12 @@ class PBFTEngine(Worker):
         self.full_proposals = full_proposals
         self.leader_period = max(1, leader_period)
         self.base_timeout = view_timeout
+        # proposal pipeline depth: consensus runs for heights in
+        # (committed, committed + waterline] concurrently, execution stays
+        # strictly in order — the reference's water-size window
+        # (PBFTConfig.cpp:189-215 canHandleNewProposal over
+        # m_waterSize above the committed proposal)
+        self.waterline = max(1, waterline)
 
         cfg = ledger.ledger_config()
         self.nodes: list[bytes] = sorted(n.node_id for n in cfg.consensus_nodes)
@@ -224,11 +230,30 @@ class PBFTEngine(Worker):
     def _grant_sealer(self) -> None:
         cfg = self.ledger.ledger_config()
         self._reload_membership(cfg)
-        nxt = self.ledger.current_number() + 1
-        lead = (self.index >= 0
-                and self.leader_for(nxt, self.view) == self.index)
-        self.sealer.set_should_seal(lead, nxt,
-                                    max_txs=cfg.block_tx_count_limit)
+        self._maybe_grant(self.ledger.current_number() + 1, cfg)
+
+    def _maybe_grant(self, number: int, cfg=None) -> None:
+        """Arm the sealer for `number` if this node leads it, it sits inside
+        the waterline window, and no proposal for it exists yet. Grants chain
+        off pre-prepares (leader of N+1 starts sealing the moment N's
+        proposal is accepted — N's txs are then marked sealed locally, so
+        consecutive proposals can never overlap), which is what lets
+        consensus of N+1 overlap execution of N (SealingManager.cpp:232-248
+        + PBFTConfig.cpp:189-215 semantics)."""
+        if self.index < 0:
+            return
+        current = self.ledger.current_number()
+        if not (current < number <= current + self.waterline):
+            return
+        if self.leader_for(number, self.view) != self.index:
+            return
+        cache = self._caches.get(number)
+        if cache is not None and cache.proposal is not None:
+            return  # this round already has its proposal
+        if cfg is None:
+            cfg = self.ledger.ledger_config()
+        self.sealer.grant(number, self.view,
+                          max_txs=cfg.block_tx_count_limit)
 
     def _reload_membership(self, cfg) -> None:
         """Apply on-chain consensus-set changes LIVE (the reference reloads
@@ -278,11 +303,19 @@ class PBFTEngine(Worker):
     # -- worker loop (PBFTEngine.cpp:555 executeWorker) --------------------
     def execute_worker(self) -> None:
         # a block committed by SYNC (not by this engine) must still apply
-        # membership changes and re-grant the sealer
+        # membership changes, retire overtaken round state and re-grant
         number = self.ledger.current_number()
         if number != self._last_seen_number:
             self._last_seen_number = number
+            for h in [h for h in self._caches if h <= number]:
+                cache = self._caches.pop(h)
+                if cache.proposal is not None and not cache.committed_phase:
+                    # txs of a dead competing round go back to the pool
+                    # (committed ones were already pruned by the sync commit)
+                    self.txpool.unseal(cache.proposal.tx_hashes)
+            self.sealer.revoke(number)
             self._grant_sealer()
+            self._try_advance(number + 1)
         local: list[Block] = []
         msgs: list[PBFTMessage] = []
         while True:
@@ -393,9 +426,19 @@ class PBFTEngine(Worker):
     def _broadcast_preprepare(self, block: Block,
                               carried: bool = False) -> None:
         number = block.header.number
-        if number != self.ledger.current_number() + 1:
+        current = self.ledger.current_number()
+        if not (current < number <= current + self.waterline):
             self.txpool.unseal(block.tx_hashes)
             self._grant_sealer()
+            return
+        prior = self._caches.get(number)
+        if prior is not None and prior.proposal is not None:
+            # a proposal for this round already exists (e.g. a carried
+            # re-proposal raced the sealer): a second one would split the
+            # prepare votes — refuse, returning the txs unless they ARE the
+            # active proposal's
+            if block.tx_hashes != prior.proposal.tx_hashes:
+                self.txpool.unseal(block.tx_hashes)
             return
         header = block.header
         header.sealer = self.index
@@ -424,17 +467,24 @@ class PBFTEngine(Worker):
         msg = make_packet(PacketType.PRE_PREPARE, self.view, number,
                           self.index, phash, wire_block.encode())
         cache.preprepare_msg = self._signed(msg)
+        # carried/re-proposed blocks arrive with their txs back in the pool
+        # (view entry unseals) — re-mark them so the next height's sealer
+        # cannot pick them up again
+        self.txpool.mark_sealed(block.tx_hashes)
         self._persist_proposal(number, cache)
         self.front.broadcast(ModuleID.PBFT, cache.preprepare_msg.encode())
         # leader's own prepare vote
         self._vote_prepare(number, phash)
+        # pipeline: the next height's leader can start sealing now
+        self._maybe_grant(number + 1)
         metric("pbft.preprepare", number=number, view=self.view,
                n_tx=len(block.tx_hashes or block.transactions))
 
     # -- replica: phase handlers ------------------------------------------
     def _handle_preprepare(self, msg: PBFTMessage) -> None:
-        expected = self.ledger.current_number() + 1
-        if (msg.view != self.view or msg.number != expected
+        current = self.ledger.current_number()
+        if (msg.view != self.view
+                or not (current < msg.number <= current + self.waterline)
                 or self.to_view > self.view):
             return
         if msg.from_idx != self.leader_for(msg.number, msg.view):
@@ -470,8 +520,16 @@ class PBFTEngine(Worker):
         cache.proposal = block
         cache.proposal_hash = msg.proposal_hash
         cache.preprepare_msg = msg
+        # mark the proposal's txs sealed so this node's sealer (if it leads
+        # a later in-flight height) never packs them into a second proposal
+        # (the reference's asyncMarkTxs on proposal receipt)
+        self.txpool.mark_sealed(block.tx_hashes
+                                or [t.hash(self.suite)
+                                    for t in block.transactions])
         self._persist_proposal(msg.number, cache)
         self._vote_prepare(msg.number, msg.proposal_hash)
+        # pipeline: if this node leads the next height, start sealing it now
+        self._maybe_grant(msg.number + 1)
         self._try_advance(msg.number)
 
     def _persist_proposal(self, number: int, cache: _ProposalCache) -> None:
@@ -533,8 +591,12 @@ class PBFTEngine(Worker):
 
     # -- quorum state machine (PBFTCacheProcessor::checkAndCommit) ---------
     def _try_advance(self, number: int) -> None:
+        """Advance height `number` as far as its quorums allow. Prepare and
+        commit phases run for ANY in-flight height (the pipeline); execution
+        and ledger commit stay strictly in order behind current+1."""
         cache = self._caches.get(number)
-        if cache is None or number != self.ledger.current_number() + 1:
+        current = self.ledger.current_number()
+        if cache is None or not (current < number <= current + self.waterline):
             return
         if cache.proposal is None:
             return
@@ -552,7 +614,8 @@ class PBFTEngine(Worker):
             self.front.broadcast(ModuleID.PBFT, vote.encode())
         commits = sum(1 for m in cache.commits.values()
                       if m.proposal_hash == phash)
-        if cache.prepared and not cache.executed and commits >= self.quorum:
+        if cache.prepared and not cache.executed and commits >= self.quorum \
+                and number == current + 1:
             self._execute_and_checkpoint(number, cache)
         if cache.executed:
             self._try_commit_ledger(number, cache)
@@ -614,8 +677,12 @@ class PBFTEngine(Worker):
                              if v > self.view}
         self._timeout = self.base_timeout
         self._reset_timer()
+        self.sealer.revoke(number)
         self._grant_sealer()
         metric("pbft.committed", number=number, view=self.view)
+        # pipeline cascade: the next height may already hold commit quorum
+        # (its consensus ran while this block executed) — act on it now
+        self._try_advance(number + 1)
 
     # -- view change -------------------------------------------------------
     def _reset_timer(self) -> None:
@@ -641,10 +708,13 @@ class PBFTEngine(Worker):
         number = self.ledger.current_number() + 1
         committed = self.ledger.header_by_number(number - 1)
         chash = committed.hash(self.suite) if committed else b"\x00" * 32
-        payload = b""
-        cache = self._caches.get(number)
-        if cache is not None and cache.prepared and cache.preprepare_msg:
-            payload = cache.preprepare_msg.encode()
+        # carry EVERY prepared in-flight proposal (the pipeline can hold
+        # several) so the new view's leaders re-propose rather than lose a
+        # potentially-committed round — the reference's ViewChangeMsg
+        # preparedProposal list (PBFTViewChangeMsg)
+        carried = [c.preprepare_msg for _n, c in sorted(self._caches.items())
+                   if c.prepared and c.preprepare_msg is not None]
+        payload = pack_messages(carried) if carried else b""
         vc = make_packet(PacketType.VIEW_CHANGE, self.to_view, number,
                          self.index, chash, payload)
         signed = self._signed(vc)
@@ -675,32 +745,43 @@ class PBFTEngine(Worker):
         self._broadcast(make_packet(PacketType.NEW_VIEW, v, number,
                                     self.index, b"", proof))
         self._enter_view(v)
-        # safety: re-propose the carried prepared proposal, if any
-        carried = self._pick_carried(vcs.values(), number)
-        if carried is not None:
-            self._broadcast_preprepare(carried, carried=True)
-        else:
-            self._grant_sealer()
+        # safety: re-propose carried prepared proposals for the heights this
+        # node leads in the new view; grant the sealer otherwise
+        self._repropose_carried(vcs.values(), v)
+        self._grant_sealer()
 
-    def _pick_carried(self, vcs, number: int) -> Optional[Block]:
-        best: Optional[PBFTMessage] = None
+    def _carried_by_height(self, vcs) -> dict[int, Block]:
+        """Highest-view carried prepared proposal per in-flight height from a
+        set of VIEW_CHANGE messages."""
+        current = self.ledger.current_number()
+        best: dict[int, PBFTMessage] = {}
         for vc in vcs:
             if not vc.payload:
                 continue
             try:
-                pp = PBFTMessage.decode(vc.payload)
+                pps = unpack_messages(vc.payload)
             except Exception:
                 continue
-            if pp.number != number:
+            for pp in pps:
+                if pp.packet_type != PacketType.PRE_PREPARE:
+                    continue
+                if not (current < pp.number <= current + self.waterline):
+                    continue
+                cur = best.get(pp.number)
+                if cur is None or pp.view > cur.view:
+                    best[pp.number] = pp
+        out: dict[int, Block] = {}
+        for number, pp in best.items():
+            try:
+                out[number] = Block.decode(pp.payload)
+            except Exception:
                 continue
-            if best is None or pp.view > best.view:
-                best = pp
-        if best is None:
-            return None
-        try:
-            return Block.decode(best.payload)
-        except Exception:
-            return None
+        return out
+
+    def _repropose_carried(self, vcs, v: int) -> None:
+        for number, block in sorted(self._carried_by_height(vcs).items()):
+            if self.leader_for(number, v) == self.index:
+                self._broadcast_preprepare(block, carried=True)
 
     def _handle_newview(self, msg: PBFTMessage) -> None:
         if msg.view <= self.view:
@@ -720,6 +801,11 @@ class PBFTEngine(Worker):
         if int(ok.sum()) < self.quorum:
             return
         self._enter_view(msg.view)
+        # re-propose carried prepared rounds for heights this node now
+        # leads BEFORE granting the sealer, so a fresh proposal can never
+        # displace a carried (potentially committed-elsewhere) one
+        self._repropose_carried(uniq.values(), msg.view)
+        self._grant_sealer()
 
     def _enter_view(self, v: int) -> None:
         # drop round state from the old view; txs go back to the pool
@@ -737,7 +823,8 @@ class PBFTEngine(Worker):
             self.log.clear_heights()
         self._timeout = self.base_timeout
         self._reset_timer()
-        self._grant_sealer()
+        # NOTE: no sealer grant here — callers re-propose carried prepared
+        # rounds first (safety), then call _grant_sealer themselves
         metric("pbft.newview", view=v)
 
     # -- introspection (getConsensusStatus RPC) ----------------------------

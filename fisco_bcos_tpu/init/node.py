@@ -58,6 +58,10 @@ class NodeConfig:
     crypto_mesh_devices: int = 0
     leader_period: int = 1  # consensus_leader_period (NodeConfig.cpp:568)
     view_timeout: float = 3.0
+    # proposal pipeline depth (PBFTConfig.cpp:189-215 water size): consensus
+    # runs this many heights ahead of the committed block while execution
+    # stays strictly ordered
+    waterline: int = 8
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
     ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
@@ -180,7 +184,8 @@ class Node:
                 leader_period=self.config.leader_period,
                 view_timeout=self.config.view_timeout,
                 txsync=self.txsync,
-                clock_ms=self.timesync.aligned_time_ms)
+                clock_ms=self.timesync.aligned_time_ms,
+                waterline=self.config.waterline)
         self.consensus.start()
         self.sealer.start()
 
@@ -231,6 +236,9 @@ class Node:
             result.header.signature_list = [(0, seal)]
             ok = self.scheduler.commit_block(result.header)
             if ok:
+                # prune consumed-round markers (bounded memory; PBFT's
+                # engine does this in _try_commit_ledger)
+                self.sealer.revoke(self.ledger.current_number())
                 self.sealer.set_should_seal(
                     True, self.ledger.current_number() + 1,
                     max_txs=cfg.block_tx_count_limit)

@@ -532,8 +532,11 @@ def ecdsa_verify_batch(cv: Curve, e, r, s, qx, qy):
     r, s: signature scalars; qx, qy: affine public key (field canonical).
     """
     e, r, s, qx, qy = map(_tx, (e, r, s, qx, qy))
-    if (_use_fused_verify() and cv.has_endo
+    if (_use_fused_verify() and cv is SECP256K1
             and e.shape[-1] % 128 == 0):
+        # gate on the exact singleton: the fused kernel hardcodes
+        # secp256k1 constants, and another has_endo curve instance would
+        # trip its internal assert inside the jitted trace (ADVICE r4)
         from . import pallas_verify
 
         return pallas_verify.ecdsa_verify_fused(cv, e, r, s, qx, qy)
@@ -565,7 +568,7 @@ def ecdsa_recover_batch(cv: Curve, e, r, s, v):
     plus validity mask [B].
     """
     e, r, s = map(_tx, (e, r, s))
-    if (_use_fused_verify() and cv.has_endo
+    if (_use_fused_verify() and cv is SECP256K1
             and e.shape[-1] % 128 == 0):
         from . import pallas_verify
 
@@ -618,8 +621,11 @@ def sm2_verify_batch(cv: Curve, e, r, s, qx, qy):
     [B, NLIMBS]; -> bool[B].
     """
     e, r, s, qx, qy = map(_tx, (e, r, s, qx, qy))
-    if (_use_fused_verify() and cv.a_is_minus3
+    if (_use_fused_verify() and cv is SM2P256V1
             and e.shape[-1] % 128 == 0):
+        # singleton gate, not a_is_minus3: sm2_verify_fused asserts the
+        # SM2 singleton, so e.g. a test-built P-256 must fall through to
+        # the XLA path instead of crashing in-trace (ADVICE r4)
         from . import pallas_verify
 
         return pallas_verify.sm2_verify_fused(cv, e, r, s, qx, qy)

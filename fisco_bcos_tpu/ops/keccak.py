@@ -211,7 +211,8 @@ def keccak256_varlen(blocks_u8: jax.Array, nvalid: jax.Array) -> jax.Array:
     if _fp._use_pallas() and blocks_u8.ndim == 3 and blocks_u8.shape[0]:
         from . import pallas_hash
 
-        return pallas_hash.keccak256_varlen_fused(blocks_u8, nvalid)
+        if pallas_hash.keccak_fused_ok(blocks_u8.shape[1]):
+            return pallas_hash.keccak256_varlen_fused(blocks_u8, nvalid)
     return _keccak256_varlen_impl(blocks_u8, nvalid, blocks_u8.shape[-2])
 
 

@@ -157,8 +157,11 @@ def _pick_blk(B: int, cap: int = BLK) -> int:
     """Largest 128-multiple block size <= cap that DIVIDES B — a grid of
     B//blk full blocks covers every lane (a floor-divided grid would
     silently drop the tail: B=640 with blk=512 left lanes 512-639
-    uncomputed), and the 128 floor keeps the product-tree inversion's
-    halving splits balanced. Shared by every pallas module; raises for
+    uncomputed). NOTE: the result is only guaranteed to be a multiple of
+    128, NOT a power of two (B=384 under cap 512 returns 384) — callers
+    that need power-of-two widths (the product-tree inversion's halving
+    splits, pallas_verify.inv_tree_values) must enforce that themselves;
+    inv_tree_values asserts it. Shared by every pallas module; raises for
     batches that are not lane-aligned (callers gate on B % 128 == 0)."""
     blk = min(cap, B)
     while blk > 128 and B % blk:

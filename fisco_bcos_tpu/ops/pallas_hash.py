@@ -34,6 +34,36 @@ from .pallas_merkle import _keccak_rounds, _sm3_compress_values
 U32 = jnp.uint32
 BLK = 1024  # lanes per kernel instance
 
+# The input tile is [nblocks, words, blk] u32 (x2 planes for keccak), and
+# the batch is bucketed to the LARGEST message — one big contract deploy
+# inflates nblocks for the whole tx batch, and an unbounded tile fails
+# Mosaic compilation at runtime. Budget the tile: shrink blk as nblocks
+# grows; when even blk=128 exceeds the budget the fused path is ineligible
+# and callers (ops.keccak / ops.sm3 varlen dispatch) fall back to the XLA
+# scan implementation, mirroring merkle_root's nbucket gate.
+_VMEM_TILE_BUDGET = 6 * 1024 * 1024
+
+
+def _tile_blk_cap(nblocks: int, words: int, planes: int) -> int:
+    """Largest power-of-two blk in [128, BLK] whose input tile fits the
+    VMEM budget; 0 when nothing fits (fused path ineligible)."""
+    per_lane = nblocks * words * 4 * planes
+    cap = _VMEM_TILE_BUDGET // max(1, per_lane)
+    if cap < 128:
+        return 0
+    blk = 128
+    while blk * 2 <= min(cap, BLK):
+        blk *= 2
+    return blk
+
+
+def keccak_fused_ok(nblocks: int) -> bool:
+    return _tile_blk_cap(nblocks, _keccak.RATE_WORDS, 2) >= 128
+
+
+def sm3_fused_ok(nblocks: int) -> bool:
+    return _tile_blk_cap(nblocks, 16, 1) >= 128
+
 
 # ---------------------------------------------------------------------------
 # Keccak-256 varlen
@@ -92,10 +122,6 @@ def _lane_pad(blocks_u8, nvalid):
     return blocks_u8, nvalid, B
 
 
-def _pick_hash_blk(B: int) -> int:
-    return pallas_fp._pick_blk(B, BLK)
-
-
 def keccak256_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
     """[B, nblocks, RATE_BYTES] pre-padded uint8 + per-message block count
     -> [B, 32] uint8 digests. Any B (lane padding handled here)."""
@@ -105,7 +131,9 @@ def keccak256_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
     bh = jnp.transpose(bh, (1, 2, 0))  # [nb, 17, B'] lane-major
     bl = jnp.transpose(bl, (1, 2, 0))
     Bp = bh.shape[-1]
-    out = _keccak_call(nblocks, Bp, _pick_hash_blk(Bp),
+    blk = pallas_fp._pick_blk(
+        Bp, _tile_blk_cap(nblocks, _keccak.RATE_WORDS, 2) or 128)
+    out = _keccak_call(nblocks, Bp, blk,
                        pallas_fp._auto_interpret(interpret))(
         jnp.asarray(_keccak._RC_HI), jnp.asarray(_keccak._RC_LO),
         bh, bl, jnp.asarray(nvalid, jnp.int32)[None, :])
@@ -151,7 +179,8 @@ def sm3_varlen_fused(blocks_u8, nvalid, interpret: bool = False):
     w = _sm3.bytes_to_be_words(blocks_u8)  # [B', nb, 16]
     w = jnp.transpose(w, (1, 2, 0))  # [nb, 16, B']
     Bp = w.shape[-1]
-    out = _sm3_call(nblocks, Bp, _pick_hash_blk(Bp),
+    blk = pallas_fp._pick_blk(Bp, _tile_blk_cap(nblocks, 16, 1) or 128)
+    out = _sm3_call(nblocks, Bp, blk,
                     pallas_fp._auto_interpret(interpret))(
         w, jnp.asarray(nvalid, jnp.int32)[None, :])
     return _sm3.be_words_to_bytes(jnp.transpose(out[:, :B]))

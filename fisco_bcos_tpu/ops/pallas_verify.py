@@ -72,6 +72,13 @@ def inv_tree_values(f: FieldCtx, a, digs_ref, nd):
     tree + ONE Fermat power on the root (exponent digits in SMEM). Zero
     lanes pass through as zero, as in fp.inv_batch. Works for both field
     kinds (the domain 1 comes from pallas_ec.field_one)."""
+    w = a.shape[-1]
+    # the halving splits below mis-pair lanes via broadcasting when the
+    # block width is not a power of two — fail loudly instead of computing
+    # wrong field inverses (today's verify/recover cap of 256 keeps
+    # _pick_blk in {128, 256}, but nothing else enforces that)
+    assert w > 0 and (w & (w - 1)) == 0, \
+        f"inv_tree_values needs a power-of-two block width, got {w}"
     zero = fp.is_zero(a)
     one_d = pallas_ec.field_one(f, a.shape)
     safe = fp.select(zero, one_d, a)

@@ -1,11 +1,17 @@
-"""Sealer — a worker loop that packages txs into proposals on the leader.
+"""Sealer — packages txs into proposals for the heights this node leads.
 
 Reference counterpart: /root/reference/bcos-sealer/bcos-sealer/Sealer.cpp
-(:94 executeWorker -> :116 submitProposal) + SealingManager.cpp (:232
-fetchTransactions via txpool asyncSealTxs). The sealer only runs when this
-node expects to lead (consensus tells it via `set_should_seal`); proposals
-carry tx-hash metadata (not full txs) like the reference's metadata-only
-sealing (MemoryStorage.cpp:570 batchFetchTxs).
+(:94 executeWorker -> :116 submitProposal) + SealingManager.cpp (:232-248
+fetchTransactions / the unsealed-txs waterline bookkeeping that lets PBFT
+pipeline proposals). The sealer only runs for heights consensus has granted
+(`grant`), and seals AT MOST ONCE per (height, view): the grant is consumed
+by the seal, so a re-delivered grant for the same round can never produce a
+second, conflicting proposal — competing proposals from one leader split
+the prepare vote set and wedge the round until a view change (the 41-TPS
+pathology of round 4's chain bench).
+
+Proposals carry tx-hash metadata (not full txs) like the reference's
+metadata-only sealing (MemoryStorage.cpp:570 batchFetchTxs).
 
 min_seal_time: like the reference's min_seal_time config, the sealer waits
 up to that long to fill a block before proposing a partial one; an empty
@@ -24,6 +30,9 @@ from ..txpool.txpool import TxPool
 from ..utils.log import LOG, badge, metric
 from ..utils.worker import Worker
 
+# view key used by solo mode's set_should_seal compatibility wrapper
+_SOLO_VIEW = -1
+
 
 class Sealer(Worker):
     def __init__(self, txpool: TxPool, suite,
@@ -40,29 +49,52 @@ class Sealer(Worker):
         self.submit_proposal = submit_proposal
         self.max_txs_per_block = max_txs_per_block
         self.min_seal_time = min_seal_time
-        self._should_seal = False
-        self._next_number = 0
-        self._first_pending_at: Optional[float] = None
         self._lock = threading.Lock()
+        # height -> (view, max_txs): heights consensus wants proposals for
+        self._grants: dict[int, tuple[int, int]] = {}
+        # (height, view) pairs already sealed — never seal a round twice
+        self._done: set[tuple[int, int]] = set()
+        self._first_pending_at: Optional[float] = None
         txpool.register_unseal_notifier(self.wakeup)
 
-    # consensus drives these
-    def set_should_seal(self, should: bool, next_number: int,
-                        max_txs: Optional[int] = None) -> None:
+    # -- consensus drives these --------------------------------------------
+    def grant(self, number: int, view: int,
+              max_txs: Optional[int] = None) -> None:
+        """Arm sealing for `number` under `view`. Idempotent; a round this
+        sealer already produced a proposal for is NOT re-armed."""
         with self._lock:
-            self._should_seal = should
-            self._next_number = next_number
-            if max_txs is not None:
-                self.max_txs_per_block = max_txs
+            if (number, view) in self._done:
+                return
+            self._grants[number] = (view, max_txs or self.max_txs_per_block)
         self.wakeup()
 
+    def revoke(self, upto_number: int) -> None:
+        """Drop grants for heights <= upto_number (committed or synced past);
+        forget consumed rounds at those heights too (bounded memory)."""
+        with self._lock:
+            for h in [h for h in self._grants if h <= upto_number]:
+                self._grants.pop(h, None)
+            self._done = {(h, v) for (h, v) in self._done
+                          if h > upto_number}
+
+    # solo-mode compatibility (init/node.py drives one height at a time)
+    def set_should_seal(self, should: bool, next_number: int,
+                        max_txs: Optional[int] = None) -> None:
+        if should:
+            self.grant(next_number, _SOLO_VIEW, max_txs)
+        else:
+            with self._lock:
+                self._grants.clear()
+            self.wakeup()
+
+    # -- worker loop --------------------------------------------------------
     def execute_worker(self) -> None:
         with self._lock:
-            should = self._should_seal
-            number = self._next_number
-            limit = self.max_txs_per_block
-        if not should:
-            return
+            if not self._grants:
+                self._first_pending_at = None
+                return
+            number = min(self._grants)
+            view, limit = self._grants[number]
         pending = self.txpool.pending_count()
         if pending == 0:
             self._first_pending_at = None
@@ -76,14 +108,25 @@ class Sealer(Worker):
         if not txs:
             return
         self._first_pending_at = None
+        with self._lock:
+            # consume the grant BEFORE submitting: whatever happens next,
+            # this (height, view) round has had its one proposal
+            self._grants.pop(number, None)
+            self._done.add((number, view))
         header = BlockHeader(number=number, timestamp=self.clock_ms())
         block = Block(header=header, transactions=list(txs),
                       tx_hashes=list(hashes))
-        with self._lock:
-            self._should_seal = False  # one proposal per grant
         if not self.submit_proposal(block):
+            # refused — nothing was broadcast, so the round is re-openable
+            # without any vote-split risk. Txs go back to the pool. Solo
+            # mode retries the height itself (a transient commit failure
+            # must not halt block production — there is no consensus layer
+            # to re-grant); under PBFT the engine re-grants via its own
+            # commit/view flow
             self.txpool.unseal(hashes)
             with self._lock:
-                self._should_seal = True
+                self._done.discard((number, view))
+                if view == _SOLO_VIEW:
+                    self._grants[number] = (view, limit)
         else:
             metric("sealer.proposal", number=number, n_tx=len(txs))

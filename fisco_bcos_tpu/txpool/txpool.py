@@ -61,7 +61,10 @@ class TxPool:
         self.block_limit_range = block_limit_range
         self._lock = threading.RLock()
         self._pending: "OrderedDict[bytes, Transaction]" = OrderedDict()
-        self._sealed: set[bytes] = set()
+        self._sealed: set[bytes] = set()  # invariant: subset of _pending
+        # pre-seal tombstones: hashes of in-flight proposal txs NOT yet in
+        # the pool (see mark_sealed) — promoted to _sealed on arrival
+        self._presealed: set[bytes] = set()
         # rolling nonce filter: block number -> set of nonces
         self._nonces_by_block: dict[int, set[str]] = {}
         self._known_nonces: set[str] = set()
@@ -128,6 +131,9 @@ class TxPool:
                         results[i] = TxSubmitResult(h, TransactionStatus.TXPOOL_FULL)
                         continue
                     self._pending[h] = tx
+                    if h in self._presealed:  # already in an in-flight
+                        self._presealed.discard(h)  # proposal: arrive sealed
+                        self._sealed.add(h)
                     if tx.nonce:
                         self._known_nonces.add(tx.nonce)
                     results[i] = TxSubmitResult(h, TransactionStatus.OK,
@@ -199,8 +205,30 @@ class TxPool:
         with self._lock:
             for h in hashes:
                 self._sealed.discard(h)
+                self._presealed.discard(h)
         self._update_pending_gauge()
         self._notify_ready()
+
+    def mark_sealed(self, hashes: Sequence[bytes]) -> None:
+        """Mark txs as sealed WITHOUT fetching them — consensus calls this
+        when accepting a proposal so the local sealer (which may lead a
+        later pipelined height) never packs the same txs into a second
+        proposal (the reference's asyncMarkTxs(sealed=true) on proposal
+        receipt, MemoryStorage.cpp:700).
+
+        A hash not in the pool yet leaves a PRE-SEAL tombstone: if the tx
+        arrives later via gossip it enters the pool already sealed, so a
+        pipelined next-height proposal can never double-include it (it
+        would become unexecutable cluster-wide once the earlier height
+        commits and prunes the tx). Tombstones are cleared by commit,
+        unseal (view change) or tx arrival."""
+        with self._lock:
+            for h in hashes:
+                if h in self._pending:
+                    self._sealed.add(h)
+                else:
+                    self._presealed.add(h)
+        self._update_pending_gauge()
 
     def pending_count(self) -> int:
         with self._lock:
@@ -254,6 +282,7 @@ class TxPool:
                 if self._precheck(tx, h, current) is None:
                     self._pending[h] = tx
                     self._sealed.add(h)
+                    self._presealed.discard(h)
                     if tx.nonce:
                         self._known_nonces.add(tx.nonce)
         return True
@@ -265,6 +294,7 @@ class TxPool:
             for h in tx_hashes:
                 self._pending.pop(h, None)
                 self._sealed.discard(h)
+                self._presealed.discard(h)
             ns = set(n for n in nonces if n)
             self._nonces_by_block[number] = ns
             self._known_nonces.update(ns)

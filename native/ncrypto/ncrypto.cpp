@@ -6,17 +6,26 @@
 // reference's per-signature hot functions are native; this framework's
 // DEVICE path batches them on TPU (ops/ec.py), and this library is the
 // native floor for the HOST path (sub-threshold batches, no-accelerator
-// deployments, ingest fallback), ~100x the pure-Python oracle.
+// deployments, ingest fallback).
 //
 // Determinism contract: results must match crypto/refimpl.py exactly —
 // including its edge semantics (coordinates implicitly reduced mod p, the
 // final verify comparison mod n, recover's x = r + (v>>1)*n overflow
 // behavior). tests/test_nativeec.py holds the equivalence suite.
 //
-// Implementation: 4x64-limb integers, Montgomery (CIOS) multiplication for
-// all four moduli, branchy Jacobian point arithmetic (host code — no
-// branch-free discipline needed; inputs are public), 4-bit-window Shamir
-// double-scalar multiplication with a lazily built static G table.
+// Implementation (batch-first, same shape as the TPU kernels):
+//   * 4x64-limb Montgomery (CIOS) field arithmetic for all four moduli.
+//   * GLV endomorphism split for secp256k1 (the same mul-shift
+//     decomposition ops/ec.py and crypto/refimpl.glv_split use): both
+//     ladder scalars become ~129-bit signed halves, halving the doubles.
+//   * wNAF ladders — static affine odd-multiple tables for G and phi(G)
+//     (window 7, built once per curve), per-signature Jacobian tables for
+//     the variable point (window 5) normalised to affine via ONE shared
+//     Montgomery-trick inversion per batch chunk, so every ladder add is
+//     a mixed (affine) add.
+//   * batch inversion for the per-signature scalar inverses (s^-1 / r^-1
+//     mod n) and for the final Jacobian->affine conversions: three muls
+//     per element instead of a ~380-mul Fermat inversion each.
 
 #include <cstdint>
 #include <cstring>
@@ -60,6 +69,13 @@ inline uint64_t sub_bb(const U256& a, const U256& b, U256& r) {
   return (uint64_t)br;
 }
 
+inline void shr1(U256& a) {
+  a.w[0] = (a.w[0] >> 1) | (a.w[1] << 63);
+  a.w[1] = (a.w[1] >> 1) | (a.w[2] << 63);
+  a.w[2] = (a.w[2] >> 1) | (a.w[3] << 63);
+  a.w[3] >>= 1;
+}
+
 U256 from_be(const uint8_t* b) {
   U256 r;
   for (int i = 0; i < 32; ++i)
@@ -80,6 +96,21 @@ int bitlen(const U256& v) {
   return 0;
 }
 
+// full 256x256 -> 512-bit product, little-endian 8 limbs (GLV mul-shift)
+void mul_wide(const U256& a, const U256& b, uint64_t out[8]) {
+  memset(out, 0, 64);
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      unsigned __int128 cur =
+          (unsigned __int128)a.w[i] * b.w[j] + out[i + j] + carry;
+      out[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    out[i + 4] = (uint64_t)carry;
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Montgomery field
 // ---------------------------------------------------------------------------
@@ -89,18 +120,64 @@ struct Mont {
   uint64_t n0inv = 0;  // -mod^-1 mod 2^64
   U256 rr;             // 2^512 mod mod (to_mont multiplier)
   U256 one_m;          // 2^256 mod mod (Montgomery 1)
+  // pseudo-Mersenne fast path: mod == 2^256 - kfold (secp256k1 field).
+  // When set, the "Montgomery domain" IS the plain domain (to_mont and
+  // from_mont are the identity) and mul/sqr reduce by folding the high
+  // 256 bits times kfold — ~21 mul64 with short carry chains instead of
+  // CIOS's 32 on a serial chain.
+  uint64_t kfold = 0;
 
   void init(const U256& m) {
     mod = m;
     uint64_t x = m.w[0];  // Newton: x := x*(2 - m*x), doubles precision
     for (int i = 0; i < 6; ++i) x *= 2 - m.w[0] * x;
     n0inv = ~x + 1;  // -(m^-1) mod 2^64
+    // detect 2^256 - k shape (k < 2^64): limbs 1..3 all ones
+    if (m.w[1] == ~0ull && m.w[2] == ~0ull && m.w[3] == ~0ull) {
+      kfold = ~m.w[0] + 1;  // 2^64 - w0 == k
+      one_m.w[0] = 1;
+      return;
+    }
     U256 v;
     v.w[0] = 1;
     for (int i = 0; i < 256; ++i) v = dbl_mod(v);
     one_m = v;
     for (int i = 0; i < 256; ++i) v = dbl_mod(v);
     rr = v;
+  }
+
+  // reduce a 512-bit product (little-endian t[8]) modulo 2^256 - kfold
+  U256 fold_reduce(const uint64_t t[8]) const {
+    uint64_t r[4];
+    unsigned __int128 cur;
+    uint64_t carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      cur = (unsigned __int128)t[4 + i] * kfold + t[i] + carry;
+      r[i] = (uint64_t)cur;
+      carry = (uint64_t)(cur >> 64);
+    }
+    // carry < kfold + 1; fold once more
+    cur = (unsigned __int128)carry * kfold + r[0];
+    r[0] = (uint64_t)cur;
+    uint64_t c = (uint64_t)(cur >> 64);
+    for (int i = 1; c && i < 4; ++i) {
+      cur = (unsigned __int128)r[i] + c;
+      r[i] = (uint64_t)cur;
+      c = (uint64_t)(cur >> 64);
+    }
+    U256 out;
+    memcpy(out.w, r, 32);
+    if (c) {  // wrapped past 2^256: add kfold (== subtract mod)
+      U256 kk;
+      kk.w[0] = kfold;
+      add_cc(out, kk, out);  // cannot carry again: out < kfold after wrap
+    }
+    if (cmp(out, mod) >= 0) {
+      U256 o;
+      sub_bb(out, mod, o);
+      return o;
+    }
+    return out;
   }
 
   U256 dbl_mod(const U256& a) const {
@@ -140,8 +217,14 @@ struct Mont {
     return r;
   }
 
-  // CIOS Montgomery multiplication
+  // CIOS Montgomery multiplication (pseudo-Mersenne moduli take the
+  // plain-domain folding path instead)
   U256 mul(const U256& a, const U256& b) const {
+    if (kfold) {
+      uint64_t t[8];
+      mul_wide(a, b, t);
+      return fold_reduce(t);
+    }
     uint64_t t[6] = {0, 0, 0, 0, 0, 0};
     for (int i = 0; i < 4; ++i) {
       unsigned __int128 carry = 0;
@@ -180,13 +263,50 @@ struct Mont {
     return r;
   }
 
-  U256 to_mont(const U256& a) const { return mul(a, rr); }
+  U256 to_mont(const U256& a) const { return kfold ? a : mul(a, rr); }
   U256 from_mont(const U256& a) const {
+    if (kfold) return a;
     U256 one;
     one.w[0] = 1;
     return mul(a, one);
   }
-  U256 sqr(const U256& a) const { return mul(a, a); }
+
+  // dedicated squaring: symmetric off-diagonal products once, doubled
+  U256 sqr(const U256& a) const {
+    if (!kfold) return mul(a, a);
+    uint64_t t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    // off-diagonal sum: sum_{i<j} a_i a_j 2^(64(i+j))
+    for (int i = 0; i < 4; ++i) {
+      uint64_t carry = 0;
+      for (int j = i + 1; j < 4; ++j) {
+        unsigned __int128 cur =
+            (unsigned __int128)a.w[i] * a.w[j] + t[i + j] + carry;
+        t[i + j] = (uint64_t)cur;
+        carry = (uint64_t)(cur >> 64);
+      }
+      t[i + 4] += carry;  // slot i+4 >= i+j+1 is untouched so far: no carry
+    }
+    // double the off-diagonal sum
+    uint64_t c = 0;
+    for (int i = 0; i < 8; ++i) {
+      uint64_t hi = t[i] >> 63;
+      t[i] = (t[i] << 1) | c;
+      c = hi;
+    }
+    // add the diagonal a_i^2 terms
+    unsigned __int128 cur;
+    uint64_t carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      unsigned __int128 sq = (unsigned __int128)a.w[i] * a.w[i];
+      cur = (unsigned __int128)t[2 * i] + (uint64_t)sq + carry;
+      t[2 * i] = (uint64_t)cur;
+      carry = (uint64_t)(cur >> 64);
+      cur = (unsigned __int128)t[2 * i + 1] + (uint64_t)(sq >> 64) + carry;
+      t[2 * i + 1] = (uint64_t)cur;
+      carry = (uint64_t)(cur >> 64);
+    }
+    return fold_reduce(t);
+  }
 
   // a^e (a Montgomery, e plain), square-and-multiply MSB-first
   U256 pow(const U256& a, const U256& e) const {
@@ -221,10 +341,36 @@ struct Mont {
     }
     return a;
   }
+
+  // plain a*b mod m via Montgomery round-trip (cold path: GLV split)
+  U256 mulmod(const U256& a, const U256& b) const {
+    return from_mont(mul(mul(a, rr), mul(b, rr)));
+  }
 };
 
+// Montgomery-trick batch inversion: in/out Montgomery domain. Zero entries
+// are passed through as zero (callers treat them as invalid lanes).
+void batch_inv(const Mont& f, U256* vals, int n) {
+  if (n <= 0) return;
+  // prefix products over the non-zero entries
+  U256* pref = new U256[n];
+  U256 acc = f.one_m;
+  for (int i = 0; i < n; ++i) {
+    pref[i] = acc;
+    if (!is_zero(vals[i])) acc = f.mul(acc, vals[i]);
+  }
+  U256 inv = f.inv(acc);
+  for (int i = n - 1; i >= 0; --i) {
+    if (is_zero(vals[i])) continue;
+    U256 vi = f.mul(inv, pref[i]);
+    inv = f.mul(inv, vals[i]);
+    vals[i] = vi;
+  }
+  delete[] pref;
+}
+
 // ---------------------------------------------------------------------------
-// Jacobian point arithmetic (coordinates in Montgomery domain)
+// Jacobian / affine point arithmetic (coordinates in Montgomery domain)
 // ---------------------------------------------------------------------------
 
 struct JPoint {
@@ -232,37 +378,88 @@ struct JPoint {
   bool inf() const { return is_zero(Z); }
 };
 
+struct APoint {
+  U256 x, y;  // affine, Montgomery domain
+};
+
+struct Curve;
+JPoint jac_add(const Curve& c, const JPoint& P, const JPoint& Q);
+JPoint jac_double(const Curve& c, const JPoint& P);
+
+constexpr int GW = 7;                 // static G window
+constexpr int GTBL = 1 << (GW - 2);   // 32 odd multiples
+constexpr int QW = 5;                 // per-signature window
+constexpr int QTBL = 1 << (QW - 2);   // 8 odd multiples
+constexpr int WNAF_MAX = 260;
+
 struct Curve {
   Mont fp, fn;
   U256 a_m, b_m;
   bool a_zero = false, a_m3 = false;
   U256 sqrt_e;   // (p+1)/4, plain
   JPoint g;      // generator, Montgomery Jacobian (Z = 1_m)
-  JPoint gtbl[16];  // window table: gtbl[k] = k*G
-  std::once_flag tbl_once;
+
+  // GLV plane (secp256k1 only)
+  bool has_glv = false;
+  U256 glv_lambda, glv_g1, glv_g2, glv_mb1, glv_mb2;  // plain
+  U256 beta_m;   // field beta, Montgomery
+  U256 half_n;   // n >> 1 (signed-half threshold)
+
+  // static affine wNAF tables: odd multiples (2i+1)G and (2i+1)phi(G)
+  APoint gtab[GTBL], phigtab[GTBL];
+  std::once_flag gtab_once;
 };
 
 JPoint jac_double(const Curve& c, const JPoint& P) {
   if (P.inf() || is_zero(P.Y)) return JPoint{};
   const Mont& f = c.fp;
+  JPoint R;
+  if (c.a_zero) {
+    // dbl-2009-l: 2M + 5S
+    U256 A = f.sqr(P.X);
+    U256 B = f.sqr(P.Y);
+    U256 C = f.sqr(B);
+    U256 t = f.add(P.X, B);
+    U256 D = f.sub(f.sub(f.sqr(t), A), C);
+    D = f.add(D, D);
+    U256 E = f.add(f.add(A, A), A);
+    U256 F = f.sqr(E);
+    R.X = f.sub(F, f.add(D, D));
+    U256 C8 = f.add(C, C);
+    C8 = f.add(C8, C8);
+    C8 = f.add(C8, C8);
+    R.Y = f.sub(f.mul(E, f.sub(D, R.X)), C8);
+    U256 yz = f.mul(P.Y, P.Z);
+    R.Z = f.add(yz, yz);
+    return R;
+  }
+  if (c.a_m3) {
+    // dbl-2001-b: 3M + 5S
+    U256 delta = f.sqr(P.Z);
+    U256 gamma = f.sqr(P.Y);
+    U256 beta = f.mul(P.X, gamma);
+    U256 t = f.mul(f.sub(P.X, delta), f.add(P.X, delta));
+    U256 alpha = f.add(f.add(t, t), t);
+    U256 beta4 = f.add(beta, beta);
+    beta4 = f.add(beta4, beta4);
+    R.X = f.sub(f.sqr(alpha), f.add(beta4, beta4));
+    U256 zy = f.add(P.Y, P.Z);
+    R.Z = f.sub(f.sub(f.sqr(zy), gamma), delta);
+    U256 g2 = f.sqr(gamma);
+    U256 g8 = f.add(g2, g2);
+    g8 = f.add(g8, g8);
+    g8 = f.add(g8, g8);
+    R.Y = f.sub(f.mul(alpha, f.sub(beta4, R.X)), g8);
+    return R;
+  }
+  // generic a
   U256 YY = f.sqr(P.Y);
   U256 S = f.mul(P.X, YY);
   S = f.add(S, S);
-  S = f.add(S, S);  // 4*X*Y^2
-  U256 M;
-  if (c.a_zero) {
-    U256 XX = f.sqr(P.X);
-    M = f.add(f.add(XX, XX), XX);
-  } else if (c.a_m3) {
-    U256 ZZ = f.sqr(P.Z);
-    U256 t = f.mul(f.sub(P.X, ZZ), f.add(P.X, ZZ));
-    M = f.add(f.add(t, t), t);
-  } else {
-    U256 XX = f.sqr(P.X);
-    U256 ZZ = f.sqr(P.Z);
-    M = f.add(f.add(f.add(XX, XX), XX), f.mul(c.a_m, f.sqr(ZZ)));
-  }
-  JPoint R;
+  S = f.add(S, S);
+  U256 XX = f.sqr(P.X);
+  U256 ZZ = f.sqr(P.Z);
+  U256 M = f.add(f.add(f.add(XX, XX), XX), f.mul(c.a_m, f.sqr(ZZ)));
   U256 MM = f.sqr(M);
   R.X = f.sub(MM, f.add(S, S));
   U256 YYYY = f.sqr(YY);
@@ -302,39 +499,89 @@ JPoint jac_add(const Curve& c, const JPoint& P, const JPoint& Q) {
   return out;
 }
 
-void build_gtbl(Curve& c) {
-  c.gtbl[0] = JPoint{};
-  c.gtbl[1] = c.g;
-  for (int k = 2; k < 16; ++k) c.gtbl[k] = jac_add(c, c.gtbl[k - 1], c.g);
-}
-
-// k1*G + k2*Q, 4-bit windows, MSB-first (k1/k2 plain canonical mod n)
-JPoint shamir(Curve& c, const U256& k1, const U256& k2, const JPoint& Q) {
-  std::call_once(c.tbl_once, build_gtbl, c);
-  JPoint tq[16];
-  tq[0] = JPoint{};
-  tq[1] = Q;
-  for (int k = 2; k < 16; ++k) tq[k] = jac_add(c, tq[k - 1], Q);
-  JPoint acc{};
-  for (int d = 63; d >= 0; --d) {
-    for (int i = 0; i < 4; ++i) acc = jac_double(c, acc);
-    unsigned d1 = (k1.w[d / 16] >> ((d % 16) * 4)) & 0xF;
-    unsigned d2 = (k2.w[d / 16] >> ((d % 16) * 4)) & 0xF;
-    if (d1) acc = jac_add(c, acc, c.gtbl[d1]);
-    if (d2) acc = jac_add(c, acc, tq[d2]);
-  }
-  return acc;
-}
-
-// affine x (plain) of P; false when infinity
-bool affine(const Curve& c, const JPoint& P, U256* x_out, U256* y_out) {
-  if (P.inf()) return false;
+// P (Jacobian) + A (affine, negate_y selects -A): 8M + 3S mixed add
+JPoint jac_madd(const Curve& c, const JPoint& P, const APoint& A,
+                bool negate_y) {
   const Mont& f = c.fp;
-  U256 zi = f.inv(P.Z);
-  U256 zi2 = f.sqr(zi);
-  if (x_out) *x_out = f.from_mont(f.mul(P.X, zi2));
-  if (y_out) *y_out = f.from_mont(f.mul(P.Y, f.mul(zi2, zi)));
-  return true;
+  U256 ay = negate_y ? f.neg(A.y) : A.y;
+  if (P.inf()) {
+    JPoint R;
+    R.X = A.x;
+    R.Y = ay;
+    R.Z = f.one_m;
+    return R;
+  }
+  U256 Z1Z1 = f.sqr(P.Z);
+  U256 U2 = f.mul(A.x, Z1Z1);
+  U256 S2 = f.mul(ay, f.mul(P.Z, Z1Z1));
+  U256 H = f.sub(U2, P.X);
+  U256 R = f.sub(S2, P.Y);
+  if (is_zero(H)) {
+    if (is_zero(R)) return jac_double(c, P);
+    return JPoint{};  // P == -A
+  }
+  U256 HH = f.sqr(H);
+  U256 HHH = f.mul(H, HH);
+  U256 V = f.mul(P.X, HH);
+  JPoint out;
+  U256 RR = f.sqr(R);
+  out.X = f.sub(f.sub(RR, HHH), f.add(V, V));
+  out.Y = f.sub(f.mul(R, f.sub(V, out.X)), f.mul(P.Y, HHH));
+  out.Z = f.mul(P.Z, H);
+  return out;
+}
+
+// normalise n Jacobian points to affine with ONE field inversion; points at
+// infinity produce (0, 0) and ok[i] = false (when ok != nullptr)
+void batch_normalize(const Curve& c, const JPoint* pts, int n, APoint* out,
+                     bool* ok) {
+  const Mont& f = c.fp;
+  U256* zs = new U256[n];
+  for (int i = 0; i < n; ++i) zs[i] = pts[i].Z;
+  batch_inv(f, zs, n);
+  for (int i = 0; i < n; ++i) {
+    if (pts[i].inf()) {
+      out[i] = APoint{};
+      if (ok) ok[i] = false;
+      continue;
+    }
+    U256 zi2 = f.sqr(zs[i]);
+    out[i].x = f.mul(pts[i].X, zi2);
+    out[i].y = f.mul(pts[i].Y, f.mul(zi2, zs[i]));
+    if (ok) ok[i] = true;
+  }
+  delete[] zs;
+}
+
+// ---------------------------------------------------------------------------
+// wNAF
+// ---------------------------------------------------------------------------
+
+// signed windowed NAF of k (k plain, any magnitude); returns digit count.
+// negate flips every digit (folds the GLV half sign into the encoding).
+int wnaf_encode(const U256& k, int w, bool negate, int8_t* out) {
+  U256 x = k;
+  int len = 0;
+  const uint64_t mask = (1ull << w) - 1;
+  const int64_t half = 1ll << (w - 1);
+  while (!is_zero(x)) {
+    int64_t d = 0;
+    if (x.w[0] & 1) {
+      d = (int64_t)(x.w[0] & mask);
+      if (d > half) d -= (int64_t)1 << w;
+      U256 dd;
+      if (d > 0) {
+        dd.w[0] = (uint64_t)d;
+        sub_bb(x, dd, x);
+      } else {
+        dd.w[0] = (uint64_t)(-d);
+        add_cc(x, dd, x);
+      }
+    }
+    out[len++] = (int8_t)(negate ? -d : d);
+    shr1(x);
+  }
+  return len;
 }
 
 // ---------------------------------------------------------------------------
@@ -370,28 +617,59 @@ Curve* make_curve(const char* p, const char* n, const char* a, const char* b,
   U256 p1 = c->fp.mod;
   U256 one;
   one.w[0] = 1;
-  add_cc(p1, one, p1);  // p odd, no overflow past 2^256 for our primes? p+1
-  // shift right 2
-  for (int s = 0; s < 2; ++s) {
-    for (int i = 0; i < 3; ++i)
-      p1.w[i] = (p1.w[i] >> 1) | (p1.w[i + 1] << 63);
-    p1.w[3] >>= 1;
-  }
+  add_cc(p1, one, p1);
+  for (int s = 0; s < 2; ++s) shr1(p1);
   c->sqrt_e = p1;
   c->g.X = c->fp.to_mont(hex_u256(gx));
   c->g.Y = c->fp.to_mont(hex_u256(gy));
   c->g.Z = c->fp.one_m;
+  c->half_n = c->fn.mod;
+  shr1(c->half_n);
   return c;
 }
 
+// static G / phi(G) odd-multiple tables (one inversion, lazy)
+void build_gtab(Curve& c) {
+  JPoint jt[GTBL];
+  jt[0] = c.g;
+  JPoint g2 = jac_double(c, c.g);
+  for (int i = 1; i < GTBL; ++i) jt[i] = jac_add(c, jt[i - 1], g2);
+  batch_normalize(c, jt, GTBL, c.gtab, nullptr);
+  if (c.has_glv) {
+    for (int i = 0; i < GTBL; ++i) {
+      c.phigtab[i].x = c.fp.mul(c.gtab[i].x, c.beta_m);
+      c.phigtab[i].y = c.gtab[i].y;
+    }
+  }
+}
+
 Curve& secp256k1() {
-  static Curve* c = make_curve(
-      "fffffffffffffffffffffffffffffffffffffffffffffffffffffffefffffc2f",
-      "fffffffffffffffffffffffffffffffebaaedce6af48a03bbfd25e8cd0364141",
-      "0000000000000000000000000000000000000000000000000000000000000000",
-      "0000000000000000000000000000000000000000000000000000000000000007",
-      "79be667ef9dcbbac55a06295ce870b07029bfcdb2dce28d959f2815b16f81798",
-      "483ada7726a3c4655da4fbfc0e1108a8fd17b448a68554199c47d08ffb10d4b8");
+  static Curve* c = [] {
+    Curve* cv = make_curve(
+        "fffffffffffffffffffffffffffffffffffffffffffffffffffffffefffffc2f",
+        "fffffffffffffffffffffffffffffffebaaedce6af48a03bbfd25e8cd0364141",
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "0000000000000000000000000000000000000000000000000000000000000007",
+        "79be667ef9dcbbac55a06295ce870b07029bfcdb2dce28d959f2815b16f81798",
+        "483ada7726a3c4655da4fbfc0e1108a8fd17b448a68554199c47d08ffb10d4b8");
+    // GLV endomorphism constants (crypto/refimpl.py:340-346 — lambda/beta
+    // published curve parameters, g1/g2 the 384-bit mul-shift rounding
+    // constants, mb1/mb2 = -b1/-b2 mod n)
+    cv->has_glv = true;
+    cv->glv_lambda = hex_u256(
+        "5363ad4cc05c30e0a5261c028812645a122e22ea20816678df02967c1b23bd72");
+    cv->beta_m = cv->fp.to_mont(hex_u256(
+        "7ae96a2b657c07106e64479eac3434e99cf0497512f58995c1396c28719501ee"));
+    cv->glv_mb1 = hex_u256(
+        "00000000000000000000000000000000e4437ed6010e88286f547fa90abfe4c3");
+    cv->glv_mb2 = hex_u256(
+        "fffffffffffffffffffffffffffffffe8a280ac50774346dd765cda83db1562c");
+    cv->glv_g1 = hex_u256(
+        "3086d221a7d46bcde86c90e49284eb153daa8a1471e8ca7fe893209a45dbb031");
+    cv->glv_g2 = hex_u256(
+        "e4437ed6010e88286f547fa90abfe4c4221208ac9df506c61571b4ae8ac47f71");
+    return cv;
+  }();
   return *c;
 }
 
@@ -438,6 +716,116 @@ U256 mod_n(const Curve& c, const U256& x) {
   return x;
 }
 
+// ---------------------------------------------------------------------------
+// GLV split (secp256k1): k -> signed halves (m1, neg1), (m2, neg2) with
+// (-1)^neg1 * m1 + (-1)^neg2 * m2 * lambda == k (mod n), |m_i| <~ 2^129.
+// Exactly refimpl.glv_split + the signed mapping min(k_i, n - k_i).
+// ---------------------------------------------------------------------------
+
+void glv_split(const Curve& c, const U256& k, U256& m1, bool& neg1,
+               U256& m2, bool& neg2) {
+  uint64_t wide[8];
+  U256 c1, c2;
+  mul_wide(k, c.glv_g1, wide);
+  c1.w[0] = wide[6];
+  c1.w[1] = wide[7];
+  mul_wide(k, c.glv_g2, wide);
+  c2.w[0] = wide[6];
+  c2.w[1] = wide[7];
+  const Mont& fn = c.fn;
+  U256 k2 = fn.add(fn.mulmod(c1, c.glv_mb1), fn.mulmod(c2, c.glv_mb2));
+  U256 k1 = fn.sub(k, fn.mulmod(k2, c.glv_lambda));
+  neg1 = cmp(k1, c.half_n) > 0;
+  m1 = neg1 ? fn.neg(k1) : k1;
+  neg2 = cmp(k2, c.half_n) > 0;
+  m2 = neg2 ? fn.neg(k2) : k2;
+}
+
+// ---------------------------------------------------------------------------
+// batch double-scalar multiplication contexts
+// ---------------------------------------------------------------------------
+
+// one signature's ladder inputs: up to 4 wNAF planes (G, phiG, Q, phiQ for
+// GLV; G, Q for plain curves) + the per-signature affine Q tables
+struct LadderCtx {
+  bool valid = false;
+  int8_t dG[WNAF_MAX], dPG[WNAF_MAX], dQ[WNAF_MAX], dPQ[WNAF_MAX];
+  int lG = 0, lPG = 0, lQ = 0, lPQ = 0;
+  APoint qtab[QTBL];     // odd multiples of Q, affine
+  APoint phiqtab[QTBL];  // phi(odd multiples), affine (GLV only)
+};
+
+// Phase A helper: Jacobian odd multiples of Q for later batch-normalise
+void q_multiples(const Curve& c, const JPoint& Q, JPoint* out) {
+  out[0] = Q;
+  JPoint q2 = jac_double(c, Q);
+  for (int i = 1; i < QTBL; ++i) out[i] = jac_add(c, out[i - 1], q2);
+}
+
+// Phase B: run one ladder (acc = sum of planes) given affine tables
+JPoint run_ladder(const Curve& c, const LadderCtx& L) {
+  int len = L.lG;
+  if (L.lPG > len) len = L.lPG;
+  if (L.lQ > len) len = L.lQ;
+  if (L.lPQ > len) len = L.lPQ;
+  JPoint acc{};
+  for (int i = len - 1; i >= 0; --i) {
+    if (!acc.inf()) acc = jac_double(c, acc);
+    int8_t d;
+    if (i < L.lG && (d = L.dG[i]) != 0)
+      acc = jac_madd(c, acc, c.gtab[(d > 0 ? d : -d) >> 1], d < 0);
+    if (i < L.lPG && (d = L.dPG[i]) != 0)
+      acc = jac_madd(c, acc, c.phigtab[(d > 0 ? d : -d) >> 1], d < 0);
+    if (i < L.lQ && (d = L.dQ[i]) != 0)
+      acc = jac_madd(c, acc, L.qtab[(d > 0 ? d : -d) >> 1], d < 0);
+    if (i < L.lPQ && (d = L.dPQ[i]) != 0)
+      acc = jac_madd(c, acc, L.phiqtab[(d > 0 ? d : -d) >> 1], d < 0);
+  }
+  return acc;
+}
+
+// fill a ladder context's scalar planes for k1*G + k2*Q on curve c.
+// GLV curves split both scalars; plain curves use full-width planes.
+void fill_scalars(const Curve& c, const U256& k1, const U256& k2,
+                  LadderCtx& L) {
+  if (c.has_glv) {
+    U256 a1, a2, b1, b2;
+    bool s1, s2, t1, t2;
+    glv_split(c, k1, a1, s1, a2, s2);
+    glv_split(c, k2, b1, t1, b2, t2);
+    L.lG = wnaf_encode(a1, GW, s1, L.dG);
+    L.lPG = wnaf_encode(a2, GW, s2, L.dPG);
+    L.lQ = wnaf_encode(b1, QW, t1, L.dQ);
+    L.lPQ = wnaf_encode(b2, QW, t2, L.dPQ);
+  } else {
+    L.lG = wnaf_encode(k1, GW, false, L.dG);
+    L.lPG = 0;
+    L.lQ = wnaf_encode(k2, QW, false, L.dQ);
+    L.lPQ = 0;
+  }
+}
+
+// build the affine Q tables for a chunk with ONE shared inversion:
+// jtabs[i*QTBL + t] are the Jacobian odd multiples of sig i's point
+void finish_q_tables(const Curve& c, JPoint* jtabs, LadderCtx* ctxs,
+                     int count) {
+  APoint* flat = new APoint[count * QTBL];
+  batch_normalize(c, jtabs, count * QTBL, flat, nullptr);
+  for (int i = 0; i < count; ++i) {
+    if (!ctxs[i].valid) continue;
+    for (int t = 0; t < QTBL; ++t) {
+      ctxs[i].qtab[t] = flat[i * QTBL + t];
+      if (c.has_glv) {
+        ctxs[i].phiqtab[t].x = c.fp.mul(flat[i * QTBL + t].x, c.beta_m);
+        ctxs[i].phiqtab[t].y = flat[i * QTBL + t].y;
+      }
+    }
+  }
+  delete[] flat;
+}
+
+constexpr int CHUNK = 128;
+
 }  // namespace
 
 extern "C" {
@@ -452,29 +840,67 @@ int ncrypto_available(void) { return 1; }
 // drifted binary so stale consensus-critical semantics fail loudly
 const char* ncrypto_src_hash(void) { return FBTPU_SRC_HASH; }
 
-
 // All arrays are count rows of 32 big-endian bytes; ok_out: count bytes.
 void ncrypto_ecdsa_verify_batch(int curve_id, uint64_t count,
                                 const uint8_t* es, const uint8_t* rs,
                                 const uint8_t* ss, const uint8_t* qxs,
                                 const uint8_t* qys, uint8_t* ok_out) {
   Curve& c = by_id(curve_id);
-  for (uint64_t i = 0; i < count; ++i) {
-    ok_out[i] = 0;
-    U256 r = from_be(rs + 32 * i), s = from_be(ss + 32 * i);
-    if (!scalar_ok(c, r, s)) continue;
-    JPoint Q;
-    if (!load_pub(c, from_be(qxs + 32 * i), from_be(qys + 32 * i), &Q))
-      continue;
-    U256 e = mod_n(c, c.fn.reduce(from_be(es + 32 * i)));
-    U256 w = c.fn.inv(c.fn.to_mont(s));
-    U256 u1 = c.fn.from_mont(c.fn.mul(c.fn.to_mont(e), w));
-    U256 u2 = c.fn.from_mont(c.fn.mul(c.fn.to_mont(r), w));
-    JPoint R = shamir(c, u1, u2, Q);
-    U256 x;
-    if (!affine(c, R, &x, nullptr)) continue;
-    ok_out[i] = cmp(mod_n(c, x), r) == 0;
+  std::call_once(c.gtab_once, build_gtab, std::ref(c));
+  LadderCtx* ctxs = new LadderCtx[CHUNK];
+  JPoint* jtabs = new JPoint[CHUNK * QTBL];
+  U256* sinv = new U256[CHUNK];
+  U256* rvals = new U256[CHUNK];
+  U256* evals = new U256[CHUNK];
+  JPoint* results = new JPoint[CHUNK];
+  APoint* aff = new APoint[CHUNK];
+  bool* aok = new bool[CHUNK];
+  for (uint64_t base = 0; base < count; base += CHUNK) {
+    int m = (int)((count - base < CHUNK) ? count - base : CHUNK);
+    // phase A: validate, collect s for batched inversion
+    for (int i = 0; i < m; ++i) {
+      uint64_t g = base + i;
+      ok_out[g] = 0;
+      ctxs[i] = LadderCtx{};
+      sinv[i] = U256{};
+      U256 r = from_be(rs + 32 * g), s = from_be(ss + 32 * g);
+      if (!scalar_ok(c, r, s)) continue;
+      JPoint Q;
+      if (!load_pub(c, from_be(qxs + 32 * g), from_be(qys + 32 * g), &Q))
+        continue;
+      ctxs[i].valid = true;
+      rvals[i] = r;
+      evals[i] = mod_n(c, c.fn.reduce(from_be(es + 32 * g)));
+      sinv[i] = c.fn.to_mont(s);
+      q_multiples(c, Q, jtabs + i * QTBL);
+    }
+    batch_inv(c.fn, sinv, m);  // sinv[i] = (s^-1) Montgomery
+    finish_q_tables(c, jtabs, ctxs, m);
+    // phase B: scalars + ladders
+    for (int i = 0; i < m; ++i) {
+      results[i] = JPoint{};
+      if (!ctxs[i].valid) continue;
+      U256 u1 = c.fn.from_mont(c.fn.mul(c.fn.to_mont(evals[i]), sinv[i]));
+      U256 u2 = c.fn.from_mont(c.fn.mul(c.fn.to_mont(rvals[i]), sinv[i]));
+      fill_scalars(c, u1, u2, ctxs[i]);
+      results[i] = run_ladder(c, ctxs[i]);
+    }
+    // phase C: one inversion for all affine x's, then the final compare
+    batch_normalize(c, results, m, aff, aok);
+    for (int i = 0; i < m; ++i) {
+      if (!ctxs[i].valid || !aok[i]) continue;
+      U256 x = c.fp.from_mont(aff[i].x);
+      ok_out[base + i] = cmp(mod_n(c, x), rvals[i]) == 0;
+    }
   }
+  delete[] ctxs;
+  delete[] jtabs;
+  delete[] sinv;
+  delete[] rvals;
+  delete[] evals;
+  delete[] results;
+  delete[] aff;
+  delete[] aok;
 }
 
 // vs: count bytes (recovery ids); pub_out: count rows of 64 bytes (x|y).
@@ -483,41 +909,77 @@ void ncrypto_ecdsa_recover_batch(int curve_id, uint64_t count,
                                  const uint8_t* ss, const uint8_t* vs,
                                  uint8_t* pub_out, uint8_t* ok_out) {
   Curve& c = by_id(curve_id);
-  for (uint64_t i = 0; i < count; ++i) {
-    ok_out[i] = 0;
-    memset(pub_out + 64 * i, 0, 64);
-    U256 r = from_be(rs + 32 * i), s = from_be(ss + 32 * i);
-    uint8_t v = vs[i];
-    if (!scalar_ok(c, r, s)) continue;
-    if ((v >> 1) >= 2) continue;  // x = r + (v>>1)*n >= 2n > p
-    U256 x = r;
-    if (v >> 1) {
-      if (add_cc(r, c.fn.mod, x)) continue;  // overflowed 2^256
+  std::call_once(c.gtab_once, build_gtab, std::ref(c));
+  LadderCtx* ctxs = new LadderCtx[CHUNK];
+  JPoint* jtabs = new JPoint[CHUNK * QTBL];
+  U256* rinv = new U256[CHUNK];
+  U256* svals = new U256[CHUNK];
+  U256* evals = new U256[CHUNK];
+  JPoint* results = new JPoint[CHUNK];
+  APoint* aff = new APoint[CHUNK];
+  bool* aok = new bool[CHUNK];
+  for (uint64_t base = 0; base < count; base += CHUNK) {
+    int m = (int)((count - base < CHUNK) ? count - base : CHUNK);
+    for (int i = 0; i < m; ++i) {
+      uint64_t g = base + i;
+      ok_out[g] = 0;
+      memset(pub_out + 64 * g, 0, 64);
+      ctxs[i] = LadderCtx{};
+      rinv[i] = U256{};
+      U256 r = from_be(rs + 32 * g), s = from_be(ss + 32 * g);
+      uint8_t v = vs[g];
+      if (!scalar_ok(c, r, s)) continue;
+      if ((v >> 1) >= 2) continue;  // x = r + (v>>1)*n >= 2n > p
+      U256 x = r;
+      if (v >> 1) {
+        if (add_cc(r, c.fn.mod, x)) continue;  // overflowed 2^256
+      }
+      if (cmp(x, c.fp.mod) >= 0) continue;
+      U256 xm = c.fp.to_mont(x);
+      U256 ysq = c.fp.add(c.fp.mul(c.fp.sqr(xm), xm), c.b_m);
+      if (!c.a_zero) ysq = c.fp.add(ysq, c.fp.mul(c.a_m, xm));
+      U256 y = c.fp.pow(ysq, c.sqrt_e);
+      if (cmp(c.fp.sqr(y), ysq) != 0) continue;  // non-residue
+      U256 y_plain = c.fp.from_mont(y);
+      if ((y_plain.w[0] & 1) != (v & 1)) y = c.fp.neg(y);
+      ctxs[i].valid = true;
+      svals[i] = s;
+      evals[i] = mod_n(c, c.fn.reduce(from_be(es + 32 * g)));
+      rinv[i] = c.fn.to_mont(r);
+      JPoint R;
+      R.X = xm;
+      R.Y = y;
+      R.Z = c.fp.one_m;
+      q_multiples(c, R, jtabs + i * QTBL);
     }
-    if (cmp(x, c.fp.mod) >= 0) continue;
-    U256 xm = c.fp.to_mont(x);
-    U256 ysq = c.fp.add(c.fp.mul(c.fp.sqr(xm), xm), c.b_m);
-    if (!c.a_zero) ysq = c.fp.add(ysq, c.fp.mul(c.a_m, xm));
-    U256 y = c.fp.pow(ysq, c.sqrt_e);
-    if (cmp(c.fp.sqr(y), ysq) != 0) continue;  // non-residue
-    U256 y_plain = c.fp.from_mont(y);
-    if ((y_plain.w[0] & 1) != (v & 1)) y = c.fp.neg(y);
-    U256 e = mod_n(c, c.fn.reduce(from_be(es + 32 * i)));
-    U256 rinv = c.fn.inv(c.fn.to_mont(r));
-    U256 u1 = c.fn.from_mont(
-        c.fn.mul(c.fn.neg(c.fn.to_mont(e)), rinv));  // -e/r mod n
-    U256 u2 = c.fn.from_mont(c.fn.mul(c.fn.to_mont(s), rinv));
-    JPoint R;
-    R.X = xm;
-    R.Y = y;
-    R.Z = c.fp.one_m;
-    JPoint Q = shamir(c, u1, u2, R);
-    U256 qx, qy;
-    if (!affine(c, Q, &qx, &qy)) continue;
-    to_be(qx, pub_out + 64 * i);
-    to_be(qy, pub_out + 64 * i + 32);
-    ok_out[i] = 1;
+    batch_inv(c.fn, rinv, m);  // rinv[i] = (r^-1) Montgomery
+    finish_q_tables(c, jtabs, ctxs, m);
+    for (int i = 0; i < m; ++i) {
+      results[i] = JPoint{};
+      if (!ctxs[i].valid) continue;
+      // u1 = -e/r, u2 = s/r (mod n)
+      U256 u1 = c.fn.from_mont(
+          c.fn.mul(c.fn.neg(c.fn.to_mont(evals[i])), rinv[i]));
+      U256 u2 = c.fn.from_mont(c.fn.mul(c.fn.to_mont(svals[i]), rinv[i]));
+      fill_scalars(c, u1, u2, ctxs[i]);
+      results[i] = run_ladder(c, ctxs[i]);
+    }
+    batch_normalize(c, results, m, aff, aok);
+    for (int i = 0; i < m; ++i) {
+      if (!ctxs[i].valid || !aok[i]) continue;
+      to_be(c.fp.from_mont(aff[i].x), pub_out + 64 * (base + i));
+      to_be(c.fp.from_mont(aff[i].y), pub_out + 64 * (base + i) + 32);
+      ok_out[base + i] = 1;
+    }
   }
+  delete[] ctxs;
+  delete[] jtabs;
+  delete[] rinv;
+  delete[] svals;
+  delete[] evals;
+  delete[] results;
+  delete[] aff;
+  delete[] aok;
 }
 
 void ncrypto_sm2_verify_batch(uint64_t count, const uint8_t* es,
@@ -525,23 +987,61 @@ void ncrypto_sm2_verify_batch(uint64_t count, const uint8_t* es,
                               const uint8_t* qxs, const uint8_t* qys,
                               uint8_t* ok_out) {
   Curve& c = sm2p256v1();
-  for (uint64_t i = 0; i < count; ++i) {
-    ok_out[i] = 0;
-    U256 r = from_be(rs + 32 * i), s = from_be(ss + 32 * i);
-    if (!scalar_ok(c, r, s)) continue;
-    JPoint Q;
-    if (!load_pub(c, from_be(qxs + 32 * i), from_be(qys + 32 * i), &Q))
-      continue;
-    U256 e = mod_n(c, c.fn.reduce(from_be(es + 32 * i)));
-    U256 t = c.fn.add(r, s);  // r, s < n: fn.add reduces mod n
-    if (is_zero(t)) continue;
-    JPoint P = shamir(c, s, t, Q);
-    U256 x;
-    if (!affine(c, P, &x, nullptr)) continue;
-    // (e + x) mod n == r
-    U256 lhs = c.fn.add(e, mod_n(c, x));
-    ok_out[i] = cmp(lhs, r) == 0;
+  std::call_once(c.gtab_once, build_gtab, std::ref(c));
+  LadderCtx* ctxs = new LadderCtx[CHUNK];
+  JPoint* jtabs = new JPoint[CHUNK * QTBL];
+  U256* rvals = new U256[CHUNK];
+  U256* evals = new U256[CHUNK];
+  U256* svals = new U256[CHUNK];
+  U256* tvals = new U256[CHUNK];
+  JPoint* results = new JPoint[CHUNK];
+  APoint* aff = new APoint[CHUNK];
+  bool* aok = new bool[CHUNK];
+  for (uint64_t base = 0; base < count; base += CHUNK) {
+    int m = (int)((count - base < CHUNK) ? count - base : CHUNK);
+    for (int i = 0; i < m; ++i) {
+      uint64_t g = base + i;
+      ok_out[g] = 0;
+      ctxs[i] = LadderCtx{};
+      U256 r = from_be(rs + 32 * g), s = from_be(ss + 32 * g);
+      if (!scalar_ok(c, r, s)) continue;
+      JPoint Q;
+      if (!load_pub(c, from_be(qxs + 32 * g), from_be(qys + 32 * g), &Q))
+        continue;
+      U256 t = c.fn.add(r, s);  // r, s < n: fn.add reduces mod n
+      if (is_zero(t)) continue;
+      ctxs[i].valid = true;
+      rvals[i] = r;
+      svals[i] = s;
+      tvals[i] = t;
+      evals[i] = mod_n(c, c.fn.reduce(from_be(es + 32 * g)));
+      q_multiples(c, Q, jtabs + i * QTBL);
+    }
+    finish_q_tables(c, jtabs, ctxs, m);
+    for (int i = 0; i < m; ++i) {
+      results[i] = JPoint{};
+      if (!ctxs[i].valid) continue;
+      fill_scalars(c, svals[i], tvals[i], ctxs[i]);  // s*G + t*Q
+      results[i] = run_ladder(c, ctxs[i]);
+    }
+    batch_normalize(c, results, m, aff, aok);
+    for (int i = 0; i < m; ++i) {
+      if (!ctxs[i].valid || !aok[i]) continue;
+      U256 x = c.fp.from_mont(aff[i].x);
+      // (e + x) mod n == r
+      U256 lhs = c.fn.add(evals[i], mod_n(c, x));
+      ok_out[base + i] = cmp(lhs, rvals[i]) == 0;
+    }
   }
+  delete[] ctxs;
+  delete[] jtabs;
+  delete[] rvals;
+  delete[] evals;
+  delete[] svals;
+  delete[] tvals;
+  delete[] results;
+  delete[] aff;
+  delete[] aok;
 }
 
 }  // extern "C"
