@@ -58,6 +58,14 @@ def load_library():
                 ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u8p]
             lib.ncrypto_sm2_verify_batch.restype = None
+            lib.ncrypto_ecdsa_sign_batch.argtypes = [
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, u8p, u8p, u8p, u8p]
+            lib.ncrypto_ecdsa_sign_batch.restype = None
+            lib.ncrypto_sm2_sign_batch.argtypes = [
+                ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, u8p, u8p, u8p]
+            lib.ncrypto_sm2_sign_batch.restype = None
             _lib = lib
         except (OSError, AttributeError):
             _lib = None
@@ -119,6 +127,52 @@ def sm2_verify_batch(es, rs, ss, qxs, qys) -> Optional[list]:
                                  _rows(rs, n), _rows(ss, n), _rows(qxs, n),
                                  _rows(qys, n), ok)
     return [bool(v) for v in ok]
+
+
+def ecdsa_sign(secret: int, digest: bytes) -> Optional[tuple]:
+    """-> (r, s, v) byte-exact with refimpl.ecdsa_sign, or None when the
+    library is unavailable or the lane degenerated (caller falls back to
+    the oracle). The RFC 6979 nonce is derived HERE (refimpl's hmac path
+    is already native-speed); the C side does the EC work."""
+    from . import refimpl
+
+    lib = load_library()
+    if lib is None:
+        return None
+    k = refimpl._rfc6979_k(secret, digest, refimpl.SECP256K1.n)
+    e = int.from_bytes(digest, "big")
+    r = (ctypes.c_uint8 * 32)()
+    s = (ctypes.c_uint8 * 32)()
+    v = (ctypes.c_uint8 * 1)()
+    ok = (ctypes.c_uint8 * 1)()
+    lib.ncrypto_ecdsa_sign_batch(
+        _CURVE_SECP, 1, _e_rows([e], 1, refimpl.SECP256K1.n),
+        _rows([secret], 1), _rows([k], 1), r, s, v, ok)
+    if not ok[0]:
+        return None
+    return (int.from_bytes(bytes(r), "big"),
+            int.from_bytes(bytes(s), "big"), v[0])
+
+
+def sm2_sign(secret: int, digest: bytes) -> Optional[tuple]:
+    """-> (r, s) byte-exact with refimpl.sm2_sign, or None."""
+    from . import refimpl
+
+    lib = load_library()
+    if lib is None:
+        return None
+    k = refimpl._rfc6979_k(secret, digest, refimpl.SM2P256V1.n, extra=b"sm2")
+    e = int.from_bytes(digest, "big")
+    r = (ctypes.c_uint8 * 32)()
+    s = (ctypes.c_uint8 * 32)()
+    ok = (ctypes.c_uint8 * 1)()
+    lib.ncrypto_sm2_sign_batch(
+        1, _e_rows([e], 1, refimpl.SM2P256V1.n), _rows([secret], 1),
+        _rows([k], 1), r, s, ok)
+    if not ok[0]:
+        return None
+    return (int.from_bytes(bytes(r), "big"),
+            int.from_bytes(bytes(s), "big"))
 
 
 def ecdsa_recover_batch(es, rs, ss, vs) -> Optional[tuple]:

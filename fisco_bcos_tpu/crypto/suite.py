@@ -178,10 +178,18 @@ class CryptoSuite:
     def sign(self, kp, digest: bytes) -> bytes:
         if hasattr(kp, "sign_digest"):  # HSM-backed: secret stays inside
             return kp.sign_digest(digest)
+        from . import nativeec
+
         if self.kind == "ecdsa":
-            r, s, v = refimpl.ecdsa_sign(self.params, kp.secret, digest)
+            # native EC, RFC 6979 nonce from the oracle — byte-exact with
+            # refimpl.ecdsa_sign (consensus packets/seals sign per message;
+            # the pure-Python ladder was ~17 ms per signature)
+            sig = nativeec.ecdsa_sign(kp.secret, digest)
+            r, s, v = sig if sig is not None else \
+                refimpl.ecdsa_sign(self.params, kp.secret, digest)
             return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
-        r, s = refimpl.sm2_sign(kp.secret, digest)
+        sig = nativeec.sm2_sign(kp.secret, digest)
+        r, s = sig if sig is not None else refimpl.sm2_sign(kp.secret, digest)
         return r.to_bytes(32, "big") + s.to_bytes(32, "big") + kp.pub_bytes
 
     # -- verification / recovery (batch-native) ----------------------------

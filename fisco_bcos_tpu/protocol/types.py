@@ -79,20 +79,30 @@ class Transaction:
 
     _hash: Optional[bytes] = dataclasses.field(default=None, repr=False)
     _sender: Optional[bytes] = dataclasses.field(default=None, repr=False)
+    # wire-encoding caches, set by decode()/encode(): a tx is re-encoded on
+    # every hop of its life (gossip, proposal persist, ledger prewrite) and
+    # the bytes are canonical — pay the Writer walk once. sign() clears
+    # them (the only mutation the codebase performs after decode).
+    _wire: Optional[bytes] = dataclasses.field(default=None, repr=False)
+    _unsigned: Optional[bytes] = dataclasses.field(default=None, repr=False)
 
     # -- encoding ----------------------------------------------------------
     def encode_unsigned(self) -> bytes:
-        w = Writer()
-        (w.u16(self.version).text(self.chain_id).text(self.group_id)
-         .i64(self.block_limit).text(self.nonce).blob(self.to)
-         .blob(self.input).text(self.abi))
-        return w.bytes()
+        if self._unsigned is None:
+            w = Writer()
+            (w.u16(self.version).text(self.chain_id).text(self.group_id)
+             .i64(self.block_limit).text(self.nonce).blob(self.to)
+             .blob(self.input).text(self.abi))
+            self._unsigned = w.bytes()
+        return self._unsigned
 
     def encode(self) -> bytes:
-        w = Writer()
-        w.blob(self.encode_unsigned()).blob(self.signature)
-        w.i64(self.import_time).u32(self.attribute)
-        return w.bytes()
+        if self._wire is None:
+            w = Writer()
+            w.blob(self.encode_unsigned()).blob(self.signature)
+            w.i64(self.import_time).u32(self.attribute)
+            self._wire = w.bytes()
+        return self._wire
 
     @classmethod
     def decode(cls, data: bytes) -> "Transaction":
@@ -106,6 +116,12 @@ class Transaction:
                  block_limit=u.i64(), nonce=u.text(), to=u.blob(),
                  input=u.blob(), abi=u.text(), signature=sig,
                  import_time=import_time, attribute=attribute)
+        # cache ONLY canonical input: wire bytes with trailing garbage (or a
+        # padded unsigned blob) must keep the old re-serialise-from-fields
+        # behavior so hash identity stays canonical for any wire variant
+        if r.done() and u.done():
+            tx._wire = bytes(data) if not isinstance(data, bytes) else data
+            tx._unsigned = unsigned
         return tx
 
     # -- identity ----------------------------------------------------------
@@ -130,6 +146,27 @@ class Transaction:
         self.signature = suite.sign(keypair, self.hash(suite))
         self._sender = keypair.address
         return self
+
+    # mechanical cache invalidation: ANY payload-field mutation after
+    # decode()/encode() must drop the cached bytes, or gossip/persist would
+    # silently re-emit stale encodings (the caches are an optimisation,
+    # never an alternate source of truth)
+    _UNSIGNED_FIELDS = frozenset({
+        "version", "chain_id", "group_id", "block_limit", "nonce", "to",
+        "input", "abi"})
+    _SIGNED_FIELDS = frozenset({"signature", "import_time", "attribute"})
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name in Transaction._UNSIGNED_FIELDS:
+            object.__setattr__(self, "_unsigned", None)
+            object.__setattr__(self, "_wire", None)
+            object.__setattr__(self, "_hash", None)
+            object.__setattr__(self, "_sender", None)
+        elif name in Transaction._SIGNED_FIELDS:
+            object.__setattr__(self, "_wire", None)
+            if name == "signature":
+                object.__setattr__(self, "_sender", None)
 
 
 @dataclasses.dataclass

@@ -982,6 +982,147 @@ void ncrypto_ecdsa_recover_batch(int curve_id, uint64_t count,
   delete[] aok;
 }
 
+// Batched signing. The nonce k comes from the CALLER (crypto/refimpl.py's
+// RFC 6979 derivation — HMAC-SHA256 stays in Python where hashlib is
+// already native); this routine does the EC work and the signature
+// algebra, byte-exact with refimpl.ecdsa_sign given the same k. A lane
+// whose r or s degenerates to zero (never in practice for RFC 6979
+// nonces) reports ok=0 and the caller falls back to the oracle.
+// es/ds/ks: count rows of 32 BE bytes (digest, secret, nonce);
+// out_r/out_s: 32-byte rows; out_v: count bytes; ok_out: count bytes.
+void ncrypto_ecdsa_sign_batch(int curve_id, uint64_t count,
+                              const uint8_t* es, const uint8_t* ds,
+                              const uint8_t* ks, uint8_t* out_r,
+                              uint8_t* out_s, uint8_t* out_v,
+                              uint8_t* ok_out) {
+  Curve& c = by_id(curve_id);
+  std::call_once(c.gtab_once, build_gtab, std::ref(c));
+  // suite.sign() calls with count=1 (one signature per consensus packet):
+  // size the scratch to the actual lane count, not the batch chunk
+  const int cap = (int)(count < CHUNK ? (count ? count : 1) : CHUNK);
+  LadderCtx* ctxs = new LadderCtx[cap];
+  U256* kinv = new U256[cap];
+  JPoint* results = new JPoint[cap];
+  APoint* aff = new APoint[cap];
+  bool* aok = new bool[cap];
+  U256 zero;
+  for (uint64_t base = 0; base < count; base += CHUNK) {
+    int m = (int)((count - base < CHUNK) ? count - base : CHUNK);
+    for (int i = 0; i < m; ++i) {
+      uint64_t g = base + i;
+      ok_out[g] = 0;
+      memset(out_r + 32 * g, 0, 32);
+      memset(out_s + 32 * g, 0, 32);
+      out_v[g] = 0;
+      ctxs[i] = LadderCtx{};
+      kinv[i] = U256{};
+      results[i] = JPoint{};
+      U256 k = from_be(ks + 32 * g);
+      if (is_zero(k) || cmp(k, c.fn.mod) >= 0) continue;
+      ctxs[i].valid = true;
+      kinv[i] = c.fn.to_mont(k);
+      fill_scalars(c, k, zero, ctxs[i]);  // k*G (Q planes empty)
+      results[i] = run_ladder(c, ctxs[i]);
+    }
+    batch_inv(c.fn, kinv, m);  // kinv[i] = (k^-1) Montgomery
+    batch_normalize(c, results, m, aff, aok);
+    for (int i = 0; i < m; ++i) {
+      if (!ctxs[i].valid || !aok[i]) continue;
+      uint64_t g = base + i;
+      U256 e = mod_n(c, c.fn.reduce(from_be(es + 32 * g)));
+      U256 d = from_be(ds + 32 * g);
+      U256 rx = c.fp.from_mont(aff[i].x);
+      U256 r = mod_n(c, rx);
+      if (is_zero(r)) continue;
+      // s = k^-1 (e + r*d) mod n
+      U256 rd = c.fn.from_mont(
+          c.fn.mul(c.fn.to_mont(r), c.fn.to_mont(c.fn.reduce(d))));
+      U256 erd = c.fn.add(e, rd);
+      U256 s = c.fn.from_mont(c.fn.mul(c.fn.to_mont(erd), kinv[i]));
+      if (is_zero(s)) continue;
+      uint8_t v = (uint8_t)(c.fp.from_mont(aff[i].y).w[0] & 1);
+      if (cmp(s, c.half_n) > 0) {  // low-s normal form (refimpl parity)
+        s = c.fn.neg(s);
+        v ^= 1;
+      }
+      to_be(r, out_r + 32 * g);
+      to_be(s, out_s + 32 * g);
+      out_v[g] = v;
+      ok_out[g] = 1;
+    }
+  }
+  delete[] ctxs;
+  delete[] kinv;
+  delete[] results;
+  delete[] aff;
+  delete[] aok;
+}
+
+// SM2 signing (GB/T 32918): r = (e + x(kG)) mod n, s = (1+d)^-1 (k - r d).
+void ncrypto_sm2_sign_batch(uint64_t count, const uint8_t* es,
+                            const uint8_t* ds, const uint8_t* ks,
+                            uint8_t* out_r, uint8_t* out_s,
+                            uint8_t* ok_out) {
+  Curve& c = sm2p256v1();
+  std::call_once(c.gtab_once, build_gtab, std::ref(c));
+  const int cap = (int)(count < CHUNK ? (count ? count : 1) : CHUNK);
+  LadderCtx* ctxs = new LadderCtx[cap];
+  U256* dinv = new U256[cap];
+  JPoint* results = new JPoint[cap];
+  APoint* aff = new APoint[cap];
+  bool* aok = new bool[cap];
+  U256 zero;
+  for (uint64_t base = 0; base < count; base += CHUNK) {
+    int m = (int)((count - base < CHUNK) ? count - base : CHUNK);
+    for (int i = 0; i < m; ++i) {
+      uint64_t g = base + i;
+      ok_out[g] = 0;
+      memset(out_r + 32 * g, 0, 32);
+      memset(out_s + 32 * g, 0, 32);
+      ctxs[i] = LadderCtx{};
+      dinv[i] = U256{};
+      results[i] = JPoint{};
+      U256 k = from_be(ks + 32 * g);
+      if (is_zero(k) || cmp(k, c.fn.mod) >= 0) continue;
+      U256 one;
+      one.w[0] = 1;
+      U256 d1 = c.fn.add(c.fn.reduce(from_be(ds + 32 * g)), one);
+      if (is_zero(d1)) continue;  // d == n-1: (1+d) not invertible
+      ctxs[i].valid = true;
+      dinv[i] = c.fn.to_mont(d1);
+      fill_scalars(c, k, zero, ctxs[i]);
+      results[i] = run_ladder(c, ctxs[i]);
+    }
+    batch_inv(c.fn, dinv, m);  // dinv[i] = ((1+d)^-1) Montgomery
+    batch_normalize(c, results, m, aff, aok);
+    for (int i = 0; i < m; ++i) {
+      if (!ctxs[i].valid || !aok[i]) continue;
+      uint64_t g = base + i;
+      U256 e = mod_n(c, c.fn.reduce(from_be(es + 32 * g)));
+      U256 k = from_be(ks + 32 * g);
+      U256 d = c.fn.reduce(from_be(ds + 32 * g));
+      U256 px = mod_n(c, c.fp.from_mont(aff[i].x));
+      U256 r = c.fn.add(e, px);
+      if (is_zero(r)) continue;
+      if (is_zero(c.fn.sub(c.fn.neg(r), k))) continue;  // r + k == n
+      // s = (1+d)^-1 (k - r*d) mod n
+      U256 rd = c.fn.from_mont(
+          c.fn.mul(c.fn.to_mont(r), c.fn.to_mont(d)));
+      U256 krd = c.fn.sub(k, rd);
+      U256 s = c.fn.from_mont(c.fn.mul(c.fn.to_mont(krd), dinv[i]));
+      if (is_zero(s)) continue;
+      to_be(r, out_r + 32 * g);
+      to_be(s, out_s + 32 * g);
+      ok_out[g] = 1;
+    }
+  }
+  delete[] ctxs;
+  delete[] dinv;
+  delete[] results;
+  delete[] aff;
+  delete[] aok;
+}
+
 void ncrypto_sm2_verify_batch(uint64_t count, const uint8_t* es,
                               const uint8_t* rs, const uint8_t* ss,
                               const uint8_t* qxs, const uint8_t* qys,
