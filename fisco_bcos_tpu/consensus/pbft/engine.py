@@ -36,6 +36,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
 import numpy as np
@@ -131,6 +132,15 @@ class PBFTEngine(Worker):
 
         self.view = 0
         self.to_view = 0  # > view while a view change is in flight
+        # single-lane execution thread (SURVEY §5 double-buffered staging):
+        # the worker hands an agreed proposal to this thread and keeps
+        # draining consensus packets, so proposal VERIFICATION of height
+        # N+1 (a device batch recover on TPU deployments) runs while
+        # height N EXECUTES on the host — the verify latency hides behind
+        # execution instead of serialising after it. One lane keeps
+        # execution strictly ordered.
+        self._exec_pool: Optional[ThreadPoolExecutor] = None
+        self._executing: Optional[int] = None
         self._last_seen_number = ledger.current_number()
         self._caches: dict[int, _ProposalCache] = {}
         self._viewchanges: dict[int, dict[int, PBFTMessage]] = {}
@@ -156,6 +166,12 @@ class PBFTEngine(Worker):
         self._reset_timer()
         super().start()
         self._grant_sealer()
+
+    def stop(self) -> None:
+        super().stop()
+        if self._exec_pool is not None:
+            self._exec_pool.shutdown(wait=False)
+            self._exec_pool = None
 
     # -- crash recovery (PBFTEngine::initState analogue) -------------------
     def _replay_log(self) -> None:
@@ -325,6 +341,8 @@ class PBFTEngine(Worker):
                 break
             if kind == "proposal":
                 local.append(item)  # type: ignore[arg-type]
+            elif kind == "executed":
+                self._on_executed(*item)  # type: ignore[misc]
             else:
                 msgs.append(item)  # type: ignore[arg-type]
         for msg in self._batch_checked(msgs):
@@ -622,25 +640,63 @@ class PBFTEngine(Worker):
 
     def _execute_and_checkpoint(self, number: int,
                                 cache: _ProposalCache) -> None:
-        result = self.scheduler.execute_block(cache.proposal)
+        """Hand the agreed proposal to the execution lane; the worker keeps
+        draining consensus packets (verify of N+1 overlaps execute of N)."""
+        if self._executing is not None:
+            return  # lane busy; _on_executed's _try_advance retries
+        if self._exec_pool is None:
+            self._exec_pool = ThreadPoolExecutor(
+                1, thread_name_prefix="pbft-exec")
+        self._executing = number
+        proposal, phash = cache.proposal, cache.proposal_hash
+
+        def run() -> None:
+            try:
+                result = self.scheduler.execute_block(proposal)
+            except Exception:
+                LOG.exception(badge("PBFT", "execute-crashed",
+                                    number=number))
+                result = None
+            self._inbox.put(("executed", (number, phash, result)))
+            self.wakeup()
+
+        self._exec_pool.submit(run)
+
+    def _on_executed(self, number: int, phash: bytes, result) -> None:
+        """Execution lane completion (runs on the worker thread)."""
+        self._executing = None
+        cache = self._caches.get(number)
+        if cache is None or cache.proposal_hash != phash:
+            # round superseded while executing (view change / sync commit):
+            # release the scheduler's cached result, then re-arm the
+            # pipeline — the successor round may already hold commit quorum
+            # and no further packet will re-trigger it
+            if result is not None:
+                self.scheduler.drop_executed(result.header)
+            self._try_advance(self.ledger.current_number() + 1)
+            return
         if result is None:
+            # genuine execution failure with a live round: do NOT self-
+            # retrigger (a deterministic failure would spin the lane);
+            # the next packet or commit for this height retries, exactly
+            # like the old synchronous path
             LOG.error(badge("PBFT", "execute-failed", number=number))
             return
         cache.executed = True
         cache.executed_hash = result.header.hash(self.suite)
         cache.executed_header = result.header
-        if self.index < 0:
-            return  # voted out: executed for local progress, no seal
-        # the checkpoint seal IS the commit seal for signature_list
-        seal = self.suite.sign(self.keypair, cache.executed_hash)
-        cache.checkpoints[self.index] = seal
-        ck = self._signed(make_packet(PacketType.CHECKPOINT, self.view,
-                                      number, self.index,
-                                      cache.executed_hash, seal))
-        cache.checkpoint_msgs[self.index] = ck
-        self.front.broadcast(ModuleID.PBFT, ck.encode())
+        if self.index >= 0:
+            # the checkpoint seal IS the commit seal for signature_list
+            seal = self.suite.sign(self.keypair, cache.executed_hash)
+            cache.checkpoints[self.index] = seal
+            ck = self._signed(make_packet(PacketType.CHECKPOINT, self.view,
+                                          number, self.index,
+                                          cache.executed_hash, seal))
+            cache.checkpoint_msgs[self.index] = ck
+            self.front.broadcast(ModuleID.PBFT, ck.encode())
         metric("pbft.executed", number=number,
                ehash=cache.executed_hash[:8].hex())
+        self._try_advance(number)
 
     def _try_commit_ledger(self, number: int, cache: _ProposalCache) -> None:
         if len(cache.checkpoints) < self.quorum or cache.committed_phase:

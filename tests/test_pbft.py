@@ -36,7 +36,7 @@ def make_tx(suite, kp, nonce, name=b"acct", amount=10):
                        nonce=nonce, block_limit=100).sign(suite, kp)
 
 
-def build_cluster(n=4, view_timeout=2.0):
+def build_cluster(n=4, view_timeout=2.0, tx_count_limit=1000):
     suite = make_suite(backend="host")
     gateway = FakeGateway()
     keypairs = [suite.generate_keypair(bytes([i + 1]) * 16) for i in range(n)]
@@ -44,7 +44,8 @@ def build_cluster(n=4, view_timeout=2.0):
     nodes = []
     for kp in keypairs:
         node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
-                               min_seal_time=0.0, view_timeout=view_timeout),
+                               min_seal_time=0.0, view_timeout=view_timeout,
+                               tx_count_limit=tx_count_limit),
                     keypair=kp, gateway=gateway)
         node.build_genesis(sealers)
         nodes.append(node)
@@ -392,5 +393,59 @@ def test_four_node_sm_crypto_consensus(tmp_path):
         assert h.txs_root == want
         rc = nodes[2].ledger.receipt(tx.hash(suite))
         assert rc is not None and rc.status == 0
+    finally:
+        stop_cluster(gateway, nodes)
+
+
+def test_verify_overlaps_execute():
+    """SURVEY §5 double-buffered staging: while height N executes on the
+    execution lane, the engine worker keeps processing consensus packets —
+    in particular the PRE-PREPARE of N+1, whose proposal verification (the
+    device batch recover on TPU deployments) then runs CONCURRENTLY with
+    N's execution instead of waiting behind it."""
+    suite, gateway, nodes, _ = build_cluster(4, tx_count_limit=20)
+    try:
+        kp = suite.generate_keypair(b"overlap-user")
+        # slow down execution on node 0 so the overlap window is visible
+        exec_spans = []
+        verify_times = []
+        orig_exec = nodes[0].scheduler.execute_block
+
+        def slow_exec(block, *a, **kw):
+            t0 = time.monotonic()
+            time.sleep(0.4)
+            r = orig_exec(block, *a, **kw)
+            exec_spans.append((t0, time.monotonic(), block.header.number))
+            return r
+
+        nodes[0].scheduler.execute_block = slow_exec
+        orig_verify = nodes[0].txpool.verify_proposal
+
+        def timed_verify(block):
+            ok = orig_verify(block)
+            verify_times.append((time.monotonic(), block.header.number))
+            return ok
+
+        nodes[0].txpool.verify_proposal = timed_verify
+
+        # 40 txs against a 20-tx block limit: at least two heights are in
+        # flight back to back regardless of gossip/seal timing
+        txs = [make_tx(suite, kp, nonce=f"ov-{i}", name=b"ov%d" % i)
+               for i in range(40)]
+        nodes[0].txpool.submit_batch(txs[:20])
+        nodes[1].txpool.submit_batch(txs[20:])
+        assert wait_until(
+            lambda: all(n.ledger.total_tx_count() >= 40 for n in nodes),
+            timeout=30), [n.ledger.total_tx_count() for n in nodes]
+
+        # node 0 verified a LATER height's proposal before an EARLIER
+        # height finished executing — verification is not serialised
+        # behind the execution lane (it either overlaps the span or, with
+        # eager pipelining, completes before execution even starts)
+        overlapped = any(
+            vt < t1 and vn > en
+            for (_t0, t1, en) in exec_spans
+            for (vt, vn) in verify_times)
+        assert overlapped, (exec_spans, verify_times)
     finally:
         stop_cluster(gateway, nodes)
