@@ -97,6 +97,11 @@ class TxEnv:
     gas_limit: int
     chain_id: int = 1
     coinbase: bytes = b"\x00" * 20
+    # active compatibility_version for this block, snapshotted from the
+    # block-START state by the executor (next-block governance semantics:
+    # a raise committed as tx i of block N must not flip gated behavior
+    # for tx i+1 of the SAME block). None = read from live state.
+    compat_version: Optional[tuple] = None
 
 
 # ---------------------------------------------------------------------------
@@ -628,6 +633,30 @@ class EVM:
         return EVMResult(True, output=b"", gas_left=res.gas_left - code_gas,
                          logs=res.logs, create_address=new_addr)
 
+    def _compat_version(self, state, env) -> tuple:
+        """Active on-chain compatibility_version for the executing block.
+        The block pipeline snapshots it from block-START state into
+        env.compat_version (TransactionExecutor), giving exact next-block
+        governance semantics; direct execute_message callers without a
+        snapshot fall back to the live state read."""
+        if env.compat_version is not None:
+            return env.compat_version
+        return self.read_compat_version(state)
+
+    @staticmethod
+    def read_compat_version(state) -> tuple:
+        from ..codec.wire import Reader
+        from ..ledger import ledger as ledger_mod
+
+        raw = state.get(ledger_mod.SYS_CONFIG,
+                        ledger_mod.SYSTEM_KEY_COMPATIBILITY_VERSION.encode())
+        if not raw:
+            return (0, 0, 0)  # pre-versioning chain: oldest semantics
+        try:
+            return ledger_mod.parse_version(Reader(raw).text())
+        except Exception:
+            return (0, 0, 0)
+
     # -- classic precompiles (addresses 1..9) + framework system contracts -
     def _precompile(self, state, env, to: bytes, data: bytes, gas: int
                     ) -> Optional[EVMResult]:
@@ -708,16 +737,29 @@ class EVM:
                     return EVMResult(False, gas_left=0,
                                      error=f"bn128: {exc}")
                 return EVMResult(True, output=out, gas_left=gas - cost)
-            if which == 8:  # bn128 pairing: NOT implemented (deviations
-                # list) — vacuous empty-input check answered, anything
-                # else fails loudly instead of lying
-                if gas < pcc.G_PAIRING_BASE:
+            if which == 8:  # bn128 pairing check (EIP-197, EIP-1108 gas),
+                # gated on compatibility_version >= 1.1.0 — the chain
+                # enables it fleet-wide at a governed height
+                # (LedgerTypeDef.h:42 rolling-upgrade semantics)
+                cost = (pcc.G_PAIRING_BASE
+                        + pcc.G_PAIRING_PER_PAIR * (len(data) // 192))
+                if gas < cost:
                     return EVMResult(False, gas_left=0, error="oog")
-                if len(data) == 0:
-                    return EVMResult(True, output=(1).to_bytes(32, "big"),
-                                     gas_left=gas - pcc.G_PAIRING_BASE)
-                return EVMResult(False, gas_left=0,
-                                 error="bn128 pairing unsupported")
+                if self._compat_version(state, env) < (1, 1, 0):
+                    if len(data) == 0:  # pre-1.1 behavior preserved
+                        return EVMResult(
+                            True, output=(1).to_bytes(32, "big"),
+                            gas_left=gas - pcc.G_PAIRING_BASE)
+                    return EVMResult(
+                        False, gas_left=0,
+                        error="bn128 pairing needs compatibility_version"
+                              " >= 1.1.0")
+                try:
+                    out = pcc.bn128_pairing(data)
+                except pcc.PrecompileInputError as exc:
+                    return EVMResult(False, gas_left=0,
+                                     error=f"bn128 pairing: {exc}")
+                return EVMResult(True, output=out, gas_left=gas - cost)
             if which == 9:  # blake2f (EIP-152)
                 try:  # gas gate BEFORE any compression work (DoS guard)
                     cost = pcc.blake2f_cost(data)

@@ -112,11 +112,22 @@ class TransactionExecutor:
         # parallel-annotation cache: address -> (abi bytes, {sel: nparams})
         self._parallel_cache: dict[bytes, tuple[bytes, dict[bytes, int]]] = {}
         self._dag_pool: Optional[tuple] = None  # cached wave thread pool
+        # block-start compatibility_version snapshot (block_number, version):
+        # taken BEFORE any tx of the block executes so a same-block
+        # governance raise activates next block, not mid-block
+        self._compat_snapshot: Optional[tuple[int, tuple]] = None
 
     # -- single transaction ------------------------------------------------
     def execute_transaction(self, tx: Transaction, state: StateStorage,
                             block_number: int, timestamp: int,
                             gas_limit: int = 3_000_000_000) -> Receipt:
+        if self._compat_snapshot is None or \
+                self._compat_snapshot[0] != block_number:
+            # first touch of this block outside the DAG path (serial /
+            # read-only call): the state is still block-start clean here
+            from .evm import EVM as _EVM
+            self._compat_snapshot = (block_number,
+                                     _EVM.read_compat_version(state))
         sender = tx.sender(self.suite) or b""
         sp = state.savepoint()
         try:
@@ -195,8 +206,11 @@ class TransactionExecutor:
     def _env(self, sender: bytes, block_number: int, timestamp: int,
              gas_limit: int):
         from .evm import TxEnv
+        snap = self._compat_snapshot
         return TxEnv(origin=sender, gas_price=0, block_number=block_number,
-                     timestamp=timestamp, gas_limit=gas_limit)
+                     timestamp=timestamp, gas_limit=gas_limit,
+                     compat_version=(snap[1] if snap and snap[0] == block_number
+                                     else None))
 
     def _execute_create(self, tx, state, sender, block_number, timestamp,
                         gas_limit) -> Receipt:
@@ -485,6 +499,11 @@ class TransactionExecutor:
         multiple cores; workers=1 (or single-tx waves) keeps the serial
         fast path."""
         t0 = time.monotonic()
+        # snapshot the feature-gate version from block-START state, before
+        # any tx (possibly a governance raise) dirties the overlay
+        from .evm import EVM as _EVM
+        self._compat_snapshot = (block_number,
+                                 _EVM.read_compat_version(state))
         waves = self.plan_dag(txs, state)
         if workers is None:
             try:  # ops knob (e.g. pin to 1 on oversubscribed hosts);

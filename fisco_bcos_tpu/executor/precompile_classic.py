@@ -165,3 +165,42 @@ def blake2f(data: bytes) -> tuple[bytes, int]:
     out = b"".join(((h[i] ^ v[i] ^ v[i + 8]) & _M64).to_bytes(8, "little")
                    for i in range(8))
     return out, rounds
+
+
+# -- alt_bn128 pairing check (EIP-197, Istanbul gas per EIP-1108) -----------
+
+G_PAIRING_PER_PAIR = 34000
+
+
+def bn128_pairing(data: bytes) -> bytes:
+    """EIP-197 pairing product check: k*192-byte input of (G1, G2) pairs ->
+    32-byte 1 (product of pairings is the identity) or 0.
+
+    G2 Fp2 elements arrive imaginary-limb first ((c1, c0) for c0 + c1*u),
+    the go-ethereum convention the whole ecosystem shares. Points must be
+    on-curve with coordinates < p; G2 points must additionally lie in the
+    r-torsion subgroup. (0,0) encodes infinity. Malformed input raises
+    PrecompileInputError (call fails, all gas consumed)."""
+    from ..crypto import bn254
+
+    if len(data) % 192 != 0:
+        raise PrecompileInputError("bn128 pairing input not k*192 bytes")
+    pairs = []
+    for off in range(0, len(data), 192):
+        w = _words(data[off:off + 192], 6)
+        x1, y1, xi_, xr, yi, yr = w
+        if any(v >= BN_P for v in w):
+            raise PrecompileInputError("bn128 coordinate >= p")
+        g1 = None if (x1 == 0 and y1 == 0) else (x1, y1)
+        if not bn254.g1_on_curve(g1):
+            raise PrecompileInputError("bn128 G1 point not on curve")
+        x2 = (xr, xi_)
+        y2 = (yr, yi)
+        g2 = None if x2 == (0, 0) and y2 == (0, 0) else (x2, y2)
+        if not bn254.g2_on_curve(g2):
+            raise PrecompileInputError("bn128 G2 point not on twist curve")
+        if g2 is not None and not bn254.g2_in_subgroup(g2):
+            raise PrecompileInputError("bn128 G2 point not in subgroup")
+        pairs.append((g1, g2))
+    ok = bn254.pairing_check(pairs)
+    return (1 if ok else 0).to_bytes(32, "big")

@@ -249,6 +249,7 @@ _GOVERNED_KEYS = {
     ledger_mod.SYSTEM_KEY_TX_COUNT_LIMIT,
     ledger_mod.SYSTEM_KEY_LEADER_PERIOD,
     ledger_mod.SYSTEM_KEY_GAS_LIMIT,
+    ledger_mod.SYSTEM_KEY_COMPATIBILITY_VERSION,
 }
 
 
@@ -262,12 +263,28 @@ class SystemConfigPrecompile(Precompile):
         key, value = r.text(), r.text()
         if key not in _GOVERNED_KEYS:
             raise PrecompileError(f"unknown system key {key}")
-        try:
-            iv = int(value)
-        except ValueError:
-            raise PrecompileError("system config value must be integer")
-        if key == ledger_mod.SYSTEM_KEY_TX_COUNT_LIMIT and iv < 1:
-            raise PrecompileError("tx_count_limit must be >= 1")
+        if key == ledger_mod.SYSTEM_KEY_COMPATIBILITY_VERSION:
+            # rolling upgrade governance (SystemConfigPrecompiled.cpp's
+            # checkVersion): X.Y.Z form, never a downgrade — a node fleet
+            # that partially understood a feature must not flap back
+            try:
+                new = ledger_mod.parse_version(value)
+            except ValueError as exc:
+                raise PrecompileError(f"bad compatibility_version: {exc}")
+            cur = ctx.state.get(ledger_mod.SYS_CONFIG, key.encode())
+            if cur is not None:
+                cv = ledger_mod.parse_version(Reader(cur).text())
+                if new < cv:
+                    raise PrecompileError(
+                        f"compatibility_version downgrade "
+                        f"{cv} -> {new} refused")
+        else:
+            try:
+                iv = int(value)
+            except ValueError:
+                raise PrecompileError("system config value must be integer")
+            if key == ledger_mod.SYSTEM_KEY_TX_COUNT_LIMIT and iv < 1:
+                raise PrecompileError("tx_count_limit must be >= 1")
         self.touch(ctx, b"s_config/" + key.encode())
         wv = Writer()
         wv.text(value).i64(ctx.block_number + 1)  # enables next block

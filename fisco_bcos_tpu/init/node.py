@@ -57,6 +57,9 @@ class NodeConfig:
     # the ICI analogue of txpool.verify_worker_num (NodeConfig.cpp:486)
     crypto_mesh_devices: int = 0
     leader_period: int = 1  # consensus_leader_period (NodeConfig.cpp:568)
+    # genesis feature-gate version (GenesisConfig.h:68); governance can
+    # raise it on-chain later (SystemConfig precompile), never lower it
+    compatibility_version: str = "1.1.0"
     view_timeout: float = 3.0
     # proposal pipeline depth (PBFTConfig.cpp:189-215 water size): consensus
     # runs this many heights ahead of the committed block while execution
@@ -137,8 +140,9 @@ class Node:
     # -- genesis -----------------------------------------------------------
     def build_genesis(self, sealers: Optional[list[ConsensusNode]] = None) -> None:
         sealers = sealers or [ConsensusNode(self.keypair.pub_bytes)]
-        self.ledger.build_genesis(sealers,
-                                  tx_count_limit=self.config.tx_count_limit)
+        self.ledger.build_genesis(
+            sealers, tx_count_limit=self.config.tx_count_limit,
+            compatibility_version=self.config.compatibility_version)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

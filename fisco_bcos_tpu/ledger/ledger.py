@@ -49,10 +49,29 @@ K_TOTAL_FAILED = b"total_failed_transaction_count"
 
 GENESIS_EXTRA = b"bcos-tpu genesis"
 
-# on-chain mutable system config keys (LedgerTypeDef.h:39-40)
+# on-chain mutable system config keys (LedgerTypeDef.h:39-42)
 SYSTEM_KEY_TX_COUNT_LIMIT = "tx_count_limit"
 SYSTEM_KEY_LEADER_PERIOD = "consensus_leader_period"
 SYSTEM_KEY_GAS_LIMIT = "tx_gas_limit"
+# feature-gating chain version (LedgerTypeDef.h:42 SYSTEM_KEY_COMPATIBILITY_
+# VERSION): every node switches gated behavior at the same height because
+# the value is on-chain state with next-block enablement — the rolling-
+# upgrade mechanism (upgrade binaries first, then raise the version by
+# governance vote once the whole fleet understands it)
+SYSTEM_KEY_COMPATIBILITY_VERSION = "compatibility_version"
+DEFAULT_COMPATIBILITY_VERSION = "1.1.0"
+
+
+def parse_version(s: str) -> tuple[int, int, int]:
+    """'X.Y.Z' -> (X, Y, Z); raises ValueError on anything else."""
+    parts = s.strip().split(".")
+    if len(parts) != 3 or not all(p.isdigit() for p in parts):
+        # strict digit check: bare int() accepts '1_1' and '+1', which a
+        # governance fat-finger would then store on-chain irreversibly
+        # (downgrades are refused)
+        raise ValueError(f"not a X.Y.Z version: {s!r}")
+    x, y, z = (int(p) for p in parts)
+    return (x, y, z)
 
 
 def _be8(n: int) -> bytes:
@@ -80,6 +99,7 @@ class LedgerConfig:
     block_tx_count_limit: int = 1000
     leader_switch_period: int = 1
     gas_limit: int = 3_000_000_000
+    compatibility_version: tuple[int, int, int] = (1, 1, 0)
 
 
 class Ledger:
@@ -92,6 +112,7 @@ class Ledger:
                       tx_count_limit: int = 1000,
                       leader_period: int = 1,
                       gas_limit: int = 3_000_000_000,
+                      compatibility_version: str = DEFAULT_COMPATIBILITY_VERSION,
                       extra: bytes = GENESIS_EXTRA) -> BlockHeader:
         """Idempotent genesis bootstrap (LedgerInitializer's buildGenesisBlock)."""
         existing = self.header_by_number(0)
@@ -109,6 +130,9 @@ class Ledger:
         self._set_config_direct(SYSTEM_KEY_TX_COUNT_LIMIT, str(tx_count_limit), 0)
         self._set_config_direct(SYSTEM_KEY_LEADER_PERIOD, str(leader_period), 0)
         self._set_config_direct(SYSTEM_KEY_GAS_LIMIT, str(gas_limit), 0)
+        parse_version(compatibility_version)  # refuse a malformed genesis
+        self._set_config_direct(SYSTEM_KEY_COMPATIBILITY_VERSION,
+                                compatibility_version, 0)
         for node in sealers:
             self._set_consensus_direct(node)
         LOG.info(badge("LEDGER", "genesis", hash=header.hash(self.suite).hex()))
@@ -291,4 +315,10 @@ class Ledger:
         v = self.system_config(SYSTEM_KEY_GAS_LIMIT)
         if v:
             cfg.gas_limit = int(v[0])
+        v = self.system_config(SYSTEM_KEY_COMPATIBILITY_VERSION)
+        if v:
+            try:
+                cfg.compatibility_version = parse_version(v[0])
+            except ValueError:
+                pass  # pre-versioning chain: keep the default
         return cfg

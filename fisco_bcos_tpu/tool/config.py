@@ -36,12 +36,16 @@ class ChainConfig:
     consensus_type: str = "pbft"
     block_tx_count_limit: int = 1000
     leader_period: int = 1
+    # GenesisConfig.h:68 m_compatibilityVersion: the chain's feature-gate
+    # version (raisable later via SystemConfig governance, never lowered)
+    compatibility_version: str = "1.1.0"
     sealers: list[bytes] = dataclasses.field(default_factory=list)
 
     def to_ini(self) -> str:
         cp = configparser.ConfigParser()
         cp["chain"] = {"chain_id": self.chain_id, "group_id": self.group_id,
                        "sm_crypto": str(self.sm_crypto).lower()}
+        cp["chain"]["compatibility_version"] = self.compatibility_version
         cp["consensus"] = {
             "consensus_type": self.consensus_type,
             "block_tx_count_limit": str(self.block_tx_count_limit),
@@ -75,6 +79,8 @@ class ChainConfig:
                                            "block_tx_count_limit",
                                            fallback=1000),
             leader_period=cp.getint("consensus", "leader_period", fallback=1),
+            compatibility_version=cp.get("chain", "compatibility_version",
+                                         fallback="1.1.0"),
             sealers=sealers,
         )
 
@@ -222,6 +228,7 @@ def _load_node_parts(node_dir: str,
     kp = suite.keypair_from_secret(int.from_bytes(key_bytes, "big"))
     cfg.tx_count_limit = chain.block_tx_count_limit
     cfg.leader_period = chain.leader_period
+    cfg.compatibility_version = chain.compatibility_version
     return cfg, chain, suite, kp
 
 

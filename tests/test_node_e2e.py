@@ -243,3 +243,57 @@ def test_wal_backed_node_restart(tmp_path):
     hdr = n2.ledger.header_by_number(committed)
     assert hdr is not None
     n2.storage.close()
+
+
+def test_compat_version_raise_not_active_same_block(node):
+    """Next-block governance semantics: a compatibility_version raise and a
+    gated-feature call landing in the SAME block must execute against the
+    block-START version — the raise activates one block later (the
+    executor's block-start snapshot; LedgerTypeDef.h:42 semantics)."""
+    suite = node.suite
+    # chain born at 1.0.0 would be ideal, but the fixture chain is 1.1.0;
+    # build a dedicated node at 1.0.0
+    n = Node(NodeConfig(crypto_backend="host", min_seal_time=0.2,
+                        compatibility_version="1.0.0"))
+    n.start()
+    try:
+        suite = n.suite
+        kp = suite.generate_keypair(b"sameblock")
+        runtime = bytes.fromhex("3660006000376020600036600060006008"
+                                "5af16020526040" "6000f3")
+        init = bytes.fromhex("601b600c600039601b6000f3") + runtime
+        tx = make_tx(suite, kp, b"", init, nonce="d1")
+        r = n.send_transaction(tx)
+        rc = n.txpool.wait_for_receipt(r.tx_hash, 15)
+        assert rc is not None and rc.status == 0
+        proxy = rc.contract_address
+
+        g2 = (
+            10857046999023057135944570762232829481370756359578518086990519993285655852781,
+            11559732032986387107991004021392285783925812861821192530917403151452391805634,
+            8495653923123431417604973247489272438418190587263600148770280649306958101930,
+            4082367875863433681332203403145435568316851327593401208105741076214120093531)
+        pair_input = b"".join(v.to_bytes(32, "big") for v in
+                              (0, 0, g2[1], g2[0], g2[3], g2[2]))
+        raise_tx = make_tx(
+            suite, kp, pc.SYS_CONFIG_ADDRESS,
+            pc.encode_call("setValueByKey",
+                           lambda w: w.text("compatibility_version")
+                           .text("1.1.0")), nonce="g1")
+        call_tx = make_tx(suite, kp, proxy, pair_input, nonce="c1")
+        results = n.txpool.submit_batch([raise_tx, call_tx])
+        assert all(int(x.status) == 0 for x in results)
+        rc_raise = n.txpool.wait_for_receipt(raise_tx.hash(suite), 15)
+        rc_call = n.txpool.wait_for_receipt(call_tx.hash(suite), 15)
+        assert rc_raise.status == 0
+        if rc_raise.block_number == rc_call.block_number:
+            # same block: the call ran under 1.0.0 — inner CALL failed
+            assert int.from_bytes(rc_call.output[32:64], "big") == 0
+        # one block later the feature is live everywhere
+        call2 = make_tx(suite, kp, proxy, pair_input, nonce="c2")
+        r2 = n.send_transaction(call2)
+        rc2 = n.txpool.wait_for_receipt(r2.tx_hash, 15)
+        assert rc2.status == 0
+        assert int.from_bytes(rc2.output[32:64], "big") == 1
+    finally:
+        n.stop()

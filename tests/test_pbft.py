@@ -449,3 +449,105 @@ def test_verify_overlaps_execute():
         assert overlapped, (exec_spans, verify_times)
     finally:
         stop_cluster(gateway, nodes)
+
+
+def test_compatibility_version_rolling_upgrade():
+    """LedgerTypeDef.h:42 rolling-upgrade governance: a chain at genesis
+    version 1.0.0 refuses the bn128 pairing precompile; a governance vote
+    raises compatibility_version to 1.1.0 on-chain, and the behavior
+    switches at the SAME height on all four nodes (on-chain state, not
+    node-local config). Downgrades are refused."""
+    from fisco_bcos_tpu.executor import precompiled as pcm
+
+    suite = make_suite(backend="host")
+    gateway = FakeGateway()
+    keypairs = [suite.generate_keypair(bytes([i + 1]) * 16) for i in range(4)]
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    nodes = []
+    for kp in keypairs:
+        node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                               min_seal_time=0.0,
+                               compatibility_version="1.0.0"),
+                    keypair=kp, gateway=gateway)
+        node.build_genesis(sealers)
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    try:
+        kp = suite.generate_keypair(b"upgrade-user")
+
+        def submit(to, data, nonce):
+            tx = Transaction(to=to, input=data, nonce=nonce,
+                             block_limit=100).sign(suite, kp)
+            res = nodes[0].send_transaction(tx)
+            assert res.status == TransactionStatus.OK
+            rc = nodes[0].txpool.wait_for_receipt(res.tx_hash, 30)
+            assert rc is not None
+            return rc
+
+        # deploy a proxy whose runtime CALLs precompile 8 with its own
+        # calldata and returns output(32) || call-success(32)
+        runtime = bytes.fromhex(
+            "3660006000376020600036600060006008"  # calldatacopy + call args
+            "5af16020526040"                      # GAS CALL; mem[32]=ok
+            "6000f3")                             # return mem[0:64]
+        init = bytes.fromhex("601b600c600039601b6000f3") + runtime
+        assert len(runtime) == 0x1b
+        rc = submit(b"", init, "deploy-proxy")
+        assert rc.status == 0 and rc.contract_address
+        proxy = rc.contract_address
+
+        # one-pair input with G1 = infinity: pairing product is vacuously
+        # 1 — cheap, but still exercises parsing + the version gate
+        g2 = (
+            10857046999023057135944570762232829481370756359578518086990519993285655852781,
+            11559732032986387107991004021392285783925812861821192530917403151452391805634,
+            8495653923123431417604973247489272438418190587263600148770280649306958101930,
+            4082367875863433681332203403145435568316851327593401208105741076214120093531)
+        # EIP-197 order: x, y, then G2 imag-first
+        pair_input = b"".join(v.to_bytes(32, "big")
+                              for v in (0, 0, g2[1], g2[0], g2[3], g2[2]))
+
+        # 1.0.0: the inner CALL to address 8 must FAIL (success word 0)
+        rc = submit(proxy, pair_input, "pre-upgrade-call")
+        assert rc.status == 0
+        assert int.from_bytes(rc.output[32:64], "big") == 0
+
+        # governance: raise the chain version
+        rc = submit(pcm.SYS_CONFIG_ADDRESS,
+                    pcm.encode_call("setValueByKey",
+                                    lambda w: w.text("compatibility_version")
+                                    .text("1.1.0")),
+                    "raise-version")
+        assert rc.status == 0
+        upgrade_height = nodes[0].ledger.current_number()
+
+        # downgrade attempts are refused on-chain
+        rc = submit(pcm.SYS_CONFIG_ADDRESS,
+                    pcm.encode_call("setValueByKey",
+                                    lambda w: w.text("compatibility_version")
+                                    .text("1.0.0")),
+                    "downgrade-refused")
+        assert rc.status != 0
+
+        # post-upgrade: the same call now succeeds (success word 1, result
+        # word 1), committed identically by all four nodes
+        rc = submit(proxy, pair_input, "post-upgrade-call")
+        assert rc.status == 0
+        assert int.from_bytes(rc.output[32:64], "big") == 1
+        assert int.from_bytes(rc.output[0:32], "big") == 1
+
+        assert wait_until(lambda: all(
+            n.ledger.current_number() >= upgrade_height + 2 for n in nodes))
+        for n in nodes:
+            # every node reads the same on-chain version and committed the
+            # identical post-upgrade receipt
+            v = n.ledger.ledger_config().compatibility_version
+            assert v == (1, 1, 0), v
+        hashes = nodes[0].ledger.tx_hashes_by_number(
+            nodes[0].ledger.current_number())
+        if hashes:
+            receipts = [n.ledger.receipt(hashes[0]) for n in nodes]
+            assert len({r.hash(suite) for r in receipts if r}) <= 1
+    finally:
+        stop_cluster(gateway, nodes)

@@ -102,9 +102,18 @@ def addr(n: int) -> bytes:
     return n.to_bytes(20, "big")
 
 
-def call_pre(which: int, data: bytes, gas: int = 100_000):
-    evm = EVM(SUITE, native=False)
+def call_pre(which: int, data: bytes, gas: int = 100_000,
+             native: bool = False, version: str | None = None):
+    evm = EVM(SUITE, native=native)
     st = StateStorage(MemoryStorage())
+    if version is not None:
+        from fisco_bcos_tpu.codec.wire import Writer
+        from fisco_bcos_tpu.ledger import ledger as ledger_mod
+        w = Writer()
+        w.text(version).i64(0)
+        st.set(ledger_mod.SYS_CONFIG,
+               ledger_mod.SYSTEM_KEY_COMPATIBILITY_VERSION.encode(),
+               w.bytes())
     return evm.execute_message(st, ENV, b"\x22" * 20, addr(which), 0,
                                data, gas)
 
@@ -126,12 +135,119 @@ def test_evm_dispatch_and_gas():
     assert not res.success and res.gas_left == 0
 
 
-def test_pairing_policy():
-    res = call_pre(8, b"")
-    assert res.success
-    assert int.from_bytes(res.output, "big") == 1
-    res = call_pre(8, b"\x00" * 192)
-    assert not res.success and "pairing" in res.error
+def test_pairing_gated_below_1_1_0():
+    """Pre-1.1 chains keep round-4 semantics: vacuous empty-input true,
+    real input refused loudly (the compatibility_version gate)."""
+    for version in (None, "1.0.0"):
+        res = call_pre(8, b"", version=version)
+        assert res.success
+        assert int.from_bytes(res.output, "big") == 1
+        res = call_pre(8, bytes(192), version=version)
+        assert not res.success and "compatibility_version" in res.error
+
+
+def _pairing_gas(n_pairs: int) -> int:
+    return pcc.G_PAIRING_BASE + pcc.G_PAIRING_PER_PAIR * n_pairs
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_pairing_canonical_vectors(native):
+    """The public go-ethereum bn256 pairing corpus (as carried by the
+    reference, EVMPrecompiledTest.cpp:1241) through BOTH interpreters at
+    compatibility_version 1.1.0 — positive, negative and 10-pair cases."""
+    from tests.data_bn256_pairing import PAIRING_VECTORS
+
+    gas = 2_000_000
+    for name, inp, exp in PAIRING_VECTORS[:4] + PAIRING_VECTORS[-3:]:
+        data = bytes.fromhex(inp)
+        res = call_pre(8, data, gas=gas, native=native, version="1.1.0")
+        assert res.success, (name, res.error)
+        assert res.output.hex() == exp, name
+        assert res.gas_left == gas - _pairing_gas(len(data) // 192), name
+
+
+def test_pairing_empty_and_malformed_at_1_1_0():
+    res = call_pre(8, b"", version="1.1.0")
+    assert res.success and int.from_bytes(res.output, "big") == 1
+    # not a multiple of 192 -> failure consuming all gas
+    res = call_pre(8, bytes(191), version="1.1.0")
+    assert not res.success and res.gas_left == 0
+    # on-curve but out-of-subgroup G2 point must be rejected (EIP-197)
+    from fisco_bcos_tpu.crypto import bn254
+
+    def f2_sqrt(a):
+        """Complex-method sqrt in Fp2 (p = 3 mod 4); None if non-residue."""
+        c0, c1 = a
+        p = bn254.P
+        if c1 == 0:
+            y = pow(c0, (p + 1) // 4, p)
+            return (y, 0) if y * y % p == c0 else None
+        norm = (c0 * c0 + c1 * c1) % p
+        lam = pow(norm, (p + 1) // 4, p)
+        if lam * lam % p != norm:
+            return None
+        for l in (lam, (-lam) % p):
+            delta = (c0 + l) * pow(2, p - 2, p) % p
+            x0 = pow(delta, (p + 1) // 4, p)
+            if x0 * x0 % p == delta and x0:
+                x1 = c1 * pow(2 * x0, p - 2, p) % p
+                cand = (x0, x1)
+                if bn254.f2_sqr(cand) == a:
+                    return cand
+        return None
+
+    q = None
+    for xi in range(1, 200):
+        x = (xi, xi + 1)
+        rhs = bn254.f2_add(bn254.f2_mul(bn254.f2_sqr(x), x), bn254.TWIST_B)
+        y = f2_sqrt(rhs)
+        if y is None:
+            continue
+        cand = (x, y)
+        assert bn254.g2_on_curve(cand)
+        if not bn254.g2_in_subgroup(cand):
+            q = cand
+            break
+    assert q is not None, "no out-of-subgroup twist point found in range"
+    g1 = (1, 2)
+    data = w32(*g1, q[0][1], q[0][0], q[1][1], q[1][0])
+    res = call_pre(8, data, version="1.1.0", gas=500_000)
+    assert not res.success and res.gas_left == 0
+
+
+def test_pairing_bilinearity():
+    """e(aP, bQ) == e(abP, Q): product e(2P,3Q) * e(-6P,Q) == 1, pure
+    algebra independent of the vector corpus."""
+    from fisco_bcos_tpu.crypto import bn254
+
+    P1 = (1, 2)
+    # the canonical G2 generator (EIP-197 / go-ethereum twist generator)
+    G2 = ((10857046999023057135944570762232829481370756359578518086990519993285655852781,
+           11559732032986387107991004021392285783925812861821192530917403151452391805634),
+          (8495653923123431417604973247489272438418190587263600148770280649306958101930,
+           4082367875863433681332203403145435568316851327593401208105741076214120093531))
+    assert bn254.g2_in_subgroup(G2)
+    p2 = pcc._bn_mul(P1, 2)
+    q3 = bn254.g2_mul(G2, 3)
+    p6neg = pcc._bn_mul(P1, pcc.BN_N - 6)
+    assert bn254.pairing_check([(p2, q3), (p6neg, G2)])
+    # and the unbalanced variant must NOT check out
+    assert not bn254.pairing_check([(p2, q3), (p6neg, bn254.g2_mul(G2, 2))])
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_bn128_add_mul_canonical_vectors(native):
+    """go-ethereum bn256 add/mul corpora through both interpreters."""
+    from tests.data_bn256_pairing import ADD_VECTORS, MUL_VECTORS
+
+    for name, inp, exp in ADD_VECTORS[:8]:
+        res = call_pre(6, bytes.fromhex(inp), native=native)
+        assert res.success, name
+        assert res.output.hex() == exp, name
+    for name, inp, exp in MUL_VECTORS[:8]:
+        res = call_pre(7, bytes.fromhex(inp), native=native)
+        assert res.success, name
+        assert res.output.hex() == exp, name
 
 
 def test_blake2f_huge_rounds_gas_gated_fast():

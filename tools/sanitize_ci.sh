@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# One-command sanitizer + differential-fuzz gate for the native engines
+# (VERDICT r4 #8; SURVEY §5 row 34 — the reference's
+# cmake -DSANITIZE_ADDRESS/-DSANITIZE_THREAD CI jobs, cmake/Options.cmake:57).
+#
+#   tools/sanitize_ci.sh            # full gate: ASan+UBSan, TSan, fuzz
+#   tools/sanitize_ci.sh --fast     # skip the @slow deep differential fuzz
+#
+# Exit 0 = every stage clean. Each stage rebuilds the sanitizer variants
+# from the CURRENT sources (the src-hash stamp keeps them honest) and runs
+# the relevant suites with the sanitized libraries injected via the
+# FBTPU_*_LIB loader seams.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+LIBASAN="$(g++ -print-file-name=libasan.so)"
+LIBTSAN="$(g++ -print-file-name=libtsan.so)"
+LIBSTDCPP="$(g++ -print-file-name=libstdc++.so.6)"
+
+echo "== [1/4] ASan+UBSan build (nevm, ncrypto, bcoskv)"
+make -C native SANITIZE=address -j"$(nproc)"
+
+echo "== [2/4] ASan+UBSan: native EVM + EC + storage suites"
+# libstdc++ must ride LD_PRELOAD beside libasan: the EVM's C++ exceptions
+# trip the __cxa_throw interceptor CHECK under dlopen otherwise (runtime
+# artifact, not a library bug)
+ASAN_OPTIONS=detect_leaks=0 \
+  LD_PRELOAD="$LIBASAN $LIBSTDCPP" \
+  FBTPU_NEVM_LIB=native/build/libnevm.asan.so \
+  FBTPU_NCRYPTO_LIB=native/build/libncrypto.asan.so \
+  FBTPU_BCOSKV_LIB=native/build/libbcoskv.asan.so \
+  python -m pytest tests/test_nevm.py tests/test_nativeec.py \
+      tests/test_native_storage.py -q -x
+
+if [ "$FAST" = 0 ]; then
+  echo "== [3/4] ASan+UBSan: deep differential fuzz (Python vs native EVM)"
+  ASAN_OPTIONS=detect_leaks=0 \
+    LD_PRELOAD="$LIBASAN $LIBSTDCPP" \
+    FBTPU_NEVM_LIB=native/build/libnevm.asan.so \
+    python -m pytest tests/test_nevm.py -q -x -m slow
+else
+  echo "== [3/4] SKIPPED (--fast): deep differential fuzz"
+fi
+
+echo "== [4/4] TSan build + native-storage race stress"
+make -C native SANITIZE=thread -j"$(nproc)"
+TSAN_OPTIONS="ignore_noninstrumented_modules=1" \
+  LD_PRELOAD="$LIBTSAN $LIBSTDCPP" \
+  FBTPU_BCOSKV_LIB=native/build/libbcoskv.tsan.so \
+  python -m pytest tests/test_native_storage.py tests/test_race_stress.py \
+      -q -x
+
+echo "sanitize_ci: ALL STAGES CLEAN"
