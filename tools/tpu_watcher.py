@@ -97,6 +97,13 @@ def _run_chain_tps() -> dict | None:
                       "chain_tps_4node", 1800)
 
 
+def _run_fused_check() -> dict | None:
+    """Single-kernel end-to-end verify/recover/SM2 device validation +
+    timing vs the default dispatch (VERDICT r4 #2: the fused-verify
+    default flips only on a measured device win)."""
+    return _run_bench("fused_check.py", [], "fused_check", 1800)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--probe-interval", type=float, default=180.0)
@@ -150,6 +157,9 @@ def main() -> None:
                         tps = _run_chain_tps()
                         if tps:
                             log(f"chain TPS OK: {tps}")
+                        fused = _run_fused_check()
+                        if fused:
+                            log(f"fused check OK: {fused}")
                     else:
                         state["sweeps_failed"] += 1
                         log(f"sweep FAILED rc={r.returncode}:\n{tail}")
