@@ -109,10 +109,12 @@ def test_ws_event_subscription_push(ws_node):
     cli = WsSdkClient("127.0.0.1", node.ws.port)
     pushes = []
     try:
-        # transfer emits a log (BalancePrecompile topics=[b"transfer"])
+        # transfer emits a log (BalancePrecompile topics=[b"transfer"]);
+        # wait on COMMITTED TX COUNT, not height — back-to-back submits may
+        # legitimately batch into one block
         node.send_transaction(_register_tx(node, kp, "we1", b"a", 100))
         node.send_transaction(_register_tx(node, kp, "we2", b"b", 0))
-        assert wait_until(lambda: node.ledger.current_number() >= 2)
+        assert wait_until(lambda: node.ledger.total_tx_count() >= 2)
         tx = Transaction(
             to=pc.BALANCE_ADDRESS,
             input=pc.encode_call("transfer", lambda w: w.blob(b"a")
@@ -120,7 +122,7 @@ def test_ws_event_subscription_push(ws_node):
             nonce="we3", block_limit=node.ledger.current_number() + 100,
         ).sign(node.suite, kp)
         node.send_transaction(tx)
-        assert wait_until(lambda: node.ledger.current_number() >= 3)
+        assert wait_until(lambda: node.ledger.total_tx_count() >= 3)
 
         # subscribe from block 0: the historical transfer must be replayed
         task = cli.subscribe_event({"fromBlock": 0}, pushes.append)
