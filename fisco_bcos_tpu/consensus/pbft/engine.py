@@ -359,6 +359,8 @@ class PBFTEngine(Worker):
                      if 0 <= m.from_idx < self.n and m.from_idx != self.index]
         if not valid_idx:
             return []
+        from ...protocol.types import prefill_hashes
+        prefill_hashes(valid_idx, lambda m: m.encode_core(), self.suite)
         digests = [m.hash(self.suite) for m in valid_idx]
         sigs = [m.signature for m in valid_idx]
         pubs = [self.nodes[m.from_idx] for m in valid_idx]

@@ -42,6 +42,20 @@ def _load() -> dict:
                 _fns["sm3"] = lib.nevm_sm3
             except AttributeError:  # library build without the exports
                 _fns.clear()
+            try:  # batch exports bind separately: an older library that
+                # lacks them must KEEP the native singles (host_hash_batch
+                # falls back to a per-message loop on its own)
+                u64p = ctypes.POINTER(ctypes.c_uint64)
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                for name in ("nevm_keccak256_batch", "nevm_sm3_batch"):
+                    fn = getattr(lib, name)
+                    fn.argtypes = [ctypes.c_char_p, u64p, ctypes.c_uint64,
+                                   u8p]
+                    fn.restype = None
+                _fns["keccak256_batch"] = lib.nevm_keccak256_batch
+                _fns["sm3_batch"] = lib.nevm_sm3_batch
+            except AttributeError:
+                pass
         _loaded = True
         return _fns
 
@@ -69,6 +83,51 @@ def keccak256() -> Optional[Callable[[bytes], bytes]]:
 def sm3() -> Optional[Callable[[bytes], bytes]]:
     """-> native sm3(data)->digest, or None when unavailable."""
     return _wrap("sm3")
+
+
+def _wrap_batch(name: str) -> Optional[Callable]:
+    fn = _load().get(name)
+    if fn is None:
+        return None
+
+    def h(msgs) -> list[bytes]:
+        n = len(msgs)
+        if n == 0:
+            return []
+        flat = b"".join(bytes(m) if not isinstance(m, bytes) else m
+                        for m in msgs)
+        offs = (ctypes.c_uint64 * (n + 1))()
+        pos = 0
+        for i, m in enumerate(msgs):
+            offs[i] = pos
+            pos += len(m)
+        offs[n] = pos
+        out = (ctypes.c_uint8 * (32 * n))()
+        fn(flat, offs, n, out)
+        raw = bytes(out)
+        return [raw[32 * i:32 * i + 32] for i in range(n)]
+
+    return h
+
+
+def keccak256_batch() -> Optional[Callable]:
+    """-> native batch keccak(msgs)->[digest], one FFI crossing, or None."""
+    return _wrap_batch("keccak256_batch")
+
+
+def sm3_batch() -> Optional[Callable]:
+    return _wrap_batch("sm3_batch")
+
+
+def host_hash_batch(alg: str) -> Callable:
+    """Batched host-path hashing for `alg`: one native call per batch when
+    available, else a per-message loop over host_hash."""
+    fn = (keccak256_batch() if alg == "keccak256" else
+          sm3_batch() if alg == "sm3" else None)
+    if fn is not None:
+        return fn
+    single = host_hash(alg)
+    return lambda msgs: [single(m) for m in msgs]
 
 
 def host_hash(alg: str) -> Callable[[bytes], bytes]:

@@ -118,12 +118,14 @@ class CryptoSuite:
             self.params = refimpl.SECP256K1
             self.hash_name = "keccak256"
             self._host_hash = nativehash.host_hash("keccak256")
+            self._host_hash_batch = nativehash.host_hash_batch("keccak256")
             self.signature_size = 65  # r(32) | s(32) | v(1)
         else:
             self.curve = ec.SM2P256V1
             self.params = refimpl.SM2P256V1
             self.hash_name = "sm3"
             self._host_hash = nativehash.host_hash("sm3")
+            self._host_hash_batch = nativehash.host_hash_batch("sm3")
             self.signature_size = 128  # r(32) | s(32) | pub(64), SignatureDataWithPub.h
 
     # -- identity ----------------------------------------------------------
@@ -135,9 +137,10 @@ class CryptoSuite:
         return self._host_hash(data)
 
     def hash_batch(self, msgs: Sequence[bytes]) -> list[bytes]:
-        """Batched hashing. Device path buckets by padded length."""
+        """Batched hashing. Device path buckets by padded length; host path
+        crosses the FFI once for the whole batch."""
         if not self._use_device(len(msgs)):
-            return [self._host_hash(m) for m in msgs]
+            return self._host_hash_batch(msgs)
         fn = (keccak.keccak256_batch_np if self.kind == "ecdsa"
               else sm3.sm3_batch_np)
         return [bytes(row) for row in fn(list(msgs))]
@@ -359,8 +362,14 @@ class CryptoSuite:
                           ) -> tuple[list[bytes | None], np.ndarray]:
         """Sender addresses for a tx batch (None where sig invalid)."""
         pubs, ok = self.recover_batch(digests, sigs)
-        return [self.address_of_pub(p) if p is not None else None
-                for p in pubs], ok
+        # one hash call for all valid pubs (address = right-160 of H(pub))
+        valid = [i for i, p in enumerate(pubs) if p is not None]
+        out: list[bytes | None] = [None] * len(pubs)
+        if valid:
+            for i, d in zip(valid, self._host_hash_batch(
+                    [pubs[i] for i in valid])):
+                out[i] = d[12:]
+        return out, ok
 
 
 def make_suite(sm_crypto: bool = False, **kw) -> CryptoSuite:

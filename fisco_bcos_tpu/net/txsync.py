@@ -26,12 +26,21 @@ from .front import FrontService
 from .moduleid import ModuleID
 
 
-def _pack_txs(txs: Sequence[Transaction]) -> bytes:
-    return Writer().seq(list(txs), lambda w, t: w.blob(t.encode())).bytes()
+def _pack_txs(txs: Sequence[Transaction], suite) -> bytes:
+    """(hash, encoding) pairs: the hash lets a receiver skip DECODING txs
+    it already holds — flood gossip delivers each tx to each peer several
+    times in a mesh, and the duplicate decodes were measurable at ingest
+    rates. The claimed hash is only ever used to SKIP work for hashes the
+    receiver already has; admission recomputes the real hash, so a lying
+    peer can only skip its own delivery."""
+    return Writer().seq(
+        list(txs),
+        lambda w, t: w.blob(t.hash(suite)).blob(t.encode())).bytes()
 
 
-def _unpack_txs(data: bytes) -> list[Transaction]:
-    return Reader(data).seq(lambda r: Transaction.decode(r.blob()))
+def _unpack_txs(data: bytes) -> list[tuple[bytes, bytes]]:
+    """-> [(claimed_hash, tx_encoding)] — decode deferred to the caller."""
+    return Reader(data).seq(lambda r: (r.blob(), r.blob()))
 
 
 class TransactionSync:
@@ -60,7 +69,7 @@ class TransactionSync:
             key = frozenset(t.hash(self.suite) for t in fresh)
             data = payload_cache.get(key)
             if data is None:
-                data = payload_cache[key] = _pack_txs(fresh)
+                data = payload_cache[key] = _pack_txs(fresh, self.suite)
             self.front.send(ModuleID.TxsSync, peer, data)
 
     # -- missing-tx fetch (proposal verification) --------------------------
@@ -72,9 +81,15 @@ class TransactionSync:
         resp = self.front.request(ModuleID.TxsSync, peer, req, timeout)
         if resp is None:
             return False
-        txs = _unpack_txs(resp)
-        if len(txs) != len(hashes):
+        pairs = _unpack_txs(resp)
+        if len(pairs) != len(hashes):
             return False
+        # pre-validate the response against the request using the claimed
+        # hashes (cheap set compare before any decode); admission below
+        # still recomputes the real hashes
+        if {h for h, _raw in pairs} != set(hashes):
+            return False
+        txs = [Transaction.decode(raw) for _h, raw in pairs]
         results = self.txpool.submit_batch(txs, broadcast=False)
         metric("txsync.fetch_missing", n=len(txs), peer=peer[:8].hex())
         from ..protocol import TransactionStatus
@@ -87,13 +102,19 @@ class TransactionSync:
         if respond is not None:  # fetch request: serve from the pool
             hashes = Reader(payload).seq(lambda r: r.blob())
             txs = self.txpool.fill_block(hashes) or []
-            respond(_pack_txs(txs))
+            respond(_pack_txs(txs, self.suite))
             return
-        txs = _unpack_txs(payload)
-        if not txs:
+        pairs = _unpack_txs(payload)
+        if not pairs:
             return
         with self._lock:
             known = self._known_by_peer.setdefault(src, set())
-            known.update(t.hash(self.suite) for t in txs)
+            known.update(h for h, _raw in pairs)
+        # decode only txs this pool does not already hold (flood gossip
+        # re-delivers most txs through every mesh edge)
+        unknown = self.txpool.unknown_hashes([h for h, _raw in pairs])
+        txs = [Transaction.decode(raw) for h, raw in pairs if h in unknown]
+        if not txs:
+            return
         # one TPU batch-recover for the whole gossip packet
         self.txpool.submit_batch(txs, broadcast=True)

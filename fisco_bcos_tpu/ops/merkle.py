@@ -124,18 +124,30 @@ def _hash_host(data: bytes, alg: str) -> bytes:
     return fn(data)
 
 
+_HOST_HASH_BATCH: dict = {}
+
+
+def _hash_host_batch(msgs: list[bytes], alg: str) -> list[bytes]:
+    fn = _HOST_HASH_BATCH.get(alg)
+    if fn is None:
+        from ..crypto import nativehash
+
+        fn = _HOST_HASH_BATCH[alg] = nativehash.host_hash_batch(alg)
+    return fn(msgs)
+
+
 def merkle_levels_host(leaves: list[bytes], alg: str = "keccak256") -> list[list[bytes]]:
-    """All tree levels, canonical semantics (host loop, device hashing)."""
+    """All tree levels, canonical semantics (host loop, one native hash
+    call per level)."""
     assert leaves
     levels = [list(leaves)]
     while len(levels[-1]) > 1:
         cur = list(levels[-1])
         while len(cur) % WIDTH:
             cur.append(b"\x00" * DIGEST)
-        nxt = []
-        for i in range(0, len(cur), WIDTH):
-            nxt.append(_hash_host(b"".join(cur[i : i + WIDTH]), alg))
-        levels.append(nxt)
+        joined = [b"".join(cur[i: i + WIDTH])
+                  for i in range(0, len(cur), WIDTH)]
+        levels.append(_hash_host_batch(joined, alg))
     return levels
 
 

@@ -328,6 +328,9 @@ class Block:
         return suite.merkle_root(leaves)
 
     def calculate_receipts_root(self, suite) -> bytes:
+        # batch-hash the uncached receipts in one call (one FFI crossing /
+        # one device dispatch instead of per-receipt singles)
+        prefill_hashes(self.receipts, lambda rc: rc.encode(), suite)
         return suite.merkle_root([rc.hash(suite) for rc in self.receipts])
 
 
@@ -335,13 +338,21 @@ class Block:
 # batch identity pipeline (the TPU-native replacement for per-tx verify loops)
 # ---------------------------------------------------------------------------
 
+def prefill_hashes(objs, encode_fn, suite) -> None:
+    """Fill the `_hash` cache of every object lacking one with ONE batched
+    hash call over `encode_fn(obj)` — the shared identity-cache contract
+    for Transaction (encode_unsigned), Receipt (encode) and PBFTMessage
+    (encode_core)."""
+    todo = [o for o in objs if o._hash is None]
+    if todo:
+        for o, d in zip(todo, suite.hash_batch(
+                [encode_fn(o) for o in todo])):
+            o._hash = d
+
+
 def batch_hash(txs: Sequence[Transaction], suite) -> list[bytes]:
     """Hash every tx in one device call; fills each tx's cache."""
-    todo = [i for i, t in enumerate(txs) if t._hash is None]
-    if todo:
-        digests = suite.hash_batch([txs[i].encode_unsigned() for i in todo])
-        for i, d in zip(todo, digests):
-            txs[i]._hash = d
+    prefill_hashes(txs, lambda t: t.encode_unsigned(), suite)
     return [t._hash for t in txs]
 
 

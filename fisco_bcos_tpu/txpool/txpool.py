@@ -256,6 +256,13 @@ class TxPool:
         with self._lock:
             return [h for h in hashes if h not in self._pending]
 
+    def unknown_hashes(self, hashes: Sequence[bytes]) -> set[bytes]:
+        """Subset of `hashes` this node holds NO copy of (not pending and
+        not committed) — the gossip import path's decode filter."""
+        with self._lock:
+            cand = [h for h in hashes if h not in self._pending]
+        return {h for h in cand if self.ledger.receipt(h) is None}
+
     def verify_proposal(self, block: Block) -> bool:
         """Verify a proposal: every tx known (already validated at submit) or,
         if the proposal carries full txs, batch-verify the unknown ones

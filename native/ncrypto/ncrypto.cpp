@@ -319,12 +319,33 @@ struct Mont {
     return acc;
   }
 
+  // fixed-window (w=4) exponentiation: ~256 sqr + ~64+14 mul for a
+  // 256-bit exponent vs square-and-multiply's ~256+hamming(e) — the sqrt
+  // exponents (p+1)/4 are almost all ones, so this saves ~35% of the
+  // recover sqrt cost
+  U256 pow_win4(const U256& a, const U256& e) const {
+    U256 tbl[16];
+    tbl[0] = one_m;
+    tbl[1] = a;
+    for (int i = 2; i < 16; ++i) tbl[i] = mul(tbl[i - 1], a);
+    int n = bitlen(e);
+    if (n == 0) return one_m;
+    int top = ((n + 3) / 4) * 4;
+    U256 acc = one_m;
+    for (int d = top - 4; d >= 0; d -= 4) {
+      acc = sqr(sqr(sqr(sqr(acc))));
+      unsigned dig = (unsigned)((e.w[d / 64] >> (d % 64)) & 0xF);
+      if (dig) acc = mul(acc, tbl[dig]);
+    }
+    return acc;
+  }
+
   U256 inv(const U256& a) const {  // Fermat (mod prime)
     U256 e = mod;
     U256 two;
     two.w[0] = 2;
     sub_bb(e, two, e);
-    return pow(a, e);
+    return pow_win4(a, e);
   }
 
   // plain value (possibly >= mod, < 2^256) -> canonical plain
@@ -938,7 +959,7 @@ void ncrypto_ecdsa_recover_batch(int curve_id, uint64_t count,
       U256 xm = c.fp.to_mont(x);
       U256 ysq = c.fp.add(c.fp.mul(c.fp.sqr(xm), xm), c.b_m);
       if (!c.a_zero) ysq = c.fp.add(ysq, c.fp.mul(c.a_m, xm));
-      U256 y = c.fp.pow(ysq, c.sqrt_e);
+      U256 y = c.fp.pow_win4(ysq, c.sqrt_e);
       if (cmp(c.fp.sqr(y), ysq) != 0) continue;  // non-residue
       U256 y_plain = c.fp.from_mont(y);
       if ((y_plain.w[0] & 1) != (v & 1)) y = c.fp.neg(y);
