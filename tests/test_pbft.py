@@ -551,3 +551,64 @@ def test_compatibility_version_rolling_upgrade():
             assert len({r.hash(suite) for r in receipts if r}) <= 1
     finally:
         stop_cluster(gateway, nodes)
+
+
+def test_view_change_carries_multiple_pipelined_heights():
+    """Waterline + view change: several heights can be PREPARED in flight
+    when a view change hits (execution stalled on the leader's lane). The
+    VIEW_CHANGE messages must carry ALL prepared rounds and the new view's
+    per-height leaders must re-propose them — none of the prepared txs may
+    be lost or double-committed."""
+    import threading
+
+    suite, gateway, nodes, _ = build_cluster(4, view_timeout=3.0,
+                                             tx_count_limit=25)
+    try:
+        kp = suite.generate_keypair(b"multi-carry")
+        # stall EXECUTION on every node so consensus pipelines ahead of it
+        # (prepared heights accumulate, nothing commits)
+        gates = []
+        for n in nodes:
+            ev = threading.Event()
+            orig = n.scheduler.execute_block
+
+            def slow(block, *a, _orig=orig, _ev=ev, **kw):
+                _ev.wait(20)
+                return _orig(block, *a, **kw)
+
+            n.scheduler.execute_block = slow
+            gates.append(ev)
+
+        txs = [make_tx(suite, kp, nonce=f"mc-{i}", name=b"mc%d" % i)
+               for i in range(75)]  # 3 blocks of 25
+        nodes[0].txpool.submit_batch(txs)
+        # wait until at least two heights hold prepared certificates
+        assert wait_until(lambda: any(
+            sum(1 for c in n.consensus._caches.values() if c.prepared) >= 2
+            for n in nodes), timeout=20), \
+            [{h: c.prepared for h, c in n.consensus._caches.items()}
+             for n in nodes]
+
+        # force a view change while execution is stalled: the timers are
+        # still running (in_flight rounds exist), so the stall itself
+        # triggers it once view_timeout expires. Release execution only
+        # AFTER the new view has been entered.
+        assert wait_until(lambda: any(n.consensus.view >= 1 for n in nodes),
+                          timeout=30), [n.consensus.view for n in nodes]
+        for ev in gates:
+            ev.set()
+
+        # every submitted tx commits exactly once, identically everywhere
+        assert wait_until(
+            lambda: all(n.ledger.total_tx_count() >= 75 for n in nodes),
+            timeout=60), [n.ledger.total_tx_count() for n in nodes]
+        for n in nodes:
+            assert n.ledger.total_tx_count() == 75  # no double commits
+        head = nodes[0].ledger.current_number()
+        h0 = nodes[0].ledger.header_by_number(head).hash(suite)
+        for n in nodes[1:]:
+            assert n.ledger.header_by_number(head).hash(suite) == h0
+    finally:
+        for ev in gates:
+            ev.set()
+        stop_cluster(gateway, nodes)

@@ -207,3 +207,76 @@ def test_garbage_and_replayed_packets_ignored(tmp_path):
         for n in nodes:
             n.stop()
         gateway.stop()
+
+
+def test_pipelined_double_include_cannot_fork_or_wedge(tmp_path):
+    """A Byzantine leader for a pipelined height proposes a block that
+    DOUBLE-INCLUDES a tx already carried by the in-flight previous height
+    (honest leaders cannot: accepted proposals mark their txs sealed, and
+    pre-seal tombstones cover gossip stragglers). When the earlier height
+    commits, the duplicate proposal becomes unexecutable everywhere (its
+    tx was pruned); the cluster must neither fork nor wedge — a view
+    change re-proposes and every tx commits exactly once."""
+    suite, gateway, keypairs, nodes = _cluster(view_timeout=2.0)
+    sorted_ids = sorted(kp.pub_bytes for kp in keypairs)
+    # leader of height 2 in view 0 forges the duplicate proposal
+    leader2_kp = next(kp for kp in keypairs
+                      if kp.pub_bytes == sorted_ids[2 % 4])
+    seen_h1_tx = {}
+
+    def inject(src, dst, data):
+        msg = _parse_pbft(data)
+        if msg is None or msg.packet_type != int(PacketType.PRE_PREPARE):
+            return True
+        if msg.number == 1 and not seen_h1_tx:
+            try:
+                seen_h1_tx["block"] = Block.decode(msg.payload)
+            except Exception:
+                pass
+            return True
+        if (msg.number == 2 and msg.from_idx == 2
+                and "block" in seen_h1_tx and "forged" not in seen_h1_tx):
+            # replace the legitimate height-2 proposal with one that
+            # re-includes height 1's txs (validly signed by leader 2)
+            seen_h1_tx["forged"] = True
+            b1 = seen_h1_tx["block"]
+            dup = Block.decode(msg.payload)
+            dup.tx_hashes = list(b1.tx_hashes) + list(dup.tx_hashes)
+            dup.transactions = []
+            dup.header.invalidate()
+            phash = dup.header.hash(suite)
+            forged = make_packet(PacketType.PRE_PREPARE, msg.view,
+                                 msg.number, msg.from_idx, phash,
+                                 dup.encode())
+            forged.sign(suite, leader2_kp)
+            for peer in sorted_ids:
+                if peer != src:
+                    gateway.send(src, peer, _front_pack(forged.encode()))
+            return False
+        return True
+
+    gateway.set_filter(inject)
+    try:
+        kp = suite.generate_keypair(b"dup-user")
+        for node in nodes:
+            node.start()
+        txs = [_tx(suite, kp, f"dup-{i}") for i in range(6)]
+        nodes[0].txpool.submit_batch(txs[:3])
+        assert wait_until(lambda: all(
+            n.ledger.current_number() >= 1 for n in nodes), timeout=20)
+        nodes[1].txpool.submit_batch(txs[3:])
+        # liveness: everything commits despite the forged duplicate
+        assert wait_until(lambda: all(
+            n.ledger.total_tx_count() >= 6 for n in nodes), timeout=60), \
+            [n.ledger.total_tx_count() for n in nodes]
+        # safety: exactly once, identical chain
+        for n in nodes:
+            assert n.ledger.total_tx_count() == 6
+        head = nodes[0].ledger.current_number()
+        for b in range(1, head + 1):
+            hh = {n.ledger.header_by_number(b).hash(suite) for n in nodes}
+            assert len(hh) == 1, f"fork at height {b}"
+    finally:
+        for n in nodes:
+            n.stop()
+        gateway.stop()
