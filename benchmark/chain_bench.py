@@ -71,7 +71,8 @@ def _build_workload(sm: bool, n: int, block_limit: int) -> list[bytes]:
         return [tx for ch in ex.map(_sign_chunk, chunks) for tx in ch]
 
 
-def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int) -> dict:
+def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
+              transport: str = "fake") -> dict:
     from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.init.node import Node, NodeConfig
     from fisco_bcos_tpu.ledger.ledger import ConsensusNode
@@ -79,19 +80,32 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int) -> dict:
     from fisco_bcos_tpu.protocol import Transaction
 
     suite = make_suite(sm, backend="host")  # node identity keys
-    gateway = FakeGateway()
     keypairs = [suite.generate_keypair(bytes([i + 1]) * 16)
                 for i in range(4)]
+    if transport == "p2p":
+        # real TCP sessions on localhost (net/p2p.py: framed wire protocol,
+        # compression negotiation, router) — the BASELINE deployment shape
+        from fisco_bcos_tpu.net.p2p import P2PGateway
+
+        gateways = [P2PGateway(kp.pub_bytes) for kp in keypairs]
+        for i, gw in enumerate(gateways):
+            for j, other in enumerate(gateways):
+                if i != j:
+                    gw.add_peer(other.host, other.port)
+    else:
+        shared = FakeGateway()
+        gateways = [shared] * 4
     sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
     nodes = []
-    for kp in keypairs:
+    for kp, gw in zip(keypairs, gateways):
         node = Node(NodeConfig(consensus="pbft", sm_crypto=sm,
                                crypto_backend=backend, min_seal_time=0.0,
                                view_timeout=30.0,
                                tx_count_limit=tx_count_limit),
-                    keypair=kp, gateway=gateway)
+                    keypair=kp, gateway=gw)
         node.build_genesis(sealers)
         nodes.append(node)
+    gateway = gateways[0]
 
     # instrument proposal verification latency on every node
     verify_times: list[float] = []
@@ -158,7 +172,8 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int) -> dict:
     finally:
         for node in nodes:
             node.stop()
-        gateway.stop()
+        for gw in set(gateways):
+            gw.stop()
 
     intervals = []
     ordered = [commit_times[k] for k in sorted(commit_times)]
@@ -190,13 +205,17 @@ def main() -> None:
     ap.add_argument("--suite", default="ecdsa",
                     choices=["ecdsa", "sm", "both"])
     ap.add_argument("--tx-count-limit", type=int, default=1000)
+    ap.add_argument("--transport", default="fake", choices=["fake", "p2p"],
+                    help="fake = in-process bus; p2p = real TCP sessions")
     args = ap.parse_args()
 
     suites = [False, True] if args.suite == "both" else \
         [args.suite == "sm"]
     for sm in suites:
-        res = run_chain(sm, args.n, args.backend, args.tx_count_limit)
-        res.update({"metric": f"chain_tps_4node_{res['suite']}",
+        res = run_chain(sm, args.n, args.backend, args.tx_count_limit,
+                        transport=args.transport)
+        res.update({"metric": f"chain_tps_4node_{res['suite']}"
+                    + ("_tcp" if args.transport == "p2p" else ""),
                     "value": res["tps"], "unit": "tx/sec"})
         print(json.dumps(res), flush=True)
 
