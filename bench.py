@@ -401,6 +401,29 @@ def main() -> None:
                         "live_note": "tunnel wedged at run time; headline "
                                      "is the persisted device sweep",
                     }
+        try:
+            # supplementary: the end-to-end 4-node chain TPS on THIS host
+            # (round 5's battle; the device grid stays the headline). A
+            # bounded subprocess so a chain wedge can never break the
+            # bench line.
+            import subprocess as _sp
+
+            r = _sp.run(
+                [sys.executable, "-u",
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmark", "chain_bench.py"),
+                 "-n", "3000", "--backend", "host"],
+                timeout=float(os.environ.get("BENCH_CHAIN_TIMEOUT", "240")),
+                stdout=_sp.PIPE, stderr=_sp.DEVNULL, text=True)
+            rows = [ln for ln in r.stdout.splitlines()
+                    if ln.startswith("{")]
+            if rows:
+                chain = json.loads(rows[-1])
+                line["chain_tps_4node_host"] = chain.get("value")
+                line["chain_block_interval_ms"] = chain.get(
+                    "block_interval_mean_ms")
+        except Exception:
+            pass
         print(json.dumps(line), flush=True)
     except Exception as exc:  # always emit a parseable line
         print(json.dumps({
