@@ -42,6 +42,7 @@ class FrontService:
         self._handlers: dict[int, Handler] = {}
         self._seq = itertools.count(1)
         self._pending: dict[int, tuple[threading.Event, list, bytes]] = {}
+        self._malformed = 0  # dropped-garbage counter (rate-limited warn)
         self._lock = threading.Lock()
         gateway.register_front(node_id, self)
 
@@ -90,9 +91,21 @@ class FrontService:
 
     # -- receive (gateway delivery thread) ---------------------------------
     def on_network_message(self, src: bytes, data: bytes) -> None:
-        r = Reader(data)
-        module, kind, seq = r.u16(), r.u8(), r.u64()
-        payload = r.blob()
+        try:
+            r = Reader(data)
+            module, kind, seq = r.u16(), r.u8(), r.u64()
+            payload = r.blob()
+        except ValueError:
+            # malformed frame: drop cheaply — a garbage flood must not buy
+            # a traceback (or even a log line) per frame; count it and
+            # warn once per 1000 so the signal survives without giving an
+            # attacker log-volume amplification
+            self._malformed += 1
+            if self._malformed % 1000 == 1:
+                LOG.warning(badge("FRONT", "malformed-frame",
+                                  src=src[:8].hex(), size=len(data),
+                                  total=self._malformed))
+            return
         if kind == KIND_RESPONSE:
             with self._lock:
                 entry = self._pending.get(seq)
