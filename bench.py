@@ -422,6 +422,10 @@ def main() -> None:
                 line["chain_tps_4node_host"] = chain.get("value")
                 line["chain_block_interval_ms"] = chain.get(
                     "block_interval_mean_ms")
+                # transport security of the measured chain (VERDICT #2:
+                # TLS overhead must be attributable from the bench line)
+                line["chain_tls"] = bool(chain.get("tls", False))
+                line["chain_transport"] = chain.get("transport", "fake")
         except Exception:
             pass
         print(json.dumps(line), flush=True)

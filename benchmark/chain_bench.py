@@ -72,7 +72,7 @@ def _build_workload(sm: bool, n: int, block_limit: int) -> list[bytes]:
 
 
 def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
-              transport: str = "fake") -> dict:
+              transport: str = "fake", tls: bool = False) -> dict:
     from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.init.node import Node, NodeConfig
     from fisco_bcos_tpu.ledger.ledger import ConsensusNode
@@ -84,15 +84,26 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
                 for i in range(4)]
     if transport == "p2p":
         # real TCP sessions on localhost (net/p2p.py: framed wire protocol,
-        # compression negotiation, router) — the BASELINE deployment shape
+        # compression negotiation, router) — the BASELINE deployment shape.
+        # --tls adds the dual-cert SM-TLS channel (the build_chain --sm-tls
+        # deployment shape), so its overhead is quantified against plain TCP
+        ctxs = [None] * 4
+        if tls:
+            from fisco_bcos_tpu.net.smtls import (CertificateAuthority,
+                                                  SMTLSContext)
+            ca = CertificateAuthority(name="bench-ca")
+            ctxs = [SMTLSContext(ca.pub, ca.issue(f"bench-node{i}"))
+                    for i in range(4)]
         from fisco_bcos_tpu.net.p2p import P2PGateway
 
-        gateways = [P2PGateway(kp.pub_bytes) for kp in keypairs]
+        gateways = [P2PGateway(kp.pub_bytes, server_ssl=ctx, client_ssl=ctx)
+                    for kp, ctx in zip(keypairs, ctxs)]
         for i, gw in enumerate(gateways):
             for j, other in enumerate(gateways):
                 if i != j:
                     gw.add_peer(other.host, other.port)
     else:
+        tls = False  # in-process bus: no transport to encrypt
         shared = FakeGateway()
         gateways = [shared] * 4
     sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
@@ -185,6 +196,8 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
 
     return {
         "suite": "sm" if sm else "ecdsa",
+        "transport": transport,
+        "tls": bool(tls),
         "txs_committed": int(committed),
         "blocks": int(height),
         "tps": round(committed / (t_end - t0), 1) if t_end > t0 else 0.0,
@@ -207,15 +220,19 @@ def main() -> None:
     ap.add_argument("--tx-count-limit", type=int, default=1000)
     ap.add_argument("--transport", default="fake", choices=["fake", "p2p"],
                     help="fake = in-process bus; p2p = real TCP sessions")
+    ap.add_argument("--tls", action="store_true",
+                    help="with --transport p2p: dual-cert SM-TLS sessions")
     args = ap.parse_args()
 
     suites = [False, True] if args.suite == "both" else \
         [args.suite == "sm"]
     for sm in suites:
         res = run_chain(sm, args.n, args.backend, args.tx_count_limit,
-                        transport=args.transport)
-        res.update({"metric": f"chain_tps_4node_{res['suite']}"
-                    + ("_tcp" if args.transport == "p2p" else ""),
+                        transport=args.transport, tls=args.tls)
+        suffix = ""
+        if args.transport == "p2p":
+            suffix = "_tls" if res["tls"] else "_tcp"
+        res.update({"metric": f"chain_tps_4node_{res['suite']}" + suffix,
                     "value": res["tps"], "unit": "tx/sec"})
         print(json.dumps(res), flush=True)
 

@@ -424,12 +424,12 @@ class PBFTEngine(Worker):
                         resp.encode())
 
     def _handle_recover_resp(self, msg: PBFTMessage) -> None:
-        try:
-            inner = unpack_messages(msg.payload)
+        try:  # cap bounds the DECODE (count prefix is sender-controlled)
+            inner = unpack_messages(msg.payload, max_count=4 * self.n + 1)
         except Exception:
             return
         # re-enqueue so each inner packet passes normal signature checking
-        for m in inner[: 4 * self.n + 1]:
+        for m in inner:
             self._inbox.put(("msg", m))
 
     # -- send helpers ------------------------------------------------------
@@ -448,6 +448,14 @@ class PBFTEngine(Worker):
         number = block.header.number
         current = self.ledger.current_number()
         if not (current < number <= current + self.waterline):
+            self.txpool.unseal(block.tx_hashes)
+            self._grant_sealer()
+            return
+        if self.leader_for(number, self.view) != self.index:
+            # stale grant: the sealer produced this under an older view and
+            # the view changed before the proposal reached the worker —
+            # broadcasting now would be rejected by every replica (wasted
+            # round); return the txs and let the real leader pick them up
             self.txpool.unseal(block.tx_hashes)
             self._grant_sealer()
             return
@@ -769,9 +777,16 @@ class PBFTEngine(Worker):
         # carry EVERY prepared in-flight proposal (the pipeline can hold
         # several) so the new view's leaders re-propose rather than lose a
         # potentially-committed round — the reference's ViewChangeMsg
-        # preparedProposal list (PBFTViewChangeMsg)
-        carried = [c.preprepare_msg for _n, c in sorted(self._caches.items())
-                   if c.prepared and c.preprepare_msg is not None]
+        # preparedProposal list (PBFTViewChangeMsg). Each pre-prepare
+        # travels WITH the prepare votes that made it prepared: the new
+        # leader re-proposes only quorum-certified carried proposals, so
+        # no single member can fabricate one (classic PBFT's P-set proof)
+        carried: list[PBFTMessage] = []
+        for _n, c in sorted(self._caches.items()):
+            if c.prepared and c.preprepare_msg is not None:
+                carried.append(c.preprepare_msg)
+                carried.extend(m for m in c.prepares.values()
+                               if m.proposal_hash == c.proposal_hash)
         payload = pack_messages(carried) if carried else b""
         vc = make_packet(PacketType.VIEW_CHANGE, self.to_view, number,
                          self.index, chash, payload)
@@ -808,26 +823,98 @@ class PBFTEngine(Worker):
         self._repropose_carried(vcs.values(), v)
         self._grant_sealer()
 
-    def _carried_by_height(self, vcs) -> dict[int, Block]:
+    def _carried_by_height(self, vcs, new_view: int) -> dict[int, Block]:
         """Highest-view carried prepared proposal per in-flight height from a
-        set of VIEW_CHANGE messages."""
+        set of VIEW_CHANGE messages.
+
+        Carried pre-prepares ride INSIDE view-change payloads, so the
+        inbox-level batch check never saw them: each one must be verified
+        here or a single Byzantine member could forge a "higher-view"
+        carried proposal that displaces a genuinely prepared one (safety
+        violation — the prepared block may already be committed elsewhere).
+        A candidate is admitted only if it (a) claims a view OLDER than the
+        view being entered, (b) claims the index that actually led its
+        (number, view) round, (c) carries that leader's valid signature
+        over the packet core, and (d) is backed by a PREPARE quorum
+        certificate — `quorum` distinct members' valid prepare signatures
+        over the same (number, view, proposal hash), aggregated across all
+        the view-changes. (a)-(c) alone would still admit a forgery by a
+        node that legitimately LED some intermediate view (it can sign a
+        fresh "carried" pre-prepare for its old round at view-change
+        time); the certificate requires honest co-signers, which a
+        fabricated round can never collect."""
         current = self.ledger.current_number()
-        best: dict[int, PBFTMessage] = {}
+        # a Byzantine VC could pack unbounded messages; the cap is applied
+        # INSIDE the decode (over-count payloads are rejected wholesale
+        # before any message is materialised)
+        per_vc_cap = (1 + self.n) * self.waterline
+        seen: set[tuple] = set()
+        candidates: list[PBFTMessage] = []
+        prepares: list[PBFTMessage] = []
         for vc in vcs:
             if not vc.payload:
                 continue
             try:
-                pps = unpack_messages(vc.payload)
+                msgs = unpack_messages(vc.payload, max_count=per_vc_cap)
             except Exception:
                 continue
-            for pp in pps:
-                if pp.packet_type != PacketType.PRE_PREPARE:
+            for m in msgs:
+                if not (current < m.number <= current + self.waterline):
                     continue
-                if not (current < pp.number <= current + self.waterline):
+                if not (0 <= m.from_idx < self.n) or m.view >= new_view:
                     continue
-                cur = best.get(pp.number)
-                if cur is None or pp.view > cur.view:
-                    best[pp.number] = pp
+                key = (m.packet_type, m.number, m.view, m.from_idx,
+                       m.proposal_hash)
+                if key in seen:
+                    continue  # same carried round from several view-changes
+                if m.packet_type == PacketType.PREPARE:
+                    seen.add(key)
+                    prepares.append(m)
+                    continue
+                if m.packet_type != PacketType.PRE_PREPARE:
+                    continue
+                if m.from_idx != self.leader_for(m.number, m.view):
+                    LOG.warning(badge("PBFT", "carried-pp-not-leader",
+                                      frm=m.from_idx, number=m.number,
+                                      view=m.view))
+                    continue
+                seen.add(key)
+                candidates.append(m)
+        if candidates:
+            from ...protocol.types import prefill_hashes
+            allmsgs = candidates + prepares
+            prefill_hashes(allmsgs, lambda m: m.encode_core(), self.suite)
+            ok = np.asarray(self.suite.verify_batch(
+                [m.hash(self.suite) for m in allmsgs],
+                [m.signature for m in allmsgs],
+                [self.nodes[m.from_idx] for m in allmsgs]))
+            kept, certified = [], {}
+            for m, good in zip(allmsgs, ok):
+                if not good:
+                    LOG.warning(badge("PBFT", "carried-bad-signature",
+                                      frm=m.from_idx, number=m.number,
+                                      view=m.view, type=m.packet_type))
+                elif m.packet_type == PacketType.PREPARE:
+                    certified.setdefault(
+                        (m.number, m.view, m.proposal_hash),
+                        set()).add(m.from_idx)
+                else:
+                    kept.append(m)
+            candidates = []
+            for pp in kept:
+                signers = certified.get(
+                    (pp.number, pp.view, pp.proposal_hash), set())
+                if len(signers) >= self.quorum:
+                    candidates.append(pp)
+                else:
+                    LOG.warning(badge("PBFT", "carried-pp-no-quorum",
+                                      frm=pp.from_idx, number=pp.number,
+                                      view=pp.view, signers=len(signers)))
+        best: dict[int, PBFTMessage] = {}
+        for pp in candidates:
+            cur = best.get(pp.number)
+            if cur is None or pp.view > cur.view:
+                best[pp.number] = pp
         out: dict[int, Block] = {}
         for number, pp in best.items():
             try:
@@ -837,7 +924,7 @@ class PBFTEngine(Worker):
         return out
 
     def _repropose_carried(self, vcs, v: int) -> None:
-        for number, block in sorted(self._carried_by_height(vcs).items()):
+        for number, block in sorted(self._carried_by_height(vcs, v).items()):
             if self.leader_for(number, v) == self.index:
                 self._broadcast_preprepare(block, carried=True)
 
@@ -846,7 +933,10 @@ class PBFTEngine(Worker):
             return
         if msg.from_idx != self.leader_for(msg.number, msg.view):
             return
-        vcs = unpack_messages(msg.payload)
+        try:  # one VC per member tops; bound the decode itself
+            vcs = unpack_messages(msg.payload, max_count=self.n)
+        except Exception:
+            return
         vcs = [m for m in vcs if m.packet_type == PacketType.VIEW_CHANGE
                and m.view == msg.view and 0 <= m.from_idx < self.n]
         uniq = {m.from_idx: m for m in vcs}

@@ -96,5 +96,13 @@ def pack_messages(msgs: list[PBFTMessage]) -> bytes:
     return Writer().seq(msgs, lambda w, m: w.blob(m.encode())).bytes()
 
 
-def unpack_messages(data: bytes) -> list[PBFTMessage]:
-    return Reader(data).seq(lambda r: PBFTMessage.decode(r.blob()))
+def unpack_messages(data: bytes,
+                    max_count: Optional[int] = None) -> list[PBFTMessage]:
+    """Decode a packed message list; `max_count` bounds the DECODE itself
+    (a Byzantine sender controls the count prefix — materialising millions
+    of junk messages before any cap would be the DoS)."""
+    r = Reader(data)
+    count = r.u32()
+    if max_count is not None and count > max_count:
+        raise ValueError(f"packed message count {count} > cap {max_count}")
+    return [PBFTMessage.decode(r.blob()) for _ in range(count)]

@@ -31,9 +31,12 @@ mirrored bit-for-bit by native/nevm — tests/test_nevm.py enforces):
   * SELFDESTRUCT follows EIP-6780 (Cancun): the balance moves at the
     opcode; same-transaction creations are deleted (code, storage,
     residual balance burned) at END of transaction;
-  * bn128 PAIRING (address 8) is unsupported: the vacuous empty-input
-    check returns true, any real pairing input fails loudly (bn128
-    add/mul and blake2f ARE implemented — precompile_classic.py);
+  * bn128 PAIRING (address 8) IS implemented (precompile_classic.py +
+    crypto/bn254), gated on compatibility_version >= 1.1.0; the pure-
+    Python pairing is priced at ~1.35M gas/pair (its measured ~0.45 s
+    cost) and capped per call AND per transaction so a pairing-heavy tx
+    cannot stall the execution lane (pre-1.1 chains keep the legacy
+    vacuous empty-input-true, loud-failure-otherwise behavior);
   * nested frames with per-frame state savepoints (revert unwinds exactly
     the frame's writes — same recoder discipline as the reference's
     executive stack, TransactionExecutive.cpp);
@@ -441,6 +444,24 @@ class EVM:
         else:
             self.native = native
 
+    # a TRANSACTION may spend at most this many pairing pairs across all
+    # its frames (~0.45 s/pair pure-Python: bounds worst-case execution-
+    # lane stall per tx; the block-level bound follows deterministically as
+    # tx_count_limit x this). Deliberately per-tx, NOT a shared per-block
+    # counter: DAG waves execute non-conflicting txs on parallel threads,
+    # so a cross-tx counter would make which tx hits the limit depend on
+    # thread scheduling — honest nodes would produce different receipts
+    # for the same block (consensus divergence). Per-tx state (reset in
+    # begin_tx_access) is order-independent and identical on every node.
+    MAX_PAIRING_PAIRS_PER_TX = 16
+
+    def _charge_pairing_budget(self, pairs: int) -> bool:
+        used = getattr(self._tls, "pairing_pairs", 0)
+        if used + pairs > self.MAX_PAIRING_PAIRS_PER_TX:
+            return False
+        self._tls.pairing_pairs = used + pairs
+        return True
+
     # -- account helpers ---------------------------------------------------
     @staticmethod
     def get_code(state: StateStorage, addr: bytes) -> bytes:
@@ -519,6 +540,7 @@ class EVM:
         (origin, target, classic precompiles 1..9, framework system
         contracts) + EIP-3651 (coinbase)."""
         acc = self._tls.access = AccessSet()
+        self._tls.pairing_pairs = 0  # fresh per-tx pairing budget
         acc.warm_address(origin)
         if target:
             acc.warm_address(target)
@@ -737,23 +759,42 @@ class EVM:
                     return EVMResult(False, gas_left=0,
                                      error=f"bn128: {exc}")
                 return EVMResult(True, output=out, gas_left=gas - cost)
-            if which == 8:  # bn128 pairing check (EIP-197, EIP-1108 gas),
+            if which == 8:  # bn128 pairing check (EIP-197, repriced gas),
                 # gated on compatibility_version >= 1.1.0 — the chain
                 # enables it fleet-wide at a governed height
                 # (LedgerTypeDef.h:42 rolling-upgrade semantics)
-                cost = (pcc.G_PAIRING_BASE
-                        + pcc.G_PAIRING_PER_PAIR * (len(data) // 192))
-                if gas < cost:
-                    return EVMResult(False, gas_left=0, error="oog")
+                pairs = len(data) // 192
+                if pairs > pcc.MAX_PAIRING_PAIRS:
+                    # O(1) refusal BEFORE gas math or curve work: the
+                    # ~0.45 s/pair pure-Python pairing must never be
+                    # droveable past the cap (execution-lane DoS guard)
+                    return EVMResult(
+                        False, gas_left=0,
+                        error=f"bn128 pairing: {pairs} pairs exceeds the "
+                              f"{pcc.MAX_PAIRING_PAIRS}-pair per-call cap")
                 if self._compat_version(state, env) < (1, 1, 0):
-                    if len(data) == 0:  # pre-1.1 behavior preserved
-                        return EVMResult(
+                    # the gate outranks the repriced gas: on a pre-1.1
+                    # chain the pairing "does not exist" for real input
+                    if len(data) == 0 and gas >= pcc.G_PAIRING_BASE:
+                        return EVMResult(  # pre-1.1 behavior preserved
                             True, output=(1).to_bytes(32, "big"),
                             gas_left=gas - pcc.G_PAIRING_BASE)
+                    if len(data) == 0:
+                        return EVMResult(False, gas_left=0, error="oog")
                     return EVMResult(
                         False, gas_left=0,
                         error="bn128 pairing needs compatibility_version"
                               " >= 1.1.0")
+                cost = (pcc.G_PAIRING_BASE
+                        + pcc.G_PAIRING_PER_PAIR * pairs)
+                if gas < cost:
+                    return EVMResult(False, gas_left=0, error="oog")
+                if pairs and not self._charge_pairing_budget(pairs):
+                    return EVMResult(
+                        False, gas_left=0,
+                        error="bn128 pairing: per-transaction pair budget "
+                              f"({self.MAX_PAIRING_PAIRS_PER_TX}) "
+                              "exhausted")
                 try:
                     out = pcc.bn128_pairing(data)
                 except pcc.PrecompileInputError as exc:

@@ -69,6 +69,13 @@ class NodeConfig:
     rpc_host: str = "127.0.0.1"
     ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
     metrics_port: Optional[int] = None  # None = no Prometheus endpoint
+    # p2p transport (the reference's [p2p] listen_ip/listen_port +
+    # nodes.json connected_nodes): consumed by the process-level daemon
+    # (init/daemon.py), which builds a P2PGateway from these; in-process
+    # embeddings keep injecting a gateway directly
+    p2p_host: str = "127.0.0.1"
+    p2p_port: Optional[int] = None  # None = no p2p listener configured
+    p2p_peers: list = dataclasses.field(default_factory=list)  # (host, port)
 
 
 class Node:
@@ -170,6 +177,8 @@ class Node:
             # observers (not in the sealer set) follow via block sync
             if self.blocksync is not None:
                 self.blocksync.start()
+        if self.txsync is not None:
+            self.txsync.start()  # periodic pool anti-entropy sweep
         if self.rpc is not None:
             self.rpc.start()
         if self.ws is not None:
@@ -217,6 +226,8 @@ class Node:
         self.sealer.stop()
         if self.consensus is not None:
             self.consensus.stop()
+        if self.txsync is not None:
+            self.txsync.stop()
         if self.blocksync is not None:
             self.blocksync.stop()
         if self.front is not None:

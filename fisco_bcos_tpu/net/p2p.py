@@ -496,18 +496,32 @@ class P2PGateway(Gateway):
                 except OSError:
                     pass
 
+    MAX_RECONNECT_BACKOFF = 30.0
+
     def _connect_loop(self) -> None:
+        # per-address exponential backoff (Service.cpp's reconnect timer
+        # discipline): a dead/refusing peer is retried at 1x, 2x, 4x ... the
+        # base interval up to MAX_RECONNECT_BACKOFF, and a successful dial
+        # resets its address — so a restarting node re-links within one base
+        # interval while a permanently-down peer costs ~nothing
+        backoff: dict[tuple[str, int], tuple[int, float]] = {}
         while not self._stopped:
             with self._lock:
                 targets = list(self.configured_peers)
                 connected = set(self._sessions)
+            now = time.monotonic()
             for host, port in targets:
                 if self._stopped:
                     return
                 with self._lock:
                     known = self._peer_by_addr.get((host, port))
                 if known is not None and known in connected:
+                    backoff.pop((host, port), None)
                     continue  # already linked to this address's node
+                fails, next_at = backoff.get((host, port), (0, 0.0))
+                if now < next_at:
+                    continue
+                sock = None
                 try:
                     sock = socket.create_connection((host, port), timeout=3)
                     if self.client_ssl is not None:
@@ -515,15 +529,40 @@ class P2PGateway(Gateway):
                             sock, server_hostname=host)
                     hs = self._handshake(sock)
                     peer_id, caps = hs if hs else (None, 0)
-                    if peer_id is not None:
-                        with self._lock:
-                            self._peer_by_addr[(host, port)] = peer_id
-                    if (peer_id is None
-                            or not self._install(peer_id, sock,
-                                                 outbound=True,
-                                                 caps=caps)):
-                        sock.close()
+                    if peer_id is None:
+                        # TCP accepted but the hello failed (hung node,
+                        # wrong protocol, dead upstream behind a proxy):
+                        # as dead as a refused dial — and each retry costs
+                        # a full TLS handshake, so it MUST back off too
+                        raise OSError("handshake failed")
+                    with self._lock:
+                        self._peer_by_addr[(host, port)] = peer_id
+                    if self._install(peer_id, sock, outbound=True,
+                                     caps=caps):
+                        sock = None  # session owns it now
+                        backoff.pop((host, port), None)
+                    else:
+                        # refused session (ACL deny, wrong direction while
+                        # the inbound link is still forming, duplicate):
+                        # each retry still paid a full TLS handshake, so
+                        # it backs off like a failure; an inbound session
+                        # landing meanwhile makes the loop skip the
+                        # address entirely
+                        raise OSError("session refused")
                 except OSError:
+                    if sock is not None:
+                        try:  # every failure path, incl. a wrap/hello
+                            sock.close()  # raise: leaked fds accumulate
+                        except OSError:   # per retry for a daemon's life
+                            pass
+                    # exponent clamped: fails grows forever for a
+                    # permanently-dead peer and 2.0**1025 would overflow,
+                    # killing this thread and all future redials
+                    delay = min(self.reconnect_interval
+                                * (2.0 ** min(fails, 16)),
+                                self.MAX_RECONNECT_BACKOFF)
+                    backoff[(host, port)] = (fails + 1,
+                                             time.monotonic() + delay)
                     continue
             time.sleep(self.reconnect_interval)
 

@@ -1,12 +1,22 @@
-"""TransactionSync — tx gossip + missing-tx fetch for proposals.
+"""TransactionSync — tx gossip + missing-tx fetch + pool anti-entropy.
 
 Reference counterpart: /root/reference/bcos-txpool/bcos-txpool/sync/
 TransactionSync.cpp — broadcast of newly submitted txs to peers, batch
 import of received packets (the **tbb::parallel_for over tx->verify** at
 :516-537 that the TPU batch-recover call replaces here: received batches go
 through `TxPool.submit_batch`, i.e. ONE device recover kernel per packet),
-and on-demand fetch of a proposal's missing txs (TxPool.cpp:160
-asyncVerifyBlock's fetch-missing path).
+on-demand fetch of a proposal's missing txs (TxPool.cpp:160
+asyncVerifyBlock's fetch-missing path), and a periodic maintenance sweep
+(TransactionSync.cpp's executeWorker maintainTransactions loop).
+
+The sweep is pool ANTI-ENTROPY: gossip sends are fire-and-forget over
+bounded p2p queues, so a dropped frame would otherwise strand a tx on the
+one node that accepted it. That is a chain-liveness hazard, not just a
+latency blip — observed failure: the stranded tx's holder is the only node
+that sees pending work, so when the next height's leader is down it is
+also the only node arming view changes, quorum is never reached, and the
+chain wedges. Re-advertising unsealed pending txs every couple of seconds
+converges the pools (receivers dedupe by hash before decoding).
 
 Wire payloads (module TxsSync):
   push:    seq<blob tx-encoding>                    (gossip batch)
@@ -17,11 +27,13 @@ Wire payloads (module TxsSync):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Sequence
 
 from ..codec.wire import Reader, Writer
 from ..protocol import Transaction
 from ..utils.log import LOG, badge, metric
+from ..utils.worker import Worker
 from .front import FrontService
 from .moduleid import ModuleID
 
@@ -43,15 +55,39 @@ def _unpack_txs(data: bytes) -> list[tuple[bytes, bytes]]:
     return Reader(data).seq(lambda r: (r.blob(), r.blob()))
 
 
-class TransactionSync:
-    def __init__(self, front: FrontService, txpool, suite):
+class TransactionSync(Worker):
+    # per-sweep rebroadcast cap: bounds anti-entropy bandwidth while still
+    # draining any realistic stranded-tx backlog within a few sweeps
+    ANTI_ENTROPY_MAX = 256
+
+    def __init__(self, front: FrontService, txpool, suite,
+                 anti_entropy_interval: float = 2.0):
+        super().__init__("tx-sync", idle_wait=0.25)
         self.front = front
         self.txpool = txpool
         self.suite = suite
+        self.anti_entropy_interval = anti_entropy_interval
+        self._last_sweep = 0.0
         self._lock = threading.Lock()
         self._known_by_peer: dict[bytes, set[bytes]] = {}
         front.register_module(ModuleID.TxsSync, self._on_message)
         txpool.register_broadcast_hook(self.broadcast_new)
+
+    # -- periodic anti-entropy sweep ---------------------------------------
+    def execute_worker(self) -> None:
+        now = time.monotonic()
+        if now - self._last_sweep < self.anti_entropy_interval:
+            return
+        self._last_sweep = now
+        pending = self.txpool.pending_txs(self.ANTI_ENTROPY_MAX)
+        if not pending:
+            return
+        # deliberately ignores _known_by_peer: that cache is optimistic
+        # (marks a tx known on ENQUEUE, not delivery) — the whole point of
+        # the sweep is to repair exactly those lost deliveries
+        data = _pack_txs(pending, self.suite)
+        for peer in self.front.peers():
+            self.front.send(ModuleID.TxsSync, peer, data)
 
     # -- outgoing gossip ---------------------------------------------------
     def broadcast_new(self, txs: Sequence[Transaction]) -> None:
@@ -63,14 +99,17 @@ class TransactionSync:
             with self._lock:
                 known = self._known_by_peer.setdefault(peer, set())
                 fresh = [t for t in txs if t.hash(self.suite) not in known]
-                known.update(t.hash(self.suite) for t in fresh)
             if not fresh:
                 continue
             key = frozenset(t.hash(self.suite) for t in fresh)
             data = payload_cache.get(key)
             if data is None:
                 data = payload_cache[key] = _pack_txs(fresh, self.suite)
-            self.front.send(ModuleID.TxsSync, peer, data)
+            if self.front.send(ModuleID.TxsSync, peer, data):
+                # mark known only once the frame was actually enqueued on a
+                # live session; the anti-entropy sweep covers drops beyond
+                with self._lock:
+                    known.update(t.hash(self.suite) for t in fresh)
 
     # -- missing-tx fetch (proposal verification) --------------------------
     def fetch_missing(self, peer: bytes, hashes: Sequence[bytes],

@@ -72,6 +72,24 @@ class WalStorage(TransactionalStorage):
                     break
                 self._apply_payload(payload)
                 off += _HDR.size + ln
+            if off < len(raw):
+                # a kill -9 mid-append leaves a torn/corrupt tail; appends
+                # after it would land BEHIND garbage and be unreadable on
+                # the next recovery — cut the log back to the valid prefix.
+                # The discarded suffix is preserved aside and the cut is
+                # logged: a few torn bytes are routine crash fallout, but a
+                # LARGE suffix means mid-file corruption ate committed
+                # records and an operator must know
+                from ..utils.log import LOG, badge
+                with open(logp + ".corrupt", "wb") as f:
+                    f.write(raw[off:])
+                LOG.warning(badge("WAL", "torn-tail-truncated",
+                                  kept=off, dropped=len(raw) - off,
+                                  saved=logp + ".corrupt"))
+                with open(logp, "rb+") as f:
+                    f.truncate(off)
+                    f.flush()
+                    os.fsync(f.fileno())
 
     def _load_snapshot(self, body: bytes) -> None:
         off = 0

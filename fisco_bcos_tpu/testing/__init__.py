@@ -1,0 +1,3 @@
+"""Fault-injection tooling for multi-process chain deployments."""
+
+from .chaos import ChaosHarness, LinkProxy  # noqa: F401

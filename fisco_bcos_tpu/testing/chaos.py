@@ -1,0 +1,367 @@
+"""ChaosHarness — fault injection against a REAL multi-process chain.
+
+The reference proves its robustness claims on chains of real OS processes
+(build_chain.sh + start_all.sh, then kill/partition nodes); this module is
+that loop as a library: it generates a deployment with tools/build_chain.py,
+runs each node as `python -m fisco_bcos_tpu <node_dir>` (its own process,
+real TCP p2p — SM-TLS when the chain is built with certs), talks to the
+cluster over real JSON-RPC HTTP, and injects faults:
+
+  * `kill(i)`            — SIGKILL, the kill -9 crash (no flush, no goodbye);
+  * `terminate(i)`       — SIGTERM graceful shutdown;
+  * `start(i)`           — (re)boot from the node's data directory, which
+                           exercises WAL replay + consensus-log recovery +
+                           block-sync catch-up;
+  * `inject_link(i, j)`  — route the i<->j p2p link through a LinkProxy
+                           that adds bounded delay and periodic connection
+                           drops (configure BEFORE first start).
+
+Assertion helpers read the chain through the RPC only — the harness never
+reaches into node internals, so everything it observes is what a real
+operator/SDK would see. Used by tests/test_chaos_e2e.py and the
+`tools/sanitize_ci.sh --chaos` stage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_port_block(n: int, tries: int = 64) -> int:
+    """A base port with n consecutive free ports (test-grade: racy against
+    other allocators, so callers get a fresh block per attempt)."""
+    for _ in range(tries):
+        base = random.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                socks.append(socket.create_server(("127.0.0.1", base + i)))
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
+class LinkProxy:
+    """TCP forwarder for one p2p link with bounded delay + periodic drops.
+
+    Transparent to SM-TLS (it moves opaque bytes), so it models a slow or
+    flapping NETWORK, not a Byzantine peer: every `drop_every` forwarded
+    chunks the connection is cut (both directions), which the gateway's
+    reconnect-with-backoff path must absorb; every chunk is delayed by
+    `delay` seconds (bounded latency)."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 delay: float = 0.0, drop_every: int = 0):
+        self.target = (target_host, target_port)
+        self.delay = delay
+        self.drop_every = drop_every
+        self._chunks = 0
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self._listener.getsockname()[1]
+        self.drops = 0
+        threading.Thread(target=self._accept_loop, name="chaos-proxy",
+                         daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self.target, timeout=3)
+            except OSError:
+                client.close()
+                continue
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        while not self._stopped:
+            try:
+                chunk = src.recv(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            if self.delay:
+                time.sleep(self.delay)
+            with self._lock:
+                self._chunks += 1
+                cut = (self.drop_every
+                       and self._chunks % self.drop_every == 0)
+                if cut:
+                    self.drops += 1
+            if cut:
+                break  # fault: sever the whole connection mid-stream
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                break
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class ChaosHarness:
+    # defaults tuned for a shared-core CI host running n_nodes full JAX
+    # processes: rounds cost ~1 s there, so a mainnet-ish 3 s view timeout
+    # produces view-change storms that slow the chain ~3x (every commit
+    # pays one-plus view changes); 8 s keeps rounds in-view, and a longer
+    # min_seal_time batches the trickle of RPC submits into fewer blocks
+    def __init__(self, out_dir: str, n_nodes: int = 4, tls: bool = True,
+                 view_timeout: float = 8.0, min_seal_time: float = 0.2,
+                 sm_crypto: bool = False):
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+        from build_chain import build_chain
+
+        self.out_dir = out_dir
+        self.n = n_nodes
+        # ONE contiguous block split in two: two independent draws could
+        # overlap each other (nothing holds the first block while the
+        # second is probed) and hand a port to both RPC and p2p
+        base = free_port_block(2 * n_nodes)
+        rpc_base, p2p_base = base, base + n_nodes
+        self.info = build_chain(
+            out_dir, n_nodes, sm_crypto=sm_crypto, consensus="pbft",
+            rpc_base_port=rpc_base, p2p_base_port=p2p_base,
+            crypto_backend="host", sm_tls=tls)
+        self.tls = tls
+        for node in self.info["nodes"]:
+            self._patch_config(node["dir"], view_timeout=view_timeout,
+                               min_seal_time=min_seal_time)
+        self.procs: list[Optional[subprocess.Popen]] = [None] * n_nodes
+        self.proxies: list[LinkProxy] = []
+
+    # -- config surgery ----------------------------------------------------
+    def _patch_config(self, node_dir: str, **overrides) -> None:
+        from fisco_bcos_tpu.tool.config import (node_config_from_ini,
+                                                node_config_to_ini)
+        path = os.path.join(node_dir, "config.ini")
+        with open(path) as f:
+            cfg = node_config_from_ini(f.read())
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        with open(path, "w") as f:
+            f.write(node_config_to_ini(cfg))
+
+    def inject_link(self, i: int, j: int, delay: float = 0.0,
+                    drop_every: int = 0) -> LinkProxy:
+        """Interpose a LinkProxy on the i<->j p2p link (call before the
+        nodes start). The gateway's deterministic dial direction means only
+        the smaller-node-id side dials, so only the dialer's peer entry is
+        rewritten to point at the proxy."""
+        ids = [bytes.fromhex(n["node_id"]) for n in self.info["nodes"]]
+        dialer, target = (i, j) if ids[i] < ids[j] else (j, i)
+        tport = self.info["nodes"][target]["p2p_port"]
+        proxy = LinkProxy("127.0.0.1", tport, delay=delay,
+                          drop_every=drop_every)
+        self.proxies.append(proxy)
+        from fisco_bcos_tpu.tool.config import node_config_from_ini
+        node_dir = self.info["nodes"][dialer]["dir"]
+        with open(os.path.join(node_dir, "config.ini")) as f:
+            peers = node_config_from_ini(f.read()).p2p_peers
+        self._patch_config(node_dir, p2p_peers=[
+            ("127.0.0.1", proxy.port) if p == tport else (h, p)
+            for h, p in peers])
+        return proxy
+
+    # -- process control ---------------------------------------------------
+    def start(self, i: int) -> None:
+        assert self.procs[i] is None or self.procs[i].poll() is not None, \
+            f"node{i} already running"
+        node_dir = self.info["nodes"][i]["dir"]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PALLAS_AXON_POOL_IPS"] = ""  # never touch a device tunnel
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH",
+                                                              "")
+        out = open(os.path.join(node_dir, "daemon.out"), "ab")
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "fisco_bcos_tpu", node_dir,
+             "--log-file", os.path.join(node_dir, "daemon.log")],
+            stdout=out, stderr=out, env=env, cwd=_REPO_ROOT)
+        out.close()
+
+    def start_all(self) -> None:
+        for i in range(self.n):
+            self.start(i)
+
+    def kill(self, i: int) -> None:
+        """kill -9: no WAL flush, no session goodbyes, pid file left behind."""
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=30)
+        self.procs[i] = None
+
+    def terminate(self, i: int, timeout: float = 30.0) -> int:
+        """SIGTERM graceful shutdown; returns the exit code."""
+        p = self.procs[i]
+        if p is None:
+            return 0
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=timeout)
+        self.procs[i] = None
+        return rc
+
+    def stop_all(self) -> None:
+        for i in range(self.n):
+            p = self.procs[i]
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for i in range(self.n):
+            p = self.procs[i]
+            if p is not None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+            self.procs[i] = None
+        for proxy in self.proxies:
+            proxy.stop()
+
+    # -- RPC-side observation ----------------------------------------------
+    def client(self, i: int):
+        from fisco_bcos_tpu.sdk.client import SdkClient
+        port = self.info["nodes"][i]["rpc_port"]
+        return SdkClient(f"http://127.0.0.1:{port}",
+                         group=self.info["group_id"])
+
+    def suite(self):
+        from fisco_bcos_tpu.crypto.suite import make_suite
+        return make_suite(self.info["sm_crypto"], backend="host")
+
+    def wait_rpc_up(self, i: int, timeout: float = 120.0) -> None:
+        cli = self.client(i)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                cli.get_block_number()
+                return
+            except Exception:
+                time.sleep(0.25)
+        raise TimeoutError(f"node{i} RPC not up within {timeout}s "
+                           f"(see {self.info['nodes'][i]['dir']}/daemon.log)")
+
+    def block_number(self, i: int) -> int:
+        return self.client(i).get_block_number()
+
+    def block_hash(self, i: int, number: int) -> Optional[str]:
+        return self.client(i).request(
+            "getBlockHashByNumber", [self.info["group_id"], "", number])
+
+    def state_root(self, i: int, number: int) -> Optional[str]:
+        blk = self.client(i).get_block_by_number(number, only_header=True)
+        return blk["stateRoot"] if blk else None
+
+    def total_txs(self, i: int) -> int:
+        return self.client(i).get_total_transaction_count()[
+            "transactionCount"]
+
+    def wait_until(self, pred, timeout: float = 60.0,
+                   what: str = "condition") -> None:
+        deadline = time.monotonic() + timeout
+        last_exc: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                if pred():
+                    return
+                last_exc = None
+            except Exception as exc:  # RPC flaps during faults are expected
+                last_exc = exc
+            time.sleep(0.25)
+        raise TimeoutError(f"timed out waiting for {what}"
+                           + (f" (last error: {last_exc})" if last_exc
+                              else ""))
+
+    def wait_converged(self, idxs, min_height: int = 1,
+                       timeout: float = 120.0) -> int:
+        """Wait until every node in `idxs` reports the SAME head hash at the
+        max common height >= min_height; returns that height."""
+        result = {}
+
+        def same_head() -> bool:
+            numbers = [self.block_number(i) for i in idxs]
+            h = min(numbers)
+            if h < min_height:
+                return False
+            hashes = {self.block_hash(i, h) for i in idxs}
+            if None in hashes or len(hashes) != 1:
+                return False
+            result["height"] = h
+            return True
+
+        self.wait_until(same_head, timeout=timeout,
+                        what=f"nodes {list(idxs)} converged")
+        return result["height"]
+
+    def read_daemon_log(self, i: int) -> str:
+        path = os.path.join(self.info["nodes"][i]["dir"], "daemon.log")
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def __enter__(self) -> "ChaosHarness":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop_all()
+
+
+def main() -> None:  # pragma: no cover — operator smoke entry
+    import argparse
+    import tempfile
+
+    ap = argparse.ArgumentParser(
+        description="boot a 4-node chaos chain, kill -9 a node, rejoin it")
+    ap.add_argument("-o", "--output", default=None)
+    ap.add_argument("--no-tls", action="store_true")
+    args = ap.parse_args()
+    out = args.output or tempfile.mkdtemp(prefix="chaos-chain-")
+    with ChaosHarness(out, tls=not args.no_tls) as h:
+        h.start_all()
+        for i in range(h.n):
+            h.wait_rpc_up(i)
+        print(json.dumps({"chain": out, "nodes": h.info["nodes"]}, indent=2))
+        h.kill(3)
+        print("node3 killed (SIGKILL); restarting...")
+        h.start(3)
+        h.wait_rpc_up(3)
+        height = h.wait_converged(range(h.n), min_height=0)
+        print(f"converged at height {height}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -100,6 +100,10 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                      "path": cfg.storage_path or ""}
     cp["rpc"] = {"listen_ip": cfg.rpc_host,
                  "listen_port": "" if cfg.rpc_port is None else str(cfg.rpc_port)}
+    cp["p2p"] = {"listen_ip": cfg.p2p_host,
+                 "listen_port": "" if cfg.p2p_port is None else str(cfg.p2p_port),
+                 # NodeConfig.cpp's nodes.json connected_nodes, inlined
+                 "nodes": ",".join(f"{h}:{p}" for h, p in cfg.p2p_peers)}
     cp["monitor"] = {"metrics_port": ""
                      if cfg.metrics_port is None else str(cfg.metrics_port)}
     cp["executor"] = {}
@@ -120,6 +124,18 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         path = os.path.join(base_dir, path)
     port_s = cp.get("rpc", "listen_port", fallback="")
     metrics_s = cp.get("monitor", "metrics_port", fallback="")
+    p2p_port_s = cp.get("p2p", "listen_port", fallback="")
+    peers = []
+    for ent in cp.get("p2p", "nodes", fallback="").split(","):
+        ent = ent.strip()
+        if not ent:
+            continue
+        host, sep, port = ent.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                f"bad [p2p] nodes entry {ent!r} in config.ini "
+                "(expected host:port)")
+        peers.append((host, int(port)))
     return NodeConfig(
         chain_id=cp.get("chain", "chain_id", fallback="chain0"),
         group_id=cp.get("chain", "group_id", fallback="group0"),
@@ -141,6 +157,9 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
         rpc_port=int(port_s) if port_s else None,
         metrics_port=int(metrics_s) if metrics_s else None,
+        p2p_host=cp.get("p2p", "listen_ip", fallback="127.0.0.1"),
+        p2p_port=int(p2p_port_s) if p2p_port_s else None,
+        p2p_peers=peers,
     )
 
 

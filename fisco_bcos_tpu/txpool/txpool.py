@@ -230,6 +230,14 @@ class TxPool:
                     self._presealed.add(h)
         self._update_pending_gauge()
 
+    def pending_txs(self, max_txs: int = 0) -> list[Transaction]:
+        """Unsealed pending txs, oldest first (TransactionSync's periodic
+        anti-entropy rebroadcast; sealed txs ride their proposal instead)."""
+        with self._lock:
+            out = [tx for h, tx in self._pending.items()
+                   if h not in self._sealed]
+        return out[:max_txs] if max_txs else out
+
     def pending_count(self) -> int:
         with self._lock:
             return len(self._pending) - len(self._sealed)

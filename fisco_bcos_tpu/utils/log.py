@@ -49,11 +49,36 @@ def metric(event: str, **kw) -> None:
                 pass
 
 
-def init_log(level: int = logging.INFO, stream=None) -> None:
-    h = logging.StreamHandler(stream or sys.stderr)
+def _install_handler(h: logging.Handler, level: int) -> logging.Handler:
     h.setFormatter(logging.Formatter(
         "%(asctime)s %(levelname).1s %(name)s %(message)s"))
     root = logging.getLogger("bcos-tpu")
     root.handlers[:] = [h]
     root.setLevel(level)
     root.propagate = False
+    return h
+
+
+def init_log(level: int = logging.INFO, stream=None) -> None:
+    _install_handler(logging.StreamHandler(stream or sys.stderr), level)
+
+
+class ReopenableFileHandler(logging.FileHandler):
+    """File handler whose stream can be re-opened in place — the SIGHUP
+    logrotate contract of the reference's Boost.Log file sink (the daemon
+    installs `reopen` as its SIGHUP action, so `mv log; kill -HUP` rotates
+    without dropping or interleaving lines)."""
+
+    def reopen(self) -> None:
+        self.acquire()
+        try:
+            if self.stream:
+                self.stream.close()
+                self.stream = None  # emit() lazily reopens at self.baseFilename
+        finally:
+            self.release()
+
+
+def init_file_log(path: str, level: int = logging.INFO
+                  ) -> ReopenableFileHandler:
+    return _install_handler(ReopenableFileHandler(path), level)

@@ -90,6 +90,7 @@ def _sign_batch(params, count, seed=0):
     return es, rs, ss, vs, pubs
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_ecdsa_verify_batch_golden():
     params = refimpl.SECP256K1
     es, rs, ss, vs, pubs = _sign_batch(params, 5)
@@ -111,6 +112,7 @@ def test_ecdsa_verify_batch_golden():
     assert ok.tolist() == [True] * 5 + [False] * 5
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_ecdsa_recover_batch_golden():
     params = refimpl.SECP256K1
     es, rs, ss, vs, pubs = _sign_batch(params, 6, seed=9)
@@ -131,6 +133,7 @@ def test_ecdsa_recover_batch_golden():
         assert bigint.from_limbs(qy[i]) == pubs[i][1]
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_sm2_verify_batch_golden():
     params = refimpl.SM2P256V1
     rng = np.random.default_rng(3)
@@ -191,6 +194,7 @@ def test_glv_split_device_matches_oracle():
         assert (k1 % n, k2 % n) == (ok1, ok2)
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_glv_ladder_matches_plain_shamir():
     """The endomorphism ladder and the plain Shamir ladder compute the
     same affine points for random (k1, k2, Q)."""

@@ -2,6 +2,8 @@
 
 import random
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -49,6 +51,7 @@ def _host_root(leaves, alg):
     return merkle.merkle_levels_host(leaves, alg)[-1][0]
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_merkle_root_device_vs_host():
     for alg in ("keccak256", "sm3"):
         for n in (1, 2, 16, 17, 40, 256, 300):
@@ -79,6 +82,7 @@ def test_merkle_proof():
     assert not merkle.verify_merkle_proof(leaves[4], bad, root)
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_suite_chunked_device_batches(monkeypatch):
     """Batches above CHUNK pipeline multiple kernel calls (double-buffered
     staging analogue) and must be bit-identical to the host oracle."""

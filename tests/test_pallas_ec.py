@@ -51,6 +51,7 @@ def _run(body, *arrays):
         interpret=True)(consts, *arrays))
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_vjac_double_matches(points):
     got = _run(lambda fc, p: pallas_ec.vjac_double(fc, p, True, False),
                points)
@@ -58,6 +59,7 @@ def test_vjac_double_matches(points):
     assert (got == want).all()
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_vjac_add_doubling_case(points):
     got = _run(lambda fc, p, q: pallas_ec.vjac_add(fc, p, q, True, False),
                points, points.copy())
@@ -66,6 +68,7 @@ def test_vjac_add_doubling_case(points):
     assert (got == want).all()
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_vjac_add_generic_and_infinity(points):
     q2 = np.asarray(ec.jac_double(CV, jnp.asarray(points)))
     got = _run(lambda fc, p, q: pallas_ec.vjac_add(fc, p, q, True, False),
@@ -79,6 +82,7 @@ def test_vjac_add_generic_and_infinity(points):
     assert (got == points).all()  # P + inf = P
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_sm2_point_ops_match():
     """The a = -3 branch of vjac_double/vjac_add (SM2, Montgomery base
     field) against the XLA ops — the secp tests only cover a = 0."""

@@ -89,6 +89,7 @@ def test_mul_const_column(field):
     assert (want == got).all()
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 @pytest.mark.parametrize("field", FIELDS[:2], ids=lambda f: f.name)
 def test_pow_const_fused(field):
     """Fused exponentiation matches the XLA scan path (small exponents in

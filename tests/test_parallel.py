@@ -36,6 +36,7 @@ def test_local_mesh_shape():
     assert local_mesh(1) is None
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_mesh_suite_verify_and_recover_match_host():
     meshed = make_suite(backend="device", device_min_batch=1,
                         mesh_devices=8)
@@ -54,6 +55,7 @@ def test_mesh_suite_verify_and_recover_match_host():
     assert meshed._mesh_kernels is not None  # the mesh path actually ran
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_mesh_suite_sm2_verify():
     meshed = make_suite(True, backend="device", device_min_batch=1,
                         mesh_devices=8)
@@ -64,6 +66,7 @@ def test_mesh_suite_sm2_verify():
     assert ok_m.tolist() == ok_h.tolist() == [True] * 7 + [False]
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_mesh_bucket_padding_covers_small_batches():
     """Batches below the mesh size still work (bucket >= mesh width)."""
     meshed = make_suite(backend="device", device_min_batch=1,

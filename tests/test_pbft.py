@@ -397,6 +397,7 @@ def test_four_node_sm_crypto_consensus(tmp_path):
         stop_cluster(gateway, nodes)
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_verify_overlaps_execute():
     """SURVEY §5 double-buffered staging: while height N executes on the
     execution lane, the engine worker keeps processing consensus packets —
@@ -553,6 +554,7 @@ def test_compatibility_version_rolling_upgrade():
         stop_cluster(gateway, nodes)
 
 
+@pytest.mark.slow  # jit-heavy / long round-trip: full-suite tier (VERDICT #7)
 def test_view_change_carries_multiple_pipelined_heights():
     """Waterline + view change: several heights can be PREPARED in flight
     when a view change hits (execution stalled on the leader's lane). The

@@ -12,6 +12,7 @@ from fisco_bcos_tpu.consensus.pbft.messages import (
     PacketType,
     PBFTMessage,
     make_packet,
+    pack_messages,
 )
 from fisco_bcos_tpu.crypto.suite import make_suite
 from fisco_bcos_tpu.executor import precompiled as pc
@@ -203,6 +204,104 @@ def test_garbage_and_replayed_packets_ignored(tmp_path):
             [n.ledger.current_number() for n in nodes]
         headers = [n.ledger.header_by_number(2) for n in nodes]
         assert len({h.hash(suite) for h in headers}) == 1
+    finally:
+        for n in nodes:
+            n.stop()
+        gateway.stop()
+
+
+def test_forged_carried_preprepare_rejected():
+    """A single Byzantine member forges a carried pre-prepare inside its
+    VIEW_CHANGE payload, claiming a HIGHER view than the genuinely prepared
+    proposal so it would displace it on re-propose. The new-view leader's
+    carried-proposal selection must verify each inner pre-prepare's leader
+    identity and signature and keep the legitimate one."""
+    suite, gateway, keypairs, nodes = _cluster(view_timeout=60.0)
+    try:
+        for node in nodes:
+            node.start()
+        eng = next(n.consensus for n in nodes if n.consensus is not None)
+        by_pub = {kp.pub_bytes: kp for kp in keypairs}
+
+        def kp_of(idx):
+            return by_pub[eng.nodes[idx]]
+
+        new_view = 2
+        leader0 = eng.leader_for(1, 0)
+        leader1 = eng.leader_for(1, 1)
+        byz_idx = next(i for i in range(eng.n)
+                       if i not in (leader0, leader1))
+
+        # the legitimate prepared proposal: height 1 sealed in view 0,
+        # carried with its leader's authentic inner signature AND the
+        # prepare quorum certificate that made it prepared
+        block = Block()
+        block.header.number = 1
+        block.header.timestamp = 1234
+        phash = block.header.hash(suite)
+        legit = make_packet(PacketType.PRE_PREPARE, 0, 1, leader0,
+                            phash, block.encode())
+        legit.sign(suite, kp_of(leader0))
+        legit_qc = []
+        for i in range(eng.quorum):
+            pv = make_packet(PacketType.PREPARE, 0, 1, i, phash)
+            pv.sign(suite, kp_of(i))
+            legit_qc.append(pv)
+
+        forged_block = Block()
+        forged_block.header.number = 1
+        forged_block.header.timestamp = 9999
+        fhash = forged_block.header.hash(suite)
+        # forgery A: claims view 1 (displaces view 0) under view 1's leader
+        # index, but only the Byzantine node's key signed it
+        forged_sig = make_packet(PacketType.PRE_PREPARE, 1, 1, leader1,
+                                 fhash, forged_block.encode())
+        forged_sig.sign(suite, kp_of(byz_idx))
+        # forgery B: validly signed by the Byzantine node under its OWN
+        # index — but it never led round (1, view 1)
+        forged_leader = make_packet(PacketType.PRE_PREPARE, 1, 1, byz_idx,
+                                    fhash, forged_block.encode())
+        forged_leader.sign(suite, kp_of(byz_idx))
+        # forgery C: validly signed by the NEW view's leader claiming the
+        # view being entered — a carried proposal must predate it
+        leader_new = eng.leader_for(1, new_view)
+        forged_view = make_packet(PacketType.PRE_PREPARE, new_view, 1,
+                                  leader_new, fhash, forged_block.encode())
+        forged_view.sign(suite, kp_of(leader_new))
+        # forgery D: the ex-leader attack — the node that legitimately LED
+        # (1, view 1) fabricates a "carried" pre-prepare for that round at
+        # view-change time with its own VALID signature, but can forge no
+        # prepare quorum (plus a lone self-prepare, far short of quorum)
+        ex_leader = make_packet(PacketType.PRE_PREPARE, 1, 1, leader1,
+                                fhash, forged_block.encode())
+        ex_leader.sign(suite, kp_of(leader1))
+        ex_leader_pv = make_packet(PacketType.PREPARE, 1, 1, leader1, fhash)
+        ex_leader_pv.sign(suite, kp_of(leader1))
+
+        payloads = [
+            pack_messages([legit] + legit_qc),
+            pack_messages([forged_sig]),
+            pack_messages([forged_leader]),
+            pack_messages([forged_view, ex_leader, ex_leader_pv]),
+        ]
+        vcs = []
+        for i, payload in enumerate(payloads):
+            vc = make_packet(PacketType.VIEW_CHANGE, new_view, 1, i,
+                             b"\x00" * 32, payload)
+            vc.sign(suite, kp_of(i))
+            vcs.append(vc)
+
+        carried = eng._carried_by_height(vcs, new_view)
+        assert 1 in carried, "legitimate carried proposal was lost"
+        assert carried[1].header.hash(suite) == phash, \
+            "a forged carried pre-prepare displaced the prepared proposal"
+
+        # and without its quorum certificate even the authentic carried
+        # proposal is not re-proposed (it provably never prepared)
+        vc_noqc = make_packet(PacketType.VIEW_CHANGE, new_view, 1, 0,
+                              b"\x00" * 32, pack_messages([legit]))
+        vc_noqc.sign(suite, kp_of(0))
+        assert eng._carried_by_height([vc_noqc], new_view) == {}
     finally:
         for n in nodes:
             n.stop()

@@ -157,7 +157,7 @@ def test_pairing_canonical_vectors(native):
     compatibility_version 1.1.0 — positive, negative and 10-pair cases."""
     from tests.data_bn256_pairing import PAIRING_VECTORS
 
-    gas = 2_000_000
+    gas = 20_000_000  # 10-pair corpus rows cost ~13.5M at the repriced gas
     for name, inp, exp in PAIRING_VECTORS[:4] + PAIRING_VECTORS[-3:]:
         data = bytes.fromhex(inp)
         res = call_pre(8, data, gas=gas, native=native, version="1.1.0")
@@ -211,7 +211,7 @@ def test_pairing_empty_and_malformed_at_1_1_0():
     assert q is not None, "no out-of-subgroup twist point found in range"
     g1 = (1, 2)
     data = w32(*g1, q[0][1], q[0][0], q[1][1], q[1][0])
-    res = call_pre(8, data, version="1.1.0", gas=500_000)
+    res = call_pre(8, data, version="1.1.0", gas=2_000_000)
     assert not res.success and res.gas_left == 0
 
 
@@ -260,3 +260,61 @@ def test_blake2f_huge_rounds_gas_gated_fast():
     res = call_pre(9, data, gas=50_000)
     assert _time.monotonic() - t0 < 1.0
     assert not res.success and res.error == "oog"
+
+
+def test_pairing_over_limit_fails_fast():
+    """An over-cap pairing call (the ~0.45 s/pair DoS vector) must be
+    refused in O(1) with a cap error — even with ample gas — instead of
+    pinning the execution lane for seconds."""
+    import time as _time
+
+    data = bytes(192) * (pcc.MAX_PAIRING_PAIRS + 1)  # all-infinity pairs
+    t0 = _time.monotonic()
+    res = call_pre(8, data, gas=1_000_000_000, version="1.1.0")
+    assert _time.monotonic() - t0 < 1.0
+    assert not res.success and res.gas_left == 0
+    assert "per-call cap" in res.error
+    # the raw implementation enforces the same cap for direct callers
+    with pytest.raises(pcc.PrecompileInputError):
+        pcc.bn128_pairing(data)
+    # under-gassed at-cap input also fails fast, by price
+    res = call_pre(8, bytes(192) * pcc.MAX_PAIRING_PAIRS, gas=100_000,
+                   version="1.1.0")
+    assert not res.success and res.error == "oog"
+
+
+def test_pairing_per_tx_budget():
+    """Nested frames of ONE transaction share a deterministic pairing-pair
+    budget (the contract-loops-CALLs DoS shape); a fresh transaction starts
+    with a full budget. The budget is per-tx, not a shared per-block
+    counter, so parallel DAG execution stays order-independent."""
+    from fisco_bcos_tpu.codec.wire import Writer
+    from fisco_bcos_tpu.ledger import ledger as ledger_mod
+    from tests.test_nevm import ENV as _ENV
+
+    evm = EVM(SUITE)
+    st = StateStorage(MemoryStorage())
+    w = Writer()
+    w.text("1.1.0").i64(0)
+    st.set(ledger_mod.SYS_CONFIG,
+           ledger_mod.SYSTEM_KEY_COMPATIBILITY_VERSION.encode(), w.bytes())
+    budget = evm.MAX_PAIRING_PAIRS_PER_TX
+    per_call = min(pcc.MAX_PAIRING_PAIRS, budget)
+    gas = pcc.G_PAIRING_BASE + pcc.G_PAIRING_PER_PAIR * per_call
+    data = bytes(192) * per_call  # infinity pairs: valid, cheap to parse
+    caller = b"\x22" * 20
+    # one tx: depth>0 frames do NOT reset the per-tx access context
+    evm.begin_tx_access(caller, addr(8))
+    calls, spent = 0, 0
+    while spent + per_call <= budget:
+        res = evm.execute_message(st, _ENV, caller, addr(8), 0, data, gas,
+                                  depth=1)
+        assert res.success, res.error
+        calls, spent = calls + 1, spent + per_call
+    assert calls >= 1
+    res = evm.execute_message(st, _ENV, caller, addr(8), 0, data, gas,
+                              depth=1)
+    assert not res.success and "per-transaction pair budget" in res.error
+    # a NEW transaction (depth-0 entry resets the tx context): full budget
+    res = evm.execute_message(st, _ENV, caller, addr(8), 0, data, gas)
+    assert res.success, res.error

@@ -55,7 +55,10 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
                 encrypt_passphrase: bytes | None = None,
                 crypto_backend: str = "auto",
                 metrics_base_port: int | None = None,
-                sm_tls: bool = False) -> dict:
+                sm_tls: bool = False,
+                p2p_base_port: int | None = None,
+                p2p_ports: list[int] | None = None,
+                host: str = "127.0.0.1") -> dict:
     suite = make_suite(sm_crypto, backend="host")
     keypairs = [suite.generate_keypair() for _ in range(n_nodes)]
     chain = ChainConfig(chain_id=chain_id, group_id=group_id,
@@ -69,6 +72,11 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
     info = {"chain_id": chain_id, "group_id": group_id,
             "sm_crypto": sm_crypto, "sm_tls": sm_tls,
             "consensus": consensus, "nodes": []}
+    # p2p plane: each node listens on its port and is configured with every
+    # OTHER node's endpoint (the deterministic smaller-id-dials rule in
+    # net/p2p.py picks the single live session per pair)
+    if p2p_ports is None and p2p_base_port is not None:
+        p2p_ports = [p2p_base_port + i for i in range(n_nodes)]
     metric_targets = []
     for i, kp in enumerate(keypairs):
         node_dir = os.path.join(out_dir, f"node{i}")
@@ -79,6 +87,10 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
             rpc_port=(rpc_base_port + i) if rpc_base_port is not None else None,
             metrics_port=(metrics_base_port + i)
             if metrics_base_port is not None else None,
+            p2p_host=host,
+            p2p_port=p2p_ports[i] if p2p_ports else None,
+            p2p_peers=[(host, p) for j, p in enumerate(p2p_ports or [])
+                       if j != i],
         )
         save_node_config(node_dir, cfg, chain, kp.secret,
                          storage_passphrase=encrypt_passphrase)
@@ -92,6 +104,7 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
             "node_id": kp.pub_bytes.hex(),
             "rpc_port": cfg.rpc_port,
             "metrics_port": cfg.metrics_port,
+            "p2p_port": cfg.p2p_port,
         })
     if metric_targets:
         _write_monitor_stack(out_dir, metric_targets)
@@ -136,6 +149,9 @@ def main() -> None:
     ap.add_argument("--chain-id", default="chain0")
     ap.add_argument("--group-id", default="group0")
     ap.add_argument("--rpc-base-port", type=int, default=None)
+    ap.add_argument("--p2p-base-port", type=int, default=None,
+                    help="per-node TCP p2p listeners + full-mesh peer "
+                         "lists (required to run nodes as OS processes)")
     ap.add_argument("--metrics-base-port", type=int, default=None,
                     help="per-node Prometheus ports + monitor stack bundle")
     ap.add_argument("--sm-tls", action="store_true",
@@ -152,6 +168,7 @@ def main() -> None:
         args.output, args.nodes, sm_crypto=args.sm,
         consensus=args.consensus, chain_id=args.chain_id,
         group_id=args.group_id, rpc_base_port=args.rpc_base_port,
+        p2p_base_port=args.p2p_base_port,
         metrics_base_port=args.metrics_base_port, sm_tls=args.sm_tls,
         encrypt_passphrase=args.encrypt_key.encode() if args.encrypt_key else None)
     if args.mode == "max":

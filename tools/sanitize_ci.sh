@@ -5,6 +5,10 @@
 #
 #   tools/sanitize_ci.sh            # full gate: ASan+UBSan, TSan, fuzz
 #   tools/sanitize_ci.sh --fast     # skip the @slow deep differential fuzz
+#   tools/sanitize_ci.sh --chaos    # ONLY the multi-process fault gate:
+#                                   # 4 OS-process TLS chain, kill -9 a node
+#                                   # mid-stream, assert it rejoins to the
+#                                   # same state root (tests/test_chaos_e2e)
 #
 # Exit 0 = every stage clean. Each stage rebuilds the sanitizer variants
 # from the CURRENT sources (the src-hash stamp keeps them honest) and runs
@@ -16,6 +20,15 @@ cd "$(dirname "$0")/.."
 
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
+
+if [ "${1:-}" = "--chaos" ]; then
+  echo "== [chaos] crash/fault e2e: kill -9 rejoin, leader view change," \
+       "degraded link (4 OS processes, SM-TLS, real JSON-RPC)"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+    python -m pytest tests/test_chaos_e2e.py -q -m slow -p no:cacheprovider
+  echo "sanitize_ci: CHAOS STAGE CLEAN"
+  exit 0
+fi
 
 LIBASAN="$(g++ -print-file-name=libasan.so)"
 LIBTSAN="$(g++ -print-file-name=libtsan.so)"
