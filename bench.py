@@ -237,6 +237,10 @@ def _cpu_reexec() -> None:
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+class _SkipIngest(Exception):
+    """BENCH_INGEST_TIMEOUT=0: skip the RPC-ingest supplementary row."""
+
+
 def main() -> None:
     if "FBTPU_BENCH_CHILD" not in os.environ:
         healthy, diag, _ = probe_default_backend(cwd=_REPO)
@@ -428,6 +432,50 @@ def main() -> None:
                 line["chain_transport"] = chain.get("transport", "fake")
         except Exception:
             pass
+        try:
+            # supplementary: concurrent RPC ingest through the
+            # continuous-batching lane (txpool/ingest.py) — the serving-
+            # stack amortization row. Bounded subprocess, same rationale
+            # as the chain bench above. BENCH_INGEST_TIMEOUT=0 skips it
+            # (quick local runs on slow hosts).
+            import subprocess as _sp
+
+            ingest_timeout = float(
+                os.environ.get("BENCH_INGEST_TIMEOUT", "300"))
+            if ingest_timeout <= 0:
+                raise _SkipIngest
+            r = _sp.run(
+                [sys.executable, "-u",
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmark", "chain_bench.py"),
+                 "--rpc-clients", "8", "-n", "800", "--backend", "host"],
+                timeout=ingest_timeout,
+                stdout=_sp.PIPE, stderr=_sp.DEVNULL, text=True)
+            rows = [json.loads(ln) for ln in r.stdout.splitlines()
+                    if ln.startswith("{")]
+            ing = next((row for row in rows
+                        if row.get("metric") == "rpc_ingest_tps"), None)
+            if ing and not ing.get("timed_out"):
+                line["rpc_ingest_tps"] = ing.get("value")
+                line["rpc_ingest_clients"] = ing.get("clients")
+                line["rpc_ingest_mean_batch"] = ing.get("mean_batch")
+                line["rpc_ingest_recover_calls_per_tx"] = ing.get(
+                    "recover_calls_per_tx")
+            elif ing:
+                print("[bench] rpc-ingest row dropped: chain timed out "
+                      f"({ing.get('txs_committed')} committed)",
+                      file=sys.stderr, flush=True)
+            else:
+                print("[bench] rpc-ingest bench produced no row "
+                      f"(rc={r.returncode})", file=sys.stderr, flush=True)
+        except _SkipIngest:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            # loud one-liner: a missing rpc_ingest_* block must read as
+            # "lane bench broken/wedged", never as an intentional skip
+            print(f"[bench] rpc-ingest bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
         print(json.dumps(line), flush=True)
     except Exception as exc:  # always emit a parseable line
         print(json.dumps({

@@ -67,7 +67,14 @@ def load_library():
                 ctypes.c_char_p, u8p, u8p, u8p]
             lib.ncrypto_sm2_sign_batch.restype = None
             _lib = lib
-        except (OSError, AttributeError):
+        except (OSError, AttributeError) as exc:
+            # LOUD single-line warning: this downgrade is bit-exact but
+            # ~200x slower (38 ms vs 0.2 ms per recover) — it once hid
+            # for a whole round behind a glibc-mismatched prebuilt .so
+            import sys
+            print(f"[nativeec] {path}: load failed ({exc}) — falling back "
+                  f"to pure-Python EC (~200x slower); rebuild with "
+                  f"`make -C native`", file=sys.stderr, flush=True)
             _lib = None
         _loaded = True
         return _lib

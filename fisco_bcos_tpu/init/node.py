@@ -29,6 +29,7 @@ from ..scheduler.scheduler import Scheduler
 from ..sealer.sealer import Sealer
 from ..storage.memory import MemoryStorage
 from ..storage.wal import WalStorage
+from ..txpool.ingest import IngestLane
 from ..txpool.txpool import TxPool
 from ..utils.log import LOG, badge
 from ..consensus.pbft.engine import PBFTEngine
@@ -49,6 +50,14 @@ class NodeConfig:
     tx_count_limit: int = 1000
     txpool_limit: int = 15000
     block_limit_range: int = 600
+    # continuous-batching ingest lane (txpool/ingest.py): coalesces
+    # concurrent RPC/gossip submissions into device-sized submit_batch
+    # calls. ingest_lane=False restores direct per-call submission (the
+    # per-request baseline, kept for benchmarking and odd embeddings).
+    ingest_lane: bool = True
+    ingest_max_batch: int = 4096
+    ingest_max_wait_ms: float = 15.0
+    ingest_queue_cap: int = 8192
     min_seal_time: float = 0.05
     consensus: str = "solo"  # solo | pbft
     crypto_backend: str = "auto"  # device | host | auto
@@ -99,6 +108,10 @@ class Node:
         self.txpool = TxPool(self.suite, self.ledger, cfg.chain_id,
                              cfg.group_id, cfg.txpool_limit,
                              cfg.block_limit_range)
+        self.ingest = IngestLane(
+            self.txpool, max_batch=cfg.ingest_max_batch,
+            max_wait_ms=cfg.ingest_max_wait_ms,
+            queue_cap=cfg.ingest_queue_cap) if cfg.ingest_lane else None
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool)
@@ -116,7 +129,8 @@ class Node:
         self.lightnode_server = None
         if gateway is not None:
             self.front = FrontService(self.keypair.pub_bytes, gateway)
-            self.txsync = TransactionSync(self.front, self.txpool, self.suite)
+            self.txsync = TransactionSync(self.front, self.txpool,
+                                          self.suite, ingest=self.ingest)
             self.blocksync = BlockSync(self.front, self.ledger,
                                        self.scheduler, self.suite,
                                        timesync=self.timesync)
@@ -177,6 +191,8 @@ class Node:
             # observers (not in the sealer set) follow via block sync
             if self.blocksync is not None:
                 self.blocksync.start()
+        if self.ingest is not None:
+            self.ingest.start()  # continuous-batching front door
         if self.txsync is not None:
             self.txsync.start()  # periodic pool anti-entropy sweep
         if self.rpc is not None:
@@ -223,6 +239,8 @@ class Node:
             self.rpc.stop()
         if self.ws is not None:
             self.ws.stop()
+        if self.ingest is not None:
+            self.ingest.stop()  # after RPC: no new submitters, drain queue
         self.sealer.stop()
         if self.consensus is not None:
             self.consensus.stop()
@@ -261,6 +279,29 @@ class Node:
 
     # -- client surface (pre-RPC, in-process) ------------------------------
     def send_transaction(self, tx) -> "object":
+        """-> TxSubmitResult, ALWAYS (the lightnode wire path and other
+        in-process embeddings encode res.status — lane conditions map to
+        statuses, they must not escape as exceptions)."""
+        if self.ingest is not None and self._started:
+            from ..protocol import TransactionStatus
+            from ..txpool.ingest import LaneStopped, TxPoolIsFull
+            from ..txpool.txpool import TxSubmitResult
+            from ..utils.task import TaskTimeout
+            try:
+                return self.ingest.submit(tx)
+            except TxPoolIsFull:
+                # same condition the pool itself reports as a status
+                return TxSubmitResult(tx.hash(self.suite),
+                                      TransactionStatus.TXPOOL_FULL)
+            except (LaneStopped, TaskTimeout):
+                pass  # shutdown race / wedged dispatcher: the pool still
+                #       works, and _precheck dedups a queued copy
+            except Exception:  # noqa: BLE001 — a failed DISPATCH rejects
+                # every coalesced submitter with the batch's error; retry
+                # THIS tx alone on the direct path so one bad cohort
+                # member can't poison the rest (a genuinely bad tx then
+                # reports its own failure from the pool)
+                LOG.exception(badge("NODE", "ingest-dispatch-failed"))
         return self.txpool.submit(tx)
 
     def call(self, tx):

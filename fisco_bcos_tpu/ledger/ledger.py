@@ -29,7 +29,7 @@ import dataclasses
 from typing import Optional, Sequence
 
 from ..codec.wire import Reader, Writer
-from ..protocol import Block, BlockHeader, Receipt, Transaction
+from ..protocol import Block, BlockHeader, Receipt, Transaction, batch_hash
 from ..storage.interface import StorageInterface
 from ..utils.log import LOG, badge
 
@@ -157,7 +157,7 @@ class Ledger:
         at commit time: its hash is only final after state_root is set."""
         header = block.header
         n = header.number
-        tx_hashes = [t.hash(self.suite) for t in block.transactions] \
+        tx_hashes = batch_hash(block.transactions, self.suite) \
             if block.transactions else list(block.tx_hashes)
         w = Writer()
         w.seq(tx_hashes, lambda ww, h: ww.blob(h))

@@ -31,7 +31,7 @@ import time
 from typing import Sequence
 
 from ..codec.wire import Reader, Writer
-from ..protocol import Transaction
+from ..protocol import Transaction, batch_hash
 from ..utils.log import LOG, badge, metric
 from ..utils.worker import Worker
 from .front import FrontService
@@ -45,6 +45,7 @@ def _pack_txs(txs: Sequence[Transaction], suite) -> bytes:
     rates. The claimed hash is only ever used to SKIP work for hashes the
     receiver already has; admission recomputes the real hash, so a lying
     peer can only skip its own delivery."""
+    batch_hash(txs, suite)  # fill any cold caches in ONE call, not per tx
     return Writer().seq(
         list(txs),
         lambda w, t: w.blob(t.hash(suite)).blob(t.encode())).bytes()
@@ -61,11 +62,15 @@ class TransactionSync(Worker):
     ANTI_ENTROPY_MAX = 256
 
     def __init__(self, front: FrontService, txpool, suite,
-                 anti_entropy_interval: float = 2.0):
+                 anti_entropy_interval: float = 2.0, ingest=None):
         super().__init__("tx-sync", idle_wait=0.25)
         self.front = front
         self.txpool = txpool
         self.suite = suite
+        # continuous-batching lane (txpool.ingest.IngestLane): gossip
+        # packets from many peers coalesce with RPC traffic into one
+        # device-sized recover instead of one recover per packet
+        self.ingest = ingest
         self.anti_entropy_interval = anti_entropy_interval
         self._last_sweep = 0.0
         self._lock = threading.Lock()
@@ -154,6 +159,15 @@ class TransactionSync(Worker):
         unknown = self.txpool.unknown_hashes([h for h, _raw in pairs])
         txs = [Transaction.decode(raw) for h, raw in pairs if h in unknown]
         if not txs:
+            return
+        if self.ingest is not None:
+            # continuous-batching lane: this packet coalesces with other
+            # peers' packets and concurrent RPC submissions into one
+            # recover. Fire-and-forget — under overload the lane drops
+            # (bounded queue) and the anti-entropy sweep re-delivers;
+            # blocking the p2p reader here would wedge the network plane
+            # behind the verify engine.
+            self.ingest.submit_many_nowait(txs)
             return
         # one TPU batch-recover for the whole gossip packet
         self.txpool.submit_batch(txs, broadcast=True)

@@ -324,7 +324,7 @@ class Block:
 
     # -- roots (TPU Merkle; BlockImpl.h:111,156) ---------------------------
     def calculate_txs_root(self, suite) -> bytes:
-        leaves = self.tx_hashes or [t.hash(suite) for t in self.transactions]
+        leaves = self.tx_hashes or batch_hash(self.transactions, suite)
         return suite.merkle_root(leaves)
 
     def calculate_receipts_root(self, suite) -> bytes:

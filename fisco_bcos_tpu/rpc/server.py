@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -153,14 +154,43 @@ class JsonRpcImpl:
                          wait: bool = True, timeout: float = 30.0):
         self._check_group(group)
         tx = Transaction.decode(_unhex(tx_hex))
-        res = self.node.txpool.submit(tx)
         from ..protocol import TransactionStatus
+        deadline = time.monotonic() + timeout
+        lane = getattr(self.node, "ingest", None)
+        if lane is not None:
+            # continuous-batching lane: this request's tx coalesces with
+            # every other in-flight sendTransaction (and gossip arrivals)
+            # into ONE batch recover; the future resolves with this tx's
+            # own admission result
+            from ..txpool.ingest import TxPoolIsFull
+            from ..utils.task import TaskTimeout
+            try:
+                res = lane.submit(tx, timeout=timeout)
+            except TxPoolIsFull as exc:
+                raise JsonRpcError(int(TransactionStatus.TXPOOL_FULL),
+                                   str(exc))
+            except TaskTimeout:
+                # same contract as the receipt timeout below: the tx MAY
+                # still land on chain; the client can re-query by hash
+                raise JsonRpcError(JSONRPC_INTERNAL_ERROR,
+                                   "timed out waiting for admission")
+            except Exception:  # noqa: BLE001 — LaneStopped or dispatch
+                # failure. submit_batch guards its broadcast hooks, so a
+                # dispatch exception means this tx was NOT admitted —
+                # retrying alone on the direct path is safe and isolates
+                # this request from a bad cohort member
+                res = self.node.txpool.submit(tx)
+        else:
+            res = self.node.txpool.submit(tx)
         if res.status != TransactionStatus.OK:
             raise JsonRpcError(int(res.status),
                                TransactionStatus(res.status).name)
         if not wait:
             return {"transactionHash": _hex(res.tx_hash), "status": None}
-        rc = self.node.txpool.wait_for_receipt(res.tx_hash, timeout)
+        # remaining budget only: admission may have consumed part of the
+        # client's timeout — wait=True must not double-spend it
+        rc = self.node.txpool.wait_for_receipt(
+            res.tx_hash, max(0.0, deadline - time.monotonic()))
         if rc is None:
             raise JsonRpcError(JSONRPC_INTERNAL_ERROR,
                                "timed out waiting for receipt")
@@ -250,9 +280,10 @@ class JsonRpcImpl:
         out["hash"] = _hex(block.header.hash(suite))
         if only_header:
             return out
+        from ..protocol import batch_hash
         if only_tx_hash:
             out["transactions"] = [_hex(h) for h in (
-                block.tx_hashes or [t.hash(suite) for t in block.transactions])]
+                block.tx_hashes or batch_hash(block.transactions, suite))]
         else:
             # one batch recover for all senders (not a per-tx scalar loop)
             from ..protocol import batch_recover_senders

@@ -81,11 +81,18 @@ class WalStorage(TransactionalStorage):
                 # LARGE suffix means mid-file corruption ate committed
                 # records and an operator must know
                 from ..utils.log import LOG, badge
-                with open(logp + ".corrupt", "wb") as f:
+                # unique evidence file per incident: a SECOND torn-tail
+                # crash must not overwrite the first one's preserved bytes
+                corrupt = logp + ".corrupt"
+                seq = 1
+                while os.path.exists(corrupt):
+                    corrupt = f"{logp}.corrupt-{seq}"
+                    seq += 1
+                with open(corrupt, "wb") as f:
                     f.write(raw[off:])
                 LOG.warning(badge("WAL", "torn-tail-truncated",
                                   kept=off, dropped=len(raw) - off,
-                                  saved=logp + ".corrupt"))
+                                  saved=corrupt))
                 with open(logp, "rb+") as f:
                     f.truncate(off)
                     f.flush()

@@ -1,5 +1,7 @@
 """Transaction pool: pending store + batch validator (bcos-txpool)."""
 
+from .ingest import IngestLane, LaneStopped, TxPoolIsFull
 from .txpool import TxPool, TxSubmitResult
 
-__all__ = ["TxPool", "TxSubmitResult"]
+__all__ = ["IngestLane", "LaneStopped", "TxPool", "TxPoolIsFull",
+           "TxSubmitResult"]

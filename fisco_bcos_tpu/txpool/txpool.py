@@ -69,8 +69,12 @@ class TxPool:
         self._nonces_by_block: dict[int, set[str]] = {}
         self._known_nonces: set[str] = set()
         self._on_ready: list[Callable[[], None]] = []
-        # receipt futures: tx hash -> Event set at commit (RPC waits on it)
-        self._waiters: dict[bytes, threading.Event] = {}
+        # receipt waits: one condition broadcast per commit. A shared CV
+        # (instead of the old per-hash Event dict) survives concurrent
+        # waiters on the same hash — with the dict, the first waiter to
+        # time out popped the registration and stranded the others — and
+        # costs one notify_all per BLOCK, not per waiting RPC thread.
+        self._receipt_cv = threading.Condition()
         self._async_waiters: dict[bytes, "object"] = {}  # hash -> Task
         # TransactionSync gossip hook (TransactionSync.cpp broadcast path)
         self._broadcast_hooks: list[Callable[[Sequence[Transaction]], None]] = []
@@ -93,7 +97,13 @@ class TxPool:
 
     def _notify_ready(self) -> None:
         for fn in self._on_ready:
-            fn()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — notifiers run AFTER
+                # admission: a raising sealer callback must not surface
+                # as a submit failure (the ingest lane's fallback treats
+                # submit_batch exceptions as "not admitted")
+                LOG.exception(badge("TXPOOL", "ready-notifier-failed"))
 
     # -- submission --------------------------------------------------------
     def submit(self, tx: Transaction) -> TxSubmitResult:
@@ -149,7 +159,16 @@ class TxPool:
                         if r.status == TransactionStatus.OK]
             if accepted:
                 for fn in self._broadcast_hooks:
-                    fn(accepted)
+                    try:
+                        fn(accepted)
+                    except Exception:  # noqa: BLE001 — the txs ARE admitted
+                        # a gossip-hook failure must not surface as a
+                        # submit failure: callers (and the ingest lane's
+                        # whole coalesced cohort) would misread an
+                        # admitted batch as rejected; anti-entropy
+                        # re-gossips what this hook dropped
+                        LOG.exception(badge("TXPOOL", "broadcast-hook-failed",
+                                            n=len(accepted)))
         return [r for r in results]
 
     def _precheck(self, tx: Transaction, h: bytes,
@@ -275,14 +294,17 @@ class TxPool:
         """Verify a proposal: every tx known (already validated at submit) or,
         if the proposal carries full txs, batch-verify the unknown ones
         (MemoryStorage.cpp:919 batchVerifyProposal)."""
-        hashes = block.tx_hashes or [t.hash(self.suite) for t in block.transactions]
+        # batch_hash: txs that rode submit -> seal on this node carry their
+        # cached hash; only gossip-fresh ones are hashed, in ONE call
+        hashes = block.tx_hashes or batch_hash(block.transactions, self.suite)
         with self._lock:
             missing = [h for h in hashes if h not in self._pending]
         if not missing:
             return True
         if not block.transactions:
             return False
-        by_hash = {t.hash(self.suite): t for t in block.transactions}
+        by_hash = dict(zip(batch_hash(block.transactions, self.suite),
+                           block.transactions))
         todo = [by_hash[h] for h in missing if h in by_hash]
         if len(todo) != len(missing):
             return False
@@ -316,12 +338,10 @@ class TxPool:
             expired = number - self.block_limit_range
             for bn in [b for b in self._nonces_by_block if b <= expired]:
                 self._known_nonces -= self._nonces_by_block.pop(bn)
-            events = [self._waiters.pop(h) for h in tx_hashes
-                      if h in self._waiters]
             tasks = [(h, self._async_waiters.pop(h)) for h in tx_hashes
                      if h in self._async_waiters]
-        for ev in events:
-            ev.set()
+        with self._receipt_cv:
+            self._receipt_cv.notify_all()
         for h, task in tasks:
             task.resolve(self.ledger.receipt(h))
         self._update_pending_gauge()
@@ -355,16 +375,25 @@ class TxPool:
 
     # -- RPC receipt waiting ----------------------------------------------
     def wait_for_receipt(self, tx_hash: bytes, timeout: float = 30.0):
-        """Block until the tx is committed; -> Receipt or None on timeout."""
+        """Block until the tx is committed; -> Receipt or None on timeout.
+
+        Event-driven: parks on `_receipt_cv` (broadcast once per committed
+        block from `on_block_committed`) instead of polling the ledger —
+        a node under concurrent RPC load must not burn its cores spinning.
+        The parked path's receipt check runs WHILE HOLDING the cv lock, so
+        a commit that lands between the check and the wait still delivers
+        its wakeup (the notifier can't broadcast until the waiter is
+        parked); the common already-committed path stays lock-free."""
         rc = self.ledger.receipt(tx_hash)
         if rc is not None:
             return rc
-        with self._lock:
-            ev = self._waiters.setdefault(tx_hash, threading.Event())
-        # commit may have landed between the first read and registration
-        if self.ledger.receipt(tx_hash) is not None:
-            ev.set()
-        ev.wait(timeout)
-        with self._lock:
-            self._waiters.pop(tx_hash, None)
-        return self.ledger.receipt(tx_hash)
+        deadline = time.monotonic() + timeout
+        with self._receipt_cv:
+            while True:
+                rc = self.ledger.receipt(tx_hash)
+                if rc is not None:
+                    return rc
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._receipt_cv.wait(left)

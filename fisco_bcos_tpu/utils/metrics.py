@@ -71,12 +71,18 @@ class MetricsRegistry:
             self._gauges[self._key(name, labels)] = value
 
     def observe(self, name: str, value: float,
-                labels: Optional[dict] = None) -> None:
+                labels: Optional[dict] = None,
+                buckets: Optional[tuple] = None) -> None:
+        """`buckets` applies on first observation of a series only (a
+        histogram's buckets are immutable once created) — pass it for
+        non-latency series (e.g. batch sizes) where the time-shaped
+        DEFAULT_BUCKETS would collapse everything into +Inf."""
         k = self._key(name, labels)
         with self._lock:
             h = self._hists.get(k)
             if h is None:
-                h = self._hists[k] = _Histogram(self.DEFAULT_BUCKETS)
+                h = self._hists[k] = _Histogram(buckets or
+                                                self.DEFAULT_BUCKETS)
             h.observe(value)
 
     def timer(self, name: str, labels: Optional[dict] = None):

@@ -29,6 +29,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <functional>  // std::ref — not transitively included by older libstdc++
 #include <mutex>
 
 namespace {

@@ -9,6 +9,10 @@
 #                                   # 4 OS-process TLS chain, kill -9 a node
 #                                   # mid-stream, assert it rejoins to the
 #                                   # same state root (tests/test_chaos_e2e)
+#   tools/sanitize_ci.sh --ingest   # ONLY the continuous-batching smoke:
+#                                   # short chain_bench --rpc-clients run,
+#                                   # assert the lane coalesces (mean batch
+#                                   # > 1) and emits an rpc_ingest_tps row
 #
 # Exit 0 = every stage clean. Each stage rebuilds the sanitizer variants
 # from the CURRENT sources (the src-hash stamp keeps them honest) and runs
@@ -20,6 +24,27 @@ cd "$(dirname "$0")/.."
 
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
+
+if [ "${1:-}" = "--ingest" ]; then
+  echo "== [ingest] continuous-batching lane smoke: 4 HTTP clients," \
+       "200 txs through the 4-node chain's ingest lane"
+  OUT="$(JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python benchmark/chain_bench.py --rpc-clients 4 -n 200 --backend host \
+    2>/dev/null | grep '"metric": "rpc_ingest_tps"')"
+  echo "$OUT"
+  python - "$OUT" <<'EOF'
+import json, sys
+row = json.loads(sys.argv[1])
+assert not row.get("timed_out"), f"chain wedged: {row}"
+assert row["txs_committed"] >= 200, row
+assert row["mean_batch"] > 1.0, f"lane not coalescing: {row}"
+assert row["recover_calls_per_tx"] < 1.0, row
+print("sanitize_ci: INGEST STAGE CLEAN "
+      f"(tps={row['tps']}, mean_batch={row['mean_batch']}, "
+      f"recover/tx={row['recover_calls_per_tx']})")
+EOF
+  exit 0
+fi
 
 if [ "${1:-}" = "--chaos" ]; then
   echo "== [chaos] crash/fault e2e: kill -9 rejoin, leader view change," \
