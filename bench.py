@@ -237,8 +237,27 @@ def _cpu_reexec() -> None:
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
-class _SkipIngest(Exception):
-    """BENCH_INGEST_TIMEOUT=0: skip the RPC-ingest supplementary row."""
+class _SkipStage(Exception):
+    """BENCH_<STAGE>_TIMEOUT=0: explicit opt-out of a supplementary row."""
+
+
+def _chain_bench_rows(argv: list[str], timeout_env: str,
+                      default_timeout: float) -> tuple[list[dict], int]:
+    """Run benchmark/chain_bench.py `argv` as a bounded subprocess (a chain
+    wedge can never break the bench line) and return its parsed JSON rows
+    plus the return code. `<timeout_env>=0` raises _SkipStage."""
+    import subprocess as sp
+
+    timeout = float(os.environ.get(timeout_env, str(default_timeout)))
+    if timeout <= 0:
+        raise _SkipStage
+    r = sp.run(
+        [sys.executable, "-u",
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "benchmark", "chain_bench.py"), *argv],
+        timeout=timeout, stdout=sp.PIPE, stderr=sp.DEVNULL, text=True)
+    return ([json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")], r.returncode)
 
 
 def main() -> None:
@@ -407,22 +426,12 @@ def main() -> None:
                     }
         try:
             # supplementary: the end-to-end 4-node chain TPS on THIS host
-            # (round 5's battle; the device grid stays the headline). A
-            # bounded subprocess so a chain wedge can never break the
-            # bench line.
-            import subprocess as _sp
-
-            r = _sp.run(
-                [sys.executable, "-u",
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "benchmark", "chain_bench.py"),
-                 "-n", "3000", "--backend", "host"],
-                timeout=float(os.environ.get("BENCH_CHAIN_TIMEOUT", "240")),
-                stdout=_sp.PIPE, stderr=_sp.DEVNULL, text=True)
-            rows = [ln for ln in r.stdout.splitlines()
-                    if ln.startswith("{")]
+            # (round 5's battle; the device grid stays the headline).
+            rows, _ = _chain_bench_rows(
+                ["-n", "3000", "--backend", "host"],
+                "BENCH_CHAIN_TIMEOUT", 240)
             if rows:
-                chain = json.loads(rows[-1])
+                chain = rows[-1]
                 line["chain_tps_4node_host"] = chain.get("value")
                 line["chain_block_interval_ms"] = chain.get(
                     "block_interval_mean_ms")
@@ -435,24 +444,11 @@ def main() -> None:
         try:
             # supplementary: concurrent RPC ingest through the
             # continuous-batching lane (txpool/ingest.py) — the serving-
-            # stack amortization row. Bounded subprocess, same rationale
-            # as the chain bench above. BENCH_INGEST_TIMEOUT=0 skips it
+            # stack amortization row. BENCH_INGEST_TIMEOUT=0 skips it
             # (quick local runs on slow hosts).
-            import subprocess as _sp
-
-            ingest_timeout = float(
-                os.environ.get("BENCH_INGEST_TIMEOUT", "300"))
-            if ingest_timeout <= 0:
-                raise _SkipIngest
-            r = _sp.run(
-                [sys.executable, "-u",
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "benchmark", "chain_bench.py"),
-                 "--rpc-clients", "8", "-n", "800", "--backend", "host"],
-                timeout=ingest_timeout,
-                stdout=_sp.PIPE, stderr=_sp.DEVNULL, text=True)
-            rows = [json.loads(ln) for ln in r.stdout.splitlines()
-                    if ln.startswith("{")]
+            rows, rc = _chain_bench_rows(
+                ["--rpc-clients", "8", "-n", "800", "--backend", "host"],
+                "BENCH_INGEST_TIMEOUT", 300)
             ing = next((row for row in rows
                         if row.get("metric") == "rpc_ingest_tps"), None)
             if ing and not ing.get("timed_out"):
@@ -467,13 +463,40 @@ def main() -> None:
                       file=sys.stderr, flush=True)
             else:
                 print("[bench] rpc-ingest bench produced no row "
-                      f"(rc={r.returncode})", file=sys.stderr, flush=True)
-        except _SkipIngest:
+                      f"(rc={rc})", file=sys.stderr, flush=True)
+        except _SkipStage:
             pass  # explicit opt-out, stay quiet
         except Exception as exc:
             # loud one-liner: a missing rpc_ingest_* block must read as
             # "lane bench broken/wedged", never as an intentional skip
             print(f"[bench] rpc-ingest bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
+            # supplementary: joining-node catch-up, full replay vs
+            # snap-sync (snapshot/ subsystem) on THIS host.
+            # BENCH_SYNC_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--sync-bench", "--sync-blocks", "40"],
+                "BENCH_SYNC_TIMEOUT", 240)
+            rep = next((row for row in rows
+                        if row.get("metric") == "replay_blocks_per_sec"),
+                       None)
+            snap = next((row for row in rows
+                         if row.get("metric") == "snap_sync_seconds"), None)
+            if rep and snap:
+                line["replay_blocks_per_sec"] = rep.get("value")
+                line["snap_sync_seconds"] = snap.get("value")
+                line["snap_sync_state_bytes"] = snap.get("state_bytes")
+                line["snap_sync_speedup_vs_replay"] = snap.get(
+                    "speedup_vs_replay")
+            else:
+                print("[bench] sync bench produced no rows "
+                      f"(rc={rc})", file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] sync bench failed: "
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         print(json.dumps(line), flush=True)

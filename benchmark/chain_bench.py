@@ -25,9 +25,17 @@ the amortization the lane exists to buy. --rpc-compare additionally runs
 the per-request baseline (lane disabled) and a single-client run, so the
 coalescing win is measured against both anchors in one invocation.
 
+Sync-bench mode (--sync-bench): a joining node's catch-up time, measured
+both ways against the same source chain — full block-by-block replay vs
+snap-sync (snapshot/ subsystem: one manifest + chunked state install, tail
+replay only). Reports `replay_blocks_per_sec` and `snap_sync_seconds`
+rows picked up by bench.py; the speedup is the O(chain length) ->
+O(state size) win the checkpoint subsystem exists to buy.
+
 Usage: python benchmark/chain_bench.py [-n 2000] [--backend auto|host]
        [--suite ecdsa|sm|both] [--tx-count-limit 1000]
        python benchmark/chain_bench.py --rpc-clients 8 [--rpc-compare]
+       python benchmark/chain_bench.py --sync-bench [--sync-blocks 40]
 """
 
 from __future__ import annotations
@@ -348,6 +356,116 @@ def run_rpc_ingest(sm: bool, n: int, backend: str, tx_count_limit: int,
     }
 
 
+def run_sync_bench(sm: bool, n_blocks: int, txs_per_block: int = 10) -> list:
+    """Join-time comparison on one source chain: replay vs snap-sync.
+
+    A single-sealer PBFT chain commits `n_blocks` full blocks; then two
+    fresh joiners catch up from it over the in-process gateway — one forced
+    through block replay (snap_sync_threshold=0), one through snap-sync
+    (source checkpoints first). Same chain, same transport, same suite.
+    """
+    import time as _t
+
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode
+    from fisco_bcos_tpu.net.gateway import FakeGateway
+    from fisco_bcos_tpu.protocol import Transaction
+
+    n_txs = n_blocks * txs_per_block
+    print(f"sync-bench: building a {n_blocks}-block source chain "
+          f"({n_txs} txs)...", file=sys.stderr, flush=True)
+    wire_txs = _build_workload(sm, n_txs, block_limit=min(
+        600, 2 * n_blocks + 50))
+
+    suite = make_suite(sm, backend="host")
+    gw = FakeGateway()
+    kp = suite.generate_keypair(b"\x01" * 16)
+    sealers = [ConsensusNode(kp.pub_bytes)]
+    src = Node(NodeConfig(consensus="pbft", sm_crypto=sm,
+                          crypto_backend="host", min_seal_time=0.0,
+                          view_timeout=30.0,
+                          tx_count_limit=txs_per_block),
+               keypair=kp, gateway=gw)
+    src.build_genesis(sealers)
+    src.start()
+    rows = []
+    joiners = []
+    try:
+        for s in range(0, n_txs, 256):
+            txs = [Transaction.decode(raw) for raw in wire_txs[s:s + 256]]
+            src.txpool.submit_batch(txs)
+        deadline = _t.monotonic() + max(120.0, n_txs / 20)
+        while _t.monotonic() < deadline:
+            if src.ledger.total_tx_count() >= n_txs:
+                break
+            _t.sleep(0.05)
+        head = src.ledger.current_number()
+        if src.ledger.total_tx_count() < n_txs:
+            raise RuntimeError(
+                f"source chain wedged at {src.ledger.total_tx_count()}/"
+                f"{n_txs} txs")
+
+        def join(threshold: int) -> tuple[float, "Node"]:
+            node = Node(NodeConfig(consensus="pbft", sm_crypto=sm,
+                                   crypto_backend="host",
+                                   snap_sync_threshold=threshold),
+                        suite=suite, gateway=gw)
+            node.build_genesis(sealers)
+            t0 = _t.perf_counter()
+            node.start()
+            deadline = _t.monotonic() + max(120.0, n_blocks)
+            while _t.monotonic() < deadline:
+                if node.ledger.current_number() >= head:
+                    break
+                _t.sleep(0.02)
+            secs = _t.perf_counter() - t0
+            joiners.append(node)
+            if node.ledger.current_number() < head:
+                raise RuntimeError(
+                    f"joiner wedged at {node.ledger.current_number()}/"
+                    f"{head}")
+            return secs, node
+
+        replay_secs, replay_node = join(threshold=0)
+        assert replay_node.blocksync.sync_mode == "replay"
+        # stop the replay joiner BEFORE the snap join: at the same height
+        # as src it would tie the peer selection, and its empty snapshot
+        # store would make the snap joiner fall back to replay
+        replay_node.stop()
+        joiners.remove(replay_node)
+        rows.append({
+            "metric": "replay_blocks_per_sec",
+            "value": round(head / replay_secs, 2), "unit": "blocks/sec",
+            "suite": "sm" if sm else "ecdsa", "blocks": head,
+            "txs": n_txs, "join_seconds": round(replay_secs, 3),
+        })
+
+        manifest = src.snapshot.checkpoint()
+        snap_secs, snap_node = join(threshold=max(1, n_blocks // 10))
+        assert snap_node.blocksync.sync_mode == "snap", \
+            "snap joiner fell back to replay"
+        rows.append({
+            "metric": "snap_sync_seconds",
+            "value": round(snap_secs, 3), "unit": "sec",
+            "suite": "sm" if sm else "ecdsa", "blocks": head,
+            "txs": n_txs, "chunks": manifest.chunk_count,
+            "state_bytes": manifest.total_bytes,
+            "replay_join_seconds": round(replay_secs, 3),
+            "speedup_vs_replay": round(replay_secs / snap_secs, 1)
+            if snap_secs > 0 else None,
+        })
+        return rows
+    finally:
+        for node in joiners:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        src.stop()
+        gw.stop()
+
+
 def _emit_rpc_mode(args, sm: bool) -> None:
     runs = []
     if args.rpc_compare:
@@ -398,10 +516,20 @@ def main() -> None:
     ap.add_argument("--rpc-compare", action="store_true",
                     help="with --rpc-clients: also run the per-request "
                          "baseline (lane off) and a single-client run")
+    ap.add_argument("--sync-bench", action="store_true",
+                    help="join-time mode: full-replay vs snap-sync catch-up "
+                         "against the same source chain")
+    ap.add_argument("--sync-blocks", type=int, default=40,
+                    help="with --sync-bench: source chain length in blocks")
     args = ap.parse_args()
 
     suites = [False, True] if args.suite == "both" else \
         [args.suite == "sm"]
+    if args.sync_bench:
+        for sm in suites:
+            for row in run_sync_bench(sm, args.sync_blocks):
+                print(json.dumps(row), flush=True)
+        return
     if args.rpc_clients > 0:
         for sm in suites:
             _emit_rpc_mode(args, sm)

@@ -156,7 +156,9 @@ class NodeDaemon:
                        p2p=f"{self.gateway.host}:{self.gateway.port}",
                        rpc=self.node.rpc.port if self.node.rpc else None,
                        tls=tls is not None,
-                       number=self.node.ledger.current_number()))
+                       number=self.node.ledger.current_number(),
+                       snapshot=cfg.snapshot_interval,
+                       pruned_below=self.node.ledger.pruned_below()))
 
     def shutdown(self) -> None:
         """Graceful stop: workers, p2p sessions, then flush/close the WAL."""

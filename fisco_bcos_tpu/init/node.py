@@ -74,6 +74,22 @@ class NodeConfig:
     # runs this many heights ahead of the committed block while execution
     # stays strictly ordered
     waterline: int = 8
+    # snapshot/checkpoint subsystem (fisco_bcos_tpu/snapshot/): every
+    # `snapshot_interval` committed blocks export a chunked Merkle-committed
+    # state snapshot; keep `snapshot_retention` of them; when
+    # `snapshot_prune` is on, drop block bodies below the checkpoint (keep
+    # headers) and compact the WAL. A joining node more than
+    # `snap_sync_threshold` blocks behind fetches a snapshot instead of
+    # replaying the chain (0 disables the preference; pruned-below answers
+    # still force it).
+    snapshot_interval: int = 0  # blocks between checkpoints; 0 = disabled
+    snapshot_retention: int = 2
+    snapshot_prune: bool = False
+    # replayable blocks kept above the prune floor, so a peer lagging by a
+    # few blocks catches up via tail replay instead of a full snap-sync
+    snapshot_keep_tail: int = 64
+    snap_sync_threshold: int = 256
+    snapshot_chunk_bytes: int = 1 << 20
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
     ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
@@ -131,9 +147,25 @@ class Node:
             self.front = FrontService(self.keypair.pub_bytes, gateway)
             self.txsync = TransactionSync(self.front, self.txpool,
                                           self.suite, ingest=self.ingest)
-            self.blocksync = BlockSync(self.front, self.ledger,
-                                       self.scheduler, self.suite,
-                                       timesync=self.timesync)
+        # snapshot/checkpoint service: always constructed (RPC status +
+        # operator checkpoint() work on any node); its periodic worker only
+        # runs when snapshot_interval > 0, and it serves SnapshotSync
+        # whenever there is a front
+        import os as _os
+        from ..snapshot.service import SnapshotService
+        self.snapshot = SnapshotService(
+            self.storage, self.ledger, self.suite, front=self.front,
+            interval=cfg.snapshot_interval, retention=cfg.snapshot_retention,
+            chunk_bytes=cfg.snapshot_chunk_bytes, prune=cfg.snapshot_prune,
+            keep_tail=cfg.snapshot_keep_tail,
+            keep_nonces=cfg.block_limit_range,
+            store_dir=_os.path.join(cfg.storage_path, "snapshots")
+            if cfg.storage_path else None)
+        if self.front is not None:
+            self.blocksync = BlockSync(
+                self.front, self.ledger, self.scheduler, self.suite,
+                timesync=self.timesync, snapshot=self.snapshot,
+                snap_sync_threshold=cfg.snap_sync_threshold)
             from ..net.amop import AMOPService
             self.amop = AMOPService(self.front)
             from ..lightnode import LightNodeServer
@@ -191,6 +223,8 @@ class Node:
             # observers (not in the sealer set) follow via block sync
             if self.blocksync is not None:
                 self.blocksync.start()
+        if self.config.snapshot_interval > 0:
+            self.snapshot.start()  # periodic checkpoint + prune worker
         if self.ingest is not None:
             self.ingest.start()  # continuous-batching front door
         if self.txsync is not None:
@@ -241,6 +275,7 @@ class Node:
             self.ws.stop()
         if self.ingest is not None:
             self.ingest.stop()  # after RPC: no new submitters, drain queue
+        self.snapshot.stop()
         self.sealer.stop()
         if self.consensus is not None:
             self.consensus.stop()

@@ -42,10 +42,14 @@ T_NONCES = "s_number_2_nonces"
 T_STATE = "s_current_state"
 SYS_CONFIG = "s_config"
 SYS_CONSENSUS = "s_consensus"
+# snapshot/pruning bookkeeping (snapshot/ subsystem): blocks with
+# number < pruned_below keep only their header + hash->number row
+T_SNAPSHOT = "s_snapshot_state"
 
 K_CURRENT = b"current_number"
 K_TOTAL_TX = b"total_transaction_count"
 K_TOTAL_FAILED = b"total_failed_transaction_count"
+K_PRUNED_BELOW = b"pruned_below"
 
 GENESIS_EXTRA = b"bcos-tpu genesis"
 
@@ -237,6 +241,73 @@ class Ledger:
                 if rc is not None:
                     blk.receipts.append(rc)
         return blk
+
+    # -- history pruning (snapshot subsystem) ------------------------------
+    def pruned_below(self) -> int:
+        """Blocks below this height have no bodies (headers remain). 0 when
+        nothing was ever pruned."""
+        v = self.storage.get(T_SNAPSHOT, K_PRUNED_BELOW)
+        return int.from_bytes(v, "big") if v else 0
+
+    # nonce rows outlive the rest of a pruned block's body by this many
+    # blocks: the txpool's duplicate-nonce filter (block_limit_range,
+    # default 600) is rebuilt from T_NONCES after a snap-sync jump — prune
+    # them too early and a recently-committed tx could be re-admitted
+    NONCE_RETAIN_BLOCKS = 600
+    # blocks swept per remove_batch round (bounds sweep memory + WAL record
+    # size on the first prune of a long chain)
+    PRUNE_SWEEP_BLOCKS = 256
+
+    def prune_block_data(self, below: int,
+                         keep_nonces: Optional[int] = None) -> int:
+        """Drop tx bodies/receipts/nonces for blocks < `below` (headers and
+        hash->number rows stay: seal verification and proofs-of-lineage
+        survive pruning; nonce rows are kept for an extra `keep_nonces`
+        blocks — see NONCE_RETAIN_BLOCKS). Returns the number of blocks
+        swept. Idempotent.
+
+        Crash-safe ordering: the floor is persisted FIRST (range serving
+        refuses `lo < floor` from that instant, so no peer can ever be
+        served a half-pruned body); each sweep then derives its work from
+        the LIVE keys of the table it prunes, and within every batch
+        T_NUM2TXS — the work list the tx/receipt sweep depends on — is
+        removed LAST. A kill -9 anywhere mid-sweep leaves orphan rows that
+        the next checkpoint's sweep picks up, never a stale floor over
+        missing bodies."""
+        if keep_nonces is None:
+            keep_nonces = self.NONCE_RETAIN_BLOCKS
+        lo = self.pruned_below()
+        below = min(below, self.current_number() + 1)
+        if below > lo:
+            self.storage.set(T_SNAPSHOT, K_PRUNED_BELOW, _be8(below))
+        floor = max(below, lo)
+        body_keys = sorted(k for k in self.storage.keys(T_NUM2TXS)
+                           if int.from_bytes(k, "big") < floor)
+        # sweep in bounded batches: the first prune of a long archive chain
+        # covers millions of txs — one remove_batch over all of them would
+        # hold O(history) hashes in memory and fsync one giant WAL record
+        # while commits wait on the storage lock
+        step = self.PRUNE_SWEEP_BLOCKS
+        txs = 0
+        for s in range(0, len(body_keys), step):
+            batch = body_keys[s:s + step]
+            tx_keys: list[bytes] = []
+            for key in batch:
+                tx_keys.extend(self.tx_hashes_by_number(
+                    int.from_bytes(key, "big")))
+            txs += len(tx_keys)
+            self.storage.remove_batch(T_TX, tx_keys)
+            self.storage.remove_batch(T_RECEIPT, tx_keys)
+            self.storage.remove_batch(T_NUM2TXS, batch)
+        nonce_floor = floor - keep_nonces
+        nonce_keys = [k for k in self.storage.keys(T_NONCES)
+                      if int.from_bytes(k, "big") < nonce_floor]
+        for s in range(0, len(nonce_keys), step):
+            self.storage.remove_batch(T_NONCES, nonce_keys[s:s + step])
+        if body_keys:
+            LOG.info(badge("LEDGER", "pruned", below=floor,
+                           blocks=len(body_keys), txs=txs))
+        return len(body_keys)
 
     # -- proofs (Ledger.cpp:759-844) --------------------------------------
     def tx_proof(self, tx_hash: bytes):

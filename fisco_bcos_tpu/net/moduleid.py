@@ -20,6 +20,7 @@ class ModuleID(enum.IntEnum):
     BlockSync = 2000
     TxsSync = 2001
     ConsTxsSync = 2002
+    SnapshotSync = 2003  # manifest/chunk fetch for snap-sync (snapshot/)
     AMOP = 3000
     LIGHTNODE_GET_BLOCK = 4000
     LIGHTNODE_GET_TRANSACTIONS = 4001

@@ -105,6 +105,7 @@ class JsonRpcImpl:
             "getPbftView": self.get_pbft_view,
             "getPendingTxSize": self.get_pending_tx_size,
             "getSyncStatus": self.get_sync_status,
+            "getSnapshotStatus": self.get_snapshot_status,
             "getConsensusStatus": self.get_consensus_status,
             "getSystemConfigByKey": self.get_system_config_by_key,
             "getTotalTransactionCount": self.get_total_transaction_count,
@@ -359,6 +360,17 @@ class JsonRpcImpl:
         bs = self.node.blocksync
         return bs.status() if bs is not None else \
             {"blockNumber": self.node.ledger.current_number(), "peers": {}}
+
+    def get_snapshot_status(self, group: str, node_name: str = ""):
+        """Checkpoint/pruning state of this node (snapshot/ subsystem):
+        last snapshot height + root, pruned-below floor, and the sync mode
+        (replay vs snap) the node last used to catch up."""
+        self._check_group(group)
+        snap = getattr(self.node, "snapshot", None)
+        out = snap.status() if snap is not None else {"enabled": False}
+        bs = self.node.blocksync
+        out["syncMode"] = bs.sync_mode if bs is not None else "replay"
+        return out
 
     def get_consensus_status(self, group: str, node_name: str = ""):
         self._check_group(group)

@@ -160,6 +160,22 @@ class Scheduler:
                ms=int((time.monotonic() - t0) * 1000))
         return True
 
+    def external_commit(self, number: int) -> None:
+        """The chain advanced OUTSIDE the execute/commit pipeline (snapshot
+        install jumped the ledger to a checkpoint height): drop execution
+        results the jump obsoleted, reconcile the txpool (per-block commit
+        notifications never ran for the jumped range) and fan out the
+        commit notification so eventsub/consensus observers see the new
+        height."""
+        with self._lock:
+            for h in [h for h, r in self._executed.items()
+                      if r.header.number <= number]:
+                self._executed.pop(h, None)
+        if self.txpool is not None:
+            self.txpool.on_snapshot_installed(number)
+        self._notify_q.put(number)
+        metric("scheduler.external_commit", number=number)
+
     def shutdown(self) -> None:
         """Stop the notifier thread (node shutdown)."""
         self._notify_q.put(None)

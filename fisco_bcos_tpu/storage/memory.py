@@ -39,6 +39,11 @@ class MemoryStorage(TransactionalStorage):
                         if k.startswith(prefix))
         return iter(ks)
 
+    def tables(self) -> list[str]:
+        """Live table names (snapshot export, operator tooling)."""
+        with self._lock:
+            return sorted(self._tables)
+
     # -- 2PC ---------------------------------------------------------------
     def prepare(self, block_number: int, changes: ChangeSet) -> None:
         with self._lock:

@@ -139,7 +139,8 @@ class ChaosHarness:
     # min_seal_time batches the trickle of RPC submits into fewer blocks
     def __init__(self, out_dir: str, n_nodes: int = 4, tls: bool = True,
                  view_timeout: float = 8.0, min_seal_time: float = 0.2,
-                 sm_crypto: bool = False):
+                 sm_crypto: bool = False,
+                 config_overrides: Optional[dict] = None):
         sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
         from build_chain import build_chain
 
@@ -157,7 +158,8 @@ class ChaosHarness:
         self.tls = tls
         for node in self.info["nodes"]:
             self._patch_config(node["dir"], view_timeout=view_timeout,
-                               min_seal_time=min_seal_time)
+                               min_seal_time=min_seal_time,
+                               **(config_overrides or {}))
         self.procs: list[Optional[subprocess.Popen]] = [None] * n_nodes
         self.proxies: list[LinkProxy] = []
 
@@ -223,6 +225,17 @@ class ChaosHarness:
             p.wait(timeout=30)
         self.procs[i] = None
 
+    def wipe_data(self, i: int) -> None:
+        """Disk loss: destroy the node's data directory (WAL, snapshots,
+        consensus log — everything below [storage] path). The node's keys
+        and config survive, so a restart is the disaster-recovery path:
+        genesis bootstrap, then catch-up (snap-sync when far behind)."""
+        import shutil
+        assert self.procs[i] is None or self.procs[i].poll() is not None, \
+            f"refusing to wipe node{i} while it is running"
+        shutil.rmtree(os.path.join(self.info["nodes"][i]["dir"], "data"),
+                      ignore_errors=True)
+
     def terminate(self, i: int, timeout: float = 30.0) -> int:
         """SIGTERM graceful shutdown; returns the exit code."""
         p = self.procs[i]
@@ -283,6 +296,10 @@ class ChaosHarness:
     def state_root(self, i: int, number: int) -> Optional[str]:
         blk = self.client(i).get_block_by_number(number, only_header=True)
         return blk["stateRoot"] if blk else None
+
+    def snapshot_status(self, i: int) -> dict:
+        return self.client(i).request(
+            "getSnapshotStatus", [self.info["group_id"], ""])
 
     def total_txs(self, i: int) -> int:
         return self.client(i).get_total_transaction_count()[

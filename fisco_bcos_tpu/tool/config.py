@@ -98,6 +98,12 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                        "tx_count_limit": str(cfg.tx_count_limit)}
     cp["storage"] = {"type": "wal" if cfg.storage_path else "memory",
                      "path": cfg.storage_path or ""}
+    cp["snapshot"] = {"interval": str(cfg.snapshot_interval),
+                      "retention": str(cfg.snapshot_retention),
+                      "prune": str(cfg.snapshot_prune).lower(),
+                      "keep_tail": str(cfg.snapshot_keep_tail),
+                      "snap_sync_threshold": str(cfg.snap_sync_threshold),
+                      "chunk_bytes": str(cfg.snapshot_chunk_bytes)}
     cp["rpc"] = {"listen_ip": cfg.rpc_host,
                  "listen_port": "" if cfg.rpc_port is None else str(cfg.rpc_port)}
     cp["p2p"] = {"listen_ip": cfg.p2p_host,
@@ -151,6 +157,14 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         leader_period=cp.getint("consensus", "leader_period", fallback=1),
         tx_count_limit=cp.getint("consensus", "tx_count_limit",
                                  fallback=1000),
+        snapshot_interval=cp.getint("snapshot", "interval", fallback=0),
+        snapshot_retention=cp.getint("snapshot", "retention", fallback=2),
+        snapshot_prune=cp.getboolean("snapshot", "prune", fallback=False),
+        snapshot_keep_tail=cp.getint("snapshot", "keep_tail", fallback=64),
+        snap_sync_threshold=cp.getint("snapshot", "snap_sync_threshold",
+                                      fallback=256),
+        snapshot_chunk_bytes=cp.getint("snapshot", "chunk_bytes",
+                                       fallback=1 << 20),
         crypto_backend=cp.get("crypto", "backend", fallback="auto"),
         device_min_batch=cp.getint("crypto", "device_min_batch", fallback=512),
         crypto_mesh_devices=cp.getint("crypto", "mesh_devices", fallback=0),

@@ -347,6 +347,48 @@ class TxPool:
         self._update_pending_gauge()
         self._notify_ready()
 
+    def on_snapshot_installed(self, number: int) -> None:
+        """The ledger jumped to `number` via a snap-sync install — per-block
+        commit notifications never ran for the jumped range. Reconcile:
+        drop pending txs the installed state already committed (receipt
+        lookup; pruned heights have none, but their txs are long past
+        block_limit anyway), rebuild the rolling nonce filter from the
+        installed nonce tables, and settle receipt waiters."""
+        with self._lock:
+            candidates = list(self._pending)
+        # receipt probes are storage reads — O(pool) of them must not run
+        # under the pool lock (they'd stall every submit/seal for the
+        # duration); the pops below re-check membership anyway
+        committed = [h for h in candidates
+                     if self.ledger.receipt(h) is not None]
+        with self._lock:
+            for h in committed:
+                self._pending.pop(h, None)
+                self._sealed.discard(h)
+                self._presealed.discard(h)
+            self._nonces_by_block = {}
+            self._known_nonces = set()
+            lo = max(0, number - self.block_limit_range + 1)
+            for bn in range(lo, number + 1):
+                ns = set(n for n in self.ledger.nonces_by_number(bn) if n)
+                if ns:
+                    self._nonces_by_block[bn] = ns
+                    self._known_nonces |= ns
+            # txs that survived the reconciliation are still pending: their
+            # nonces were admitted at submit time and must keep blocking
+            # duplicates (they are in no block's nonce table yet)
+            for tx in self._pending.values():
+                if tx.nonce:
+                    self._known_nonces.add(tx.nonce)
+            tasks = [(h, self._async_waiters.pop(h)) for h in committed
+                     if h in self._async_waiters]
+        with self._receipt_cv:
+            self._receipt_cv.notify_all()
+        for h, task in tasks:
+            task.resolve(self.ledger.receipt(h))
+        self._update_pending_gauge()
+        self._notify_ready()
+
     def submit_async(self, tx: Transaction):
         """Submit and return a Task[Receipt] that settles at commit — the
         libtask analogue of the reference's coroutine submitTransaction

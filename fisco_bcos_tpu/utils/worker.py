@@ -49,6 +49,11 @@ class Worker:
     def wakeup(self) -> None:
         self._wake.set()
 
+    def stopping(self) -> bool:
+        """True once stop() was requested — long-blocking execute_worker
+        bodies poll this so stop() doesn't abandon them mid-operation."""
+        return self._stop.is_set()
+
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()

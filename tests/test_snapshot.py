@@ -1,0 +1,703 @@
+"""Snapshot/checkpoint subsystem: export, verify, install, prune, serve.
+
+Covers the acceptance contract of the subsystem in-process: a snapshot is
+one batched `suite.hash_batch` call per manifest (asserted by counting
+instrumentation on export AND import), any tampering is rejected whole, a
+pruned chain answers range requests with a pruned-below marker, and a
+joining node more than `snap_sync_threshold` blocks behind installs the
+snapshot + replays only the tail (sync_mode == "snap").
+"""
+
+import time
+
+import pytest
+
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.ledger.ledger import ConsensusNode, Ledger
+from fisco_bcos_tpu.net.gateway import FakeGateway
+from fisco_bcos_tpu.protocol import BlockHeader, Transaction
+from fisco_bcos_tpu.snapshot import (SnapshotManifest, SnapshotStore,
+                                     SnapshotVerifyError, export_snapshot,
+                                     install_snapshot, verify_snapshot)
+from fisco_bcos_tpu.snapshot.manifest import pack_chunks, unpack_chunk
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+
+
+def wait_until(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def make_tx(suite, kp, i):
+    return Transaction(to=pc.BALANCE_ADDRESS,
+                       input=pc.encode_call(
+                           "register",
+                           lambda w: w.blob(b"acct%d" % i).u64(5)),
+                       nonce=f"snap-{i}", block_limit=500).sign(suite, kp)
+
+
+def commit_blocks(node, n, start=0):
+    kp = node.suite.generate_keypair(b"snap-user")
+    for i in range(start, start + n):
+        res = node.send_transaction(make_tx(node.suite, kp, i))
+        rc = node.txpool.wait_for_receipt(res.tx_hash, 15)
+        assert rc is not None and rc.status == 0
+
+
+@pytest.fixture()
+def solo_node():
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0))
+    node.start()
+    yield node
+    node.stop()
+
+
+def make_verify_seals(suite, sealer_pubs):
+    """Standalone seal verifier for import-side tests (what BlockSync's
+    _verify_seals does, without needing a BlockSync)."""
+    import numpy as np
+
+    def verify(header: BlockHeader) -> bool:
+        sealer_set = sorted(sealer_pubs)
+        if list(header.sealer_list) != sealer_set:
+            return False
+        hh = header.hash(suite)
+        by_idx = {i: s for i, s in header.signature_list
+                  if 0 <= i < len(sealer_set)}
+        quorum = 2 * ((len(sealer_set) - 1) // 3) + 1
+        if len(by_idx) < quorum:
+            return False
+        idxs = sorted(by_idx)
+        ok = np.asarray(suite.verify_batch(
+            [hh] * len(idxs), [by_idx[i] for i in idxs],
+            [sealer_set[i] for i in idxs]))
+        return int(ok.sum()) >= quorum
+
+    return verify
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_manifest_and_chunk_codec_roundtrip():
+    rows = [("t_a", b"k1", b"v1"), ("t_a", b"k2", b"v" * 100),
+            ("t_b", b"", b""), ("t_c", b"k" * 40, b"x" * 3000)]
+    chunks = pack_chunks(rows, chunk_bytes=256)
+    assert len(chunks) > 1  # budget forces a split
+    assert [r for c in chunks for r in unpack_chunk(c)] == rows
+    m = SnapshotManifest(height=7, header_bytes=b"hdr", root=b"r" * 32,
+                         chunk_hashes=[b"h" * 32, b"i" * 32],
+                         total_bytes=123)
+    m2 = SnapshotManifest.decode(m.encode())
+    assert m2 == m
+
+
+def test_pack_chunks_oversized_row_never_wedges():
+    rows = [("t", b"k", b"v" * 10_000)]
+    chunks = pack_chunks(rows, chunk_bytes=64)
+    assert len(chunks) == 1  # at least one row per chunk
+
+
+# -- export / install -------------------------------------------------------
+
+def test_export_install_roundtrip(solo_node):
+    node = solo_node
+    commit_blocks(node, 3)
+    manifest, chunks = export_snapshot(node.storage, node.ledger,
+                                       node.suite, chunk_bytes=512)
+    assert manifest.height == node.ledger.current_number()
+    assert manifest.chunk_count == len(chunks) > 1
+
+    fresh = MemoryStorage()
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+    header = install_snapshot(manifest, chunks, fresh, node.suite, verify)
+    led2 = Ledger(fresh, node.suite)
+    assert led2.current_number() == manifest.height
+    assert (led2.header_by_number(manifest.height).hash(node.suite)
+            == header.hash(node.suite))
+    # every public row travelled (spot check: receipts + config)
+    for n in range(1, manifest.height + 1):
+        assert led2.tx_hashes_by_number(n) == \
+            node.ledger.tx_hashes_by_number(n)
+    assert led2.system_config("tx_count_limit") == \
+        node.ledger.system_config("tx_count_limit")
+    # chain-state c_* tables travel (c_balance is written by the register
+    # precompile) — only the consensus-PRIVATE log is excluded
+    src_bal = list(node.storage.keys("c_balance"))
+    assert src_bal, "fixture no longer touches c_balance"
+    assert list(fresh.keys("c_balance")) == src_bal
+    for k in src_bal:
+        assert fresh.get("c_balance", k) == node.storage.get("c_balance", k)
+
+
+def test_private_tables_never_exported(solo_node):
+    node = solo_node
+    commit_blocks(node, 1)
+    node.storage.set("c_pbft_log", b"view", b"\x00" * 8)  # consensus-private
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite)
+    tables = {t for c in chunks for t, _, _ in unpack_chunk(c)}
+    assert "c_pbft_log" not in tables
+    assert "s_number_2_header" in tables
+    # c_ is NOT a private prefix: replicated chain state under c_* (the
+    # balance/auth/account precompile tables) must be snapshotted
+    assert "c_balance" in tables
+
+
+def test_one_batched_hash_call_per_manifest(solo_node):
+    """The acceptance instrumentation: ALL chunk hashing is one
+    suite.hash_batch call on export, and one on verify."""
+    node = solo_node
+    commit_blocks(node, 2)
+    calls = []
+    orig = node.suite.hash_batch
+
+    def counted(msgs, _orig=orig):
+        calls.append(len(msgs))
+        return _orig(msgs)
+
+    node.suite.hash_batch = counted
+    try:
+        manifest, chunks = export_snapshot(node.storage, node.ledger,
+                                           node.suite, chunk_bytes=256)
+        assert calls == [len(chunks)]  # exactly ONE call, all chunks in it
+        calls.clear()
+        verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+        verify_snapshot(manifest, chunks, node.suite, verify)
+        assert calls == [len(chunks)]
+    finally:
+        node.suite.hash_batch = orig
+
+
+def test_tampered_snapshot_rejected(solo_node):
+    node = solo_node
+    commit_blocks(node, 2)
+    manifest, chunks = export_snapshot(node.storage, node.ledger,
+                                       node.suite, chunk_bytes=256)
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+    fresh = MemoryStorage()
+
+    # 1. flipped chunk byte
+    bad = list(chunks)
+    bad[0] = bytes([bad[0][0] ^ 0xFF]) + bad[0][1:]
+    with pytest.raises(SnapshotVerifyError):
+        install_snapshot(manifest, bad, fresh, node.suite, verify)
+    # 2. root mismatch
+    m2 = SnapshotManifest.decode(manifest.encode())
+    m2.root = bytes(32)
+    with pytest.raises(SnapshotVerifyError):
+        install_snapshot(m2, chunks, fresh, node.suite, verify)
+    # 3. missing chunk
+    with pytest.raises(SnapshotVerifyError):
+        install_snapshot(manifest, chunks[:-1], fresh, node.suite, verify)
+    # 4. forged header (seals won't cover it)
+    m3 = SnapshotManifest.decode(manifest.encode())
+    hdr = BlockHeader.decode(m3.header_bytes)
+    hdr.timestamp += 1
+    hdr.invalidate()
+    m3.header_bytes = hdr.encode()
+    with pytest.raises(SnapshotVerifyError):
+        install_snapshot(m3, chunks, fresh, node.suite, verify)
+    # 5. seal-verifier rejection propagates
+    with pytest.raises(SnapshotVerifyError):
+        install_snapshot(manifest, chunks, fresh, node.suite,
+                         lambda h: False)
+    # nothing was installed by any failed attempt
+    assert Ledger(fresh, node.suite).current_number() == -1
+    # and the untampered snapshot still installs
+    install_snapshot(manifest, chunks, fresh, node.suite, verify)
+    assert Ledger(fresh, node.suite).current_number() == manifest.height
+
+
+def test_malformed_chunk_content_is_verify_error(solo_node):
+    """Review fix: a Byzantine peer can serve chunks whose hashes MATCH its
+    own manifest but whose bytes are garbage — the decode failure must
+    surface as SnapshotVerifyError (reject-whole + snap backoff), not as a
+    plain ValueError that escapes to the worker loop with sync_mode stuck
+    on "snap"."""
+    node = solo_node
+    commit_blocks(node, 1)
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite)
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+
+    garbage = [b"\xff\x07not-a-chunk-record"]
+    forged = SnapshotManifest.decode(manifest.encode())
+    forged.chunk_hashes = node.suite.hash_batch(garbage)
+    forged.root = node.suite.merkle_root(forged.chunk_hashes)
+    forged.total_bytes = sum(len(c) for c in garbage)
+    fresh = MemoryStorage()
+    with pytest.raises(SnapshotVerifyError):
+        install_snapshot(forged, garbage, fresh, node.suite, verify)
+    assert not list(fresh.keys("s_current_state"))
+
+    # same attack through snap_sync: returns None (backoff path), no raise
+    from fisco_bcos_tpu.snapshot import importer as imp
+
+    class Front:
+        def request(self, module, peer, payload, timeout=5.0):
+            from fisco_bcos_tpu.codec.wire import Reader
+            r = Reader(payload)
+            op = r.u8()
+            return forged.encode() if op == imp.OP_MANIFEST else garbage[0]
+
+    assert imp.snap_sync(Front(), b"P" * 64, fresh, node.suite, verify,
+                         current_number=-1) is None
+
+
+def test_install_removes_stale_genesis_rows(solo_node):
+    node = solo_node
+    commit_blocks(node, 1)
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite)
+    fresh = MemoryStorage()
+    # a divergent local row that is NOT in the snapshot must not survive
+    fresh.set("s_current_state", b"bogus_key", b"stale")
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+    install_snapshot(manifest, chunks, fresh, node.suite, verify)
+    assert fresh.get("s_current_state", b"bogus_key") is None
+
+
+def test_snap_sync_authenticates_before_fetching(solo_node, monkeypatch):
+    """A peer-supplied manifest must not drive chunk downloads until its
+    header seals verified and its declared size passed the resource caps."""
+    from fisco_bcos_tpu.snapshot import importer as imp
+
+    node = solo_node
+    commit_blocks(node, 2)
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite,
+                                       chunk_bytes=256)
+    assert manifest.chunk_count > 1
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+
+    class Front:
+        def __init__(self, manifest_bytes):
+            self.manifest_bytes = manifest_bytes
+            self.chunk_requests = 0
+
+        def request(self, module, peer, payload, timeout=5.0):
+            from fisco_bcos_tpu.codec.wire import Reader
+            r = Reader(payload)
+            op, height, index = r.u8(), r.i64(), r.u32()
+            if op == imp.OP_MANIFEST:
+                return self.manifest_bytes
+            self.chunk_requests += 1
+            return chunks[index]
+
+    fresh = MemoryStorage()
+    # 1. forged seals: rejected with ZERO chunk fetches
+    forged = SnapshotManifest.decode(manifest.encode())
+    hdr = BlockHeader.decode(forged.header_bytes)
+    hdr.signature_list = [(0, b"\x00" * 65)]
+    forged.header_bytes = hdr.encode()
+    front = Front(forged.encode())
+    assert imp.snap_sync(front, b"P" * 64, fresh, node.suite, verify,
+                         current_number=-1) is None
+    assert front.chunk_requests == 0
+    # 2. declared size beyond the cap: rejected with ZERO chunk fetches
+    monkeypatch.setattr(imp, "MAX_SNAPSHOT_CHUNKS", 1)
+    front = Front(manifest.encode())
+    assert imp.snap_sync(front, b"P" * 64, fresh, node.suite, verify,
+                         current_number=-1) is None
+    assert front.chunk_requests == 0
+    # 3. caps restored: the same wire path installs fine — and the 2f+1
+    # quorum is batch-verified exactly ONCE per join (pre-fetch; install
+    # must not pay for the same expensive check again)
+    monkeypatch.setattr(imp, "MAX_SNAPSHOT_CHUNKS", 1 << 16)
+    front = Front(manifest.encode())
+    seal_checks = []
+
+    def counting_verify(header, _v=verify):
+        seal_checks.append(header.number)
+        return _v(header)
+
+    res = imp.snap_sync(front, b"P" * 64, fresh, node.suite,
+                        counting_verify, current_number=-1)
+    assert res is not None
+    assert seal_checks == [manifest.height]
+    assert Ledger(fresh, node.suite).current_number() == manifest.height
+
+
+def test_snap_sync_fetch_deadline_aborts(solo_node, monkeypatch):
+    """A peer with a genuinely sealed header but a forged/dribbled chunk
+    inventory is cut off at the wall-clock fetch deadline instead of
+    wedging the download worker for chunk_count * request_timeout."""
+    from fisco_bcos_tpu.snapshot import importer as imp
+
+    node = solo_node
+    commit_blocks(node, 2)
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite,
+                                       chunk_bytes=256)
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+
+    class Front:
+        def __init__(self):
+            self.chunk_requests = 0
+
+        def request(self, module, peer, payload, timeout=5.0):
+            from fisco_bcos_tpu.codec.wire import Reader
+            r = Reader(payload)
+            op, height, index = r.u8(), r.i64(), r.u32()
+            if op == imp.OP_MANIFEST:
+                return manifest.encode()
+            self.chunk_requests += 1
+            return chunks[index]
+
+    # an already-expired deadline models the dribbling peer: abort before
+    # a single chunk is fetched, so the caller can move to another peer
+    monkeypatch.setattr(imp, "SNAP_FETCH_MIN_SECONDS", -1.0)
+    monkeypatch.setattr(imp, "MIN_FETCH_BYTES_PER_SEC", float("inf"))
+    front = Front()
+    fresh = MemoryStorage()
+    assert imp.snap_sync(front, b"P" * 64, fresh, node.suite, verify,
+                         current_number=-1) is None
+    assert front.chunk_requests == 0
+
+
+def test_snap_sync_aborts_on_stop_signal(solo_node):
+    """Review fix: the chunk-fetch loop must yield to shutdown — a stop
+    signal raised mid-fetch aborts before the next chunk, and one raised
+    after the fetch aborts BEFORE any storage write (an abandoned download
+    thread must never commit into a WAL the daemon already closed)."""
+    from fisco_bcos_tpu.snapshot import importer as imp
+
+    node = solo_node
+    commit_blocks(node, 2)
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite,
+                                       chunk_bytes=256)
+    assert manifest.chunk_count > 1
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+
+    class Front:
+        def __init__(self):
+            self.chunk_requests = 0
+
+        def request(self, module, peer, payload, timeout=5.0):
+            from fisco_bcos_tpu.codec.wire import Reader
+            r = Reader(payload)
+            op, height, index = r.u8(), r.i64(), r.u32()
+            if op == imp.OP_MANIFEST:
+                return manifest.encode()
+            self.chunk_requests += 1
+            return chunks[index]
+
+    # stop raised before the first chunk: zero fetches, nothing installed
+    front = Front()
+    fresh = MemoryStorage()
+    assert imp.snap_sync(front, b"P" * 64, fresh, node.suite, verify,
+                         current_number=-1,
+                         should_abort=lambda: True) is None
+    assert front.chunk_requests == 0
+    assert not list(fresh.keys("s_current_state"))
+    # stop raised after the last chunk: fetch completes but the install
+    # must still bail before touching storage
+    front = Front()
+    fresh = MemoryStorage()
+    polls = []
+
+    def abort_after_fetch():
+        polls.append(True)
+        return len(polls) > manifest.chunk_count  # True only pre-install
+
+    assert imp.snap_sync(front, b"P" * 64, fresh, node.suite, verify,
+                         current_number=-1,
+                         should_abort=abort_after_fetch) is None
+    assert front.chunk_requests == manifest.chunk_count
+    assert not list(fresh.keys("s_current_state"))
+
+
+def test_prune_sweeps_in_bounded_batches(solo_node, monkeypatch):
+    """The first prune of a long chain must not hold every historical tx
+    hash in one remove_batch (O(history) memory + one giant WAL record) —
+    the sweep runs in PRUNE_SWEEP_BLOCKS rounds, same end state."""
+    from fisco_bcos_tpu.ledger.ledger import T_NUM2TXS
+
+    node = solo_node
+    commit_blocks(node, 4)
+    head = node.ledger.current_number()
+    monkeypatch.setattr(type(node.ledger), "PRUNE_SWEEP_BLOCKS", 1)
+    calls = []
+    orig = node.ledger.storage.remove_batch
+
+    def counting(table, keys, _o=orig):
+        calls.append((table, len(keys)))
+        return _o(table, keys)
+
+    monkeypatch.setattr(node.ledger.storage, "remove_batch", counting)
+    assert node.ledger.prune_block_data(head, keep_nonces=0) == head - 1
+    rounds = [n for t, n in calls if t == T_NUM2TXS]
+    assert rounds == [1] * (head - 1)  # bounded rounds, never one sweep
+    for n in range(1, head):
+        assert node.ledger.tx_hashes_by_number(n) == []
+        assert node.ledger.nonces_by_number(n) == []
+    assert node.ledger.tx_hashes_by_number(head)
+
+
+def test_txpool_reconciled_after_snap_install(solo_node):
+    """A tx the snapshot's chain already committed must leave the joiner's
+    pool after the install jump (and its nonce must stay rejected) — the
+    per-block commit notifications never ran for the jumped range."""
+    node = solo_node
+    commit_blocks(node, 2)
+    manifest, chunks = export_snapshot(node.storage, node.ledger, node.suite)
+    committed_hash = node.ledger.tx_hashes_by_number(1)[0]
+    committed_tx = node.ledger.transaction(committed_hash)
+
+    joiner = Node(NodeConfig(crypto_backend="host"), suite=node.suite)
+    joiner.build_genesis([ConsensusNode(node.keypair.pub_bytes)])
+    res = joiner.txpool.submit(committed_tx)  # pending on the joiner
+    assert res.status == 0
+    # a second pending tx the snapshot chain does NOT contain: it must
+    # survive the reconciliation WITH its nonce still blocking duplicates
+    kp2 = node.suite.generate_keypair(b"still-pending")
+    fresh_tx = Transaction(to=pc.BALANCE_ADDRESS,
+                           input=pc.encode_call(
+                               "register",
+                               lambda w: w.blob(b"fresh").u64(1)),
+                           nonce="keep-me",
+                           block_limit=500).sign(node.suite, kp2)
+    assert joiner.txpool.submit(fresh_tx).status == 0
+    assert joiner.txpool.pending_count() == 2
+
+    verify = make_verify_seals(node.suite, [node.keypair.pub_bytes])
+    install_snapshot(manifest, chunks, joiner.storage, node.suite, verify)
+    joiner.scheduler.external_commit(manifest.height)
+    assert joiner.txpool.pending_count() == 1  # fresh_tx survived
+    rc = joiner.txpool.wait_for_receipt(committed_hash, 5)
+    assert rc is not None and rc.status == 0  # waiter settled from ledger
+    # nonce filter rebuilt from the installed nonce tables: resubmitting
+    # the already-committed tx is refused
+    from fisco_bcos_tpu.protocol import TransactionStatus
+    dup = node.ledger.transaction(committed_hash)
+    assert joiner.txpool.submit(dup).status in (
+        TransactionStatus.NONCE_CHECK_FAIL, TransactionStatus.ALREADY_KNOWN)
+    # review fix: the surviving pending tx's nonce must also still be in
+    # the rebuilt filter — a conflicting tx reusing it is refused
+    conflict = Transaction(to=pc.BALANCE_ADDRESS,
+                           input=pc.encode_call(
+                               "register",
+                               lambda w: w.blob(b"conflict").u64(2)),
+                           nonce="keep-me",
+                           block_limit=500).sign(node.suite, kp2)
+    assert joiner.txpool.submit(conflict).status == \
+        TransactionStatus.NONCE_CHECK_FAIL
+
+
+# -- store ------------------------------------------------------------------
+
+def test_store_fs_roundtrip_and_retention(tmp_path):
+    store = SnapshotStore(str(tmp_path / "snaps"))
+    for h in (4, 8, 12):
+        m = SnapshotManifest(height=h, header_bytes=b"hdr", root=b"r" * 32,
+                             chunk_hashes=[b"h" * 32], total_bytes=3)
+        store.save(m, [b"abc"])
+    assert store.heights() == [4, 8, 12]
+    assert store.latest_height() == 12
+    assert store.manifest(8).height == 8
+    assert store.chunk(8, 0) == b"abc"
+    assert store.chunk(8, 1) is None
+    assert store.retain(2) == [4]
+    assert store.heights() == [8, 12]
+    # reopen: crash-swept, same content
+    store2 = SnapshotStore(str(tmp_path / "snaps"))
+    assert store2.heights() == [8, 12]
+    assert store2.chunk(12, 0) == b"abc"
+
+
+def test_store_memory_mode():
+    store = SnapshotStore(None)
+    m = SnapshotManifest(height=2, header_bytes=b"h", root=b"r" * 32,
+                         chunk_hashes=[b"h" * 32], total_bytes=1)
+    store.save(m, [b"x"])
+    assert store.latest().height == 2
+    assert store.chunk(2, 0) == b"x"
+    store.retain(0)
+    assert store.heights() == []
+
+
+# -- pruning + worker -------------------------------------------------------
+
+def test_prune_keeps_headers_drops_bodies(solo_node):
+    node = solo_node
+    commit_blocks(node, 3)
+    head = node.ledger.current_number()
+    tx_hash = node.ledger.tx_hashes_by_number(1)[0]
+    # head-1 body rows swept (genesis has no body row); keep_nonces=0 so
+    # the nonce sweep is visible at this tiny height (the retention window
+    # has its own test below)
+    assert node.ledger.prune_block_data(head, keep_nonces=0) == head - 1
+    assert node.ledger.pruned_below() == head
+    assert node.ledger.prune_block_data(head, keep_nonces=0) == 0
+    for n in range(1, head):
+        assert node.ledger.header_by_number(n) is not None
+        assert node.ledger.tx_hashes_by_number(n) == []
+        assert node.ledger.nonces_by_number(n) == []
+    assert node.ledger.transaction(tx_hash) is None
+    assert node.ledger.receipt(tx_hash) is None
+    # head block's own body is kept
+    assert node.ledger.tx_hashes_by_number(head)
+
+
+def test_prune_nonce_retention_window(solo_node):
+    """Nonce rows outlive pruned bodies by keep_nonces blocks: the txpool's
+    duplicate-nonce filter is rebuilt from T_NONCES after a snap jump, so
+    a recently-committed tx must not become re-admittable."""
+    node = solo_node
+    commit_blocks(node, 4)
+    head = node.ledger.current_number()
+    assert node.ledger.prune_block_data(head, keep_nonces=2) == head - 1
+    for n in range(1, head):
+        assert node.ledger.tx_hashes_by_number(n) == []  # bodies swept
+    kept = [n for n in range(1, head) if node.ledger.nonces_by_number(n)]
+    assert kept == list(range(max(1, head - 2), head))
+    assert node.ledger.prune_block_data(head, keep_nonces=2) == 0
+
+
+def test_checkpoint_keep_tail_leaves_replay_window(solo_node):
+    """Pruning stops keep_tail blocks below the checkpoint, so a peer only
+    a few blocks behind catches up by cheap tail replay instead of being
+    forced into a full O(state) snap-sync."""
+    from fisco_bcos_tpu.snapshot.service import SnapshotService
+    node = solo_node
+    commit_blocks(node, 5)
+    head = node.ledger.current_number()
+    svc = SnapshotService(node.storage, node.ledger, node.suite,
+                          prune=True, keep_tail=2)
+    manifest = svc.checkpoint()
+    assert manifest.height == head
+    assert node.ledger.pruned_below() == head - 2
+    for n in range(head - 2, head + 1):  # the tail stays replayable
+        assert node.ledger.tx_hashes_by_number(n)
+
+
+def test_snapshot_worker_checkpoints_prunes_and_retains(tmp_path):
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                           storage_path=str(tmp_path / "data"),
+                           snapshot_interval=2, snapshot_retention=1,
+                           snapshot_prune=True, snapshot_keep_tail=0,
+                           snapshot_chunk_bytes=1024))
+    node.start()
+    try:
+        commit_blocks(node, 2)
+        assert wait_until(
+            lambda: node.snapshot.store.latest_height() is not None)
+        commit_blocks(node, 2, start=10)
+        assert wait_until(
+            lambda: (node.snapshot.store.latest_height() or 0) >= 4
+            and len(node.snapshot.store.heights()) == 1
+            and node.ledger.pruned_below()
+            == node.snapshot.store.latest_height(), timeout=20)
+        st = node.snapshot.status()
+        assert st["enabled"] and st["prune"]
+        assert st["lastSnapshotNumber"] == node.snapshot.store.latest_height()
+        assert st["prunedBelow"] > 0
+    finally:
+        node.stop()
+    # WAL compaction after prune: a reboot comes back at the same height
+    node2 = Node(NodeConfig(crypto_backend="host",
+                            storage_path=str(tmp_path / "data")))
+    assert node2.ledger.current_number() >= 4
+    assert node2.ledger.pruned_below() > 0
+
+
+def test_get_snapshot_status_rpc(solo_node):
+    from fisco_bcos_tpu.rpc.server import JsonRpcImpl
+    node = solo_node
+    commit_blocks(node, 1)
+    node.snapshot.checkpoint()
+    impl = JsonRpcImpl(node)
+    resp = impl.handle({"jsonrpc": "2.0", "id": 1,
+                        "method": "getSnapshotStatus",
+                        "params": [node.config.group_id, ""]})
+    st = resp["result"]
+    assert st["lastSnapshotNumber"] == node.ledger.current_number()
+    assert st["syncMode"] == "replay"  # no gateway: never snap-synced
+    assert st["root"].startswith("0x")
+
+
+# -- snap-sync join (in-process, full network path) -------------------------
+
+def _single_sealer_chain(tmp_path=None, **cfg):
+    suite = make_suite(backend="host")
+    gw = FakeGateway()
+    kp = suite.generate_keypair(b"\x01" * 16)
+    sealers = [ConsensusNode(kp.pub_bytes)]
+    node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                           min_seal_time=0.0, **cfg),
+                keypair=kp, gateway=gw)
+    node.build_genesis(sealers)
+    node.start()
+    return suite, gw, node, sealers
+
+
+def test_snap_sync_join_and_pruned_peer_serves():
+    """A far-behind joiner snap-syncs from a PRUNED peer: manifest + chunks
+    over SnapshotSync, one batched verify, tail replay only — and the
+    joiner adopts the snapshot so it can serve the next joiner."""
+    suite, gw, src, sealers = _single_sealer_chain(
+        snapshot_interval=3, snapshot_prune=True, snapshot_keep_tail=0,
+        snapshot_chunk_bytes=1024)
+    joiners = []
+    try:
+        commit_blocks(src, 6)
+        assert wait_until(
+            lambda: (src.snapshot.store.latest_height() or 0) >= 3
+            and src.ledger.pruned_below() > 0, timeout=20)
+        floor = src.ledger.pruned_below()
+
+        obs = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                              snap_sync_threshold=2),
+                   keypair=suite.generate_keypair(b"obs-1"), gateway=gw)
+        obs.build_genesis(sealers)
+        replayed = []
+        orig_exec = obs.scheduler.execute_block
+
+        def traced(block, *a, _orig=orig_exec, **kw):
+            replayed.append(block.header.number)
+            return _orig(block, *a, **kw)
+
+        obs.scheduler.execute_block = traced
+        obs.start()
+        joiners.append(obs)
+        assert wait_until(lambda: obs.ledger.current_number()
+                          >= src.ledger.current_number(), timeout=40)
+        assert obs.blocksync.sync_mode == "snap"
+        # NO pruned block was replayed — only the tail above the checkpoint
+        assert replayed == [] or min(replayed) > floor
+        h = src.ledger.current_number()
+        assert (obs.ledger.header_by_number(h).hash(suite)
+                == src.ledger.header_by_number(h).hash(suite))
+        assert (obs.ledger.header_by_number(h).state_root
+                == src.ledger.header_by_number(h).state_root)
+        # the joiner adopted the snapshot and can now serve it itself
+        assert obs.snapshot.store.latest_height() == floor
+    finally:
+        for j in joiners:
+            j.stop()
+        src.stop()
+        gw.stop()
+
+
+def test_snap_sync_threshold_zero_keeps_replay():
+    suite, gw, src, sealers = _single_sealer_chain(
+        snapshot_interval=2, snapshot_chunk_bytes=1024)
+    obs = None
+    try:
+        commit_blocks(src, 3)
+        assert wait_until(
+            lambda: src.snapshot.store.latest_height() is not None)
+        obs = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                              snap_sync_threshold=0),
+                   keypair=suite.generate_keypair(b"obs-2"), gateway=gw)
+        obs.build_genesis(sealers)
+        obs.start()
+        assert wait_until(lambda: obs.ledger.current_number()
+                          >= src.ledger.current_number(), timeout=40)
+        assert obs.blocksync.sync_mode == "replay"
+    finally:
+        if obs is not None:
+            obs.stop()
+        src.stop()
+        gw.stop()

@@ -1,0 +1,155 @@
+"""Chaos e2e for the snapshot subsystem: disk loss + snap-sync rejoin.
+
+The disaster-recovery claim the in-process suites cannot make: on a REAL
+4-node TLS chain of OS processes, every node checkpointing + pruning on a
+cadence, a node that dies by kill -9 AND loses its whole data directory
+rejoins by fetching a snapshot from a PRUNED peer (which can no longer
+serve the early blocks at all), installs it after one batched verify, and
+replays only the tail — ending at the survivors' exact head hash and state
+root without ever replaying pruned history.
+
+Marked `slow`; `tools/sanitize_ci.sh --chaos` runs the chaos tier in CI.
+"""
+
+import re
+
+import pytest
+
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import TransactionBuilder
+from fisco_bcos_tpu.testing.chaos import ChaosHarness
+
+pytestmark = pytest.mark.slow
+
+SNAP_CFG = {
+    # aggressive cadence so a short test crosses several checkpoints
+    "snapshot_interval": 2,
+    "snapshot_prune": True,
+    "snapshot_keep_tail": 0,
+    "snapshot_retention": 1,
+    "snap_sync_threshold": 3,
+    "snapshot_chunk_bytes": 16384,
+}
+
+
+class _Workload:
+    def __init__(self, harness):
+        self.h = harness
+        self.suite = harness.suite()
+        self.kp = self.suite.generate_keypair(b"snap-chaos-user")
+        self.builder = TransactionBuilder(
+            self.suite, None, chain_id=harness.info["chain_id"],
+            group_id=harness.info["group_id"])
+        self.sent = 0
+
+    def burst(self, n, via):
+        for k in range(n):
+            node = via[k % len(via)]
+            tx = self.builder.build(
+                self.kp, pc.BALANCE_ADDRESS,
+                pc.encode_call("register",
+                               lambda w: w.blob(b"sacct%d" % self.sent)
+                               .u64(1)),
+                nonce=f"snap-chaos-{self.sent}", block_limit=500)
+            self.h.client(node).send_transaction(tx, wait=False)
+            self.sent += 1
+
+    def drive_to_height(self, target, via, timeout=300):
+        """Commit waves of txs until every node in `via` reports at least
+        `target` blocks — fire-and-forget bursts coalesce into few blocks,
+        so each wave waits for its commits before the next one."""
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        while min(self.h.block_number(i) for i in via) < target:
+            assert _t.monotonic() < deadline, \
+                f"chain never reached height {target}"
+            self.burst(2, via=via)
+            self.h.wait_until(
+                lambda: min(self.h.total_txs(i) for i in via) >= self.sent,
+                timeout=120, what=f"wave commits toward height {target}")
+
+
+def _replayed_numbers(log: str) -> list[int]:
+    """Block numbers this daemon committed through sync REPLAY."""
+    return [int(m) for m in
+            re.findall(r"METRIC\|sync\.committed\|\d+\|number=(\d+)", log)]
+
+
+def test_wiped_node_rejoins_via_snap_sync(tmp_path):
+    """Acceptance: kill -9 + data-dir wipe; the node rejoins via snap-sync
+    from pruned peers to the identical head hash and state root, without
+    replaying pruned history."""
+    with ChaosHarness(str(tmp_path / "chain"), tls=True,
+                      config_overrides=SNAP_CFG) as h:
+        h.start_all()
+        for i in range(h.n):
+            h.wait_rpc_up(i)
+        w = _Workload(h)
+        survivors = [0, 1, 2]
+
+        # drive the chain past at least one checkpoint on every node: all
+        # four must have pruned (the serving side of the claim) before the
+        # victim goes down
+        w.drive_to_height(SNAP_CFG["snapshot_interval"] + 2,
+                          via=list(range(h.n)))
+        h.wait_until(
+            lambda: min(h.snapshot_status(i)["prunedBelow"]
+                        for i in range(h.n)) > 0,
+            timeout=240, what="every node checkpointed + pruned")
+        floor0 = h.snapshot_status(0)["prunedBelow"]
+        assert h.snapshot_status(0)["lastSnapshotNumber"] >= floor0
+
+        h.kill(3)
+        h.wipe_data(3)  # disk loss: WAL, snapshots, consensus log all gone
+
+        # keep the chain moving (and past the snap threshold) while dead,
+        # so the wiped node rejoins genuinely FAR behind
+        w.drive_to_height(
+            h.block_number(0) + SNAP_CFG["snap_sync_threshold"] + 1,
+            via=survivors)
+
+        h.start(3)
+        h.wait_rpc_up(3)
+        # total_txs reflects the installed snapshot the instant its storage
+        # commit lands, which is BEFORE the sync worker finishes the
+        # install path — also wait for the badge the assertions below grep
+        h.wait_until(lambda: h.total_txs(3) >= w.sent
+                     and "snap-sync-installed" in h.read_daemon_log(3),
+                     timeout=240, what="node3 snap-sync + tail catch-up")
+
+        log3 = h.read_daemon_log(3)
+        # wiped: the daemon booted at genesis, NOT from replayed WAL
+        boots = re.findall(r"\[DAEMON\]\[up\].*?number=(-?\d+)", log3)
+        assert boots and int(boots[-1]) <= 0, \
+            f"data dir was not actually wiped (boot heights {boots})"
+        assert "snap-sync-installed" in log3, \
+            "node3 caught up without the snapshot path"
+        status3 = h.snapshot_status(3)
+        assert status3["syncMode"] == "snap"
+        floor = status3["prunedBelow"]
+        assert floor > 0  # adopted snapshot implies adopted pruning floor
+
+        # no pruned block was ever REPLAYED in the REJOINED life: daemon.log
+        # survives the data wipe and spans both lives, and pre-kill the node
+        # may legitimately have replayed low blocks while lagging under
+        # load — only entries after the last boot count
+        rejoined_log = log3[log3.rindex("[DAEMON][up]"):]
+        replayed = _replayed_numbers(rejoined_log)
+        installed = re.findall(
+            r"METRIC\|snapshot\.install\|\d+\|number=(\d+)", rejoined_log)
+        assert installed, "no snapshot install recorded"
+        checkpoint = int(installed[0])
+        assert all(n > checkpoint for n in replayed), \
+            f"replayed pruned history: {replayed} vs checkpoint {checkpoint}"
+
+        # identical chain: same head hash AND state root on all four
+        height = h.wait_converged(range(h.n), min_height=1, timeout=180)
+        hashes = {h.block_hash(i, height) for i in range(h.n)}
+        assert len(hashes) == 1, f"head hash diverged at {height}: {hashes}"
+        roots = {h.state_root(i, height) for i in range(h.n)}
+        assert len(roots) == 1, f"state root diverged at {height}: {roots}"
+
+        # and the freshly-rejoined (pruned) node serves the chain onward:
+        # its RPC refuses nothing the others serve at the head
+        blk3 = h.client(3).get_block_by_number(height, only_header=True)
+        assert blk3 is not None and blk3["stateRoot"] in roots

@@ -13,6 +13,11 @@
 #                                   # short chain_bench --rpc-clients run,
 #                                   # assert the lane coalesces (mean batch
 #                                   # > 1) and emits an rpc_ingest_tps row
+#   tools/sanitize_ci.sh --snapshot # ONLY the checkpoint smoke: export a
+#                                   # snapshot from a live WAL-backed chain,
+#                                   # wipe a fresh data dir, import, verify
+#                                   # identical head hash + state root and
+#                                   # emit the snap_sync_seconds bench row
 #
 # Exit 0 = every stage clean. Each stage rebuilds the sanitizer variants
 # from the CURRENT sources (the src-hash stamp keeps them honest) and runs
@@ -43,6 +48,78 @@ print("sanitize_ci: INGEST STAGE CLEAN "
       f"(tps={row['tps']}, mean_batch={row['mean_batch']}, "
       f"recover/tx={row['recover_calls_per_tx']})")
 EOF
+  exit 0
+fi
+
+if [ "${1:-}" = "--snapshot" ]; then
+  echo "== [snapshot] checkpoint smoke: export -> wipe -> import ->" \
+       "verify state root (WAL-backed solo chain)"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 300 \
+    python - <<'EOF'
+import shutil, tempfile
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.ledger.ledger import Ledger
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.snapshot import export_snapshot, install_snapshot
+from fisco_bcos_tpu.storage.wal import WalStorage
+
+work = tempfile.mkdtemp(prefix="snap-smoke-")
+try:
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                           storage_path=work + "/data"))
+    node.start()
+    kp = node.suite.generate_keypair(b"snap-smoke")
+    for i in range(5):
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register",
+                             lambda w, i=i: w.blob(b"a%d" % i).u64(1)),
+                         nonce=f"s{i}", block_limit=100).sign(node.suite, kp)
+        rc = node.txpool.wait_for_receipt(
+            node.send_transaction(tx).tx_hash, 30)
+        assert rc is not None and rc.status == 0, rc
+    node.stop()
+    head = node.ledger.current_number()
+    want_hash = node.ledger.header_by_number(head).hash(node.suite)
+    want_root = node.ledger.header_by_number(head).state_root
+    manifest, chunks = export_snapshot(node.storage, node.ledger,
+                                       node.suite, chunk_bytes=4096)
+    node.storage.close()
+
+    # disaster: the data dir is gone; import into a brand-new WAL store
+    shutil.rmtree(work + "/data")
+    fresh = WalStorage(work + "/data2")
+    import numpy as np
+    def verify_seals(header):
+        sealer = node.keypair.pub_bytes
+        assert list(header.sealer_list) == [sealer]
+        hh = header.hash(node.suite)
+        ok = node.suite.verify_batch(
+            [hh], [header.signature_list[0][1]], [sealer])
+        return bool(np.asarray(ok)[0])
+    install_snapshot(manifest, chunks, fresh, node.suite, verify_seals)
+    led = Ledger(fresh, node.suite)
+    assert led.current_number() == head == manifest.height
+    assert led.header_by_number(head).hash(node.suite) == want_hash
+    assert led.header_by_number(head).state_root == want_root
+    # executor state travelled too, not just chain metadata: the balances
+    # the register txs wrote must be byte-identical on the imported side
+    bal_keys = list(node.storage.keys("c_balance"))
+    assert bal_keys and list(fresh.keys("c_balance")) == bal_keys
+    for k in bal_keys:
+        assert fresh.get("c_balance", k) == node.storage.get("c_balance", k)
+    fresh.close()
+    print("sanitize_ci: SNAPSHOT STAGE CLEAN "
+          f"(height={head}, chunks={manifest.chunk_count}, "
+          f"bytes={manifest.total_bytes})")
+finally:
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+  echo "== [snapshot] join-time bench row (replay vs snap-sync)"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 300 \
+    python benchmark/chain_bench.py --sync-bench --sync-blocks 20 \
+    2>/dev/null | grep '"metric": "snap_sync_seconds"'
   exit 0
 fi
 
