@@ -473,6 +473,31 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: read-plane QPS through the keep-alive edge +
+            # commit-coherent query cache (rpc/edge.py, rpc/cache.py).
+            # BENCH_READ_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--read-clients", "8", "--read-requests", "2000",
+                 "--backend", "host"],
+                "BENCH_READ_TIMEOUT", 240)
+            rd = next((row for row in rows
+                       if row.get("metric") == "rpc_read_qps"), None)
+            if rd:
+                line["rpc_read_qps"] = rd.get("value")
+                line["rpc_read_clients"] = rd.get("clients")
+                line["rpc_read_p50_ms"] = rd.get("p50_ms")
+                line["rpc_read_p99_ms"] = rd.get("p99_ms")
+                line["rpc_read_cache_hit_rate"] = rd.get("cache_hit_rate")
+            else:
+                print(f"[bench] rpc-read bench produced no row (rc={rc})",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] rpc-read bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: joining-node catch-up, full replay vs
             # snap-sync (snapshot/ subsystem) on THIS host.
             # BENCH_SYNC_TIMEOUT=0 skips it.

@@ -285,10 +285,12 @@ def run_rpc_ingest(sm: bool, n: int, backend: str, tx_count_limit: int,
 
     print(f"signing {n} txs (excluded from the timed window)...",
           file=sys.stderr, flush=True)
-    blocks_needed = -(-n // max(1, tx_count_limit))
-    block_limit = min(600, max(100, 2 * blocks_needed + 20))
+    # full 600-block tx lifetime: serving-mode blocks are TIME-sealed
+    # (min_seal 0.2 s), so a trickling client can commit far more blocks
+    # than n/tx_count_limit — a tighter limit expires the tail of the
+    # workload mid-run (BLOCK_LIMIT_CHECK_FAIL)
     wire_txs = ["0x" + raw.hex()
-                for raw in _build_workload(sm, n, block_limit=block_limit)]
+                for raw in _build_workload(sm, n, block_limit=600)]
     shares = [wire_txs[c::clients] for c in range(clients)]
 
     for node in nodes:
@@ -353,6 +355,151 @@ def run_rpc_ingest(sm: bool, n: int, backend: str, tx_count_limit: int,
         "mean_batch": lane_stats.get("mean_batch", 1.0),
         "recover_calls": recover_stats["calls"],
         "recover_calls_per_tx": round(recover_stats["calls"] / n, 4),
+    }
+
+
+def run_rpc_read(sm: bool, backend: str, clients: int, n_requests: int,
+                 blocks: int = 8, txs_per_block: int = 100,
+                 cache: bool = True, keepalive: bool = True) -> dict:
+    """Read-plane throughput: N keep-alive HTTP clients, mixed workload.
+
+    A solo chain commits `blocks` full blocks, then `clients` independent
+    JSON-RPC clients hammer a serving-shaped read mix — getBlockByNumber
+    with txs (the sender-recovery-heavy call), getTransactionReceipt,
+    `call` (balance read), and header-only getBlockByNumber — over
+    persistent connections. Reports `rpc_read_qps`, request p50/p99, the
+    query-cache hit rate, and recover calls during the read window (the
+    per-request tax the commit-coherent cache exists to delete).
+    `cache=False, keepalive=False` is the per-request baseline
+    (--read-compare): fresh TCP connection + full re-render + a recover
+    batch per getBlock, the shape of the old ThreadingHTTPServer edge.
+    """
+    import threading
+
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.sdk.client import SdkClient
+
+    node = Node(NodeConfig(consensus="solo", sm_crypto=sm,
+                           crypto_backend=backend, min_seal_time=0.0,
+                           tx_count_limit=txs_per_block, rpc_port=0,
+                           rpc_cache_entries=4096 if cache else 0))
+    node.build_genesis()
+    n_txs = blocks * txs_per_block
+    print(f"read-bench: building a {blocks}-block chain ({n_txs} txs)...",
+          file=sys.stderr, flush=True)
+    wire_txs = _build_workload(sm, n_txs, block_limit=min(
+        600, 2 * blocks + 50))
+    node.start()
+    try:
+        for s in range(0, n_txs, 256):
+            node.txpool.submit_batch(
+                [Transaction.decode(raw) for raw in wire_txs[s:s + 256]])
+        deadline = time.monotonic() + max(120.0, n_txs / 20)
+        while time.monotonic() < deadline:
+            if node.ledger.total_tx_count() >= n_txs:
+                break
+            time.sleep(0.05)
+        if node.ledger.total_tx_count() < n_txs:
+            raise RuntimeError(
+                f"read-bench chain wedged at {node.ledger.total_tx_count()}"
+                f"/{n_txs} txs")
+        head = node.ledger.current_number()
+        # hot set: the last 8 committed blocks and their txs (polling-
+        # client shape — receipts/blocks near the head dominate)
+        hot_blocks = list(range(max(1, head - 7), head + 1))
+        hot_txs = ["0x" + h.hex() for n in hot_blocks
+                   for h in node.ledger.tx_hashes_by_number(n)]
+        from fisco_bcos_tpu.executor import precompiled as pc
+        call_to = "0x" + pc.BALANCE_ADDRESS.hex()
+        call_data = "0x" + pc.encode_call(
+            "balanceOf", lambda w: w.blob(b"acct0")).hex()
+
+        # instrument the recover entry point for the READ window only
+        recover_stats = {"calls": 0}
+        orig_recover = node.suite.recover_addresses
+
+        def counted(hashes, sigs, _orig=orig_recover):
+            recover_stats["calls"] += 1
+            return _orig(hashes, sigs)
+
+        url = f"http://{node.rpc.host}:{node.rpc.port}"
+        per_client = n_requests // clients
+        latencies: list[list[float]] = [[] for _ in range(clients)]
+        errors: list[str] = []
+        barrier = threading.Barrier(clients + 1)
+
+        def client(c):
+            sdk = SdkClient(url, keepalive=keepalive)
+            lat = latencies[c]
+            barrier.wait()
+            for i in range(per_client):
+                j = c * per_client + i
+                try:
+                    t0 = time.perf_counter()
+                    # 4:2:1:1 getBlock-with-txs : receipt : call : header —
+                    # explorer/SDK read traffic is block-fetch dominated,
+                    # and getBlock-with-txs is where the per-request
+                    # recover tax lived
+                    op = j % 8
+                    if op < 4:
+                        sdk.get_block_by_number(hot_blocks[j % len(hot_blocks)])
+                    elif op < 6:
+                        sdk.get_transaction_receipt(hot_txs[j % len(hot_txs)])
+                    elif op == 6:
+                        sdk.request("call", ["group0", "", call_to,
+                                             call_data])
+                    else:
+                        sdk.get_block_by_number(
+                            hot_blocks[j % len(hot_blocks)],
+                            only_header=True)
+                    lat.append(time.perf_counter() - t0)
+                except Exception as exc:  # noqa: BLE001 — report, don't die
+                    errors.append(f"{type(exc).__name__}: {exc}")
+                    return
+
+        node.suite.recover_addresses = counted
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        for th in threads:
+            th.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for th in threads:
+            th.join(600)
+        wall = time.perf_counter() - t0
+        if any(th.is_alive() for th in threads):
+            # a wedged client would otherwise yield a plausible-looking
+            # but wrong QPS row (and race the instrumented suite restore)
+            raise RuntimeError("read client wedged past the join timeout")
+        node.suite.recover_addresses = orig_recover
+        if errors:
+            raise RuntimeError(f"read client failed: {errors[0]}")
+        flat = sorted(x for ls in latencies for x in ls)
+        done = len(flat)
+
+        def pct(p):
+            return flat[min(done - 1, int(p * done))] if flat else 0.0
+
+        cache_stats = node.query_cache.stats() if node.query_cache else {}
+    finally:
+        node.stop()
+
+    return {
+        "suite": "sm" if sm else "ecdsa",
+        "clients": clients,
+        "requests": done,
+        "cache": bool(cache),
+        "keepalive": bool(keepalive),
+        "qps": round(done / wall, 1) if wall > 0 else 0.0,
+        "wall_seconds": round(wall, 3),
+        "p50_ms": round(pct(0.50) * 1000, 2),
+        "p99_ms": round(pct(0.99) * 1000, 2),
+        "cache_hit_rate": cache_stats.get("hit_rate", 0.0),
+        "cache_entries": cache_stats.get("entries", 0),
+        "recover_calls": recover_stats["calls"],
+        "blocks": head,
+        "txs": n_txs,
     }
 
 
@@ -498,6 +645,38 @@ def _emit_rpc_mode(args, sm: bool) -> None:
         }), flush=True)
 
 
+def _emit_read_mode(args, sm: bool) -> None:
+    suffix = "_sm" if sm else ""
+    rows = {}
+    if args.read_compare:
+        # per-request/no-cache anchor: fresh connection per request, no
+        # query cache — the old ThreadingHTTPServer serving shape
+        base = run_rpc_read(sm, args.backend, args.read_clients,
+                            args.read_requests, cache=False,
+                            keepalive=False)
+        base.update({"metric": f"rpc_read_baseline_qps{suffix}",
+                     "value": base["qps"], "unit": "req/sec"})
+        rows["base"] = base
+        print(json.dumps(base), flush=True)
+    res = run_rpc_read(sm, args.backend, args.read_clients,
+                       args.read_requests)
+    res.update({"metric": f"rpc_read_qps{suffix}", "value": res["qps"],
+                "unit": "req/sec"})
+    rows["read"] = res
+    print(json.dumps(res), flush=True)
+    if args.read_compare:
+        base = rows["base"]
+        print(json.dumps({
+            "metric": f"rpc_read_speedup{suffix}", "unit": "x",
+            "value": round(res["qps"] / max(base["qps"], 0.001), 2),
+            "qps_baseline": base["qps"], "qps": res["qps"],
+            "p99_ms_baseline": base["p99_ms"], "p99_ms": res["p99_ms"],
+            "recover_calls_baseline": base["recover_calls"],
+            "recover_calls": res["recover_calls"],
+            "cache_hit_rate": res["cache_hit_rate"],
+        }), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", type=int, default=2000)
@@ -516,6 +695,15 @@ def main() -> None:
     ap.add_argument("--rpc-compare", action="store_true",
                     help="with --rpc-clients: also run the per-request "
                          "baseline (lane off) and a single-client run")
+    ap.add_argument("--read-clients", type=int, default=0, metavar="N",
+                    help="read-plane mode: N keep-alive HTTP clients with "
+                         "a mixed getBlock/getReceipt/call workload")
+    ap.add_argument("--read-requests", type=int, default=2000,
+                    help="with --read-clients: total requests across "
+                         "clients")
+    ap.add_argument("--read-compare", action="store_true",
+                    help="with --read-clients: also run the per-request/"
+                         "no-cache baseline (fresh connection, cache off)")
     ap.add_argument("--sync-bench", action="store_true",
                     help="join-time mode: full-replay vs snap-sync catch-up "
                          "against the same source chain")
@@ -529,6 +717,10 @@ def main() -> None:
         for sm in suites:
             for row in run_sync_bench(sm, args.sync_blocks):
                 print(json.dumps(row), flush=True)
+        return
+    if args.read_clients > 0:
+        for sm in suites:
+            _emit_read_mode(args, sm)
         return
     if args.rpc_clients > 0:
         for sm in suites:

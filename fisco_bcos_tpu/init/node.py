@@ -92,6 +92,14 @@ class NodeConfig:
     snapshot_chunk_bytes: int = 1 << 20
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
+    # serving read plane (rpc/edge.py + rpc/cache.py): one bounded worker
+    # pool shared by the HTTP event-loop edge and the WS server; a
+    # commit-coherent LRU serving rendered block/tx/receipt JSON
+    rpc_workers: int = 8        # blocking-call offload threads
+    rpc_max_batch: int = 256    # JSON-RPC 2.0 batch entry cap
+    rpc_cache_entries: int = 4096  # 0 disables the query cache
+    rpc_cache_mb: int = 64      # approximate rendered-bytes bound
+    rpc_keepalive_s: float = 60.0  # idle keep-alive connection reap
     ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
     metrics_port: Optional[int] = None  # None = no Prometheus endpoint
     # p2p transport (the reference's [p2p] listen_ip/listen_port +
@@ -173,16 +181,36 @@ class Node:
         from ..rpc.eventsub import EventSub
         self.eventsub = EventSub(self.ledger, self.scheduler)
         self.rpc = None
-        if cfg.rpc_port is not None:
-            from ..rpc.server import JsonRpcImpl, JsonRpcServer
-            self.rpc = JsonRpcServer(JsonRpcImpl(self),
-                                     host=cfg.rpc_host, port=cfg.rpc_port)
         self.ws = None
-        if cfg.ws_port is not None:
-            from ..rpc.server import JsonRpcImpl
-            from ..rpc.ws_server import WsRpcServer
-            self.ws = WsRpcServer(JsonRpcImpl(self),
-                                  host=cfg.rpc_host, port=cfg.ws_port)
+        self.query_cache = None
+        self.rpc_pool = None
+        if cfg.rpc_port is not None or cfg.ws_port is not None:
+            from ..rpc.cache import QueryCache
+            from ..rpc.edge import WorkerPool
+            from ..rpc.server import JsonRpcImpl, JsonRpcServer
+            if cfg.rpc_cache_entries > 0:
+                self.query_cache = QueryCache(
+                    max_entries=cfg.rpc_cache_entries,
+                    max_bytes=cfg.rpc_cache_mb << 20)
+            self.rpc_pool = WorkerPool(cfg.rpc_workers)
+            impl = JsonRpcImpl(self)  # reads self.query_cache: order matters
+            if self.query_cache is not None:
+                # commit-coherent: pre-render the committed block's hot
+                # responses off the consensus path; wipe on rollback and
+                # snap-sync install (a stale cache would serve pre-wipe
+                # blocks after a snapshot jumped the head)
+                self.scheduler.on_commit.append(impl.prime_block)
+                self.scheduler.on_invalidate.append(
+                    self.query_cache.invalidate)
+            if cfg.rpc_port is not None:
+                self.rpc = JsonRpcServer(impl, host=cfg.rpc_host,
+                                         port=cfg.rpc_port,
+                                         pool=self.rpc_pool,
+                                         keepalive_s=cfg.rpc_keepalive_s)
+            if cfg.ws_port is not None:
+                from ..rpc.ws_server import WsRpcServer
+                self.ws = WsRpcServer(impl, host=cfg.rpc_host,
+                                      port=cfg.ws_port, pool=self.rpc_pool)
         self.metrics = None
         if cfg.metrics_port is not None:
             from ..utils.metrics import MetricsServer
@@ -229,6 +257,8 @@ class Node:
             self.ingest.start()  # continuous-batching front door
         if self.txsync is not None:
             self.txsync.start()  # periodic pool anti-entropy sweep
+        if self.rpc_pool is not None:
+            self.rpc_pool.start()  # before the edges: they offload into it
         if self.rpc is not None:
             self.rpc.start()
         if self.ws is not None:
@@ -273,6 +303,8 @@ class Node:
             self.rpc.stop()
         if self.ws is not None:
             self.ws.stop()
+        if self.rpc_pool is not None:
+            self.rpc_pool.stop()  # after the edges: no new submitters
         if self.ingest is not None:
             self.ingest.stop()  # after RPC: no new submitters, drain queue
         self.snapshot.stop()

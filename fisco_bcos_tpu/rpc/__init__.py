@@ -1,3 +1,6 @@
+from .cache import QueryCache
+from .edge import EventLoopHttpServer, WorkerPool
 from .server import JsonRpcServer, JsonRpcImpl
 
-__all__ = ["JsonRpcServer", "JsonRpcImpl"]
+__all__ = ["JsonRpcServer", "JsonRpcImpl", "QueryCache",
+           "EventLoopHttpServer", "WorkerPool"]

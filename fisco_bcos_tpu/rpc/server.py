@@ -3,10 +3,23 @@
 Reference counterpart: /root/reference/bcos-rpc/bcos-rpc/ — method table in
 jsonrpc/JsonRpcInterface.cpp:16-71 (24 methods) and the implementation
 JsonRpcImpl_2_0.cpp (:416 sendTransaction co_awaits the txpool; queries fan
-out to ledger/scheduler/txpool/consensus/sync). Serving here is Python's
-threading HTTP server instead of boostssl's ASIO stack; the method surface
-and response shapes follow the reference so a reference SDK user finds the
-same API. Hex conventions: tx/block/hash parameters are 0x-hex.
+out to ledger/scheduler/txpool/consensus/sync). Serving runs on the
+event-loop edge (rpc/edge.py — keep-alive, pipelining, bounded worker
+offload, the boostssl-ASIO analogue); the method surface and response
+shapes follow the reference so a reference SDK user finds the same API.
+Hex conventions: tx/block/hash parameters are 0x-hex.
+
+JSON-RPC 2.0 BATCH payloads (list bodies) are handled per spec over both
+transports: per-entry responses carry the entry's id, invalid entries get
+their own error objects, notifications (no "id") produce no response, an
+all-notification batch produces an empty reply body, and response order
+matches request order.
+
+Hot immutable queries (block/tx/receipt JSON, recovered senders) serve
+from the commit-coherent `QueryCache` (rpc/cache.py) when the node has
+one: rendered once per commit (`JsonRpcImpl.prime_block` rides
+`Scheduler.on_commit`) or on first touch, invalidated on rollback and
+snapshot install.
 
 `JsonRpcImpl` is transport-independent (the WS server and the in-process SDK
 reuse it); `JsonRpcServer` binds it to HTTP.
@@ -15,16 +28,22 @@ reuse it); `JsonRpcServer` binds it to HTTP.
 from __future__ import annotations
 
 import json
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from ..protocol import Block, BlockHeader, Receipt, Transaction
 from ..utils.log import LOG, badge
+from .edge import EventLoopHttpServer, WorkerPool
 
 JSONRPC_PARSE_ERROR = -32700
 JSONRPC_INVALID_REQUEST = -32600
+# server-side caps on client-blockable time: one request's receipt wait
+# (the client's `timeout` param is clamped to this) and one payload's
+# total execution budget (a batch runs its entries sequentially in ONE
+# bounded-pool worker — without a budget, 256 blocking sendTransaction
+# entries could park a worker for hours and starve the shared pool)
+MAX_WAIT_SECONDS = 30.0
+BATCH_BUDGET_SECONDS = 60.0
 JSONRPC_METHOD_NOT_FOUND = -32601
 JSONRPC_INVALID_PARAMS = -32602
 JSONRPC_INTERNAL_ERROR = -32603
@@ -56,6 +75,26 @@ def _receipt_json(rc: Receipt, tx_hash: bytes) -> dict:
     }
 
 
+def _tx_json(tx: Transaction, h: bytes,
+             sender: Optional[bytes] = None) -> dict:
+    out = {
+        "version": tx.version,
+        "hash": _hex(h),
+        "chainID": tx.chain_id,
+        "groupID": tx.group_id,
+        "blockLimit": tx.block_limit,
+        "nonce": tx.nonce,
+        "to": _hex(tx.to),
+        "input": _hex(tx.input),
+        "abi": tx.abi,
+        "signature": _hex(tx.signature),
+        "importTime": tx.import_time,
+    }
+    if sender:
+        out["from"] = _hex(sender)
+    return out
+
+
 def _header_json(h: BlockHeader) -> dict:
     return {
         "version": h.version,
@@ -84,11 +123,64 @@ class JsonRpcError(Exception):
         self.message = message
 
 
+def handle_payload_with(impl, payload, max_batch: int = 256):
+    """JSON-RPC 2.0 framing over any `impl` with `.handle(dict) -> dict`
+    (JsonRpcImpl, the multigroup facade, the Pro facade): accepts a single
+    request dict OR a batch list, returns a response dict, a response
+    list, or None (nothing to send — notification-only payload)."""
+    if isinstance(payload, list):
+        if not payload:
+            return {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": JSONRPC_INVALID_REQUEST,
+                              "message": "empty batch"}}
+        if len(payload) > max_batch:
+            return {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": JSONRPC_INVALID_REQUEST,
+                              "message": f"batch too large (> {max_batch} "
+                                         "entries)"}}
+        out = []
+        deadline = time.monotonic() + BATCH_BUDGET_SECONDS
+        for entry in payload:
+            if time.monotonic() > deadline:
+                # budget exhausted: answer the remaining entries instead
+                # of executing them — this worker must come back to the
+                # pool (order + per-id shape preserved; notifications
+                # stay silent per spec)
+                if isinstance(entry, dict) and "id" not in entry:
+                    continue
+                out.append({"jsonrpc": "2.0",
+                            "id": entry.get("id")
+                            if isinstance(entry, dict) else None,
+                            "error": {"code": -32000,
+                                      "message": "batch budget exhausted"}})
+                continue
+            resp = _handle_entry(impl, entry)
+            if resp is not None:
+                out.append(resp)
+        return out or None
+    return _handle_entry(impl, payload)
+
+
+def _handle_entry(impl, entry):
+    if not isinstance(entry, dict):
+        return {"jsonrpc": "2.0", "id": None,
+                "error": {"code": JSONRPC_INVALID_REQUEST,
+                          "message": "invalid request"}}
+    resp = impl.handle(entry)
+    # a notification (no "id" member) is executed but never answered
+    return None if "id" not in entry else resp
+
+
 class JsonRpcImpl:
     """Method table bound to one node (multi-group: one impl per group)."""
 
     def __init__(self, node):
         self.node = node
+        # commit-coherent query cache: present when the node wired one
+        # (init/node.py); facades without it serve uncached
+        self.cache = getattr(node, "query_cache", None)
+        self.max_batch = getattr(getattr(node, "config", None),
+                                 "rpc_max_batch", 256)
         self.methods = {
             "call": self.call,
             "sendTransaction": self.send_transaction,
@@ -118,6 +210,11 @@ class JsonRpcImpl:
         }
 
     # -- dispatch ----------------------------------------------------------
+    def handle_payload(self, payload):
+        """Single request dict OR JSON-RPC 2.0 batch list -> response
+        dict / list / None (see handle_payload_with)."""
+        return handle_payload_with(self, payload, self.max_batch)
+
     def handle(self, request: dict) -> dict:
         rid = request.get("id")
         try:
@@ -156,6 +253,9 @@ class JsonRpcImpl:
         self._check_group(group)
         tx = Transaction.decode(_unhex(tx_hex))
         from ..protocol import TransactionStatus
+        # the wait budget is CLIENT-supplied: clamp it, or a crafted
+        # request parks a shared-pool worker for arbitrary time
+        timeout = max(0.0, min(float(timeout), MAX_WAIT_SECONDS))
         deadline = time.monotonic() + timeout
         lane = getattr(self.node, "ingest", None)
         if lane is not None:
@@ -183,9 +283,16 @@ class JsonRpcImpl:
                 res = self.node.txpool.submit(tx)
         else:
             res = self.node.txpool.submit(tx)
-        if res.status != TransactionStatus.OK:
+        if res.status not in (TransactionStatus.OK,
+                              TransactionStatus.ALREADY_IN_TXPOOL,
+                              TransactionStatus.ALREADY_KNOWN):
             raise JsonRpcError(int(res.status),
                                TransactionStatus(res.status).name)
+        # ALREADY_IN_TXPOOL / ALREADY_KNOWN are NOT errors here: the tx is
+        # admitted (or committed) — exactly what a client re-POSTing after
+        # a connection reset produces (SdkClient's bounded retry). Fall
+        # through to the receipt wait so the retry resolves like the
+        # original would have.
         if not wait:
             return {"transactionHash": _hex(res.tx_hash), "status": None}
         # remaining budget only: admission may have consumed part of the
@@ -215,29 +322,29 @@ class JsonRpcImpl:
                         tx_hash: str = "", require_proof: bool = False):
         self._check_group(group)
         h = _unhex(tx_hash)
-        tx = self.node.ledger.transaction(h)
-        if tx is None:
+        out = self._tx_json_cached(h)
+        if out is None:
             return None
-        out = {
-            "version": tx.version,
-            "hash": _hex(h),
-            "chainID": tx.chain_id,
-            "groupID": tx.group_id,
-            "blockLimit": tx.block_limit,
-            "nonce": tx.nonce,
-            "to": _hex(tx.to),
-            "input": _hex(tx.input),
-            "abi": tx.abi,
-            "signature": _hex(tx.signature),
-            "importTime": tx.import_time,
-        }
-        sender = tx.sender(self.node.suite)
-        if sender:
-            out["from"] = _hex(sender)
         if require_proof:
+            out = dict(out)  # cached values are frozen; annotate a copy
             proof, root = self.node.ledger.tx_proof(h)
             out["txProof"] = _proof_json(proof)
             out["txsRoot"] = _hex(root)
+        return out
+
+    def _tx_json_cached(self, h: bytes):
+        cache = self.cache
+        if cache is not None:
+            hit = cache.get(("tx", h))
+            if hit is not None:
+                return hit
+            gen = cache.generation()
+        tx = self.node.ledger.transaction(h)
+        if tx is None:
+            return None
+        out = _tx_json(tx, h, sender=tx.sender(self.node.suite))
+        if cache is not None:
+            cache.put(("tx", h), out, gen)
         return out
 
     def get_transaction_receipt(self, group: str, node_name: str = "",
@@ -245,22 +352,49 @@ class JsonRpcImpl:
                                 require_proof: bool = False):
         self._check_group(group)
         h = _unhex(tx_hash)
+        out = self._receipt_json_cached(h)
+        if out is None:
+            return None
+        if require_proof:
+            out = dict(out)  # cached values are frozen; annotate a copy
+            proof, root = self.node.ledger.receipt_proof(h)
+            out["receiptProof"] = _proof_json(proof)
+            out["receiptsRoot"] = _hex(root)
+        return out
+
+    def _receipt_json_cached(self, h: bytes):
+        cache = self.cache
+        if cache is not None:
+            hit = cache.get(("rc", h))
+            if hit is not None:
+                return hit
+            gen = cache.generation()
         rc = self.node.ledger.receipt(h)
         if rc is None:
             return None
         out = _receipt_json(rc, h)
-        if require_proof:
-            proof, root = self.node.ledger.receipt_proof(h)
-            out["receiptProof"] = _proof_json(proof)
-            out["receiptsRoot"] = _hex(root)
+        if cache is not None:
+            cache.put(("rc", h), out, gen)
         return out
 
     def get_block_by_number(self, group: str, node_name: str = "",
                             number: int = 0, only_header: bool = False,
                             only_tx_hash: bool = False):
         self._check_group(group)
-        return self._block_json(self.node.ledger.block_by_number(
-            number, with_txs=not only_header), only_header, only_tx_hash)
+        cache = self.cache
+        key = ("block", number, bool(only_header), bool(only_tx_hash))
+        gen = None
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+            gen = cache.generation()  # BEFORE the ledger reads (fencing)
+        out = self._block_json(self.node.ledger.block_by_number(
+            number, with_txs=not only_header), only_header, only_tx_hash,
+            gen=gen)
+        if cache is not None and out is not None:
+            cache.put(key, out, gen)
+        return out
 
     def get_block_by_hash(self, group: str, node_name: str = "",
                           block_hash: str = "", only_header: bool = False,
@@ -273,7 +407,7 @@ class JsonRpcImpl:
                                         only_tx_hash)
 
     def _block_json(self, block: Optional[Block], only_header: bool,
-                    only_tx_hash: bool):
+                    only_tx_hash: bool, gen: Optional[int] = None):
         if block is None:
             return None
         suite = self.node.suite
@@ -286,29 +420,68 @@ class JsonRpcImpl:
             out["transactions"] = [_hex(h) for h in (
                 block.tx_hashes or batch_hash(block.transactions, suite))]
         else:
-            # one batch recover for all senders (not a per-tx scalar loop)
-            from ..protocol import batch_recover_senders
-            senders, _ = batch_recover_senders(block.transactions, suite)
+            senders = self._senders_for_block(block, gen)
             txs_json = []
             for t, sender in zip(block.transactions, senders):
-                tj = {
-                    "version": t.version,
-                    "hash": _hex(t.hash(suite)),
-                    "chainID": t.chain_id,
-                    "groupID": t.group_id,
-                    "blockLimit": t.block_limit,
-                    "nonce": t.nonce,
-                    "to": _hex(t.to),
-                    "input": _hex(t.input),
-                    "abi": t.abi,
-                    "signature": _hex(t.signature),
-                    "importTime": t.import_time,
-                }
-                if sender:
-                    tj["from"] = _hex(sender)
-                txs_json.append(tj)
+                txs_json.append(_tx_json(t, t.hash(suite), sender=sender))
             out["transactions"] = txs_json
         return out
+
+    def _senders_for_block(self, block: Block, gen: Optional[int]):
+        """Recovered senders for a committed block: computed ONCE (at
+        commit via prime_block, or on first touch) and reused — N
+        identical getBlock requests cost <= 1 recover batch."""
+        cache, n = self.cache, block.header.number
+        if cache is not None:
+            hit = cache.get(("senders", n))
+            if hit is not None and len(hit) == len(block.transactions):
+                return hit
+        # one batch recover for all senders (not a per-tx scalar loop)
+        from ..protocol import batch_recover_senders
+        senders, _ = batch_recover_senders(block.transactions,
+                                           self.node.suite)
+        if cache is not None and gen is not None:
+            cache.put(("senders", n), senders, gen)
+        return senders
+
+    # -- commit-time cache priming (Scheduler.on_commit observer) ----------
+    def prime_block(self, number: int) -> None:
+        """Render the just-committed block's hot responses once, off the
+        consensus path (runs on the scheduler's notifier thread): block
+        JSON with txs / tx-hash-only / header-only, per-tx transaction +
+        receipt JSON, and the recovered-senders row."""
+        cache = self.cache
+        if cache is None:
+            return
+        try:
+            gen = cache.generation()
+            ledger = self.node.ledger
+            block = ledger.block_by_number(number, with_txs=True)
+            if block is None or number > ledger.current_number():
+                return
+            # use the scheduler's LIVE tx objects when they are this
+            # block's: their senders were recovered at admission/verify,
+            # so the render below costs ZERO extra recover batches
+            # (ledger reads decode fresh copies with _sender unset)
+            stash = getattr(self.node.scheduler, "last_committed_txs",
+                            {}).get(number)
+            if stash is not None and len(stash) == len(block.transactions):
+                block.transactions = list(stash)
+            full = self._block_json(block, False, False, gen=gen)
+            cache.put(("block", number, False, False), full, gen)
+            cache.put(("block", number, False, True),
+                      self._block_json(block, False, True), gen)
+            cache.put(("block", number, True, False),
+                      self._block_json(block, True, False), gen)
+            suite = self.node.suite
+            for tx, tj in zip(block.transactions, full["transactions"]):
+                h = tx.hash(suite)
+                cache.put(("tx", h), tj, gen)
+            for rc, tx in zip(block.receipts, block.transactions):
+                h = tx.hash(suite)
+                cache.put(("rc", h), _receipt_json(rc, h), gen)
+        except Exception:  # noqa: BLE001 — priming is best-effort
+            LOG.exception(badge("RPC", "cache-prime-failed", number=number))
 
     def get_block_hash_by_number(self, group: str, node_name: str = "",
                                  number: int = 0):
@@ -433,49 +606,52 @@ def _proof_json(proof) -> list:
             for sibs, pos in proof]
 
 
+def http_body_handler(impl, max_batch: int = 256):
+    """-> handler(raw_body) -> response bytes, for EventLoopHttpServer.
+    Works with any impl exposing `.handle` (handle_payload_with does the
+    batch framing), so the multigroup and Pro facades serve batches too."""
+
+    def handle(raw: bytes) -> bytes:
+        try:
+            payload = json.loads(raw)
+        except Exception:
+            resp = {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": JSONRPC_PARSE_ERROR,
+                              "message": "parse error"}}
+        else:
+            resp = handle_payload_with(impl, payload, max_batch)
+            if resp is None:
+                return b""  # notification-only payload: nothing to send
+        return json.dumps(resp).encode()
+
+    return handle
+
+
 class JsonRpcServer:
-    """HTTP binding (the reference's boostssl HttpServer role)."""
+    """HTTP binding (the reference's boostssl HttpServer role): the
+    selectors event loop in rpc/edge.py with keep-alive + pipelining,
+    method execution offloaded to a bounded (optionally node-shared)
+    WorkerPool."""
 
-    def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1",
-                 port: int = 0):
+    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0,
+                 pool: Optional[WorkerPool] = None, workers: int = 8,
+                 keepalive_s: float = 60.0):
         self.impl = impl
-        impl_ref = impl
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):  # noqa: N802 — http.server API
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                try:
-                    req = json.loads(body)
-                except Exception:
-                    resp = {"jsonrpc": "2.0", "id": None,
-                            "error": {"code": JSONRPC_PARSE_ERROR,
-                                      "message": "parse error"}}
-                else:
-                    if isinstance(req, list):
-                        resp = [impl_ref.handle(r) for r in req]
-                    else:
-                        resp = impl_ref.handle(req)
-                data = json.dumps(resp).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def log_message(self, *args):  # quiet
-                pass
-
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.host, self.port = self._httpd.server_address[:2]
-        self._thread: Optional[threading.Thread] = None
+        max_batch = getattr(impl, "max_batch", 256)
+        self._own_pool = pool is None
+        self._pool = pool if pool is not None else WorkerPool(workers)
+        self._edge = EventLoopHttpServer(
+            http_body_handler(impl, max_batch), host=host, port=port,
+            pool=self._pool, keepalive_s=keepalive_s)
+        self.host, self.port = self._edge.host, self._edge.port
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="jsonrpc-http", daemon=True)
-        self._thread.start()
+        if self._own_pool:
+            self._pool.start()
+        self._edge.start()
         LOG.info(badge("RPC", "listening", host=self.host, port=self.port))
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._edge.stop()
+        if self._own_pool:
+            self._pool.stop()

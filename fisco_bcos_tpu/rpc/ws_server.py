@@ -59,9 +59,22 @@ class _Session:
 
 class WsRpcServer:
     def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, pool=None):
         self.impl = impl
         self.node = impl.node
+        # bounded dispatch offload, shared with the HTTP edge when the
+        # node wires one (init/node.py): method calls can block (receipt
+        # waits, AMOP round trips), so they never run on the reader
+        # thread — but neither does every message get its own OS thread
+        self.pool = pool
+        # fallback-thread cap: when the shared pool is saturated (or
+        # absent) a bounded number of one-off threads keeps WS sessions
+        # from deadlocking behind HTTP load — but beyond it this
+        # transport sheds like HTTP does, or a frame-spamming client
+        # turns pool saturation into unbounded OS threads parked in
+        # 30 s receipt waits
+        self._fallback = threading.BoundedSemaphore(
+            max(4, pool.workers if pool is not None else 4))
         self._seq = itertools.count(1)
         self._lock = threading.Lock()
         self._sessions: dict[WsConnection, _Session] = {}
@@ -119,22 +132,79 @@ class WsRpcServer:
             sess.push({"jsonrpc": "2.0", "id": None,
                        "error": {"code": -32700, "message": "parse error"}})
             return
+        if isinstance(msg, list):
+            # JSON-RPC 2.0 batch over WS: same framing as HTTP
+            # (handle_payload — per-id errors, notifications omitted,
+            # order preserved); WS-only methods are not batchable
+            self._offload(self._dispatch_batch, sess, msg)
+            return
+        if not isinstance(msg, dict):
+            sess.push({"jsonrpc": "2.0", "id": None,
+                       "error": {"code": -32600,
+                                 "message": "invalid request"}})
+            return
         if msg.get("type") == "amopResp":
             self._on_amop_resp(sess, msg)  # non-blocking: stays inline
             return
         if "method" not in msg:
+            if "id" in msg:  # a notification-shaped frame stays silent
+                sess.push({"jsonrpc": "2.0", "id": msg["id"],
+                           "error": {"code": -32600,
+                                     "message": "invalid request"}})
             return
         # dispatch off the reader thread: methods can block (sendTransaction
         # waits for a receipt; publishTopic waits for an amopResp that this
         # very reader thread must deliver — inline handling would deadlock a
         # session publishing to a topic it also serves)
-        threading.Thread(target=self._dispatch, args=(sess, msg),
-                         name="ws-dispatch", daemon=True).start()
+        self._offload(self._dispatch, sess, msg)
+
+    def _offload(self, fn, sess: _Session, msg) -> None:
+        """Run `fn(sess, msg)` on the shared bounded pool; a saturated (or
+        absent) pool falls back to a BOUNDED set of one-off threads so a
+        WS session never deadlocks behind HTTP load; past that cap the
+        request is shed with the same busy error HTTP answers."""
+        if self.pool is not None and self.pool.try_submit(
+                lambda: fn(sess, msg)):
+            return
+        if not self._fallback.acquire(blocking=False):
+            if isinstance(msg, list):
+                # batch shed: per-id errors (order preserved, notifications
+                # silent) so id-correlating clients resolve every waiter —
+                # one id:null error would leave them all hanging
+                errs = [{"jsonrpc": "2.0", "id": e.get("id"),
+                         "error": {"code": -32000,
+                                   "message": "server busy"}}
+                        for e in msg
+                        if isinstance(e, dict) and e.get("id") is not None]
+                if errs:
+                    sess.push(errs)
+                return
+            if isinstance(msg, dict) and "id" not in msg:
+                return  # notification: never answered, even when shed
+            sess.push({"jsonrpc": "2.0", "id": msg.get("id"),
+                       "error": {"code": -32000, "message": "server busy"}})
+            return
+
+        def run() -> None:
+            try:
+                fn(sess, msg)
+            finally:
+                self._fallback.release()
+
+        threading.Thread(target=run, name="ws-dispatch",
+                         daemon=True).start()
+
+    def _dispatch_batch(self, sess: _Session, msgs: list) -> None:
+        resp = self.impl.handle_payload(msgs)
+        if resp is not None:
+            sess.push(resp)
 
     def _dispatch(self, sess: _Session, msg: dict) -> None:
         handler = self._ws_methods().get(msg["method"])
         if handler is None:
-            sess.push(self.impl.handle(msg))
+            resp = self.impl.handle_payload(msg)
+            if resp is not None:  # None: notification, nothing to send
+                sess.push(resp)
             return
         mid = msg.get("id")
         try:

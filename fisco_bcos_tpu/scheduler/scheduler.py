@@ -38,6 +38,11 @@ class ExecutionResult:
     header: BlockHeader
     receipts: list[Receipt]
     state: StateStorage  # holds the block's execution changeset
+    # the proposal's LIVE tx objects: their _sender fields were populated
+    # by the admission/verify batch recover, so commit-time consumers
+    # (the RPC cache's prime_block) can render senders without re-running
+    # a recover batch over freshly-decoded copies
+    txs: list = dataclasses.field(default_factory=list)
 
 
 class Scheduler:
@@ -56,6 +61,17 @@ class Scheduler:
         # Initializer.cpp:393-416). Observers run on a notifier thread so a
         # slow subscriber cannot stall the consensus commit path.
         self.on_commit: list = []
+        # invalidation observers: callback(block_number) run SYNCHRONOUSLY
+        # when previously-served state may no longer be trustworthy — a
+        # commit 2PC rollback, or a snap-sync install that jumped the head
+        # over wiped tables. The RPC query cache (rpc/cache.py) rides this:
+        # it must be empty BEFORE any reader can observe the new state.
+        self.on_invalidate: list = []
+        # number -> the committed block's live txs, for commit observers
+        # that want the sender-populated tx objects (RPC cache priming).
+        # A few heights are kept because priming runs async on the
+        # notifier thread and can lag a burst of commits.
+        self.last_committed_txs: dict[int, list] = {}
         self._notify_q: "queue.Queue[Optional[int]]" = queue.Queue()
         self._notifier = threading.Thread(target=self._notify_loop,
                                           daemon=True, name="sched-notify")
@@ -107,7 +123,8 @@ class Scheduler:
             header.invalidate()
             if sealer_list is not None:
                 header.sealer_list = list(sealer_list)
-            result = ExecutionResult(header, receipts, state)
+            result = ExecutionResult(header, receipts, state,
+                                     list(block.transactions))
             self._executed[header.hash(self.suite)] = result
             metric("scheduler.execute", number=header.number, n_tx=len(txs),
                    ms=int((time.monotonic() - t0) * 1000))
@@ -142,11 +159,19 @@ class Scheduler:
                 # must not strand the height (PBFT retries the checkpoint;
                 # without this the node could only recover via block sync)
                 self._executed[hh] = result
+                self._fire_invalidate(header.number)
                 return False
             # drop any other stale executed results for this height
             for h in [h for h, r in self._executed.items()
                       if r.header.number <= header.number]:
                 self._executed.pop(h, None)
+            # hand the committed block's LIVE txs (senders already
+            # recovered at admission/verify) to the commit observers —
+            # prime_block renders the senders row from these instead of
+            # re-recovering freshly-decoded copies
+            self.last_committed_txs[header.number] = result.txs
+            while len(self.last_committed_txs) > 8:
+                self.last_committed_txs.pop(min(self.last_committed_txs))
         if self.txpool is not None:
             tx_hashes = self.ledger.tx_hashes_by_number(header.number)
             nonces = self.ledger.nonces_by_number(header.number)
@@ -171,10 +196,30 @@ class Scheduler:
             for h in [h for h, r in self._executed.items()
                       if r.header.number <= number]:
                 self._executed.pop(h, None)
+            # the stash refers to the pre-install chain — a same-number
+            # block on the installed chain must not reuse its senders
+            self.last_committed_txs.clear()
+        # BEFORE the commit notification fans out: a reader woken by the
+        # new height must never be served a pre-install cache entry
+        self._fire_invalidate(number)
         if self.txpool is not None:
             self.txpool.on_snapshot_installed(number)
         self._notify_q.put(number)
         metric("scheduler.external_commit", number=number)
+
+    def invalidate_caches(self, number: int) -> None:
+        """Public entry for subsystems that are ABOUT to mutate served
+        state outside the commit pipeline (snap-sync install): wipes the
+        on_invalidate observers' caches before the mutation publishes."""
+        self._fire_invalidate(number)
+
+    def _fire_invalidate(self, number: int) -> None:
+        for cb in list(self.on_invalidate):
+            try:
+                cb(number)
+            except Exception:
+                LOG.exception(badge("SCHED", "invalidate-observer-failed",
+                                    number=number))
 
     def shutdown(self) -> None:
         """Stop the notifier thread (node shutdown)."""

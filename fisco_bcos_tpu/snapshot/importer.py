@@ -190,6 +190,7 @@ def snap_sync(front, peer: bytes, storage, suite,
               verify_seals: Callable[[BlockHeader], bool],
               current_number: int, request_timeout: float = 5.0,
               should_abort: Optional[Callable[[], bool]] = None,
+              pre_install: Optional[Callable[[], None]] = None,
               ) -> Optional[tuple[SnapshotManifest, list[bytes]]]:
     """Fetch + verify + install a snapshot from `peer` over the
     ModuleID.SnapshotSync front module.
@@ -268,6 +269,14 @@ def snap_sync(front, peer: bytes, storage, suite,
         # that shutdown is about to (or already did) flush and close
         LOG.info(badge("SNAP", "install-aborted", number=manifest.height))
         return None
+    if pre_install is not None:
+        # serving caches must be empty BEFORE the install commit publishes
+        # the new state — the post-install invalidation (external_commit)
+        # alone leaves a window where a reader sees the installed head but
+        # a cache still serves pre-install blocks. (The second, post-
+        # install invalidation fences out renders in flight across the
+        # commit.)
+        pre_install()
     try:
         # the quorum was batch-verified on this same header pre-fetch —
         # don't pay for it a second time on the install path

@@ -221,7 +221,10 @@ class BlockSync(Worker):
         res = snap_sync(self.front, peer, self.ledger.storage, self.suite,
                         self._verify_seals, self.ledger.current_number(),
                         request_timeout=REQUEST_TIMEOUT,
-                        should_abort=self._downloader.stopping)
+                        should_abort=self._downloader.stopping,
+                        pre_install=None if self.scheduler is None else
+                        lambda: self.scheduler.invalidate_caches(
+                            self.ledger.current_number()))
         if res is None:
             self.sync_mode = prev_mode
             REGISTRY.set_gauge("bcos_sync_mode",

@@ -105,7 +105,13 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                       "snap_sync_threshold": str(cfg.snap_sync_threshold),
                       "chunk_bytes": str(cfg.snapshot_chunk_bytes)}
     cp["rpc"] = {"listen_ip": cfg.rpc_host,
-                 "listen_port": "" if cfg.rpc_port is None else str(cfg.rpc_port)}
+                 "listen_port": "" if cfg.rpc_port is None else str(cfg.rpc_port),
+                 # serving read plane (rpc/edge.py + rpc/cache.py)
+                 "workers": str(cfg.rpc_workers),
+                 "max_batch": str(cfg.rpc_max_batch),
+                 "cache_entries": str(cfg.rpc_cache_entries),
+                 "cache_mb": str(cfg.rpc_cache_mb),
+                 "keepalive_s": str(cfg.rpc_keepalive_s)}
     cp["p2p"] = {"listen_ip": cfg.p2p_host,
                  "listen_port": "" if cfg.p2p_port is None else str(cfg.p2p_port),
                  # NodeConfig.cpp's nodes.json connected_nodes, inlined
@@ -170,6 +176,11 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         crypto_mesh_devices=cp.getint("crypto", "mesh_devices", fallback=0),
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
         rpc_port=int(port_s) if port_s else None,
+        rpc_workers=cp.getint("rpc", "workers", fallback=8),
+        rpc_max_batch=cp.getint("rpc", "max_batch", fallback=256),
+        rpc_cache_entries=cp.getint("rpc", "cache_entries", fallback=4096),
+        rpc_cache_mb=cp.getint("rpc", "cache_mb", fallback=64),
+        rpc_keepalive_s=cp.getfloat("rpc", "keepalive_s", fallback=60.0),
         metrics_port=int(metrics_s) if metrics_s else None,
         p2p_host=cp.get("p2p", "listen_ip", fallback="127.0.0.1"),
         p2p_port=int(p2p_port_s) if p2p_port_s else None,

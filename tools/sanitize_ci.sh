@@ -18,6 +18,11 @@
 #                                   # wipe a fresh data dir, import, verify
 #                                   # identical head hash + state root and
 #                                   # emit the snap_sync_seconds bench row
+#   tools/sanitize_ci.sh --rpc      # ONLY the read-plane smoke: boot a
+#                                   # node, issue a keep-alive JSON-RPC 2.0
+#                                   # batch, assert cache-hit metrics
+#                                   # increment and a post-commit query
+#                                   # serves the cached bytes
 #
 # Exit 0 = every stage clean. Each stage rebuilds the sanitizer variants
 # from the CURRENT sources (the src-hash stamp keeps them honest) and runs
@@ -47,6 +52,67 @@ assert row["recover_calls_per_tx"] < 1.0, row
 print("sanitize_ci: INGEST STAGE CLEAN "
       f"(tps={row['tps']}, mean_batch={row['mean_batch']}, "
       f"recover/tx={row['recover_calls_per_tx']})")
+EOF
+  exit 0
+fi
+
+if [ "${1:-}" = "--rpc" ]; then
+  echo "== [rpc] read-plane smoke: keep-alive batch request +" \
+       "commit-coherent query cache"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 300 \
+    python - <<'EOF'
+import json
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.node import Node, NodeConfig
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.sdk.client import SdkClient
+
+node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                       rpc_port=0))
+node.start()
+try:
+    kp = node.suite.generate_keypair(b"rpc-smoke")
+    def register(i):
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register",
+                             lambda w: w.blob(b"rs%d" % i).u64(10 + i)),
+                         nonce=f"rs{i}", block_limit=100).sign(node.suite, kp)
+        rc = node.txpool.wait_for_receipt(
+            node.send_transaction(tx).tx_hash, 30)
+        assert rc is not None and rc.status == 0, rc
+    for i in range(3):
+        register(i)
+
+    sdk = SdkClient(f"http://{node.rpc.host}:{node.rpc.port}")
+    # ONE keep-alive connection, ONE JSON-RPC 2.0 batch body
+    head = node.ledger.current_number()
+    resps = sdk.request_batch([
+        ("getBlockNumber", ["group0", ""]),
+        ("getBlockByNumber", ["group0", "", head, False, False]),
+        ("getBlockByNumber", ["group0", "", head, False, False]),
+    ])
+    assert len(resps) == 3 and all("result" in r for r in resps), resps
+    assert resps[0]["result"] == head
+    assert json.dumps(resps[1]["result"]) == json.dumps(resps[2]["result"])
+    s0 = node.query_cache.stats()
+    assert s0["hits"] >= 1, s0  # identical in-batch query served cached
+
+    # post-commit: a NEW block's responses serve from the primed cache,
+    # byte-for-byte identical across requests on the same connection
+    register(3)
+    new_head = node.ledger.current_number()
+    assert new_head > head
+    b1 = sdk.get_block_by_number(new_head)
+    b2 = sdk.get_block_by_number(new_head)
+    assert json.dumps(b1) == json.dumps(b2)
+    s1 = node.query_cache.stats()
+    assert s1["hits"] > s0["hits"], (s0, s1)
+    print("sanitize_ci: RPC STAGE CLEAN "
+          f"(hits={s1['hits']}, hit_rate={s1['hit_rate']}, "
+          f"entries={s1['entries']})")
+finally:
+    node.stop()
 EOF
   exit 0
 fi
