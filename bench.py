@@ -426,12 +426,15 @@ def main() -> None:
                     }
         try:
             # supplementary: the end-to-end 4-node chain TPS on THIS host
-            # (round 5's battle; the device grid stays the headline).
+            # (round 5's battle; the device grid stays the headline), plus
+            # the pipeline stage-occupancy breakdown (round 9).
             rows, _ = _chain_bench_rows(
-                ["-n", "3000", "--backend", "host"],
+                ["-n", "3000", "--backend", "host", "--pipeline-profile"],
                 "BENCH_CHAIN_TIMEOUT", 240)
-            if rows:
-                chain = rows[-1]
+            chain = next((r for r in rows
+                          if str(r.get("metric", "")).startswith(
+                              "chain_tps_4node")), None)
+            if chain:
                 line["chain_tps_4node_host"] = chain.get("value")
                 line["chain_block_interval_ms"] = chain.get(
                     "block_interval_mean_ms")
@@ -439,6 +442,17 @@ def main() -> None:
                 # TLS overhead must be attributable from the bench line)
                 line["chain_tls"] = bool(chain.get("tls", False))
                 line["chain_transport"] = chain.get("transport", "fake")
+                line["chain_pipeline"] = bool(chain.get("pipeline", False))
+            ptps = next((r for r in rows
+                         if r.get("metric") == "pipeline_tps"), None)
+            prof = next((r for r in rows
+                         if r.get("metric") == "pipeline_profile"), None)
+            if ptps and not ptps.get("timed_out"):
+                line["pipeline_tps"] = ptps.get("value")
+            if prof:
+                line["pipeline_stage_occupancy"] = prof.get("occupancy")
+                line["pipeline_speculative_execs"] = prof.get(
+                    "speculative_execs")
         except Exception:
             pass
         try:

@@ -91,7 +91,8 @@ def _build_workload(sm: bool, n: int, block_limit: int) -> list[bytes]:
 def _build_chain(sm: bool, backend: str, tx_count_limit: int,
                  transport: str = "fake", tls: bool = False,
                  rpc_on_first: bool = False, ingest_lane: bool = True,
-                 min_seal_time: float = 0.0, max_wait_ms: float = 15.0):
+                 min_seal_time: float = 0.0, max_wait_ms: float = 15.0,
+                 pipeline: bool = True):
     """4-node PBFT chain -> (nodes, gateways, tls_effective)."""
     from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.init.node import Node, NodeConfig
@@ -135,6 +136,7 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
                                tx_count_limit=tx_count_limit,
                                ingest_lane=ingest_lane,
                                ingest_max_wait_ms=max_wait_ms,
+                               pipeline_commit=pipeline,
                                rpc_port=0 if rpc_on_first and i == 0
                                else None),
                     keypair=kp, gateway=gw)
@@ -144,11 +146,12 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
 
 
 def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
-              transport: str = "fake", tls: bool = False) -> dict:
+              transport: str = "fake", tls: bool = False,
+              pipeline: bool = True, profile: bool = False) -> dict:
     from fisco_bcos_tpu.protocol import Transaction
 
     nodes, gateways, tls = _build_chain(sm, backend, tx_count_limit,
-                                        transport, tls)
+                                        transport, tls, pipeline=pipeline)
     gateway = gateways[0]
 
     # instrument proposal verification latency on every node
@@ -213,6 +216,10 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
         t_end = time.perf_counter()
         committed = want.total_tx_count()
         height = want.current_number()
+        # the ingress node's per-stage occupancy (fill/execute/roots/
+        # consensus_wait/commit seconds) — collected before stop so the
+        # numbers cover exactly the timed window's blocks
+        pstats = nodes[0].scheduler.pipeline_stats() if profile else None
     finally:
         for node in nodes:
             node.stop()
@@ -227,10 +234,11 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
     def pct(p):
         return vt[min(len(vt) - 1, int(p * len(vt)))] if vt else 0.0
 
-    return {
+    row = {
         "suite": "sm" if sm else "ecdsa",
         "transport": transport,
         "tls": bool(tls),
+        "pipeline": bool(pipeline),
         "txs_committed": int(committed),
         "blocks": int(height),
         "tps": round(committed / (t_end - t0), 1) if t_end > t0 else 0.0,
@@ -241,11 +249,15 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
         "block_verify_p50_ms": round(pct(0.50) * 1000, 2),
         "block_verify_p95_ms": round(pct(0.95) * 1000, 2),
     }
+    if pstats is not None:
+        row["pipeline_stats"] = pstats
+    return row
 
 
 def run_rpc_ingest(sm: bool, n: int, backend: str, tx_count_limit: int,
                    clients: int, ingest_lane: bool = True,
-                   max_wait_ms: float = 100.0) -> dict:
+                   max_wait_ms: float = 100.0,
+                   pipeline: bool = True) -> dict:
     """N independent HTTP JSON-RPC clients against a live 4-node chain.
 
     Measures the serving-stack amortization the ingest lane buys: each
@@ -269,7 +281,8 @@ def run_rpc_ingest(sm: bool, n: int, backend: str, tx_count_limit: int,
                                       rpc_on_first=True,
                                       ingest_lane=ingest_lane,
                                       min_seal_time=0.2,
-                                      max_wait_ms=max_wait_ms)
+                                      max_wait_ms=max_wait_ms,
+                                      pipeline=pipeline)
     ingress = nodes[0]
     # instrument the ingress node's recover entry point (instance-attr
     # shadow): every signature verification on node 0 crosses it
@@ -343,6 +356,7 @@ def run_rpc_ingest(sm: bool, n: int, backend: str, tx_count_limit: int,
         "suite": "sm" if sm else "ecdsa",
         "clients": clients,
         "ingest_lane": bool(ingest_lane),
+        "pipeline": bool(pipeline),
         "max_wait_ms": max_wait_ms,
         # a wedged chain must not masquerade as a slow one: consumers
         # (bench.py, sanitize_ci) check this before trusting tps
@@ -623,7 +637,8 @@ def _emit_rpc_mode(args, sm: bool) -> None:
     rows = {}
     for name, clients, lane in runs:
         res = run_rpc_ingest(sm, args.n, args.backend, args.tx_count_limit,
-                             clients, ingest_lane=lane)
+                             clients, ingest_lane=lane,
+                             pipeline=not args.no_pipeline)
         suffix = "_sm" if sm else ""
         res.update({"metric": f"{name}_tps{suffix}", "value": res["tps"],
                     "unit": "tx/sec"})
@@ -709,6 +724,13 @@ def main() -> None:
                          "against the same source chain")
     ap.add_argument("--sync-blocks", type=int, default=40,
                     help="with --sync-bench: source chain length in blocks")
+    ap.add_argument("--pipeline-profile", action="store_true",
+                    help="direct mode: also emit pipeline_tps and a per-"
+                         "stage (fill/execute/roots/consensus_wait/commit) "
+                         "occupancy breakdown from the ingress node")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="disable pipelined block production (serial "
+                         "execute-then-commit — the before/after anchor)")
     args = ap.parse_args()
 
     suites = [False, True] if args.suite == "both" else \
@@ -728,13 +750,44 @@ def main() -> None:
         return
     for sm in suites:
         res = run_chain(sm, args.n, args.backend, args.tx_count_limit,
-                        transport=args.transport, tls=args.tls)
+                        transport=args.transport, tls=args.tls,
+                        pipeline=not args.no_pipeline,
+                        profile=args.pipeline_profile)
         suffix = ""
         if args.transport == "p2p":
             suffix = "_tls" if res["tls"] else "_tcp"
+        pstats = res.pop("pipeline_stats", None)
         res.update({"metric": f"chain_tps_4node_{res['suite']}" + suffix,
                     "value": res["tps"], "unit": "tx/sec"})
         print(json.dumps(res), flush=True)
+        if args.pipeline_profile:
+            print(json.dumps({
+                "metric": "pipeline_tps", "value": res["tps"],
+                "unit": "tx/sec", "suite": res["suite"],
+                "pipeline": res["pipeline"], "blocks": res["blocks"],
+                "txs_committed": res["txs_committed"],
+                "timed_out": res["txs_committed"] < args.n,
+            }), flush=True)
+            wall = max(res["wall_seconds"], 1e-9)
+            stages = (pstats or {}).get("stages", {})
+            print(json.dumps({
+                "metric": "pipeline_profile", "unit": "occupancy",
+                "suite": res["suite"], "pipeline": res["pipeline"],
+                "wall_seconds": res["wall_seconds"],
+                # fraction of the timed window each stage kept busy on the
+                # ingress node; stages can sum past 1.0 exactly when the
+                # pipeline overlaps them — that overlap IS the win, and the
+                # biggest stage is where the next order of magnitude lives
+                "occupancy": {k: round(v["seconds"] / wall, 3)
+                              for k, v in stages.items()},
+                "stage_seconds": {k: v["seconds"]
+                                  for k, v in stages.items()},
+                "blocks_profiled": max(
+                    [v["count"] for v in stages.values()] or [0]),
+                "speculative_execs": (pstats or {}).get(
+                    "speculative_execs", 0),
+                "overlap_commits": (pstats or {}).get("overlap_commits", 0),
+            }), flush=True)
 
 
 if __name__ == "__main__":

@@ -296,6 +296,10 @@ class PBFTEngine(Worker):
                 self.txpool.unseal(cache.proposal.tx_hashes)
         self._caches.clear()
         self._viewchanges.clear()
+        # in-flight speculative executions belong to the discarded epoch
+        abort = getattr(self.scheduler, "abort_speculation", None)
+        if abort is not None:
+            abort()
         metric("pbft.membership", n=self.n, was=old_n, index=self.index)
 
     # -- ingress -----------------------------------------------------------
@@ -331,7 +335,7 @@ class PBFTEngine(Worker):
                     self.txpool.unseal(cache.proposal.tx_hashes)
             self.sealer.revoke(number)
             self._grant_sealer()
-            self._try_advance(number + 1)
+            self._try_advance(self._next_exec())
         local: list[Block] = []
         msgs: list[PBFTMessage] = []
         while True:
@@ -343,6 +347,8 @@ class PBFTEngine(Worker):
                 local.append(item)  # type: ignore[arg-type]
             elif kind == "executed":
                 self._on_executed(*item)  # type: ignore[misc]
+            elif kind == "committed":
+                self._on_commit_done(*item)  # type: ignore[misc]
             else:
                 msgs.append(item)  # type: ignore[arg-type]
         for msg in self._batch_checked(msgs):
@@ -618,10 +624,19 @@ class PBFTEngine(Worker):
         self._try_advance(msg.number)
 
     # -- quorum state machine (PBFTCacheProcessor::checkAndCommit) ---------
+    def _next_exec(self) -> int:
+        """The next height the execution lane may run: the scheduler's
+        speculative head + 1 under pipelining (execute N+1 while N's commit
+        is in flight), committed + 1 for proxy schedulers."""
+        ne = getattr(self.scheduler, "next_executable", None)
+        return ne() if ne is not None else self.ledger.current_number() + 1
+
     def _try_advance(self, number: int) -> None:
         """Advance height `number` as far as its quorums allow. Prepare and
-        commit phases run for ANY in-flight height (the pipeline); execution
-        and ledger commit stay strictly in order behind current+1."""
+        commit phases run for ANY in-flight height (the pipeline);
+        execution stays strictly ordered but runs SPECULATIVELY ahead of
+        the ledger (height N+1 executes over N's uncommitted changeset
+        while N's 2PC runs on the scheduler's commit thread)."""
         cache = self._caches.get(number)
         current = self.ledger.current_number()
         if cache is None or not (current < number <= current + self.waterline):
@@ -643,7 +658,7 @@ class PBFTEngine(Worker):
         commits = sum(1 for m in cache.commits.values()
                       if m.proposal_hash == phash)
         if cache.prepared and not cache.executed and commits >= self.quorum \
-                and number == current + 1:
+                and number == self._next_exec():
             self._execute_and_checkpoint(number, cache)
         if cache.executed:
             self._try_commit_ledger(number, cache)
@@ -683,7 +698,7 @@ class PBFTEngine(Worker):
             # and no further packet will re-trigger it
             if result is not None:
                 self.scheduler.drop_executed(result.header)
-            self._try_advance(self.ledger.current_number() + 1)
+            self._try_advance(self._next_exec())
             return
         if result is None:
             # genuine execution failure with a live round: do NOT self-
@@ -707,6 +722,10 @@ class PBFTEngine(Worker):
         metric("pbft.executed", number=number,
                ehash=cache.executed_hash[:8].hex())
         self._try_advance(number)
+        # pipeline: the next height may already hold its commit quorum
+        # (consensus ran ahead) — it can execute speculatively NOW, over
+        # this result's changeset, while this block's seals/commit land
+        self._try_advance(number + 1)
 
     def _try_commit_ledger(self, number: int, cache: _ProposalCache) -> None:
         if len(cache.checkpoints) < self.quorum or cache.committed_phase:
@@ -731,10 +750,42 @@ class PBFTEngine(Worker):
         # proposal header never learns its roots
         header = cache.executed_header
         header.signature_list = good
+        commit_async = getattr(self.scheduler, "commit_async", None)
+        if commit_async is not None:
+            # pipelined commit: hand the decided block to the scheduler's
+            # commit thread and keep draining packets — the next height
+            # can reach quorum and execute while this 2PC + fsync runs
+            self._reset_timer()  # a decided block IS progress
+
+            def _done(ok: bool, _n=number) -> None:
+                self._inbox.put(("committed", (_n, ok)))
+                self.wakeup()
+
+            commit_async(header, _done)
+            return
         if not self.scheduler.commit_block(header):
             LOG.error(badge("PBFT", "ledger-commit-failed", number=number))
             cache.committed_phase = False
             return
+        self._finish_commit(number)
+
+    def _on_commit_done(self, number: int, ok: bool) -> None:
+        """Commit-stage completion (delivered through the inbox, so all
+        bookkeeping stays on the worker thread)."""
+        if not ok:
+            LOG.error(badge("PBFT", "ledger-commit-failed", number=number))
+            cache = self._caches.get(number)
+            if cache is not None:
+                # re-arm the checkpoint path: the next packet or timeout
+                # retries the commit, exactly like the synchronous path
+                cache.committed_phase = False
+            return
+        self._finish_commit(number)
+
+    def _finish_commit(self, number: int) -> None:
+        """Post-commit bookkeeping (shared by the sync and pipelined
+        paths). Idempotent: a sync-committed height observed by the worker
+        loop may already have retired the caches."""
         for h in [h for h in self._caches if h <= number]:
             self._caches.pop(h, None)
         if self.log is not None:
@@ -961,6 +1012,13 @@ class PBFTEngine(Worker):
             if cache.proposal is not None and not cache.committed_phase:
                 self.txpool.unseal(cache.proposal.tx_hashes)
             self._caches.pop(number, None)
+        # speculative executions hang off rounds this view just discarded;
+        # the new view's (re-)proposals must re-execute against the durable
+        # head (results already on the commit stage are kept — they hold a
+        # checkpoint quorum and will land)
+        abort = getattr(self.scheduler, "abort_speculation", None)
+        if abort is not None:
+            abort()
         self.view = v
         self.to_view = v
         if self.log is not None:

@@ -518,7 +518,8 @@ class TransactionExecutor:
             pool = self._wave_pool(workers)
         try:
             for wave in waves:
-                if pool is None or len(wave) == 1:
+                if pool is None or len(wave) == 1 \
+                        or not self._wave_parallelizable(wave, txs):
                     for i in wave:
                         receipts[i] = self.execute_transaction(
                             txs[i], state, block_number, timestamp)
@@ -548,6 +549,20 @@ class TransactionExecutor:
         metric("executor.dag", n=len(txs), waves=len(waves),
                workers=workers, ms=int((time.monotonic() - t0) * 1000))
         return [r for r in receipts]
+
+    def _wave_parallelizable(self, wave: list[int],
+                             txs: Sequence[Transaction]) -> bool:
+        """Threads only help a wave whose execution RELEASES the GIL — the
+        native frame interpreter's ctypes calls (contract-code txs with
+        native/nevm loaded). Pure-Python precompile waves hold the GIL for
+        their whole body: pooling them buys zero parallelism and charges
+        per-tx overlay + merge + pool-dispatch overhead, which under a
+        multi-node-per-host bench turned a ~80 ms wave into seconds of
+        thread thrash. Those waves run serially on the block state."""
+        if not self.evm.native:
+            return False
+        return any(txs[i].to and txs[i].to not in self.registry
+                   for i in wave)
 
     def _wave_pool(self, workers: int):
         """Cached wave thread pool (per-block spawn/teardown stays off the

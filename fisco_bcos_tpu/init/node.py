@@ -59,6 +59,17 @@ class NodeConfig:
     ingest_max_wait_ms: float = 15.0
     ingest_queue_cap: int = 8192
     min_seal_time: float = 0.05
+    # busy-pipeline fill ceiling: while a block is executing/committing the
+    # sealer keeps filling the next proposal up to this long (bigger DAG
+    # waves, fewer consensus rounds per tx); an idle pipeline still seals
+    # at min_seal_time. Clamped to >= min_seal_time.
+    max_seal_time: float = 0.5
+    # pipelined block production ([scheduler] pipeline): commit runs on a
+    # dedicated scheduler thread with strict height ordering, and height
+    # N+1 executes speculatively over N's uncommitted changeset (stacked
+    # state view; state_root stays per-changeset). False restores the
+    # serial execute-then-commit path (comparison benches, odd embeddings).
+    pipeline_commit: bool = True
     consensus: str = "solo"  # solo | pbft
     crypto_backend: str = "auto"  # device | host | auto
     device_min_batch: int = 512
@@ -138,12 +149,19 @@ class Node:
             queue_cap=cfg.ingest_queue_cap) if cfg.ingest_lane else None
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
-                                   self.suite, self.txpool)
+                                   self.suite, self.txpool,
+                                   pipeline=cfg.pipeline_commit)
         from ..tool.timesync import NodeTimeMaintenance
         self.timesync = NodeTimeMaintenance()
+        # solo mode commits synchronously inside the proposal callback, so
+        # busy-aware filling would only see its own in-flight proposal
+        busy = (self.scheduler.pipeline_busy
+                if cfg.pipeline_commit and cfg.consensus != "solo" else None)
         self.sealer = Sealer(self.txpool, self.suite, self._on_proposal,
                              cfg.tx_count_limit, cfg.min_seal_time,
-                             clock_ms=self.timesync.aligned_time_ms)
+                             clock_ms=self.timesync.aligned_time_ms,
+                             max_seal_time=cfg.max_seal_time,
+                             pipeline_busy=busy)
         self._commit_lock = threading.Lock()
         self.consensus = None  # bound by PBFT wiring in start()
         self.front: Optional[FrontService] = None

@@ -1,4 +1,5 @@
-"""Scheduler — drives a block through execute -> roots -> 2PC commit.
+"""Scheduler — drives blocks through execute -> roots -> 2PC commit as a
+multi-stage pipeline across heights.
 
 Reference counterpart: /root/reference/bcos-scheduler/src/SchedulerImpl.cpp
 (:125 executeBlock, :370 commitBlock) and BlockExecutive.cpp (:52 prepare,
@@ -11,10 +12,27 @@ over the changeset — and returns the finalised header for consensus
 checkpointing. `commit` stages ledger writes + execution state into one
 changeset and drives prepare/commit on the transactional storage.
 
-Blocks execute strictly in order (block N+1 waits for N's header hash); the
-pipeline overlap happens a level up, in consensus (PBFT pipelines proposals,
-PBFTConfig waterlines) — matching the reference's design where the scheduler
-serialises execution per block.
+Pipelining (the hardware-assisted-BFT shape: keep the accelerator fed by
+overlapping stages instead of serialising them on one thread):
+
+  * **Commit stage on its own thread.** `commit_async` hands a decided
+    block to a dedicated commit worker; the consensus worker returns to
+    draining packets immediately instead of blocking on the 2PC + WAL
+    fsync. Commits stay strictly height-ordered (the worker refuses
+    anything but committed+1).
+  * **Speculative execution.** Block N+1 executes while N's commit is in
+    flight: its StateStorage overlay reads through a StackedStorageView
+    over N's (and any earlier uncommitted) changeset. Each block's
+    `state_root` stays the Merkle root of ITS OWN changeset (it is NOT
+    cumulative), so speculation changes nothing about header identity.
+    The speculative chain (`_spec`) links by parent hash; a commit whose
+    parent check fails, a 2PC rollback, or `abort_speculation` (view
+    change) discards the speculative tail and execution re-runs against
+    the durable head.
+
+Blocks still execute strictly in order (N+1 chains on N's finalised
+header); `pipeline=False` restores the serial execute-then-commit shape
+for comparison benches and odd embeddings.
 """
 
 from __future__ import annotations
@@ -23,13 +41,14 @@ import dataclasses
 import queue
 import threading
 import time
-from typing import Optional, Sequence
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
 
 from ..executor.executor import TransactionExecutor
 from ..ledger.ledger import Ledger
 from ..protocol import Block, BlockHeader, ParentInfo, Receipt, Transaction
-from ..storage.interface import TransactionalStorage
-from ..storage.state import StateStorage
+from ..storage.interface import ChangeSet, Entry, TransactionalStorage
+from ..storage.state import StackedStorageView, StateStorage
 from ..utils.log import LOG, badge, metric
 
 
@@ -43,19 +62,35 @@ class ExecutionResult:
     # (the RPC cache's prime_block) can render senders without re-running
     # a recover batch over freshly-decoded copies
     txs: list = dataclasses.field(default_factory=list)
+    # the block's changeset snapshot: N+1's speculative reads stack over
+    # it, and commit stages exactly it (plus the header rows) into the 2PC
+    changes: ChangeSet = dataclasses.field(default_factory=dict)
+    parent_hash: bytes = b""  # chain link checked again at commit time
+    hh: bytes = b""           # header hash (commit identity key)
+    committing: bool = False  # handed to the commit stage; abort keeps it
+    t_executed: float = 0.0   # monotonic stamp for consensus-wait timing
 
 
 class Scheduler:
     def __init__(self, storage: TransactionalStorage, ledger: Ledger,
-                 executor: TransactionExecutor, suite, txpool=None):
+                 executor: TransactionExecutor, suite, txpool=None,
+                 pipeline: bool = True):
         self.storage = storage
         self.ledger = ledger
         self.executor = executor
         self.suite = suite
         self.txpool = txpool
-        self._lock = threading.RLock()
-        # cache: block hash -> ExecutionResult awaiting commit
+        self.pipeline = pipeline
+        self._lock = threading.RLock()       # bookkeeping dicts below
+        self._exec_lock = threading.RLock()  # serialises block execution
+        self._commit_2pc = threading.Lock()  # serialises the storage 2PC
+        # executed results awaiting commit: hash -> result, plus a height
+        # index so eviction never rebuilds the whole dict under the lock
         self._executed: dict[bytes, ExecutionResult] = {}
+        self._exec_heights: dict[int, set[bytes]] = {}
+        # the speculative chain: contiguous heights committed+1..head, in
+        # order; each entry's changeset backs the next height's reads
+        self._spec: "OrderedDict[int, ExecutionResult]" = OrderedDict()
         # commit observers: callback(block_number) after a durable commit
         # (the reference's block-number notification fan-out,
         # Initializer.cpp:393-416). Observers run on a notifier thread so a
@@ -69,133 +104,399 @@ class Scheduler:
         self.on_invalidate: list = []
         # number -> the committed block's live txs, for commit observers
         # that want the sender-populated tx objects (RPC cache priming).
-        # A few heights are kept because priming runs async on the
-        # notifier thread and can lag a burst of commits.
-        self.last_committed_txs: dict[int, list] = {}
+        # Commits are strictly height-ordered, so an OrderedDict evicts
+        # its oldest entry in O(1) instead of re-scanning for min().
+        self.last_committed_txs: "OrderedDict[int, list]" = OrderedDict()
+        # per-stage occupancy accounting (chain_bench --pipeline-profile)
+        self._stage_s: dict[str, float] = {}
+        self._stage_n: dict[str, int] = {}
+        self._overlap_commits = 0      # 2PCs that ran while a block executed
+        self._speculative_execs = 0    # executions stacked over uncommitted state
+        self._exec_busy = False
+        self._commit_busy = False
         self._notify_q: "queue.Queue[Optional[int]]" = queue.Queue()
         self._notifier = threading.Thread(target=self._notify_loop,
                                           daemon=True, name="sched-notify")
         self._notifier.start()
+        # the commit stage: only materialised in pipeline mode — callers
+        # probe `commit_async` (None = synchronous commit path)
+        self.commit_async: Optional[Callable] = None
+        self._commit_q: "queue.Queue" = queue.Queue()
+        self._commit_thread: Optional[threading.Thread] = None
+        if pipeline:
+            self.commit_async = self._commit_async
+            self._commit_thread = threading.Thread(
+                target=self._commit_loop, daemon=True, name="sched-commit")
+            self._commit_thread.start()
+
+    # -- stage accounting --------------------------------------------------
+    def _stage(self, name: str, dt: float) -> None:
+        with self._lock:
+            self._stage_s[name] = self._stage_s.get(name, 0.0) + dt
+            self._stage_n[name] = self._stage_n.get(name, 0) + 1
+
+    def pipeline_stats(self) -> dict:
+        with self._lock:
+            return {
+                "depth": len(self._spec),
+                "commit_queue": self._commit_q.qsize(),
+                "overlap_commits": self._overlap_commits,
+                "speculative_execs": self._speculative_execs,
+                "stages": {k: {"seconds": round(v, 4),
+                               "count": self._stage_n.get(k, 0)}
+                           for k, v in sorted(self._stage_s.items())},
+            }
+
+    def reset_pipeline_stats(self) -> None:
+        with self._lock:
+            self._stage_s.clear()
+            self._stage_n.clear()
+            self._overlap_commits = 0
+            self._speculative_execs = 0
+
+    def pipeline_busy(self) -> bool:
+        """True while a block is executing or awaiting/undergoing commit —
+        the sealer's keep-filling signal (a proposal sealed now would only
+        queue behind the pipeline, so it may as well grow)."""
+        with self._lock:
+            if self._spec:
+                return True
+        return self._exec_busy
+
+    def next_executable(self) -> int:
+        """The height the next execute_block call must carry: speculative
+        head + 1, or committed + 1 when nothing is in flight (always
+        committed + 1 with the pipeline disabled — no speculation)."""
+        with self._lock:
+            committed = self.ledger.current_number()
+            if not self.pipeline:
+                return committed + 1
+            while self._spec and next(iter(self._spec)) <= committed:
+                self._forget_locked(self._spec.popitem(last=False)[1])
+            if self._spec:
+                return max(next(reversed(self._spec)), committed) + 1
+            return committed + 1
 
     # -- execute (SchedulerImpl::executeBlock) -----------------------------
     def execute_block(self, block: Block, sealer_list: Sequence[bytes] | None = None
                       ) -> Optional[ExecutionResult]:
         """Execute a proposal; returns the finalised header (with roots) or
-        None if the block cannot be executed (bad parent / missing txs)."""
+        None if the block cannot be executed (bad parent / missing txs).
+
+        With pipelining, the proposal may chain on a not-yet-committed
+        parent: reads stack over the speculative chain's changesets."""
         t0 = time.monotonic()
+        with self._exec_lock:
+            self._exec_busy = True
+            try:
+                return self._execute_locked(block, sealer_list, t0)
+            finally:
+                self._exec_busy = False
+
+    def _execute_locked(self, block: Block,
+                        sealer_list: Sequence[bytes] | None,
+                        t0: float) -> Optional[ExecutionResult]:
+        header = block.header
         with self._lock:
-            header = block.header
-            current = self.ledger.current_number()
-            if header.number != current + 1:
-                LOG.warning(badge("SCHED", "execute-out-of-order",
-                                  number=header.number, current=current))
-                return None
-            parent = self.ledger.header_by_number(current)
+            committed = self.ledger.current_number()
+            while self._spec and next(iter(self._spec)) <= committed:
+                self._forget_locked(self._spec.popitem(last=False)[1])
+            # re-executing an in-flight height replaces the speculative
+            # tail from there up (solo retry after a commit failure, or a
+            # superseding proposal) — unless a replaced entry is already on
+            # the commit stage, in which case its outcome decides first
+            if committed < header.number and self._spec \
+                    and header.number <= next(reversed(self._spec)):
+                if any(r.committing for n, r in self._spec.items()
+                       if n >= header.number):
+                    LOG.warning(badge("SCHED", "execute-vs-commit-race",
+                                      number=header.number))
+                    return None
+                self._drop_spec_from_locked(header.number)
+            spec = list(self._spec.values())
+        if not self.pipeline:
+            # serial mode: never stack over uncommitted state — execution
+            # strictly follows the durable head (the documented opt-out
+            # and the --no-pipeline bench anchor)
+            spec = []
+        base_number = spec[-1].header.number if spec else committed
+        if header.number != base_number + 1:
+            LOG.warning(badge("SCHED", "execute-out-of-order",
+                              number=header.number, current=committed,
+                              spec_head=base_number))
+            return None
+        if spec:
+            parent_hash = spec[-1].header.hash(self.suite)
+            backend = StackedStorageView(self.storage,
+                                         [r.changes for r in spec])
+        else:
+            parent = self.ledger.header_by_number(committed)
             parent_hash = parent.hash(self.suite) if parent else b"\x00" * 32
+            backend = self.storage
 
-            from ..utils.trace import block_trace
-            trace = block_trace(header.number)
-            txs = block.transactions
-            if not txs and block.tx_hashes:
-                if self.txpool is None:
-                    return None
-                txs = self.txpool.fill_block(block.tx_hashes)
-                if txs is None:
-                    LOG.warning(badge("SCHED", "missing-txs", number=header.number))
-                    return None
-                block.transactions = txs
-            trace.stage("fill")
+        from ..utils.trace import block_trace
+        trace = block_trace(header.number)
+        txs = block.transactions
+        if not txs and block.tx_hashes:
+            if self.txpool is None:
+                return None
+            txs = self.txpool.fill_block(block.tx_hashes)
+            if txs is None:
+                LOG.warning(badge("SCHED", "missing-txs", number=header.number))
+                return None
+            block.transactions = txs
+        trace.stage("fill")
+        t_fill = time.monotonic()
+        self._stage("fill", t_fill - t0)
 
-            state = StateStorage(self.storage)
-            receipts = self.executor.execute_block_dag(
-                txs, state, header.number, header.timestamp)
-            trace.stage("execute")
+        state = StateStorage(backend)
+        receipts = self.executor.execute_block_dag(
+            txs, state, header.number, header.timestamp)
+        trace.stage("execute")
+        t_exec = time.monotonic()
+        self._stage("execute", t_exec - t_fill)
 
-            # finalise header: parent info + roots
-            header.parent_info = [ParentInfo(current, parent_hash)]
-            header.txs_root = block.calculate_txs_root(self.suite)
-            block.receipts = receipts
-            header.receipts_root = block.calculate_receipts_root(self.suite)
-            self.ledger.prewrite_block(block, state)
-            header.state_root = self.executor.state_root(state.changeset())
-            trace.stage("roots")
-            header.gas_used = sum(r.gas_used for r in receipts)
-            header.invalidate()
-            if sealer_list is not None:
-                header.sealer_list = list(sealer_list)
-            result = ExecutionResult(header, receipts, state,
-                                     list(block.transactions))
-            self._executed[header.hash(self.suite)] = result
-            metric("scheduler.execute", number=header.number, n_tx=len(txs),
-                   ms=int((time.monotonic() - t0) * 1000))
-            return result
-
-    # -- commit (SchedulerImpl::commitBlock; 2PC) --------------------------
-    def commit_block(self, header: BlockHeader) -> bool:
-        """Commit a previously-executed block (by header hash identity)."""
-        t0 = time.monotonic()
+        # finalise header: parent info + roots
+        header.parent_info = [ParentInfo(header.number - 1, parent_hash)]
+        header.txs_root = block.calculate_txs_root(self.suite)
+        block.receipts = receipts
+        header.receipts_root = block.calculate_receipts_root(self.suite)
+        self.ledger.prewrite_block(block, state)
+        changes = state.changeset()
+        # per-CHANGESET root, deliberately NOT cumulative: identical whether
+        # the parent's changeset is durable or still speculative
+        header.state_root = self.executor.state_root(changes)
+        trace.stage("roots")
+        header.gas_used = sum(r.gas_used for r in receipts)
+        header.invalidate()
+        if sealer_list is not None:
+            header.sealer_list = list(sealer_list)
+        hh = header.hash(self.suite)
+        result = ExecutionResult(header, receipts, state,
+                                 list(block.transactions), changes,
+                                 parent_hash, hh,
+                                 t_executed=time.monotonic())
+        self._stage("roots", result.t_executed - t_exec)
         with self._lock:
-            hh = header.hash(self.suite)
-            result = self._executed.pop(hh, None)
+            # re-validate the chain didn't move while we executed (a commit
+            # popping the front is fine; an abort/external jump is not)
+            committed2 = self.ledger.current_number()
+            tail = (self._spec[next(reversed(self._spec))]
+                    if self._spec else None)
+            if tail is not None:
+                valid = (tail.header.number == header.number - 1
+                         and tail.hh == parent_hash)
+            else:
+                parent = self.ledger.header_by_number(header.number - 1)
+                valid = (committed2 == header.number - 1
+                         and parent is not None
+                         and parent.hash(self.suite) == parent_hash)
+            if not valid:
+                metric("scheduler.execute_discarded", number=header.number)
+                return None
+            if spec:
+                self._speculative_execs += 1
+            if self._commit_busy:
+                self._overlap_commits += 1
+            self._executed[hh] = result
+            self._exec_heights.setdefault(header.number, set()).add(hh)
+            self._spec[header.number] = result
+        metric("scheduler.execute", number=header.number, n_tx=len(txs),
+               speculative=bool(spec),
+               ms=int((time.monotonic() - t0) * 1000))
+        return result
+
+    # -- bookkeeping helpers (all under self._lock) ------------------------
+    def _forget_locked(self, result: ExecutionResult) -> None:
+        self._executed.pop(result.hh, None)
+        hs = self._exec_heights.get(result.header.number)
+        if hs is not None:
+            hs.discard(result.hh)
+            if not hs:
+                self._exec_heights.pop(result.header.number, None)
+
+    def _drop_spec_from_locked(self, number: int) -> None:
+        """Drop speculative results at `number` and above — their reads
+        went through a changeset that is no longer part of the chain."""
+        for n in [n for n in self._spec if n >= number]:
+            self._forget_locked(self._spec.pop(n))
+
+    def _evict_upto_locked(self, number: int) -> None:
+        """Retire executed results at or below a committed height. The
+        height index makes this O(heights retired), not O(results)."""
+        for n in [n for n in self._exec_heights if n <= number]:
+            for h in self._exec_heights.pop(n):
+                self._executed.pop(h, None)
+            self._spec.pop(n, None)
+
+    def abort_speculation(self) -> int:
+        """Discard the speculative chain (view change replaced the rounds,
+        or sync needs the execution slot). Results already handed to the
+        commit stage are KEPT — they hold a checkpoint quorum and will
+        land; everything above them re-executes against the new chain.
+        Returns the number of results dropped."""
+        dropped = 0
+        with self._lock:
+            while self._spec:
+                n = next(reversed(self._spec))
+                r = self._spec[n]
+                if r.committing:
+                    break
+                self._forget_locked(self._spec.pop(n))
+                dropped += 1
+        if dropped:
+            metric("scheduler.speculation_aborted", dropped=dropped)
+        return dropped
+
+    # -- commit stage (SchedulerImpl::commitBlock; 2PC) --------------------
+    def _commit_async(self, header: BlockHeader,
+                      done: Optional[Callable[[bool], None]] = None) -> None:
+        """Queue a decided block for the commit worker; `done(ok)` fires on
+        completion. Strict height ordering comes from FIFO submission plus
+        commit_block's committed+1 check."""
+        with self._lock:
+            r = self._executed.get(header.hash(self.suite))
+            if r is not None:
+                r.committing = True
+        self._commit_q.put((header, done))
+
+    def _commit_loop(self) -> None:
+        while True:
+            item = self._commit_q.get()
+            if item is None:
+                return
+            header, done = item
+            try:
+                # dynamic lookup so per-instance instrumentation wrappers
+                # (benches, soak tests) see pipelined commits too
+                ok = self.commit_block(header)
+            except Exception:
+                LOG.exception(badge("SCHED", "commit-worker-crashed",
+                                    number=header.number))
+                ok = False
+            if done is not None:
+                try:
+                    done(ok)
+                except Exception:
+                    LOG.exception(badge("SCHED", "commit-done-cb-failed",
+                                        number=header.number))
+
+    def commit_block(self, header: BlockHeader) -> bool:
+        """Commit a previously-executed block (by header hash identity).
+        Runs on the commit worker in pipeline mode; callable directly for
+        sync replay, solo mode and service proxies."""
+        t0 = time.monotonic()
+        hh = header.hash(self.suite)
+        with self._lock:
+            result = self._executed.get(hh)
             if result is None:
                 LOG.error(badge("SCHED", "commit-unknown-block",
                                 number=header.number))
                 return False
-            # persist the final header (with any commit seals collected)
-            result.header.signature_list = header.signature_list
-            st = result.state
-            from ..ledger.ledger import T_HASH2NUM, T_HEADER, _be8
-            st.set(T_HEADER, _be8(header.number), result.header.encode())
-            st.set(T_HASH2NUM, hh, _be8(header.number))
-            changes = st.changeset()
+            committed = self.ledger.current_number()
+        if result.header.number != committed + 1:
+            # out of order (an earlier commit failed transiently, or sync
+            # already passed this height): refuse WITHOUT dropping — a
+            # retried predecessor re-enables this exact result
+            LOG.error(badge("SCHED", "commit-out-of-order",
+                            number=result.header.number, current=committed))
+            return False
+        parent = self.ledger.header_by_number(result.header.number - 1)
+        parent_hash = parent.hash(self.suite) if parent else b"\x00" * 32
+        if result.parent_hash and result.parent_hash != parent_hash:
+            # built on a chain that lost: this result can never commit —
+            # drop it and every speculative child stacked over it
+            LOG.error(badge("SCHED", "commit-parent-mismatch",
+                            number=result.header.number))
+            with self._lock:
+                self._drop_spec_from_locked(result.header.number)
+                self._forget_locked(result)
+            return False
+        with self._lock:
+            result.committing = True
+            self._forget_locked(result)  # restored below on 2PC failure
+        # persist the final header (with any commit seals collected)
+        result.header.signature_list = header.signature_list
+        number = result.header.number
+        from ..ledger.ledger import T_HASH2NUM, T_HEADER, _be8
+        changes = dict(result.changes)
+        changes[(T_HEADER, _be8(number))] = Entry(result.header.encode())
+        changes[(T_HASH2NUM, hh)] = Entry(_be8(number))
+        from ..utils.trace import block_trace, drop_block_trace
+        trace = block_trace(number)
+        trace.stage("consensus_wait")
+        if result.t_executed:
+            self._stage("consensus_wait", t0 - result.t_executed)
+        with self._commit_2pc:
+            # re-check under the 2PC lock: a concurrent committer (sync
+            # replay racing the commit worker) must not land a second
+            # block at this height
+            if self.ledger.current_number() != number - 1:
+                LOG.error(badge("SCHED", "commit-raced", number=number))
+                with self._lock:
+                    result.committing = False
+                    self._executed[hh] = result
+                    self._exec_heights.setdefault(number, set()).add(hh)
+                return False
+            self._commit_busy = True
             try:
-                self.storage.prepare(header.number, changes)
-                self.storage.commit(header.number)
+                self.storage.prepare(number, changes)
+                self.storage.commit(number)
             except Exception:
                 LOG.exception(badge("SCHED", "commit-2pc-failed",
-                                    number=header.number))
-                self.storage.rollback(header.number)
+                                    number=number))
+                self.storage.rollback(number)
                 # put the executed result back: a transient storage failure
                 # must not strand the height (PBFT retries the checkpoint;
-                # without this the node could only recover via block sync)
-                self._executed[hh] = result
-                self._fire_invalidate(header.number)
+                # without this the node could only recover via block sync).
+                # The speculative chain above it stays valid — it reads the
+                # byte-identical preserved changeset.
+                with self._lock:
+                    result.committing = False
+                    self._executed[hh] = result
+                    self._exec_heights.setdefault(number, set()).add(hh)
+                self._fire_invalidate(number)
                 return False
+            finally:
+                self._commit_busy = False
+        if self._exec_busy:
+            with self._lock:
+                self._overlap_commits += 1
+        trace.stage("commit")
+        self._stage("commit", time.monotonic() - t0)
+        with self._lock:
             # drop any other stale executed results for this height
-            for h in [h for h, r in self._executed.items()
-                      if r.header.number <= header.number]:
-                self._executed.pop(h, None)
+            self._evict_upto_locked(number)
             # hand the committed block's LIVE txs (senders already
             # recovered at admission/verify) to the commit observers —
             # prime_block renders the senders row from these instead of
             # re-recovering freshly-decoded copies
-            self.last_committed_txs[header.number] = result.txs
+            self.last_committed_txs[number] = result.txs
             while len(self.last_committed_txs) > 8:
-                self.last_committed_txs.pop(min(self.last_committed_txs))
+                self.last_committed_txs.popitem(last=False)
         if self.txpool is not None:
-            tx_hashes = self.ledger.tx_hashes_by_number(header.number)
-            nonces = self.ledger.nonces_by_number(header.number)
-            self.txpool.on_block_committed(header.number, tx_hashes, nonces)
-        self._notify_q.put(header.number)
-        from ..utils.trace import drop_block_trace
-        trace = drop_block_trace(header.number)
-        if trace is not None:
-            trace.finish()
-        metric("scheduler.commit", number=header.number,
+            tx_hashes = self.ledger.tx_hashes_by_number(number)
+            nonces = self.ledger.nonces_by_number(number)
+            self.txpool.on_block_committed(number, tx_hashes, nonces)
+        self._notify_q.put(number)
+        tr = drop_block_trace(number)
+        if tr is not None:
+            tr.finish()
+        metric("scheduler.commit", number=number,
                ms=int((time.monotonic() - t0) * 1000))
         return True
 
     def external_commit(self, number: int) -> None:
         """The chain advanced OUTSIDE the execute/commit pipeline (snapshot
-        install jumped the ledger to a checkpoint height): drop execution
-        results the jump obsoleted, reconcile the txpool (per-block commit
-        notifications never ran for the jumped range) and fan out the
-        commit notification so eventsub/consensus observers see the new
-        height."""
+        install jumped the ledger to a checkpoint height): drop every
+        execution result (the speculative chain hangs off the pre-install
+        head), reconcile the txpool (per-block commit notifications never
+        ran for the jumped range) and fan out the commit notification so
+        eventsub/consensus observers see the new height."""
         with self._lock:
-            for h in [h for h, r in self._executed.items()
-                      if r.header.number <= number]:
-                self._executed.pop(h, None)
+            self._spec.clear()
+            self._executed.clear()
+            self._exec_heights.clear()
             # the stash refers to the pre-install chain — a same-number
             # block on the installed chain must not reuse its senders
             self.last_committed_txs.clear()
@@ -222,7 +523,13 @@ class Scheduler:
                                     number=number))
 
     def shutdown(self) -> None:
-        """Stop the notifier thread (node shutdown)."""
+        """Stop the notifier + commit threads (node shutdown). Queued
+        commits drain first — a decided block holds a checkpoint quorum
+        and is cheap to land now versus a replay at next boot."""
+        if self._commit_thread is not None:
+            self._commit_q.put(None)
+            self._commit_thread.join(timeout=10.0)
+            self._commit_thread = None
         self._notify_q.put(None)
 
     def _notify_loop(self) -> None:
@@ -238,9 +545,17 @@ class Scheduler:
                                         number=number))
 
     def drop_executed(self, header: BlockHeader) -> None:
-        """Discard a cached execution result (failed sync replay etc.)."""
+        """Discard a cached execution result (failed sync replay, round
+        superseded mid-execution). Speculative children stacked over it are
+        discarded too — their reads went through its changeset."""
         with self._lock:
-            self._executed.pop(header.hash(self.suite), None)
+            r = self._executed.get(header.hash(self.suite))
+            if r is None:
+                return
+            self._forget_locked(r)
+            if self._spec.get(r.header.number) is r:
+                self._spec.pop(r.header.number)
+                self._drop_spec_from_locked(r.header.number + 1)
 
     # -- read-only call (SchedulerImpl::call) ------------------------------
     def call(self, tx: Transaction) -> Receipt:

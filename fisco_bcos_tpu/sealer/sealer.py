@@ -17,6 +17,16 @@ min_seal_time: like the reference's min_seal_time config, the sealer waits
 up to that long to fill a block before proposing a partial one; an empty
 pool proposes nothing (consensus generates empty blocks on timeout if
 configured, not the sealer).
+
+Pipeline-aware filling: when the block pipeline is BUSY (a block is
+executing or its commit is in flight — `pipeline_busy`), a partial
+proposal sealed now would only queue behind it, so the sealer keeps
+filling up to `max_seal_time` instead. Bigger blocks feed the DAG
+executor wider conflict-free waves and amortise the per-block consensus/
+commit overhead — the early-sealing half of the cross-height pipeline
+(the other half is consensus granting N+1's sealer the moment N's
+pre-prepare is accepted, engine._maybe_grant). An idle pipeline seals at
+min_seal_time exactly as before.
 """
 
 from __future__ import annotations
@@ -39,7 +49,9 @@ class Sealer(Worker):
                  submit_proposal: Callable[[Block], bool],
                  max_txs_per_block: int = 1000,
                  min_seal_time: float = 0.5,
-                 clock_ms: Callable[[], int] | None = None):
+                 clock_ms: Callable[[], int] | None = None,
+                 max_seal_time: float = 0.5,
+                 pipeline_busy: Callable[[], bool] | None = None):
         super().__init__("sealer", idle_wait=0.05)
         self.txpool = txpool
         self.suite = suite
@@ -49,6 +61,11 @@ class Sealer(Worker):
         self.submit_proposal = submit_proposal
         self.max_txs_per_block = max_txs_per_block
         self.min_seal_time = min_seal_time
+        # fill ceiling while the pipeline is busy; never below the floor
+        self.max_seal_time = max(max_seal_time, min_seal_time)
+        # callable -> True while a block is executing/committing (wired to
+        # Scheduler.pipeline_busy); None disables busy-aware filling
+        self.pipeline_busy = pipeline_busy
         self._lock = threading.Lock()
         # height -> (view, max_txs): heights consensus wants proposals for
         self._grants: dict[int, tuple[int, int]] = {}
@@ -102,8 +119,20 @@ class Sealer(Worker):
         now = time.monotonic()
         if self._first_pending_at is None:
             self._first_pending_at = now
-        if pending < limit and now - self._first_pending_at < self.min_seal_time:
-            return  # wait to fill the block
+        waited = now - self._first_pending_at
+        if pending < limit:
+            if waited < self.min_seal_time:
+                return  # wait to fill the block
+            if (pending < limit // 2
+                    and self.pipeline_busy is not None
+                    and waited < self.max_seal_time
+                    and self.pipeline_busy()):
+                # a block is executing/committing and this one is still
+                # SMALL: proposing now wouldn't commit any sooner — keep
+                # filling. A half-full block already amortizes the
+                # per-block overhead, so it ships at min_seal_time (a
+                # burst's tail block must not idle out the window).
+                return
         txs, hashes = self.txpool.seal(limit)
         if not txs:
             return

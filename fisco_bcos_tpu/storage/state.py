@@ -10,7 +10,7 @@ bcos-table's recoder pattern).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Sequence
 
 from .interface import ChangeSet, Entry, EntryStatus, StorageInterface
 
@@ -87,3 +87,51 @@ class StateStorage(StorageInterface):
     def clear(self) -> None:
         self._writes.clear()
         self._journal.clear()
+
+
+class StackedStorageView(StorageInterface):
+    """Read-only view of committed storage plus a stack of not-yet-committed
+    block changesets (oldest first).
+
+    This is what lets the scheduler execute block N+1 speculatively while
+    block N's 2PC commit (and WAL fsync) is still in flight: N+1's
+    StateStorage overlay reads THROUGH N's changeset, so N+1's own
+    changeset — and therefore its per-changeset `state_root` — comes out
+    byte-identical to what a strictly serial execute-after-commit would
+    have produced. The stack holds plain dict snapshots captured at
+    execution end, so a commit that lands (applying the same entries to
+    the backend) or fails mid-read can never tear a lookup.
+    """
+
+    def __init__(self, backend: StorageInterface,
+                 changesets: Sequence[ChangeSet]):
+        self.backend = backend
+        self._stack = list(changesets)
+
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        tk = (table, key)
+        for cs in reversed(self._stack):
+            e = cs.get(tk)
+            if e is not None:
+                return None if e.deleted else e.value
+        return self.backend.get(table, key)
+
+    def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
+        base = set(self.backend.keys(table, prefix))
+        for cs in self._stack:
+            for (t, k), e in cs.items():
+                if t != table or not k.startswith(prefix):
+                    continue
+                if e.deleted:
+                    base.discard(k)
+                else:
+                    base.add(k)
+        return iter(sorted(base))
+
+    def set(self, table: str, key: bytes, value: bytes) -> None:
+        raise RuntimeError("StackedStorageView is read-only: block writes "
+                           "belong in the StateStorage overlay above it")
+
+    def remove(self, table: str, key: bytes) -> None:
+        raise RuntimeError("StackedStorageView is read-only: block writes "
+                           "belong in the StateStorage overlay above it")

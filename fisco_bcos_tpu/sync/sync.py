@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -248,44 +249,115 @@ class BlockSync(Worker):
         """Verify one block's commit seals against the LOCAL ledger's sealer
         set (never the peer-supplied header.sealer_list — a malicious peer
         could fabricate that), deduplicated by sealer index, quorum 2f+1.
-        All seals go through one batch verify (BlockValidator.cpp:141)."""
-        sealer_set = sorted(n.node_id for n in self.ledger.consensus_nodes()
-                            if n.node_type == "consensus_sealer")
-        if list(header.sealer_list) != sealer_set:
-            LOG.warning(badge("SYNC", "sealer-list-mismatch",
+        All seals go through one batch verify (BlockValidator.cpp:141);
+        admission rules are shared with the range-wide batched pre-pass
+        via `_collect_seals`."""
+        sealer_set = self._sealer_set()
+        collected = self._collect_seals(header, sealer_set)
+        if collected is None:
+            LOG.warning(badge("SYNC", "sealer-list-or-quorum-mismatch",
                               number=header.number))
             return False
+        idxs, seals = collected
         hh = header.hash(self.suite)
-        by_idx: dict[int, bytes] = {}
-        for idx, seal in header.signature_list:
-            if 0 <= idx < len(sealer_set):
-                by_idx.setdefault(idx, seal)
-        n = len(sealer_set)
-        quorum = 2 * ((n - 1) // 3) + 1
-        if len(by_idx) < quorum:
-            return False
-        idxs = sorted(by_idx)
+        quorum = 2 * ((len(sealer_set) - 1) // 3) + 1
         ok = np.asarray(self.suite.verify_batch(
-            [hh] * len(idxs), [by_idx[i] for i in idxs],
-            [sealer_set[i] for i in idxs]))
+            [hh] * len(idxs), seals, [sealer_set[i] for i in idxs]))
         if int(ok.sum()) < quorum:
             LOG.warning(badge("SYNC", "seal-quorum-failed",
                               number=header.number))
             return False
         return True
 
+    def _sealer_set(self) -> list[bytes]:
+        return sorted(n.node_id for n in self.ledger.consensus_nodes()
+                      if n.node_type == "consensus_sealer")
+
+    @staticmethod
+    def _collect_seals(header: BlockHeader, sealer_set: list[bytes]
+                       ) -> Optional[tuple[list[int], list[bytes]]]:
+        """Deduplicated (index, seal) pairs for quorum judging, or None if
+        the header can't reach quorum structurally (sealer-list mismatch /
+        too few distinct signers). Shared by the batched range pre-pass
+        and the per-block fallback so the two paths can never apply
+        different admission rules."""
+        if list(header.sealer_list) != sealer_set:
+            return None
+        n = len(sealer_set)
+        quorum = 2 * ((n - 1) // 3) + 1
+        by_idx: dict[int, bytes] = {}
+        for idx, seal in header.signature_list:
+            if 0 <= idx < n:
+                by_idx.setdefault(idx, seal)
+        if len(by_idx) < quorum:
+            return None
+        idxs = sorted(by_idx)
+        return idxs, [by_idx[i] for i in idxs]
+
+    def _batch_verify_seals(self, headers: list[BlockHeader]
+                            ) -> tuple[dict[bytes, bool], list[bytes]]:
+        """ONE `suite.verify_batch` across every header's commit seals (the
+        PBFT drain-loop trick, engine._batch_checked) instead of a device
+        round trip per block. Returns ({header hash: quorum-ok}, the
+        sealer set the batch was judged against). Verdicts are keyed by
+        HEADER HASH, never height: a response may carry two different
+        blocks at one height, and a by-number verdict would let a forged
+        one ride a legit sibling's True. The replay loop falls back to
+        the per-block `_verify_seals` for any header this pre-pass
+        rejected or whenever a replayed block changes the on-chain
+        sealer set."""
+        sealer_set = self._sealer_set()
+        quorum = 2 * ((len(sealer_set) - 1) // 3) + 1
+        digests: list[bytes] = []
+        seals: list[bytes] = []
+        pubs: list[bytes] = []
+        spans: list[tuple[bytes, int, int]] = []  # (hash, start, count)
+        out: dict[bytes, bool] = {}
+        for header in headers:
+            hh = header.hash(self.suite)
+            collected = self._collect_seals(header, sealer_set)
+            if collected is None:
+                out[hh] = False
+                continue
+            idxs, hseals = collected
+            spans.append((hh, len(digests), len(idxs)))
+            digests.extend([hh] * len(idxs))
+            seals.extend(hseals)
+            pubs.extend(sealer_set[i] for i in idxs)
+        if digests:
+            ok = np.asarray(self.suite.verify_batch(digests, seals, pubs))
+            for hh, start, count in spans:
+                out[hh] = int(ok[start:start + count].sum()) >= quorum
+        return out, sealer_set
+
     def _apply_blocks(self, blocks: list[Block]) -> None:
         blocks = [b for b in blocks
                   if b.header.number > self.ledger.current_number()]
         blocks.sort(key=lambda b: b.header.number)
+        if not blocks:
+            return
+        # replay needs the execution slot at committed+1; consensus may
+        # hold a speculative chain built on rounds the cluster moved past
+        # (we would not be downloading otherwise) — discard it first
+        nxt = getattr(self.scheduler, "next_executable", None)
+        abort = getattr(self.scheduler, "abort_speculation", None)
+        if nxt is not None and abort is not None \
+                and nxt() != self.ledger.current_number() + 1:
+            abort()
+        # coalesce seal verification for the whole response into one batch
+        pre, batch_set = self._batch_verify_seals([b.header for b in blocks])
         for block in blocks:
-            # verify per block, AFTER the previous replay: the sealer set is
-            # ledger state and may change at any height
             if block.header.number <= self.ledger.current_number():
                 continue  # duplicate within the response: already committed
             if block.header.number != self.ledger.current_number() + 1:
                 return  # gap: stop, the next request refetches from here
-            if not self._verify_seals(block.header):
+            # the sealer set is ledger state and may change at any replayed
+            # height: the batched verdict only holds while the set still
+            # matches the one the batch was judged against
+            if self._sealer_set() == batch_set \
+                    and pre.get(block.header.hash(self.suite)) is True:
+                pass  # seals verified in the range-wide batch
+            elif not self._verify_seals(block.header):
                 return
             synced = block.header
             expect_hash = synced.hash(self.suite)

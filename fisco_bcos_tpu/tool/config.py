@@ -93,9 +93,16 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                     "block_limit_range": str(cfg.block_limit_range)}
     cp["consensus"] = {"type": cfg.consensus,
                        "min_seal_time": str(cfg.min_seal_time),
+                       # busy-pipeline fill ceiling (sealer/sealer.py)
+                       "max_seal_time": str(cfg.max_seal_time),
                        "view_timeout": str(cfg.view_timeout),
                        "leader_period": str(cfg.leader_period),
-                       "tx_count_limit": str(cfg.tx_count_limit)}
+                       "tx_count_limit": str(cfg.tx_count_limit),
+                       # proposal pipeline depth (PBFT water size)
+                       "waterline": str(cfg.waterline)}
+    # pipelined block production (scheduler/scheduler.py): off-thread
+    # ordered commit + speculative next-height execution
+    cp["scheduler"] = {"pipeline": str(cfg.pipeline_commit).lower()}
     cp["storage"] = {"type": "wal" if cfg.storage_path else "memory",
                      "path": cfg.storage_path or ""}
     cp["snapshot"] = {"interval": str(cfg.snapshot_interval),
@@ -159,10 +166,15 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         consensus=cp.get("consensus", "type", fallback="solo"),
         min_seal_time=cp.getfloat("consensus", "min_seal_time",
                                   fallback=0.05),
+        max_seal_time=cp.getfloat("consensus", "max_seal_time",
+                                  fallback=0.5),
         view_timeout=cp.getfloat("consensus", "view_timeout", fallback=3.0),
         leader_period=cp.getint("consensus", "leader_period", fallback=1),
         tx_count_limit=cp.getint("consensus", "tx_count_limit",
                                  fallback=1000),
+        waterline=cp.getint("consensus", "waterline", fallback=8),
+        pipeline_commit=cp.getboolean("scheduler", "pipeline",
+                                      fallback=True),
         snapshot_interval=cp.getint("snapshot", "interval", fallback=0),
         snapshot_retention=cp.getint("snapshot", "retention", fallback=2),
         snapshot_prune=cp.getboolean("snapshot", "prune", fallback=False),

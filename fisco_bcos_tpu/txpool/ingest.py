@@ -98,6 +98,12 @@ class IngestLane:
         # and batches > 1 are the reliable tell.
         self._rate = 0.0
         self._batch_ewma = 1.0
+        # EWMA of the intra-batch arrival gap (spread between a batch's
+        # first and last enqueue over its size): the quiesce threshold is
+        # "a few typical gaps of silence", so tightly-clustered closed-loop
+        # cohorts dispatch within ~ms of assembling while slow open-loop
+        # trickles still coalesce over the patient window
+        self._gap_ewma = 0.0
         self._last_dispatch = time.monotonic()
         # totals for stats()/bench (REGISTRY mirrors them as metrics)
         self._txs_total = 0
@@ -233,17 +239,28 @@ class IngestLane:
                 target, window = self._plan(len(self._q))
                 if window > 0.0:
                     # park up to `window` for the target, but early-exit
-                    # once arrivals quiesce for window/4: concurrent
-                    # submitters re-post within a few ms of each other
-                    # after their previous dispatch resolves, so a short
-                    # silence means the in-flight cohort has fully landed
+                    # once arrivals quiesce: concurrent submitters re-post
+                    # within a few ms of each other after their previous
+                    # dispatch resolves, so a short silence means the
+                    # in-flight cohort has fully landed. The quiesce
+                    # threshold is ADAPTIVE: while the queue is still below
+                    # the steady cohort size (the batch EWMA), wait the
+                    # patient window/4 — trickling open-loop arrivals keep
+                    # coalescing; once a full cohort is in, a ~2 ms silence
+                    # suffices. Closed-loop clients' end-to-end rate is
+                    # 1/admission-latency, so the old fixed window/4 idle
+                    # AFTER the cohort arrived was a direct TPS ceiling.
                     deadline = time.monotonic() + window
-                    quiet = window / 4.0
+                    cohort = max(2.0, self._batch_ewma)
+                    gappy = window / 4.0
+                    if self._gap_ewma > 0.0:
+                        gappy = min(gappy, max(0.005, 8.0 * self._gap_ewma))
                     while (len(self._q) < target and not self._stop):
                         left = deadline - time.monotonic()
                         if left <= 0.0:
                             break
                         before = len(self._q)
+                        quiet = 0.002 if before >= cohort else gappy
                         self._cv.wait(min(left, quiet))
                         if len(self._q) == before:
                             break  # quiesced: the cohort is in
@@ -277,6 +294,10 @@ class IngestLane:
         self._rate = inst if self._rate == 0.0 else \
             0.3 * inst + 0.7 * self._rate
         self._batch_ewma = 0.3 * len(batch) + 0.7 * self._batch_ewma
+        if len(batch) > 1:
+            spread = (batch[-1].t_enq - batch[0].t_enq) / (len(batch) - 1)
+            self._gap_ewma = spread if self._gap_ewma == 0.0 else \
+                0.3 * spread + 0.7 * self._gap_ewma
         with self._cv:
             self._txs_total += len(batch)
             self._batches_total += 1

@@ -376,3 +376,120 @@ def test_sync_status_reports_mode_and_floor():
     st = bs.status()
     assert st["syncMode"] == "replay"
     assert st["prunedBelow"] == 0
+
+
+# -- coalesced range-batch seal verification -------------------------------
+
+class _VerifyCountingSuite:
+    """Delegating wrapper counting verify_batch calls + signatures — the
+    instrument behind "ONE device call per range response"."""
+
+    def __init__(self, suite):
+        self._suite = suite
+        self.calls = 0
+        self.sigs = 0
+
+    def __getattr__(self, name):
+        return getattr(self._suite, name)
+
+    def verify_batch(self, digests, sigs, pubs):
+        self.calls += 1
+        self.sigs += len(digests)
+        return self._suite.verify_batch(digests, sigs, pubs)
+
+
+def test_range_batch_verifies_seals_in_one_call():
+    """A whole range response's commit seals go through ONE verify_batch
+    (the PBFT drain-loop trick) instead of a device round trip per block."""
+    src, blocks = build_source_chain(4)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    counting = _VerifyCountingSuite(src.suite)
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, counting)
+    bs._apply_blocks(blocks)
+    assert target.ledger.current_number() == 4
+    assert counting.calls == 1, (
+        f"{counting.calls} verify_batch calls for a 4-block response")
+    assert counting.sigs == sum(len(b.header.signature_list) for b in blocks)
+
+
+def test_range_batch_forged_seal_still_rejected():
+    """A forged seal mid-range fails the batched quorum check; the
+    per-block fallback confirms and replay stops exactly there."""
+    src, blocks = build_source_chain(3)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    counting = _VerifyCountingSuite(src.suite)
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, counting)
+    idx, seal = blocks[2].header.signature_list[0]
+    blocks[2].header.signature_list = [(idx, b"\x00" * len(seal))]
+    bs._apply_blocks(blocks)
+    assert target.ledger.current_number() == 2  # stopped at the forgery
+    # one range batch + one per-block fallback for the rejected header
+    assert counting.calls == 2, counting.calls
+
+
+def test_range_batch_falls_back_when_sealer_set_changes(monkeypatch):
+    """If a replayed block changes the on-chain sealer set, the batch
+    verdict (judged against the pre-replay set) is discarded and the
+    remaining blocks re-verify per block against the LIVE set."""
+    src, blocks = build_source_chain(3)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    counting = _VerifyCountingSuite(src.suite)
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, counting)
+    # simulate a mid-replay governance change: after block 1 commits, the
+    # live sealer set no longer matches the batch-time snapshot
+    real_set = bs._sealer_set
+    state = {"mutated": False}
+
+    def mutating_set():
+        s = real_set()
+        return list(reversed(s)) + [b"\xff" * 64] if state["mutated"] else s
+
+    orig_commit = target.scheduler.commit_block
+
+    def commit_and_mutate(header):
+        ok = orig_commit(header)
+        if ok and header.number == 1:
+            state["mutated"] = True
+        return ok
+
+    monkeypatch.setattr(bs, "_sealer_set", mutating_set)
+    monkeypatch.setattr(target.scheduler, "commit_block", commit_and_mutate)
+    bs._apply_blocks(blocks)
+    # block 1 rode the batch verdict; from block 2 on the live set no
+    # longer matches the batch-time snapshot, so the batch verdict is NOT
+    # trusted — block 2 goes through the per-block fallback, which judges
+    # it against the LIVE (changed) set and rejects it: replay stops at 1
+    # (both paths apply the same admission rules via _collect_seals)
+    assert target.ledger.current_number() == 1
+    # the rejected fallback needed no crypto (structural sealer-list
+    # mismatch): the range batch stays the only verify_batch call
+    assert counting.calls == 1, counting.calls
+
+
+def test_range_batch_duplicate_height_cannot_ride_sibling_verdict():
+    """Security regression: batch verdicts are keyed by HEADER HASH. A
+    response carrying [forged block N (bogus seals), legit block N] must
+    not let the forged sibling ride the legit one's True verdict — the
+    forged block (first in peer-controlled order) is rejected and nothing
+    from the poisoned response commits."""
+    from fisco_bcos_tpu.protocol import Block
+
+    src, blocks = build_source_chain(2)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    counting = _VerifyCountingSuite(src.suite)
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, counting)
+    forged = Block.decode(blocks[0].encode())
+    forged.header.extra_data = b"evil"
+    forged.header.invalidate()
+    idx, seal = forged.header.signature_list[0]
+    forged.header.signature_list = [(idx, b"\x00" * len(seal))]
+    bs._apply_blocks([forged, blocks[0], blocks[1]])
+    assert target.ledger.current_number() == 0, \
+        "a block with forged seals was committed"
+    # the legit blocks alone still replay fine afterwards
+    bs._apply_blocks(blocks)
+    assert target.ledger.current_number() == 2
