@@ -512,6 +512,42 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: multi-group sharding — G ledgers behind one
+            # edge over the shared crypto lane (init/group.py,
+            # crypto/lane.py), same-session interleaved 1-vs-G medians +
+            # the cross-shard settlement tax. BENCH_GROUPS_TIMEOUT=0
+            # skips it.
+            rows, rc = _chain_bench_rows(
+                ["--groups", "2", "--groups-compare", "--groups-runs", "3",
+                 "--cross-shard-pct", "10", "-n", "2000",
+                 "--backend", "host"],
+                "BENCH_GROUPS_TIMEOUT", 900)
+            scal = next((row for row in rows
+                         if row.get("metric") == "groups_scaling"), None)
+            grp = next((row for row in reversed(rows)
+                        if row.get("metric") == "groups_tps"), None)
+            if scal and not scal.get("timed_out"):
+                line["groups_scaling_2x"] = scal.get("value")
+                line["groups_tps_median"] = scal.get("tps_median")
+                line["groups_tps_1group_median"] = scal.get(
+                    "tps_1group_median")
+                line["groups_lane_mean_batch"] = scal.get(
+                    "lane_mean_device_batch")
+            if grp and not grp.get("timed_out"):
+                line["groups_cross_shard_settle_tps"] = grp.get(
+                    "cross_shard_settle_tps")
+                line["groups_cross_shard_drain_s"] = grp.get(
+                    "cross_shard_drain_seconds")
+            if not scal:
+                print(f"[bench] groups bench produced no scaling row "
+                      f"(rc={rc})", file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] groups bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: joining-node catch-up, full replay vs
             # snap-sync (snapshot/ subsystem) on THIS host.
             # BENCH_SYNC_TIMEOUT=0 skips it.

@@ -182,6 +182,167 @@ class BalancePrecompile(Precompile):
 
 
 # ---------------------------------------------------------------------------
+# Cross-group (cross-shard) atomic transfers — the coordinator precompile.
+#
+# A transfer id (client-chosen, unique) moves `amount` from `src` on THIS
+# group to `dst` on `dst_group`. The protocol is a logical 2PC riding each
+# group's block 2PC + WAL:
+#
+#   phase 1  transferOut  (source group tx): debit src into escrow, write a
+#            durable outbox intent (c_xshard_out) + pending marker — funds
+#            are locked, invisible to both balances;
+#   phase 2  credit       (dest group tx, coordinator-submitted): credit
+#            dst, record the id in the dedup inbox (c_xshard_in). Retries
+#            after a crash are IDEMPOTENT: an identical already-credited id
+#            succeeds as a no-op, a mismatched one reverts;
+#   phase 3  finish       (source group tx): ok=1 marks the escrow spent;
+#            ok=0 (dest unknown / credit reverted) REFUNDS src. Either way
+#            the pending marker clears.
+#
+# Every phase is a committed block change, so kill -9 anywhere recovers via
+# WAL replay: the coordinator's boot sweep re-drives whatever is still
+# marked pending and lands the same all-or-nothing outcome
+# (init/xshard.py CrossShardCoordinator).
+# ---------------------------------------------------------------------------
+
+XSHARD_ADDRESS = addr(0x1012)
+T_XSHARD_OUT = "c_xshard_out"    # outbox: id -> encoded intent + status
+T_XSHARD_PEND = "c_xshard_pend"  # pending markers (coordinator scan set)
+T_XSHARD_IN = "c_xshard_in"      # inbox: id -> credited record (dedup)
+
+XS_PENDING, XS_DONE, XS_ABORTED = 0, 1, 2
+
+
+def _encode_intent(status: int, dst_group: str, src: bytes, dst: bytes,
+                   amount: int) -> bytes:
+    return (Writer().u8(status).text(dst_group).blob(src).blob(dst)
+            .u64(amount).bytes())
+
+
+def decode_intent(raw: bytes) -> dict:
+    r = Reader(raw)
+    return {"status": r.u8(), "dst_group": r.text(), "src": r.blob(),
+            "dst": r.blob(), "amount": r.u64()}
+
+
+class XShardPrecompile(Precompile):
+    """Cross-group transfer legs. Balance rows are the same `c_balance`
+    table BalancePrecompile serves, so cross-shard value is ordinary value.
+    """
+
+    name = "xshard"
+
+    def methods(self):
+        return {
+            "transferOut": self._transfer_out,
+            "credit": self._credit,
+            "finish": self._finish,
+        }
+
+    def conflict_keys(self, input_: bytes) -> Optional[list]:
+        try:
+            r = Reader(input_)
+            method = r.text()
+            if method == "transferOut":
+                xid = r.blob()
+                _dst_group = r.text()
+                src = r.blob()
+                return [T_BALANCE.encode() + src,
+                        T_XSHARD_OUT.encode() + xid]
+            if method == "credit":
+                xid = r.blob()
+                _src_group = r.text()
+                dst = r.blob()
+                return [T_BALANCE.encode() + dst,
+                        T_XSHARD_IN.encode() + xid]
+            # finish reads the outbox row to learn which balance it may
+            # refund — unknowable from call data alone: stay opaque so the
+            # DAG planner serializes it
+        except Exception:
+            pass
+        return None
+
+    # -- phase 1: escrow-debit on the source group -------------------------
+    def _transfer_out(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        xid, dst_group = r.blob(), r.text()
+        src, dst, amount = r.blob(), r.blob(), r.u64()
+        if not xid:
+            raise PrecompileError("empty transfer id")
+        self.touch(ctx, T_BALANCE.encode() + src,
+                   T_XSHARD_OUT.encode() + xid)
+        if ctx.state.get(T_XSHARD_OUT, xid) is not None:
+            raise PrecompileError("duplicate transfer id",
+                                  TransactionStatus.REVERT)
+        bal = ctx.state.get(T_BALANCE, src)
+        bal = int.from_bytes(bal, "big") if bal else 0
+        if bal < amount:
+            raise PrecompileError("insufficient balance",
+                                  TransactionStatus.REVERT)
+        ctx.state.set(T_BALANCE, src, (bal - amount).to_bytes(16, "big"))
+        ctx.state.set(T_XSHARD_OUT, xid,
+                      _encode_intent(XS_PENDING, dst_group, src, dst,
+                                     amount))
+        ctx.state.set(T_XSHARD_PEND, xid, b"\x01")
+        ctx.logs.append(LogEntry(address=ctx.to, topics=[b"xshard_out"],
+                                 data=xid))
+        w.u32(0)
+
+    # -- phase 2: idempotent credit on the destination group ---------------
+    def _credit(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        xid, src_group = r.blob(), r.text()
+        dst, amount = r.blob(), r.u64()
+        self.touch(ctx, T_BALANCE.encode() + dst,
+                   T_XSHARD_IN.encode() + xid)
+        record = (Writer().text(src_group).blob(dst).u64(amount).bytes())
+        seen = ctx.state.get(T_XSHARD_IN, xid)
+        if seen is not None:
+            if seen == record:
+                w.u32(0)  # coordinator retry after a crash: already landed
+                return
+            raise PrecompileError("transfer id reused with different terms",
+                                  TransactionStatus.REVERT)
+        bal = ctx.state.get(T_BALANCE, dst)
+        bal = int.from_bytes(bal, "big") if bal else 0
+        ctx.state.set(T_BALANCE, dst, (bal + amount).to_bytes(16, "big"))
+        ctx.state.set(T_XSHARD_IN, xid, record)
+        ctx.logs.append(LogEntry(address=ctx.to, topics=[b"xshard_in"],
+                                 data=xid))
+        w.u32(0)
+
+    # -- phase 3: settle the escrow on the source group --------------------
+    def _finish(self, ctx: CallContext, r: Reader, w: Writer) -> None:
+        xid, ok = r.blob(), r.u8()
+        raw = ctx.state.get(T_XSHARD_OUT, xid)
+        if raw is None:
+            raise PrecompileError("unknown transfer id",
+                                  TransactionStatus.REVERT)
+        intent = decode_intent(raw)
+        self.touch(ctx, T_XSHARD_OUT.encode() + xid,
+                   T_BALANCE.encode() + intent["src"])
+        final = XS_DONE if ok else XS_ABORTED
+        if intent["status"] != XS_PENDING:
+            if intent["status"] == final:
+                w.u32(0)  # idempotent coordinator retry
+                return
+            raise PrecompileError("transfer already settled differently",
+                                  TransactionStatus.REVERT)
+        if not ok:
+            bal = ctx.state.get(T_BALANCE, intent["src"])
+            bal = int.from_bytes(bal, "big") if bal else 0
+            ctx.state.set(T_BALANCE, intent["src"],
+                          (bal + intent["amount"]).to_bytes(16, "big"))
+        ctx.state.set(T_XSHARD_OUT, xid,
+                      _encode_intent(final, intent["dst_group"],
+                                     intent["src"], intent["dst"],
+                                     intent["amount"]))
+        ctx.state.remove(T_XSHARD_PEND, xid)
+        ctx.logs.append(LogEntry(
+            address=ctx.to,
+            topics=[b"xshard_done" if ok else b"xshard_abort"], data=xid))
+        w.u32(0)
+
+
+# ---------------------------------------------------------------------------
 # KV table (precompiled/KVTablePrecompiled.cpp semantics)
 # ---------------------------------------------------------------------------
 
@@ -1185,6 +1346,7 @@ class GroupSigPrecompile(Precompile):
 
 PRECOMPILED_REGISTRY: dict[bytes, Precompile] = {
     BALANCE_ADDRESS: BalancePrecompile(),
+    XSHARD_ADDRESS: XShardPrecompile(),
     DAG_TRANSFER_ADDRESS: BalancePrecompile(),  # same semantics, bench alias
     KV_TABLE_ADDRESS: KVTablePrecompile(),
     TABLE_ADDRESS: TablePrecompile(),

@@ -61,6 +61,14 @@ class NodeDaemon:
         self.log_level = log_level
         self.node = None
         self.gateway = None
+        # multi-group mode ([groups] in config.ini): the registry hosting
+        # one Node per group, the storage they share, and the one edge
+        self.manager = None
+        self.shared_storage = None
+        self.rpc = None
+        self.ws = None
+        self.rpc_pool = None
+        self.metrics = None
         self._log_handler = None
         self._stop = threading.Event()
         self._pid_path = os.path.join(self.node_dir, PID_FILE)
@@ -138,7 +146,7 @@ class NodeDaemon:
         from ..tool.config import (_load_node_parts, load_node,
                                    load_smtls_context)
 
-        cfg, _chain, _suite, kp = _load_node_parts(
+        cfg, chain, _suite, kp = _load_node_parts(
             self.node_dir, self.storage_passphrase)
         if cfg.p2p_port is None:
             raise DaemonError(
@@ -148,6 +156,9 @@ class NodeDaemon:
         self.gateway = P2PGateway(
             kp.pub_bytes, host=cfg.p2p_host, port=cfg.p2p_port,
             peers=list(cfg.p2p_peers), server_ssl=tls, client_ssl=tls)
+        if len(cfg.groups) >= 2:
+            self._boot_multigroup(cfg, chain, kp, tls)
+            return
         self.node = load_node(self.node_dir, gateway=self.gateway,
                               storage_passphrase=self.storage_passphrase)
         self.node.start()
@@ -160,8 +171,97 @@ class NodeDaemon:
                        snapshot=cfg.snapshot_interval,
                        pruned_below=self.node.ledger.pruned_below()))
 
+    def _boot_multigroup(self, cfg, chain, kp, tls) -> None:
+        """[groups] wiring: G ledger/txpool/consensus/scheduler stacks in
+        THIS process behind one RPC edge, one p2p gateway (namespaced per
+        group), one shared crypto lane, and one WAL the groups' storage is
+        namespaced over. Every group runs the same node key and the
+        genesis sealer set (the reference's one-node-many-groups shape)."""
+        import dataclasses as _dc
+
+        from ..ledger.ledger import ConsensusNode
+        from ..net.gateway import MuxGateway
+        from ..rpc.edge import WorkerPool
+        from ..storage.memory import MemoryStorage
+        from ..storage.wal import WalStorage
+        from .group import GroupedJsonRpc, GroupManager
+
+        self.shared_storage = (WalStorage(cfg.storage_path)
+                               if cfg.storage_path else MemoryStorage())
+        # ONE p2p listener for all groups: group tags ride the frames
+        # (MuxGateway), sessions authenticate with the single node key
+        self.manager = GroupManager(shared_gateway=MuxGateway(self.gateway),
+                                    chain_id=cfg.chain_id,
+                                    storage=self.shared_storage)
+        for gid in cfg.groups:
+            gcfg = _dc.replace(
+                cfg, group_id=gid, groups=[],
+                # the shared storage is injected; the per-group path only
+                # anchors side stores (snapshot chunks)
+                storage_path=os.path.join(cfg.storage_path, "groups", gid)
+                if cfg.storage_path else None,
+                rpc_port=None, ws_port=None, metrics_port=None,
+                p2p_port=None, p2p_peers=[])
+            node = self.manager.add_group(gcfg, keypair=kp)
+            if node.ledger.current_number() < 0:
+                node.build_genesis([ConsensusNode(pk)
+                                    for pk in chain.sealers] or None)
+        self.node = self.manager.node(cfg.groups[0])  # primary (logs/ops)
+        self.manager.start()
+        impl = GroupedJsonRpc(self.manager, default_group=cfg.groups[0])
+        if cfg.rpc_port is not None or cfg.ws_port is not None:
+            self.rpc_pool = WorkerPool(cfg.rpc_workers)
+            self.rpc_pool.start()
+        if cfg.rpc_port is not None:
+            from ..rpc.server import JsonRpcServer
+            self.rpc = JsonRpcServer(impl, host=cfg.rpc_host,
+                                     port=cfg.rpc_port, pool=self.rpc_pool,
+                                     keepalive_s=cfg.rpc_keepalive_s)
+            self.rpc.start()
+        if cfg.ws_port is not None:
+            from ..rpc.ws_server import WsRpcServer
+            self.ws = WsRpcServer(impl, host=cfg.rpc_host, port=cfg.ws_port,
+                                  pool=self.rpc_pool)
+            self.ws.start()
+        if cfg.metrics_port is not None:
+            from ..utils.metrics import MetricsServer
+            self.metrics = MetricsServer(host=cfg.rpc_host,
+                                         port=cfg.metrics_port)
+            self.metrics.start()
+        LOG.info(badge("DAEMON", "up-multigroup", pid=os.getpid(),
+                       node=kp.pub_bytes[:8].hex(),
+                       groups=",".join(cfg.groups),
+                       p2p=f"{self.gateway.host}:{self.gateway.port}",
+                       rpc=self.rpc.port if self.rpc else None,
+                       ws=self.ws.port if self.ws else None,
+                       tls=tls is not None))
+
     def shutdown(self) -> None:
         """Graceful stop: workers, p2p sessions, then flush/close the WAL."""
+        # multi-group teardown first (edges before nodes: no new submitters)
+        for attr in ("metrics", "rpc", "ws", "rpc_pool"):
+            svc = getattr(self, attr)
+            setattr(self, attr, None)
+            if svc is not None:
+                try:
+                    svc.stop()
+                except Exception:
+                    LOG.exception(badge("DAEMON", f"{attr}-stop-failed"))
+        manager, self.manager = self.manager, None
+        if manager is not None:
+            self.node = None  # owned by the manager
+            try:
+                manager.stop()
+            except Exception:
+                LOG.exception(badge("DAEMON", "manager-stop-failed"))
+        storage, self.shared_storage = self.shared_storage, None
+        if storage is not None:
+            close = getattr(storage, "close", None)
+            if close is not None:
+                try:
+                    close()  # flush + fsync the shared WAL tail
+                except Exception:
+                    LOG.exception(badge("DAEMON", "storage-close-failed"))
         node, self.node = self.node, None
         if node is not None:
             try:

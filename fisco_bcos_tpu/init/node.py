@@ -101,6 +101,18 @@ class NodeConfig:
     snapshot_keep_tail: int = 64
     snap_sync_threshold: int = 256
     snapshot_chunk_bytes: int = 1 << 20
+    # multi-group hosting (init/group.py + the daemon's [groups] wiring):
+    # group ids this PROCESS runs — G independent ledger/txpool/consensus/
+    # scheduler stacks behind one RPC edge, one gateway, one shared
+    # crypto lane, storage namespaced per group over one WAL. Empty =
+    # single-group node (this config's group_id only).
+    groups: list = dataclasses.field(default_factory=list)
+    # shared crypto-plane lane (crypto/lane.py): merge all groups'
+    # verify/recover/hash batches into single device calls. Only engaged
+    # by multi-group composition; wait_ms > 0 adds a coalescing
+    # micro-window for device deployments (0 = merge in-flight only).
+    crypto_lane: bool = True
+    crypto_lane_wait_ms: float = 0.0
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
     # serving read plane (rpc/edge.py + rpc/cache.py): one bounded worker
@@ -139,14 +151,24 @@ class Node:
         self.storage = storage if storage is not None else (
             WalStorage(cfg.storage_path) if cfg.storage_path
             else MemoryStorage())
+        # per-group metrics view: every bcos_* series this node's
+        # subsystems emit carries a group label ALONGSIDE the unlabeled
+        # totals, so G in-process stacks stay tellable apart
+        from ..utils.metrics import for_group
+        self.metrics_view = for_group(cfg.group_id)
+        # multi-group composition (init/group.py) sets this to the
+        # GroupManager so RPC group methods enumerate the real registry
+        self.group_registry = None
         self.ledger = Ledger(self.storage, self.suite)
         self.txpool = TxPool(self.suite, self.ledger, cfg.chain_id,
                              cfg.group_id, cfg.txpool_limit,
-                             cfg.block_limit_range)
+                             cfg.block_limit_range,
+                             registry=self.metrics_view)
         self.ingest = IngestLane(
             self.txpool, max_batch=cfg.ingest_max_batch,
             max_wait_ms=cfg.ingest_max_wait_ms,
-            queue_cap=cfg.ingest_queue_cap) if cfg.ingest_lane else None
+            queue_cap=cfg.ingest_queue_cap,
+            registry=self.metrics_view) if cfg.ingest_lane else None
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool,
@@ -186,12 +208,13 @@ class Node:
             keep_tail=cfg.snapshot_keep_tail,
             keep_nonces=cfg.block_limit_range,
             store_dir=_os.path.join(cfg.storage_path, "snapshots")
-            if cfg.storage_path else None)
+            if cfg.storage_path else None, registry=self.metrics_view)
         if self.front is not None:
             self.blocksync = BlockSync(
                 self.front, self.ledger, self.scheduler, self.suite,
                 timesync=self.timesync, snapshot=self.snapshot,
-                snap_sync_threshold=cfg.snap_sync_threshold)
+                snap_sync_threshold=cfg.snap_sync_threshold,
+                registry=self.metrics_view)
             from ..net.amop import AMOPService
             self.amop = AMOPService(self.front)
             from ..lightnode import LightNodeServer
@@ -203,23 +226,10 @@ class Node:
         self.query_cache = None
         self.rpc_pool = None
         if cfg.rpc_port is not None or cfg.ws_port is not None:
-            from ..rpc.cache import QueryCache
             from ..rpc.edge import WorkerPool
-            from ..rpc.server import JsonRpcImpl, JsonRpcServer
-            if cfg.rpc_cache_entries > 0:
-                self.query_cache = QueryCache(
-                    max_entries=cfg.rpc_cache_entries,
-                    max_bytes=cfg.rpc_cache_mb << 20)
+            from ..rpc.server import JsonRpcServer
             self.rpc_pool = WorkerPool(cfg.rpc_workers)
-            impl = JsonRpcImpl(self)  # reads self.query_cache: order matters
-            if self.query_cache is not None:
-                # commit-coherent: pre-render the committed block's hot
-                # responses off the consensus path; wipe on rollback and
-                # snap-sync install (a stale cache would serve pre-wipe
-                # blocks after a snapshot jumped the head)
-                self.scheduler.on_commit.append(impl.prime_block)
-                self.scheduler.on_invalidate.append(
-                    self.query_cache.invalidate)
+            impl = self.make_rpc_impl()
             if cfg.rpc_port is not None:
                 self.rpc = JsonRpcServer(impl, host=cfg.rpc_host,
                                          port=cfg.rpc_port,
@@ -235,6 +245,30 @@ class Node:
             self.metrics = MetricsServer(host=cfg.rpc_host,
                                          port=cfg.metrics_port)
         self._started = False
+
+    # -- RPC impl wiring ---------------------------------------------------
+    def make_rpc_impl(self):
+        """-> JsonRpcImpl bound to this node with the commit-coherent
+        query cache wired (created on first call when rpc_cache_entries >
+        0): hot responses pre-rendered at commit off the consensus path,
+        wiped on rollback and snap-sync install (a stale cache would
+        serve pre-wipe blocks after a snapshot jumped the head). The ONE
+        place this wiring lives — the node's own RPC/WS servers and the
+        multi-group edge (init/group.py) both call it."""
+        from ..rpc.server import JsonRpcImpl
+
+        cfg = self.config
+        if self.query_cache is None and cfg.rpc_cache_entries > 0:
+            from ..rpc.cache import QueryCache
+            self.query_cache = QueryCache(
+                max_entries=cfg.rpc_cache_entries,
+                max_bytes=cfg.rpc_cache_mb << 20,
+                registry=self.metrics_view)
+            impl = JsonRpcImpl(self)  # reads query_cache: order matters
+            self.scheduler.on_commit.append(impl.prime_block)
+            self.scheduler.on_invalidate.append(self.query_cache.invalidate)
+            return impl
+        return JsonRpcImpl(self)
 
     # -- genesis -----------------------------------------------------------
     def build_genesis(self, sealers: Optional[list[ConsensusNode]] = None) -> None:

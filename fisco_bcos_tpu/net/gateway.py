@@ -91,6 +91,104 @@ class _Unwrap:
         self.front.on_network_message(src[self.cut:], data)
 
 
+# frame-level group multiplexing (MuxGateway): first payload byte. Safe to
+# discriminate because untagged front frames start with the ModuleID's
+# high byte, and no module id reaches 0xF5xx.
+MUX_MAGIC = 0xF5
+
+
+class MuxGateway:
+    """Many groups' traffic over ONE point-to-point transport session set.
+
+    `GroupGateway` namespaces by wrapping node IDS — right for the
+    in-process FakeGateway, where registration ids are free. A socket
+    transport (net/p2p.py) authenticates sessions by the REAL node key,
+    so group separation must travel in the FRAME instead: this mux
+    registers ONE front (itself) under the node's real id and prefixes
+    every outbound payload with `MUX_MAGIC u8len group`, demuxing inbound
+    frames to the right group's front — the reference gateway's
+    (groupID, moduleID) multiplexing over shared TLS sessions
+    (bcos-gateway GatewayNodeManager.cpp).
+
+    Deployment contract: peer processes run the SAME group set (the
+    daemon's [groups] shape), so `peers()` is the transport's peer set.
+    """
+
+    def __init__(self, shared: Gateway):
+        self.shared = shared
+        self._lock = threading.Lock()
+        self._fronts: dict[str, "object"] = {}
+        self._node_id: Optional[bytes] = None
+
+    def view(self, group_id: str) -> "Gateway":
+        return _MuxView(self, group_id)
+
+    # -- front protocol (registered once on the shared transport) ----------
+    def on_network_message(self, src: bytes, data: bytes) -> None:
+        if not data or data[0] != MUX_MAGIC or len(data) < 2:
+            LOG.warning(badge("MUXGW", "untagged-frame-dropped",
+                              src=src[:8].hex()))
+            return
+        glen = data[1]
+        group = data[2:2 + glen].decode("utf-8", "replace")
+        with self._lock:
+            front = self._fronts.get(group)
+        if front is None:
+            return  # a group this process does not host
+        front.on_network_message(src, data[2 + glen:])
+
+    # -- mux wiring --------------------------------------------------------
+    def _register(self, group_id: str, node_id: bytes, front) -> None:
+        with self._lock:
+            first = not self._fronts
+            if self._node_id is not None and node_id != self._node_id:
+                raise ValueError(
+                    "MuxGateway carries ONE node identity across groups; "
+                    "per-group keys need per-group transports")
+            self._node_id = node_id
+            self._fronts[group_id] = front
+        if first:
+            self.shared.register_front(node_id, self)
+
+    def _unregister(self, group_id: str) -> None:
+        with self._lock:
+            self._fronts.pop(group_id, None)
+            last = not self._fronts
+            node_id = self._node_id
+        if last and node_id is not None:
+            self.shared.unregister_front(node_id)
+
+    def _tag(self, group_id: str, data: bytes) -> bytes:
+        g = group_id.encode()
+        return bytes((MUX_MAGIC, len(g))) + g + data
+
+
+class _MuxView(Gateway):
+    """One group's Gateway interface over the shared mux."""
+
+    def __init__(self, mux: MuxGateway, group_id: str):
+        self.mux = mux
+        self.group_id = group_id
+
+    def register_front(self, node_id: bytes, front) -> None:
+        self.mux._register(self.group_id, node_id, front)
+
+    def unregister_front(self, node_id: bytes) -> None:
+        self.mux._unregister(self.group_id)
+
+    def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        return self.mux.shared.send(src, dst,
+                                    self.mux._tag(self.group_id, data))
+
+    def broadcast(self, src: bytes, data: bytes) -> None:
+        tagged = self.mux._tag(self.group_id, data)
+        for dst in self.mux.shared.peers(src):
+            self.mux.shared.send(src, dst, tagged)
+
+    def peers(self, src: bytes) -> list[bytes]:
+        return self.mux.shared.peers(src)
+
+
 class FakeGateway(Gateway):
     """In-process transport with one ordered delivery queue per node.
 

@@ -43,7 +43,10 @@ from ..utils.metrics import REGISTRY
 
 class QueryCache:
     def __init__(self, max_entries: int = 4096,
-                 max_bytes: int = 64 << 20):
+                 max_bytes: int = 64 << 20, registry=None):
+        # metrics sink: multi-group nodes pass a group-labeled view so
+        # G caches' counters don't silently aggregate
+        self._reg = registry if registry is not None else REGISTRY
         self.max_entries = max(1, int(max_entries))
         self.max_bytes = max(1, int(max_bytes))
         self._lock = threading.Lock()
@@ -71,7 +74,7 @@ class QueryCache:
             self._entries.clear()
             self._bytes = 0
             self._invalidations += 1
-        REGISTRY.inc("bcos_rpc_cache_invalidations_total")
+        self._reg.inc("bcos_rpc_cache_invalidations_total")
 
     # -- lookup / insert ---------------------------------------------------
     def get(self, key: Hashable) -> Optional[Any]:
@@ -79,11 +82,11 @@ class QueryCache:
             item = self._entries.get(key)
             if item is None:
                 self._misses += 1
-                REGISTRY.inc("bcos_rpc_cache_misses_total")
+                self._reg.inc("bcos_rpc_cache_misses_total")
                 return None
             self._entries.move_to_end(key)
             self._hits += 1
-        REGISTRY.inc("bcos_rpc_cache_hits_total")
+        self._reg.inc("bcos_rpc_cache_hits_total")
         return item[0]
 
     def put(self, key: Hashable, value: Any, gen: int) -> None:
@@ -106,9 +109,9 @@ class QueryCache:
                    or self._bytes > self.max_bytes):
                 _, (_, sz) = self._entries.popitem(last=False)
                 self._bytes -= sz
-            REGISTRY.set_gauge("bcos_rpc_cache_entries",
+            self._reg.set_gauge("bcos_rpc_cache_entries",
                                len(self._entries))
-            REGISTRY.set_gauge("bcos_rpc_cache_bytes", self._bytes)
+            self._reg.set_gauge("bcos_rpc_cache_bytes", self._bytes)
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:

@@ -47,6 +47,9 @@ BATCH_BUDGET_SECONDS = 60.0
 JSONRPC_METHOD_NOT_FOUND = -32601
 JSONRPC_INVALID_PARAMS = -32602
 JSONRPC_INTERNAL_ERROR = -32603
+# group routing failure gets its OWN code (the reference's GroupNotExist):
+# clients must be able to tell "no such group" from a malformed request
+JSONRPC_GROUP_NOT_FOUND = -32004
 
 
 def _hex(b: bytes) -> str:
@@ -241,10 +244,25 @@ class JsonRpcImpl:
                               "message": str(exc)}}
 
     # -- group guard -------------------------------------------------------
+    def _registry(self):
+        """The process's group registry (GroupManager) when this node is
+        one of several groups behind a shared edge, else None."""
+        return getattr(self.node, "group_registry", None)
+
     def _check_group(self, group: str) -> None:
-        if group != self.node.config.group_id:
-            raise JsonRpcError(JSONRPC_INVALID_PARAMS,
-                               f"unknown group {group}")
+        if group == self.node.config.group_id:
+            return
+        reg = self._registry()
+        if reg is not None and reg.node(group) is not None:
+            # a registered sibling group: this impl serves ONE group, the
+            # shared edge should have routed there — answer with the
+            # routable error, not a parameter error
+            raise JsonRpcError(
+                JSONRPC_INVALID_PARAMS,
+                f"group {group} is served by a sibling impl; route via "
+                "the grouped RPC edge")
+        raise JsonRpcError(JSONRPC_GROUP_NOT_FOUND,
+                           f"unknown group {group}")
 
     # -- tx path -----------------------------------------------------------
     def send_transaction(self, group: str, node_name: str = "",
@@ -574,22 +592,43 @@ class JsonRpcImpl:
         return [p["p2pNodeID"] for p in self.get_peers()["peers"]]
 
     def get_group_list(self):
-        return {"groupList": [self.node.config.group_id]}
+        reg = self._registry()
+        groups = reg.groups() if reg is not None \
+            else [self.node.config.group_id]
+        return {"groupList": groups}
+
+    @staticmethod
+    def _group_info_of(node) -> dict:
+        g0 = node.ledger.header_by_number(0)
+        return {
+            "groupID": node.config.group_id,
+            "chainID": node.config.chain_id,
+            "genesisHash": _hex(g0.hash(node.suite)) if g0 else "",
+            "smCrypto": node.config.sm_crypto,
+            "blockNumber": node.ledger.current_number(),
+        }
 
     def get_group_info(self, group: str = ""):
         gid = group or self.node.config.group_id
-        self._check_group(gid)
-        return {
-            "groupID": gid,
-            "chainID": self.node.config.chain_id,
-            "genesisHash": _hex(
-                self.node.ledger.header_by_number(0).hash(self.node.suite)),
-            "smCrypto": self.node.config.sm_crypto,
-            "blockNumber": self.node.ledger.current_number(),
-        }
+        if gid == self.node.config.group_id:
+            return self._group_info_of(self.node)
+        reg = self._registry()
+        other = reg.node(gid) if reg is not None else None
+        if other is None:
+            raise JsonRpcError(JSONRPC_GROUP_NOT_FOUND,
+                               f"unknown group {gid}")
+        return self._group_info_of(other)
 
     def get_group_info_list(self):
-        return [self.get_group_info()]
+        reg = self._registry()
+        if reg is None:
+            return [self._group_info_of(self.node)]
+        infos = []
+        for gid in reg.groups():
+            node = reg.node(gid)
+            if node is not None:
+                infos.append(self._group_info_of(node))
+        return infos
 
     def get_group_node_info(self, group: str, node_name: str = ""):
         self._check_group(group)

@@ -58,6 +58,12 @@ class _Session:
 
 
 class WsRpcServer:
+    """`impl` is a JsonRpcImpl OR the multi-group `GroupedJsonRpc` facade
+    (init/group.py): both expose `handle_payload` for the JSON-RPC surface
+    — group-routed requests answer with the same error objects as HTTP —
+    and `.node` for the WS-only planes (eventsub/AMOP bind to the default
+    group in multi-group mode)."""
+
     def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1",
                  port: int = 0, pool=None):
         self.impl = impl

@@ -191,6 +191,7 @@ def snap_sync(front, peer: bytes, storage, suite,
               current_number: int, request_timeout: float = 5.0,
               should_abort: Optional[Callable[[], bool]] = None,
               pre_install: Optional[Callable[[], None]] = None,
+              registry=None,
               ) -> Optional[tuple[SnapshotManifest, list[bytes]]]:
     """Fetch + verify + install a snapshot from `peer` over the
     ModuleID.SnapshotSync front module.
@@ -290,5 +291,6 @@ def snap_sync(front, peer: bytes, storage, suite,
     metric("snapshot.snap_sync", number=manifest.height,
            ms=int(secs * 1000))
     from ..utils.metrics import REGISTRY
-    REGISTRY.set_gauge("bcos_snap_sync_seconds", round(secs, 3))
+    (registry or REGISTRY).set_gauge("bcos_snap_sync_seconds",
+                                     round(secs, 3))
     return manifest, chunks

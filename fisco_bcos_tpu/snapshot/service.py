@@ -38,8 +38,10 @@ class SnapshotService(Worker):
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
                  prune: bool = False, keep_tail: int = DEFAULT_KEEP_TAIL,
                  keep_nonces: Optional[int] = None,
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None, registry=None):
         super().__init__("snapshot", idle_wait=0.25)
+        # metrics sink: multi-group nodes pass a group-labeled view
+        self._reg = registry if registry is not None else REGISTRY
         self.storage = storage
         self.ledger = ledger
         self.suite = suite
@@ -98,13 +100,13 @@ class SnapshotService(Worker):
             return manifest
 
     def _publish_gauges(self, manifest: SnapshotManifest) -> None:
-        REGISTRY.set_gauge("bcos_snapshot_last_number", manifest.height)
-        REGISTRY.set_gauge("bcos_snapshot_chunks", manifest.chunk_count)
-        REGISTRY.set_gauge("bcos_snapshot_bytes", manifest.total_bytes)
-        REGISTRY.set_gauge("bcos_snapshot_pruned_below",
+        self._reg.set_gauge("bcos_snapshot_last_number", manifest.height)
+        self._reg.set_gauge("bcos_snapshot_chunks", manifest.chunk_count)
+        self._reg.set_gauge("bcos_snapshot_bytes", manifest.total_bytes)
+        self._reg.set_gauge("bcos_snapshot_pruned_below",
                            self.ledger.pruned_below())
         if self._last_export_ms is not None:
-            REGISTRY.observe("bcos_snapshot_export_seconds",
+            self._reg.observe("bcos_snapshot_export_seconds",
                              self._last_export_ms / 1000.0)
 
     # -- SnapshotSync serving ----------------------------------------------

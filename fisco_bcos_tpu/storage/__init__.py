@@ -9,6 +9,7 @@ overlays (bcos-table/src/).
 
 from .interface import Entry, StorageInterface, TransactionalStorage
 from .memory import MemoryStorage
+from .namespace import NamespacedStorage
 from .state import StateStorage
 from .wal import WalStorage
 
@@ -17,6 +18,7 @@ __all__ = [
     "StorageInterface",
     "TransactionalStorage",
     "MemoryStorage",
+    "NamespacedStorage",
     "StateStorage",
     "WalStorage",
 ]

@@ -83,8 +83,11 @@ class _DownloadWorker(Worker):
 class BlockSync(Worker):
     def __init__(self, front: FrontService, ledger, scheduler, suite,
                  status_interval: float = 1.0, timesync=None,
-                 snapshot=None, snap_sync_threshold: int = 0):
+                 snapshot=None, snap_sync_threshold: int = 0,
+                 registry=None):
         super().__init__("block-sync", idle_wait=0.1)
+        # metrics sink: multi-group nodes pass a group-labeled view
+        self._reg = registry if registry is not None else REGISTRY
         self.front = front
         self.ledger = ledger
         self.scheduler = scheduler
@@ -110,7 +113,7 @@ class BlockSync(Worker):
         self._inflight = False
         self._next_snap_attempt = 0.0
         self._downloader = _DownloadWorker(self)
-        REGISTRY.set_gauge("bcos_sync_mode", 0)  # 0 replay | 1 snap
+        self._reg.set_gauge("bcos_sync_mode", 0)  # 0 replay | 1 snap
         front.register_module(ModuleID.BlockSync, self._on_message)
 
     # -- lifecycle ---------------------------------------------------------
@@ -218,17 +221,18 @@ class BlockSync(Worker):
         # stale "replay" mode after seeing the post-install height
         prev_mode = self.sync_mode
         self.sync_mode = "snap"
-        REGISTRY.set_gauge("bcos_sync_mode", 1)
+        self._reg.set_gauge("bcos_sync_mode", 1)
         res = snap_sync(self.front, peer, self.ledger.storage, self.suite,
                         self._verify_seals, self.ledger.current_number(),
                         request_timeout=REQUEST_TIMEOUT,
                         should_abort=self._downloader.stopping,
                         pre_install=None if self.scheduler is None else
                         lambda: self.scheduler.invalidate_caches(
-                            self.ledger.current_number()))
+                            self.ledger.current_number()),
+                        registry=self._reg)
         if res is None:
             self.sync_mode = prev_mode
-            REGISTRY.set_gauge("bcos_sync_mode",
+            self._reg.set_gauge("bcos_sync_mode",
                                1 if prev_mode == "snap" else 0)
             self._next_snap_attempt = now + SNAP_RETRY_SECONDS
             return False

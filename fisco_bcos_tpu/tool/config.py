@@ -89,6 +89,9 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     cp = configparser.ConfigParser()
     cp["chain"] = {"chain_id": cfg.chain_id, "group_id": cfg.group_id,
                    "sm_crypto": str(cfg.sm_crypto).lower()}
+    # multi-group hosting: group ids this process runs (init/group.py);
+    # empty = single-group node
+    cp["groups"] = {"list": ",".join(cfg.groups)}
     cp["txpool"] = {"limit": str(cfg.txpool_limit),
                     "block_limit_range": str(cfg.block_limit_range)}
     cp["consensus"] = {"type": cfg.consensus,
@@ -128,7 +131,11 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     cp["executor"] = {}
     cp["crypto"] = {"backend": cfg.crypto_backend,
                     "device_min_batch": str(cfg.device_min_batch),
-                    "mesh_devices": str(cfg.crypto_mesh_devices)}
+                    "mesh_devices": str(cfg.crypto_mesh_devices),
+                    # shared crypto-plane lane (crypto/lane.py): merge all
+                    # groups' batches into single device calls
+                    "lane": str(cfg.crypto_lane).lower(),
+                    "lane_wait_ms": str(cfg.crypto_lane_wait_ms)}
     import io
     buf = io.StringIO()
     cp.write(buf)
@@ -155,10 +162,13 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
                 f"bad [p2p] nodes entry {ent!r} in config.ini "
                 "(expected host:port)")
         peers.append((host, int(port)))
+    groups = [g.strip() for g in
+              cp.get("groups", "list", fallback="").split(",") if g.strip()]
     return NodeConfig(
         chain_id=cp.get("chain", "chain_id", fallback="chain0"),
         group_id=cp.get("chain", "group_id", fallback="group0"),
         sm_crypto=cp.getboolean("chain", "sm_crypto", fallback=False),
+        groups=groups,
         storage_path=path,
         txpool_limit=cp.getint("txpool", "limit", fallback=15000),
         block_limit_range=cp.getint("txpool", "block_limit_range",
@@ -186,6 +196,9 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         crypto_backend=cp.get("crypto", "backend", fallback="auto"),
         device_min_batch=cp.getint("crypto", "device_min_batch", fallback=512),
         crypto_mesh_devices=cp.getint("crypto", "mesh_devices", fallback=0),
+        crypto_lane=cp.getboolean("crypto", "lane", fallback=True),
+        crypto_lane_wait_ms=cp.getfloat("crypto", "lane_wait_ms",
+                                        fallback=0.0),
         rpc_host=cp.get("rpc", "listen_ip", fallback="127.0.0.1"),
         rpc_port=int(port_s) if port_s else None,
         rpc_workers=cp.getint("rpc", "workers", fallback=8),

@@ -79,8 +79,11 @@ class IngestLane:
 
     def __init__(self, txpool, max_batch: int = 4096,
                  max_wait_ms: float = 15.0, queue_cap: int = 8192,
-                 broadcast: bool = True):
+                 broadcast: bool = True, registry=None):
         self.txpool = txpool
+        # metrics sink: a multi-group node passes a group-labeled view
+        # (utils.metrics.for_group) so G lanes don't silently aggregate
+        self._reg = registry if registry is not None else REGISTRY
         self.max_batch = max(1, int(max_batch))
         self.max_wait = max(0.0, float(max_wait_ms)) / 1000.0
         self.queue_cap = max(1, int(queue_cap))
@@ -105,7 +108,7 @@ class IngestLane:
         # trickles still coalesce over the patient window
         self._gap_ewma = 0.0
         self._last_dispatch = time.monotonic()
-        # totals for stats()/bench (REGISTRY mirrors them as metrics)
+        # totals for stats()/bench (the registry mirrors them as metrics)
         self._txs_total = 0
         self._batches_total = 0
         self._rejected_total = 0
@@ -154,13 +157,13 @@ class IngestLane:
                 raise LaneStopped("ingest lane stopped")
             if len(self._q) >= self.queue_cap:
                 self._rejected_total += 1
-                REGISTRY.inc("bcos_ingest_rejected_total")
+                self._reg.inc("bcos_ingest_rejected_total")
                 raise TxPoolIsFull(
                     f"ingest queue at capacity ({self.queue_cap})")
             self._q.append(entry)
             depth = len(self._q)
             self._cv.notify_all()
-        REGISTRY.set_gauge("bcos_ingest_queue_depth", depth)
+        self._reg.set_gauge("bcos_ingest_queue_depth", depth)
         return entry.task
 
     def submit(self, tx: Transaction, timeout: float = 30.0
@@ -190,9 +193,9 @@ class IngestLane:
             if accepted:
                 self._cv.notify_all()
         if dropped:
-            REGISTRY.inc("bcos_ingest_dropped_total", dropped)
+            self._reg.inc("bcos_ingest_dropped_total", dropped)
             metric("ingest.drop", n=dropped)
-        REGISTRY.set_gauge("bcos_ingest_queue_depth", depth)
+        self._reg.set_gauge("bcos_ingest_queue_depth", depth)
         return accepted
 
     # -- adaptive coalescing -----------------------------------------------
@@ -267,7 +270,7 @@ class IngestLane:
                 batch = [self._q.popleft()
                          for _ in range(min(len(self._q), self.max_batch))]
                 depth = len(self._q)
-            REGISTRY.set_gauge("bcos_ingest_queue_depth", depth)
+            self._reg.set_gauge("bcos_ingest_queue_depth", depth)
             try:
                 self._dispatch(batch)
             except Exception as exc:  # noqa: BLE001 — lane must survive
@@ -301,13 +304,13 @@ class IngestLane:
         with self._cv:
             self._txs_total += len(batch)
             self._batches_total += 1
-        REGISTRY.inc("bcos_ingest_txs_total", len(batch))
-        REGISTRY.inc("bcos_ingest_batches_total")
-        REGISTRY.observe("bcos_ingest_batch_size", len(batch),
+        self._reg.inc("bcos_ingest_txs_total", len(batch))
+        self._reg.inc("bcos_ingest_batches_total")
+        self._reg.observe("bcos_ingest_batch_size", len(batch),
                          buckets=_SIZE_BUCKETS)
-        REGISTRY.observe("bcos_ingest_coalesce_delay_seconds",
+        self._reg.observe("bcos_ingest_coalesce_delay_seconds",
                          now - batch[0].t_enq)
-        REGISTRY.observe("bcos_ingest_per_tx_seconds", dt / len(batch))
+        self._reg.observe("bcos_ingest_per_tx_seconds", dt / len(batch))
         metric("ingest.batch", n=len(batch), ms=int(dt * 1000),
                rate=int(self._rate))
 

@@ -52,8 +52,9 @@ class SubmitRejected(RuntimeError):
 class TxPool:
     def __init__(self, suite, ledger: Ledger, chain_id: str = "chain0",
                  group_id: str = "group0", pool_limit: int = DEFAULT_POOL_LIMIT,
-                 block_limit_range: int = 600):
+                 block_limit_range: int = 600, registry=None):
         self.suite = suite
+        self._registry = registry  # None -> utils.metrics.REGISTRY
         self.ledger = ledger
         self.chain_id = chain_id
         self.group_id = group_id
@@ -93,7 +94,7 @@ class TxPool:
         from ..utils.metrics import REGISTRY
         with self._lock:
             n = len(self._pending) - len(self._sealed)
-        REGISTRY.set_gauge("bcos_txpool_pending", n)
+        (self._registry or REGISTRY).set_gauge("bcos_txpool_pending", n)
 
     def _notify_ready(self) -> None:
         for fn in self._on_ready:

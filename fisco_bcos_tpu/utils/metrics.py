@@ -154,7 +154,61 @@ class MetricsRegistry:
             }
 
 
+class GroupMetricsView:
+    """A registry facade stamping every series with a `group` label while
+    ALSO writing the unlabeled series — multi-group processes get accurate
+    per-group counters/gauges without breaking dashboards built on the
+    unlabeled totals (unlabeled counters become cross-group sums; unlabeled
+    gauges keep their documented last-writer-wins semantics)."""
+
+    def __init__(self, registry: "MetricsRegistry", group: str):
+        self._r = registry
+        self._labels = {"group": group}
+
+    def _merge(self, labels: Optional[dict]) -> dict:
+        return {**(labels or {}), **self._labels}
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[dict] = None) -> None:
+        self._r.inc(name, value, labels)
+        self._r.inc(name, value, self._merge(labels))
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[dict] = None) -> None:
+        self._r.set_gauge(name, value, labels)
+        self._r.set_gauge(name, value, self._merge(labels))
+
+    def observe(self, name: str, value: float,
+                labels: Optional[dict] = None,
+                buckets: Optional[tuple] = None) -> None:
+        self._r.observe(name, value, labels, buckets=buckets)
+        self._r.observe(name, value, self._merge(labels), buckets=buckets)
+
+    def timer(self, name: str, labels: Optional[dict] = None):
+        view = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                # observe() dual-writes (unlabeled + group) like every
+                # other method here — the docstring's promise holds for
+                # timers too
+                view.observe(name, time.perf_counter() - self.t0, labels)
+                return False
+
+        return _T()
+
+
 REGISTRY = MetricsRegistry()  # process-wide default
+
+
+def for_group(group: str, registry: Optional[MetricsRegistry] = None
+              ) -> GroupMetricsView:
+    """Per-group dual-writing view over `registry` (default REGISTRY)."""
+    return GroupMetricsView(registry or REGISTRY, group)
 
 
 class MetricsServer:

@@ -4,12 +4,15 @@ import json
 import time
 import urllib.request
 
+import pytest
+
 from fisco_bcos_tpu.init.group import GroupManager, GroupedJsonRpc
 from fisco_bcos_tpu.init.node import NodeConfig
 from fisco_bcos_tpu.net.gateway import FakeGateway, GroupGateway
 from fisco_bcos_tpu.net.front import FrontService
 from fisco_bcos_tpu.net.moduleid import ModuleID
 from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.rpc.server import JSONRPC_GROUP_NOT_FOUND
 from fisco_bcos_tpu.executor import precompiled as pc
 
 
@@ -95,3 +98,149 @@ def test_two_groups_independent_chains_and_rpc():
     finally:
         mgr.stop()
         n1.storage.close() if hasattr(n1.storage, "close") else None
+
+
+@pytest.fixture()
+def grouped_pair():
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+
+    mgr = GroupManager(storage=MemoryStorage())
+    n1 = mgr.add_group(NodeConfig(group_id="group0", crypto_backend="host",
+                                  min_seal_time=0.0))
+    n2 = mgr.add_group(NodeConfig(group_id="group1", crypto_backend="host",
+                                  min_seal_time=0.0))
+    mgr.start()
+    yield mgr, n1, n2
+    mgr.stop()
+
+
+def _http_rpc(port, method, params, rid=1):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
+                         "params": params}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as f:
+        return json.load(f)
+
+
+def test_group_methods_enumerate_real_registry(grouped_pair):
+    """getGroupList/getGroupInfo/getGroupInfoList answer from the live
+    registry on EVERY group's impl (rpc/server.py), not a hardcoded
+    single group."""
+    from fisco_bcos_tpu.rpc.server import JsonRpcImpl
+
+    mgr, n1, n2 = grouped_pair
+    impl = JsonRpcImpl(n1)  # a single group's impl, registry-aware
+    assert impl.get_group_list() == {"groupList": ["group0", "group1"]}
+    infos = impl.get_group_info_list()
+    assert [i["groupID"] for i in infos] == ["group0", "group1"]
+    # info for a SIBLING group renders from that group's node
+    info = impl.get_group_info("group1")
+    assert info["groupID"] == "group1"
+    assert info["genesisHash"] == "0x" + n2.ledger.header_by_number(0).hash(
+        n2.suite).hex()
+    # a node WITHOUT a registry still reports only itself
+    lone = mgr.node("group0")
+    reg, lone.group_registry = lone.group_registry, None
+    try:
+        assert JsonRpcImpl(lone).get_group_list() == \
+            {"groupList": ["group0"]}
+    finally:
+        lone.group_registry = reg
+
+
+def test_unknown_group_error_parity_http_and_ws(grouped_pair):
+    """Every group-routed method answers an unknown group with the SAME
+    dedicated error object (code -32004) over HTTP and WS."""
+    from fisco_bcos_tpu.rpc.ws_server import WsRpcServer
+    from fisco_bcos_tpu.sdk.client import RpcCallError
+    from fisco_bcos_tpu.sdk.ws import WsSdkClient
+
+    mgr, n1, n2 = grouped_pair
+    grouped = GroupedJsonRpc(mgr)
+    srv = grouped.serve(port=0)
+    ws = WsRpcServer(grouped, port=0)
+    ws.start()
+    try:
+        for method, params in [
+            ("getBlockNumber", ["nope"]),
+            ("getGroupInfo", ["nope"]),
+            ("sendTransaction", ["nope", "", "0x00"]),
+            ("getGroupPeers", ["nope"]),
+        ]:
+            body = _http_rpc(srv.port, method, params)
+            assert body["error"]["code"] == JSONRPC_GROUP_NOT_FOUND, \
+                (method, body)
+            assert "nope" in body["error"]["message"]
+        # known groups still route per group over the one edge
+        assert _http_rpc(srv.port, "getBlockNumber", ["group1"])[
+            "result"] == 0
+        client = WsSdkClient("127.0.0.1", ws.port)
+        try:
+            assert client.request("getBlockNumber", ["group0"]) >= 0
+            with pytest.raises(RpcCallError) as exc:
+                client.request("getBlockNumber", ["nope"])
+            assert exc.value.code == JSONRPC_GROUP_NOT_FOUND
+            with pytest.raises(RpcCallError) as exc:
+                client.request("getGroupInfo", ["nope"])
+            assert exc.value.code == JSONRPC_GROUP_NOT_FOUND
+            assert client.request("getGroupList", [])[
+                "groupList"] == ["group0", "group1"]
+        finally:
+            client.close()
+    finally:
+        ws.stop()
+        srv.stop()
+
+
+def test_per_group_query_caches_behind_one_edge(grouped_pair):
+    """The shared edge wires one commit-coherent QueryCache PER group:
+    hot responses never cross groups and invalidation stays local."""
+    mgr, n1, n2 = grouped_pair
+    grouped = GroupedJsonRpc(mgr)
+    kp = n1.suite.generate_keypair(b"mg-cache")
+    tx = Transaction(to=pc.BALANCE_ADDRESS,
+                     input=pc.encode_call(
+                         "register", lambda w: w.blob(b"c").u64(1)),
+                     nonce="c1", group_id="group0",
+                     block_limit=100).sign(n1.suite, kp)
+    r = n1.send_transaction(tx)
+    assert n1.txpool.wait_for_receipt(r.tx_hash, 15) is not None
+    # route a block query through the edge twice: second serves cached
+    req = {"jsonrpc": "2.0", "id": 1, "method": "getBlockByNumber",
+           "params": ["group0", "", 1, False, False]}
+    r1 = grouped.handle(dict(req))
+    r2 = grouped.handle(dict(req))
+    assert r1["result"] is not None
+    assert r1["result"] is r2["result"]  # same cached object
+    # group1's cache wires on its first routed request (lazy per group)
+    grouped.handle({"jsonrpc": "2.0", "id": 2, "method": "getBlockNumber",
+                    "params": ["group1"]})
+    assert n1.query_cache is not None and n2.query_cache is not None
+    assert n1.query_cache is not n2.query_cache
+    assert n1.query_cache.stats()["hits"] >= 1
+    assert n2.query_cache.stats()["hits"] == 0
+
+
+def test_metrics_carry_group_label_and_keep_totals(grouped_pair):
+    """bcos_* series from per-group subsystems carry a {group=...} label
+    ALONGSIDE the unlabeled totals (dashboard compatibility)."""
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    mgr, n1, n2 = grouped_pair
+    kp = n1.suite.generate_keypair(b"mg-metrics")
+    for node, gid in ((n1, "group0"), (n2, "group1")):
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register", lambda w: w.blob(b"m").u64(1)),
+                         nonce=f"m-{gid}", group_id=gid,
+                         block_limit=100).sign(node.suite, kp)
+        r = node.send_transaction(tx)
+        assert node.txpool.wait_for_receipt(r.tx_hash, 15) is not None
+    text = REGISTRY.prometheus_text()
+    assert 'bcos_txpool_pending{group="group0"}' in text
+    assert 'bcos_txpool_pending{group="group1"}' in text
+    # the unlabeled series survives for existing dashboards
+    assert any(line.startswith("bcos_txpool_pending ")
+               for line in text.splitlines())

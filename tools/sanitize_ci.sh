@@ -28,6 +28,14 @@
 #                                   # batch, assert cache-hit metrics
 #                                   # increment and a post-commit query
 #                                   # serves the cached bytes
+#   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
+#                                   # daemon hosting two groups ([groups]
+#                                   # ini), disjoint writes routed by the
+#                                   # group RPC param, per-group head
+#                                   # hashes diverge, a cross-group
+#                                   # transfer settles atomically, and the
+#                                   # shared crypto lane's batch metric
+#                                   # shows real (>1) merged batches
 #
 # Exit 0 = every stage clean. Each stage rebuilds the sanitizer variants
 # from the CURRENT sources (the src-hash stamp keeps them honest) and runs
@@ -262,6 +270,129 @@ EOF
   JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
     python benchmark/chain_bench.py -n 1000 --backend host \
     --pipeline-profile 2>/dev/null | grep '"metric": "pipeline_'
+  exit 0
+fi
+
+if [ "${1:-}" = "--groups" ]; then
+  echo "== [groups] multi-group smoke: one daemon, two groups, routed RPC," \
+       "cross-group transfer, shared crypto lane"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python - <<'EOF'
+import json, shutil, tempfile, threading, time
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.daemon import NodeDaemon
+from fisco_bcos_tpu.init.node import NodeConfig
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.sdk.client import SdkClient
+from fisco_bcos_tpu.tool.config import ChainConfig, save_node_config
+
+work = tempfile.mkdtemp(prefix="groups-smoke-")
+try:
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    suite = make_suite(False, backend="host")
+    kp = suite.generate_keypair(b"groups-smoke")
+    cfg = NodeConfig(groups=["group0", "group1"], consensus="solo",
+                     crypto_backend="host", min_seal_time=0.0,
+                     storage_path="data", rpc_port=0, p2p_port=0)
+    chain = ChainConfig(consensus_type="solo", sealers=[kp.pub_bytes])
+    save_node_config(work, cfg, chain, kp.secret)
+    daemon = NodeDaemon(work)
+    daemon.start()
+    try:
+        assert daemon.manager is not None, "daemon did not boot multigroup"
+        assert daemon.manager.groups() == ["group0", "group1"]
+        url = f"http://127.0.0.1:{daemon.rpc.port}"
+        sdk = SdkClient(url)
+
+        def register(group, account, amount, nonce):
+            tx = Transaction(to=pc.BALANCE_ADDRESS,
+                             input=pc.encode_call(
+                                 "register",
+                                 lambda w: w.blob(account).u64(amount)),
+                             nonce=nonce, group_id=group,
+                             block_limit=100).sign(suite, kp)
+            return sdk.request("sendTransaction",
+                               [group, "", "0x" + tx.encode().hex(),
+                                False, True, 30.0])
+
+        # disjoint writes routed by the group param over ONE edge
+        rc = register("group0", b"alice", 100, "g0-a")
+        assert rc["status"] == 0, rc
+        rc = register("group1", b"bob", 7, "g1-b")
+        assert rc["status"] == 0, rc
+        h0 = sdk.request("getBlockHashByNumber", ["group0", "", 1])
+        h1 = sdk.request("getBlockHashByNumber", ["group1", "", 1])
+        assert h0 and h1 and h0 != h1, "group heads did not diverge"
+
+        # a real (>1) verify batch through the shared crypto lane: one
+        # in-process burst per group, submitted concurrently
+        nodes = [daemon.manager.node(g) for g in ("group0", "group1")]
+        bursts = [[Transaction(to=pc.BALANCE_ADDRESS,
+                               input=pc.encode_call(
+                                   "register",
+                                   lambda w, i=i: w.blob(
+                                       b"%s-%d" % (g.encode(), i)).u64(1)),
+                               nonce=f"b-{g}-{i}", group_id=g,
+                               block_limit=100).sign(suite, kp)
+                   for i in range(64)]
+                  for g in ("group0", "group1")]
+        ths = [threading.Thread(
+            target=lambda n=n, b=b: n.txpool.submit_batch(b), daemon=True)
+            for n, b in zip(nodes, bursts)]
+        for t in ths: t.start()
+        for t in ths: t.join(60)
+        lane = daemon.manager.crypto_lane_stats()["ecdsa"]
+        assert lane["mean_device_batch"] > 1.0, lane
+
+        # cross-group transfer via RPC settles atomically
+        tx = Transaction(to=pc.XSHARD_ADDRESS,
+                         input=pc.encode_call(
+                             "transferOut",
+                             lambda w: w.blob(b"smoke-x").text("group1")
+                             .blob(b"alice").blob(b"bob").u64(30)),
+                         nonce="x-s", group_id="group0",
+                         block_limit=100).sign(suite, kp)
+        rc = sdk.request("sendTransaction",
+                         ["group0", "", "0x" + tx.encode().hex(),
+                          False, True, 30.0])
+        assert rc["status"] == 0, rc
+        bal_call = pc.encode_call("balanceOf", lambda w: w.blob(b"bob"))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            out = sdk.request("call", ["group1", "",
+                                       "0x" + pc.BALANCE_ADDRESS.hex(),
+                                       "0x" + bal_call.hex()])
+            if int(out["output"][2:], 16) == 37:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("cross-group credit never landed")
+        out = sdk.request("call", ["group0", "",
+                                   "0x" + pc.BALANCE_ADDRESS.hex(),
+                                   "0x" + pc.encode_call(
+                                       "balanceOf",
+                                       lambda w: w.blob(b"alice")).hex()])
+        assert int(out["output"][2:], 16) == 70
+        # and an unknown group answers the dedicated error object
+        try:
+            sdk.request("getBlockNumber", ["nope"])
+            raise AssertionError("unknown group did not error")
+        except Exception as exc:
+            assert "-32004" in str(exc) or "unknown group" in str(exc), exc
+        print("sanitize_ci: GROUPS STAGE CLEAN "
+              f"(lane_mean_batch={lane['mean_device_batch']}, "
+              f"merged_calls={lane['merged_calls']}, "
+              f"xshard={daemon.manager.coordinator.stats()})")
+    finally:
+        daemon.shutdown()
+finally:
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+  echo "== [groups] scaling bench row (2 groups vs 1, interleaved medians)"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python benchmark/chain_bench.py --groups 2 --groups-compare \
+    --cross-shard-pct 10 -n 1000 --backend host 2>/dev/null \
+    | grep '"metric": "groups'
   exit 0
 fi
 
