@@ -574,6 +574,35 @@ def main() -> None:
             print(f"[bench] sync bench failed: "
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
+        try:
+            # supplementary: persistent storage engine A/B (storage/
+            # engine.py) — sustained-write TPS, cold-restart seconds, and
+            # peak RSS for memory vs WAL vs disk backends, each in a fresh
+            # process. BENCH_STORAGE_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--storage-compare", "-n", "400", "--tx-count-limit",
+                 "100", "--storage-memtable-mb", "1"],
+                "BENCH_STORAGE_TIMEOUT", 600)
+            comp = next((row for row in rows
+                         if row.get("metric") == "storage_compare"), None)
+            if comp:
+                line["storage_disk_tps"] = comp.get("disk_tps")
+                line["storage_memory_tps"] = comp.get("memory_tps")
+                line["storage_disk_vs_memory"] = comp.get(
+                    "disk_vs_memory_tps")
+                line["storage_restart_disk_seconds"] = comp.get(
+                    "restart_disk_seconds")
+                line["storage_peak_rss_disk_mb"] = comp.get(
+                    "peak_rss_disk_mb")
+            else:
+                print(f"[bench] storage bench produced no compare row "
+                      f"(rc={rc})", file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] storage bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
         print(json.dumps(line), flush=True)
     except Exception as exc:  # always emit a parseable line
         print(json.dumps({

@@ -963,6 +963,132 @@ def _emit_read_mode(args, sm: bool) -> None:
         }), flush=True)
 
 
+def run_storage_child(backend: str, n: int, tx_count_limit: int,
+                      memtable_mb: int) -> dict:
+    """ONE backend's sustained-write run in THIS process (the parent
+    forks a fresh interpreter per backend so peak RSS is honest): a solo
+    single-node chain ingests n register txs, then the data directory is
+    re-opened cold to time restart recovery."""
+    import resource
+    import shutil
+    import tempfile
+
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ledger.ledger import Ledger
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.storage import make_storage
+
+    work = tempfile.mkdtemp(prefix=f"storage-bench-{backend}-")
+    data = os.path.join(work, "data")
+    try:
+        blocks_needed = -(-n // max(1, tx_count_limit))
+        block_limit = min(600, max(100, 2 * blocks_needed + 20))
+        wire_txs = _build_workload(False, n, block_limit=block_limit)
+        node = Node(NodeConfig(
+            consensus="solo", crypto_backend="host", min_seal_time=0.0,
+            tx_count_limit=tx_count_limit, storage_path=data,
+            storage_backend=backend, storage_memtable_mb=memtable_mb))
+        node.start()
+        t0 = time.perf_counter()
+        for s in range(0, len(wire_txs), 512):
+            node.txpool.submit_batch(
+                [Transaction.decode(raw) for raw in wire_txs[s:s + 512]])
+        deadline = time.monotonic() + max(120.0, n / 20)
+        while time.monotonic() < deadline:
+            if node.ledger.total_tx_count() >= n:
+                break
+            time.sleep(0.05)
+        t_end = time.perf_counter()
+        committed = node.ledger.total_tx_count()
+        blocks = node.ledger.current_number()
+        node.stop()
+        close = getattr(node.storage, "close", None)
+        if close is not None:
+            close()
+        engine_stats = None
+        stats = getattr(node.storage, "stats", None)
+        if stats is not None:
+            engine_stats = stats()
+        dataset = sum(os.path.getsize(os.path.join(r, f))
+                      for r, _, fs in os.walk(data) for f in fs) \
+            if os.path.isdir(data) else 0
+
+        restart_s = None
+        if backend != "memory":
+            t0r = time.perf_counter()
+            st2 = make_storage(backend, data, memtable_mb=memtable_mb)
+            led2 = Ledger(st2, node.suite)
+            assert led2.current_number() == blocks, \
+                (led2.current_number(), blocks)
+            assert led2.header_by_number(blocks) is not None
+            restart_s = round(time.perf_counter() - t0r, 3)
+            st2.close()
+        rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+        row = {
+            "metric": "storage_backend_run", "backend": backend,
+            "txs_committed": int(committed), "blocks": int(blocks),
+            "tps": round(committed / (t_end - t0), 1) if t_end > t0 else 0,
+            "wall_seconds": round(t_end - t0, 3),
+            "restart_seconds": restart_s,
+            "peak_rss_mb": round(rss_mb, 1),
+            "dataset_mb": round(dataset / (1 << 20), 2),
+            "memtable_mb": memtable_mb,
+            "timed_out": committed < n,
+        }
+        if engine_stats is not None:
+            row["segments"] = engine_stats["segment_count"]
+            row["bloom_skip_rate"] = engine_stats["bloom_skip_rate"]
+        return row
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _emit_storage_compare(args) -> None:
+    """Fork one child per backend (honest peak RSS), emit each backend's
+    row plus a `storage_compare` summary row for bench.py pickup."""
+    import subprocess
+
+    rows = {}
+    for backend in ("memory", "wal", "disk"):
+        r = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "--storage-child", backend, "-n", str(args.n),
+             "--tx-count-limit", str(args.tx_count_limit),
+             "--storage-memtable-mb", str(args.storage_memtable_mb)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            timeout=1200)
+        row = None
+        for ln in r.stdout.splitlines():
+            if ln.startswith("{"):
+                row = json.loads(ln)
+        if row is None:
+            print(json.dumps({"metric": "storage_backend_run",
+                              "backend": backend, "error":
+                              f"child rc={r.returncode}"}), flush=True)
+            continue
+        rows[backend] = row
+        print(json.dumps(row), flush=True)
+    disk, mem = rows.get("disk"), rows.get("memory")
+    wal = rows.get("wal")
+    if disk and mem:
+        print(json.dumps({
+            "metric": "storage_compare", "value": disk["tps"],
+            "unit": "tx/sec", "n": args.n,
+            "memtable_mb": args.storage_memtable_mb,
+            "disk_tps": disk["tps"], "memory_tps": mem["tps"],
+            "wal_tps": wal["tps"] if wal else None,
+            "disk_vs_memory_tps": round(disk["tps"] / mem["tps"], 3)
+            if mem["tps"] else None,
+            "restart_disk_seconds": disk["restart_seconds"],
+            "restart_wal_seconds": wal["restart_seconds"] if wal else None,
+            "peak_rss_disk_mb": disk["peak_rss_mb"],
+            "peak_rss_memory_mb": mem["peak_rss_mb"],
+            "disk_dataset_mb": disk["dataset_mb"],
+            "disk_segments": disk.get("segments"),
+            "timed_out": bool(disk["timed_out"] or mem["timed_out"]),
+        }), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", type=int, default=2000)
@@ -1013,6 +1139,16 @@ def main() -> None:
                          "against the same source chain")
     ap.add_argument("--sync-blocks", type=int, default=40,
                     help="with --sync-bench: source chain length in blocks")
+    ap.add_argument("--storage-compare", action="store_true",
+                    help="storage mode: sustained-write TPS, restart "
+                         "seconds, and peak RSS for the memory/wal/disk "
+                         "backends, one fresh process per backend")
+    ap.add_argument("--storage-child", default=None, metavar="BACKEND",
+                    help=argparse.SUPPRESS)  # internal: one backend's run
+    ap.add_argument("--storage-memtable-mb", type=int, default=4,
+                    help="with --storage-compare: disk-engine memtable cap "
+                         "(small by default so the dataset spills to "
+                         "segments and RSS boundedness is actually tested)")
     ap.add_argument("--pipeline-profile", action="store_true",
                     help="direct mode: also emit pipeline_tps and a per-"
                          "stage (fill/execute/roots/consensus_wait/commit) "
@@ -1024,6 +1160,14 @@ def main() -> None:
 
     suites = [False, True] if args.suite == "both" else \
         [args.suite == "sm"]
+    if args.storage_child:
+        print(json.dumps(run_storage_child(
+            args.storage_child, args.n, args.tx_count_limit,
+            args.storage_memtable_mb)), flush=True)
+        return
+    if args.storage_compare:
+        _emit_storage_compare(args)
+        return
     if args.sync_bench:
         for sm in suites:
             for row in run_sync_bench(sm, args.sync_blocks):

@@ -42,6 +42,7 @@ def main() -> None:
     ap.add_argument("--value-size", type=int, default=64)
     args = ap.parse_args()
 
+    from fisco_bcos_tpu.storage.engine import DiskStorage
     from fisco_bcos_tpu.storage.keypage import KeyPageStorage
     from fisco_bcos_tpu.storage.memory import MemoryStorage
     from fisco_bcos_tpu.storage.state import StateStorage
@@ -58,6 +59,17 @@ def main() -> None:
         bench_backend("keypage_over_wal",
                       lambda: KeyPageStorage(
                           WalStorage(os.path.join(tmp, "kp"))),
+                      args.n, args.value_size),
+        # the log-structured engine, sized so the dataset spills out of
+        # the memtable into segments (reads hit bloom+index, not RAM)
+        bench_backend("disk_engine",
+                      lambda: DiskStorage(os.path.join(tmp, "disk"),
+                                          memtable_bytes=1 << 20),
+                      args.n, args.value_size),
+        bench_backend("keypage_over_disk",
+                      lambda: KeyPageStorage(
+                          DiskStorage(os.path.join(tmp, "kpd"),
+                                      memtable_bytes=1 << 20)),
                       args.n, args.value_size),
     ]
     if native.available():

@@ -182,12 +182,17 @@ class NodeDaemon:
         from ..ledger.ledger import ConsensusNode
         from ..net.gateway import MuxGateway
         from ..rpc.edge import WorkerPool
-        from ..storage.memory import MemoryStorage
-        from ..storage.wal import WalStorage
+        from ..storage import make_storage
         from .group import GroupedJsonRpc, GroupManager
 
-        self.shared_storage = (WalStorage(cfg.storage_path)
-                               if cfg.storage_path else MemoryStorage())
+        # ONE engine for all groups (the per-group NamespacedStorage views
+        # ride over it); unlabeled registry — the store is shared, the
+        # per-group series come from each node's own subsystems
+        self.shared_storage = make_storage(
+            cfg.storage_backend, cfg.storage_path,
+            memtable_mb=cfg.storage_memtable_mb,
+            compact_segments=cfg.storage_compact_segments,
+            key_page_size=cfg.storage_key_page_size)
         # ONE p2p listener for all groups: group tags ride the frames
         # (MuxGateway), sessions authenticate with the single node key
         self.manager = GroupManager(shared_gateway=MuxGateway(self.gateway),

@@ -27,8 +27,6 @@ from ..ledger.ledger import ConsensusNode, Ledger
 from ..protocol import Block
 from ..scheduler.scheduler import Scheduler
 from ..sealer.sealer import Sealer
-from ..storage.memory import MemoryStorage
-from ..storage.wal import WalStorage
 from ..txpool.ingest import IngestLane
 from ..txpool.txpool import TxPool
 from ..utils.log import LOG, badge
@@ -47,6 +45,17 @@ class NodeConfig:
     group_id: str = "group0"
     sm_crypto: bool = False
     storage_path: Optional[str] = None  # None = in-memory
+    # persistent backend selection (storage/__init__.py make_storage):
+    # auto = wal when a path is configured, memory otherwise (historical
+    # behavior); disk = the log-structured engine (storage/engine.py —
+    # memtable + sorted segments + manifest, restart flat in chain length,
+    # datasets beyond RAM). memory/wal force those backends.
+    storage_backend: str = "auto"  # auto | memory | wal | disk
+    storage_memtable_mb: int = 64  # disk engine: flush watermark
+    storage_compact_segments: int = 8  # disk engine: merge past this many
+    # > 0 wraps the persistent backend in KeyPageStorage (page-packed rows,
+    # the reference's storage.key_page_size — NodeConfig.cpp:620)
+    storage_key_page_size: int = 0
     tx_count_limit: int = 1000
     txpool_limit: int = 15000
     block_limit_range: int = 600
@@ -145,17 +154,22 @@ class Node:
             device_min_batch=cfg.device_min_batch,
             mesh_devices=cfg.crypto_mesh_devices)
         self.keypair = keypair or self.suite.generate_keypair()
-        # storage injection seam — the reference's StorageInitializer picks
-        # RocksDB vs TiKV (libinitializer/Initializer.cpp:145-261); callers
-        # pass e.g. a storage.sharded.ShardedStorage cluster for Max mode
-        self.storage = storage if storage is not None else (
-            WalStorage(cfg.storage_path) if cfg.storage_path
-            else MemoryStorage())
         # per-group metrics view: every bcos_* series this node's
         # subsystems emit carries a group label ALONGSIDE the unlabeled
         # totals, so G in-process stacks stay tellable apart
         from ..utils.metrics import for_group
         self.metrics_view = for_group(cfg.group_id)
+        # storage injection seam — the reference's StorageInitializer picks
+        # RocksDB vs TiKV (libinitializer/Initializer.cpp:145-261); callers
+        # pass e.g. a storage.sharded.ShardedStorage cluster for Max mode,
+        # the multi-group manager a per-group NamespacedStorage
+        from ..storage import make_storage
+        self.storage = storage if storage is not None else make_storage(
+            cfg.storage_backend, cfg.storage_path,
+            memtable_mb=cfg.storage_memtable_mb,
+            compact_segments=cfg.storage_compact_segments,
+            key_page_size=cfg.storage_key_page_size,
+            registry=self.metrics_view)
         # multi-group composition (init/group.py) sets this to the
         # GroupManager so RPC group methods enumerate the real registry
         self.group_registry = None

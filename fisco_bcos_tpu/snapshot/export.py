@@ -44,18 +44,28 @@ def _storage_tables(storage) -> list[str]:
     return list(tables())
 
 
-def _capture_rows(storage, ledger) -> tuple[int, Optional[bytes],
-                                            list[tuple[str, bytes, bytes]]]:
+def _capture_rows(storage, ledger):
+    """-> (height, header_bytes, rows). `rows` is a list for plain
+    storages (copied under the caller's lock) but a LAZY stream for the
+    disk engine: `capture_rows` freezes a consistent view (memtable copy
+    + pinned immutable segments) in O(memtable) under the lock, and the
+    actual bytes stream straight from the segments when the chunk packer
+    iterates — after the caller has released the lock, so commits keep
+    flowing during a multi-second export of a big on-disk state."""
     height = ledger.current_number()
     header = ledger.header_by_number(height)
-    rows: list[tuple[str, bytes, bytes]] = []
-    for table in sorted(_storage_tables(storage)):
-        if is_private_table(table):
-            continue
-        for key in storage.keys(table):
-            value = storage.get(table, key)
-            if value is not None:
-                rows.append((table, key, value))
+    cap = getattr(storage, "capture_rows", None)
+    if cap is not None:
+        rows = (row for row in cap() if not is_private_table(row[0]))
+    else:
+        rows = []
+        for table in sorted(_storage_tables(storage)):
+            if is_private_table(table):
+                continue
+            for key in storage.keys(table):
+                value = storage.get(table, key)
+                if value is not None:
+                    rows.append((table, key, value))
     return height, header.encode() if header else None, rows
 
 

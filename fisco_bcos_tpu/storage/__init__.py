@@ -4,14 +4,61 @@ Reference counterpart: TransactionalStorageInterface with asyncPrepare/
 asyncCommit/asyncRollback (/root/reference/bcos-framework/bcos-framework/
 storage/StorageInterface.h:126-141), RocksDBStorage (bcos-storage/
 bcos-storage/RocksDBStorage.h:64-68) and the StateStorage/KeyPageStorage
-overlays (bcos-table/src/).
+overlays (bcos-table/src/). The persistent slot has two fills: WalStorage
+(snapshot + full-log replay, small states) and DiskStorage (log-structured
+segments + manifest, storage/engine.py — restart flat in chain length,
+datasets beyond RAM), selected by the `[storage] backend` ini knob.
 """
+
+from typing import Optional
 
 from .interface import Entry, StorageInterface, TransactionalStorage
 from .memory import MemoryStorage
 from .namespace import NamespacedStorage
 from .state import StateStorage
 from .wal import WalStorage
+
+
+def __getattr__(name):  # lazy: engine pulls in sstable/compact machinery
+    if name == "DiskStorage":
+        from .engine import DiskStorage
+        return DiskStorage
+    if name == "KeyPageStorage":
+        from .keypage import KeyPageStorage
+        return KeyPageStorage
+    raise AttributeError(name)
+
+
+def make_storage(backend: str, path: Optional[str],
+                 memtable_mb: int = 64, compact_segments: int = 8,
+                 key_page_size: int = 0, registry=None
+                 ) -> TransactionalStorage:
+    """Build the node's backing store from the `[storage]` config surface.
+
+    backend: `auto` keeps the historical selection (WAL-backed when a path
+    is configured, in-memory otherwise); `memory`/`wal`/`disk` force one.
+    `key_page_size` > 0 wraps the persistent backend in KeyPageStorage so
+    wide-table rows are page-packed (reference KeyPageStorage layout).
+    """
+    if backend in ("", "auto", None):
+        backend = "wal" if path else "memory"
+    if backend == "memory":
+        return MemoryStorage()
+    if path is None:
+        raise ValueError(f"[storage] backend={backend} needs a data path")
+    if backend == "wal":
+        st: TransactionalStorage = WalStorage(path)
+    elif backend == "disk":
+        from .engine import DiskStorage
+        st = DiskStorage(path, memtable_bytes=memtable_mb << 20,
+                         max_segments=compact_segments, registry=registry)
+    else:
+        raise ValueError(f"unknown [storage] backend {backend!r}")
+    if key_page_size > 0:
+        from .keypage import KeyPageStorage
+        st = KeyPageStorage(st, page_size=key_page_size)
+    return st
+
 
 __all__ = [
     "Entry",
@@ -21,4 +68,7 @@ __all__ = [
     "NamespacedStorage",
     "StateStorage",
     "WalStorage",
+    "DiskStorage",
+    "KeyPageStorage",
+    "make_storage",
 ]

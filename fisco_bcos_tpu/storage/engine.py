@@ -1,0 +1,692 @@
+"""DiskStorage — log-structured persistent engine behind the 2PC seam.
+
+The production storage slot (ROADMAP item 4; the reference's RocksDBStorage
+layering, PAPER.md §1 layer 5) with the same `TransactionalStorage`
+prepare/commit/rollback contract the scheduler's batchBlockCommit drives,
+so it is a drop-in alternative to MemoryStorage/WalStorage selected by the
+`[storage] backend = disk` ini knob.
+
+Shape (a small LSM tree):
+
+  * writes land in an in-RAM **memtable** after an fsynced record on a
+    rotated WAL segment (storage/wal.py SegmentedWal) — commit durability
+    is exactly WalStorage's;
+  * when the memtable exceeds its byte cap (or at checkpoint compaction)
+    it is frozen and flushed to an immutable sorted **segment** on disk
+    (storage/sstable.py: block-aligned, prefix-compressed keys, per-segment
+    bloom filter + sparse index);
+  * a **manifest** names the live segments and the WAL flush floor; every
+    edge is written to a fresh `MANIFEST-<n>` file and published by an
+    atomic rename of `CURRENT` (the snapshot store's fsync discipline), so
+    kill -9 at ANY point recovers to either the pre- or post-edge state;
+  * once a flush is durable in the manifest, the WAL segments it covers
+    are retired — the log stays O(memtable), not O(history);
+  * background **compaction** (storage/compact.py) merges segments and
+    drops tombstones/pruned history; reads consult memtable -> newest
+    segment -> oldest.
+
+Restart cost is flat in chain length: boot reads the manifest, opens the
+segment metadata, and replays only the WAL tail above the flush floor —
+no full-log replay, no O(state) RAM requirement beyond the memtable.
+
+Datasets larger than RAM are served from segments; `keys()`/`get()` read
+through bloom filters and the sparse index. All G groups can share one
+engine through storage/namespace.py unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Iterator, Optional
+
+from ..utils.log import LOG, badge
+from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
+from .sstable import SSTableReader, composite_key, split_key, write_sstable
+from .wal import SegmentedWal, unpack_payload
+
+_MANIFEST_MAGIC = b"FBTPUMAN"
+_TOMBSTONE = None  # memtable value sentinel
+
+
+class ManifestError(RuntimeError):
+    pass
+
+
+def _pack_manifest(next_seg: int, wal_floor: int, seg_ids: list[int]) -> bytes:
+    body = struct.pack("<QQI", next_seg, wal_floor, len(seg_ids))
+    body += b"".join(struct.pack("<Q", s) for s in seg_ids)
+    return _MANIFEST_MAGIC + struct.pack("<I", zlib.crc32(body)) + body
+
+
+def _unpack_manifest(data: bytes) -> tuple[int, int, list[int]]:
+    if data[:8] != _MANIFEST_MAGIC:
+        raise ManifestError("bad manifest magic")
+    (crc,) = struct.unpack_from("<I", data, 8)
+    body = data[12:]
+    if zlib.crc32(body) != crc:
+        raise ManifestError("manifest crc mismatch")
+    next_seg, wal_floor, n = struct.unpack_from("<QQI", body, 0)
+    ids = [struct.unpack_from("<Q", body, 20 + 8 * i)[0] for i in range(n)]
+    return next_seg, wal_floor, ids
+
+
+class DiskStorage(TransactionalStorage):
+    CURRENT = "CURRENT"
+
+    def __init__(self, path: str, memtable_bytes: int = 64 << 20,
+                 max_segments: int = 8, registry=None,
+                 auto_compact: bool = True, block_bytes: int = 4096):
+        from ..utils.metrics import REGISTRY
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.memtable_bytes = memtable_bytes
+        self.max_segments = max(2, max_segments)
+        self.block_bytes = block_bytes
+        self._reg = registry if registry is not None else REGISTRY
+        self._lock = threading.RLock()
+        self._flush_lock = threading.Lock()    # serialises flush/install
+        self._compact_lock = threading.Lock()  # one merge at a time
+        self._prepared: dict[int, ChangeSet] = {}
+        self._mem: dict[bytes, Optional[bytes]] = {}
+        self._mem_bytes = 0
+        self._frozen: list[dict] = []  # being flushed; newest last
+        self._segments: list[SSTableReader] = []  # oldest -> newest
+        self._graveyard: list[SSTableReader] = []  # retired, fds kept briefly
+        self._manifest_seq = 0
+        self._next_seg = 1
+        self._wal_floor = 0
+        self._closed = False
+        # bloom accounting published per commit (counters are lock-guarded;
+        # keep the read hot path to plain int adds)
+        self._bloom_probes = 0
+        self._bloom_skips = 0
+        self._bloom_pub = (0, 0)
+        # test fail-points: names added here raise _FailPoint when crossed
+        self._failpoints: set[str] = set()
+        self._recover()
+        self._compactor = None
+        if auto_compact:
+            from .compact import Compactor
+            self._compactor = Compactor(self)
+            self._compactor.start()
+
+    # -- fail-point plumbing (crash-injection tests) -----------------------
+    class _FailPoint(RuntimeError):
+        pass
+
+    def _maybe_fail(self, name: str) -> None:
+        if name in self._failpoints:
+            raise DiskStorage._FailPoint(name)
+
+    # -- manifest ----------------------------------------------------------
+    def _manifest_path(self, seq: int) -> str:
+        return os.path.join(self.path, f"MANIFEST-{seq:08d}")
+
+    def _write_manifest_locked(self) -> None:
+        """Publish the current segment list + WAL floor: fresh MANIFEST-<n>
+        fsynced, then CURRENT atomically renamed onto it. The rename is the
+        single commit point for every flush/compaction/install edge."""
+        self._manifest_seq += 1
+        mpath = self._manifest_path(self._manifest_seq)
+        data = _pack_manifest(self._next_seg, self._wal_floor,
+                              [s.seg_id for s in self._segments])
+        with open(mpath, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        self._maybe_fail("manifest-before-current")
+        cur_tmp = os.path.join(self.path, self.CURRENT + ".tmp")
+        with open(cur_tmp, "w") as f:
+            f.write(os.path.basename(mpath))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(cur_tmp, os.path.join(self.path, self.CURRENT))
+        dirfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        # superseded manifest files are garbage once CURRENT moved on
+        try:
+            os.remove(self._manifest_path(self._manifest_seq - 1))
+        except OSError:
+            pass
+
+    def _seg_path(self, seg_id: int) -> str:
+        return os.path.join(self.path, f"seg-{seg_id:08d}.sst")
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self) -> None:
+        t0 = time.monotonic()
+        seg_ids: list[int] = []
+        cur = os.path.join(self.path, self.CURRENT)
+        if os.path.exists(cur):
+            with open(cur) as f:
+                name = f.read().strip()
+            try:
+                with open(os.path.join(self.path, name), "rb") as f:
+                    self._next_seg, self._wal_floor, seg_ids = \
+                        _unpack_manifest(f.read())
+                self._manifest_seq = int(name.rsplit("-", 1)[1])
+            except (OSError, ManifestError, ValueError, IndexError) as exc:
+                raise ManifestError(
+                    f"{self.path}: CURRENT points at unreadable manifest "
+                    f"{name!r} ({exc}) — refusing to boot on corrupt "
+                    "storage") from exc
+        for sid in seg_ids:
+            reader = SSTableReader(self._seg_path(sid))
+            reader.seg_id = sid
+            self._segments.append(reader)
+        # orphans: segments written but never referenced (crash between
+        # sstable fsync and the manifest edge), superseded manifests
+        live = {os.path.basename(self._seg_path(s)) for s in seg_ids}
+        live.add(self.CURRENT)
+        if self._manifest_seq:
+            live.add(os.path.basename(self._manifest_path(self._manifest_seq)))
+        for name in os.listdir(self.path):
+            if (name.startswith("seg-") and name.endswith(".sst")
+                    and name not in live) or \
+               (name.startswith("MANIFEST-") and name not in live) or \
+               name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self.path, name))
+                except OSError:
+                    pass
+        # WAL tail replay: only records above the flush floor
+        wal_records = 0
+        max_seq = 0
+        for seq, payload in SegmentedWal.replay(self.path, self._wal_floor):
+            max_seq = max(max_seq, seq)
+            _, items = unpack_payload(payload)
+            for deleted, table, key, value in items:
+                self._apply_one(composite_key(table, key),
+                                _TOMBSTONE if deleted else value)
+            wal_records += 1
+        # stale retired segments below the floor may survive a crash
+        # between manifest write and retire — sweep them now
+        for seq, p in SegmentedWal.list_segments(self.path):
+            if seq < self._wal_floor:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        # always append to a FRESH segment (never behind a truncated tail)
+        self._wal = SegmentedWal(self.path, max(max_seq,
+                                                self._wal_floor) + 1)
+        LOG.info(badge("ENGINE", "recovered", path=self.path,
+                       segments=len(self._segments),
+                       records=sum(s.nrecords for s in self._segments),
+                       wal_records=wal_records,
+                       ms=int((time.monotonic() - t0) * 1000)))
+        self._publish_gauges()
+
+    # -- memtable ----------------------------------------------------------
+    def _apply_one(self, ck: bytes, value: Optional[bytes]) -> None:
+        # approximate byte accounting (overwrites double-count until the
+        # next flush resets it — the cap is a watermark, not a ledger)
+        self._mem[ck] = value
+        self._mem_bytes += len(ck) + (len(value) if value else 0) + 16
+
+    def _apply_changeset_locked(self, cs: ChangeSet) -> None:
+        for (table, key), e in cs.items():
+            self._apply_one(composite_key(table, key),
+                            _TOMBSTONE if e.deleted else e.value)
+
+    # -- reads -------------------------------------------------------------
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        ck = composite_key(table, key)
+        for _ in range(3):  # retry if a compaction closed a reader mid-read
+            with self._lock:
+                if ck in self._mem:
+                    v = self._mem[ck]
+                    return v
+                for frozen in reversed(self._frozen):
+                    if ck in frozen:
+                        return frozen[ck]
+                segs = list(self._segments)
+            probes = skips = 0
+            try:
+                for r in reversed(segs):
+                    probes += 1
+                    if not r.may_contain(ck):
+                        skips += 1
+                        continue
+                    hit = r.get(ck)
+                    if hit is not None:
+                        flag, value = hit
+                        return None if flag else value
+                return None
+            except OSError:
+                continue  # reader swapped out under us; re-resolve
+            finally:
+                self._bloom_probes += probes
+                self._bloom_skips += skips
+        raise RuntimeError("storage readers kept churning during get")
+
+    def keys(self, table: str, prefix: bytes = b"") -> Iterator[bytes]:
+        pfx = composite_key(table, prefix)
+        out = [split_key(ck)[1]
+               for ck, v in self._iter_merged(pfx) if v is not None]
+        return iter(out)
+
+    def _iter_merged(self, prefix_ck: bytes,
+                     sources: Optional[tuple] = None
+                     ) -> Iterator[tuple[bytes, Optional[bytes]]]:
+        """Merged (composite_key, value|None) scan under a composite
+        prefix, newest source wins; tombstones yielded as None. `sources`
+        (mem_items, seg_list) pins a frozen view (snapshot export)."""
+        own_pins = False
+        if sources is None:
+            mem_items, segs = self._pinned_view()
+            own_pins = True
+        else:
+            mem_items, segs = sources
+        mem_items = [(ck, v) for ck, v in mem_items
+                     if ck.startswith(prefix_ck)]
+        try:
+            yield from self._merge_sources(prefix_ck, mem_items, segs)
+        finally:
+            if own_pins:
+                self._unpin(segs)
+
+    def _pinned_view(self) -> tuple[list, list]:
+        """Freeze a consistent (mem_items, segments) view: one merged mem
+        snapshot (oldest frozen -> live, newer wins) plus the segment list
+        with every reader PINNED against the graveyard sweep — a
+        concurrent compaction/install retiring a reader must not close it
+        while a scan holds it. Callers MUST `_unpin(segs)` when done.
+        This is the ONE owner of the pin lifecycle (scans, snapshot
+        capture, install, compaction all go through it), and pins are
+        only ever mutated under `_lock` — the sweep's `pins == 0` check
+        is also under `_lock`, so no lost update can zero a live pin."""
+        with self._lock:
+            md: dict[bytes, Optional[bytes]] = {}
+            for m in list(self._frozen) + [self._mem]:
+                md.update(m)
+            mem_items = sorted(md.items())
+            segs = list(self._segments)
+            for r in segs:
+                r.pins += 1
+        return mem_items, segs
+
+    def _unpin(self, segs) -> None:
+        with self._lock:
+            for r in segs:
+                r.pins -= 1
+
+    @staticmethod
+    def _merge_sources(prefix_ck, mem_items, segs
+                       ) -> Iterator[tuple[bytes, Optional[bytes]]]:
+        import heapq
+
+        iters: list[Iterator[tuple[bytes, int, Optional[bytes]]]] = []
+        # priority: higher = newer. memtable is newest.
+        nsrc = len(segs)
+
+        def mem_iter():
+            for ck, v in mem_items:
+                yield ck, nsrc, v
+        iters.append(mem_iter())
+
+        def seg_iter(reader, prio):
+            for ck, flag, value in reader.iter_from(prefix_ck):
+                if not ck.startswith(prefix_ck):
+                    return
+                yield ck, prio, (_TOMBSTONE if flag else value)
+        for i, r in enumerate(segs):
+            iters.append(seg_iter(r, i))
+
+        heap = []
+        for idx, it in enumerate(iters):
+            ent = next(it, None)
+            if ent is not None:
+                ck, prio, v = ent
+                heap.append((ck, -prio, idx, v))
+        heapq.heapify(heap)
+        last_ck = None
+        while heap:
+            ck, negprio, idx, v = heapq.heappop(heap)
+            ent = next(iters[idx], None)
+            if ent is not None:
+                nck, nprio, nv = ent
+                heapq.heappush(heap, (nck, -nprio, idx, nv))
+            if ck == last_ck:
+                continue  # an older source's shadowed version
+            last_ck = ck
+            yield ck, v
+
+    def tables(self) -> list[str]:
+        with self._lock:
+            names: set[str] = set()
+            for m in [self._mem] + list(self._frozen):
+                for ck in m:
+                    names.add(split_key(ck)[0])
+            for r in self._segments:
+                names.update(r.tables())
+        return sorted(names)
+
+    # -- writes (direct, non-transactional path) ---------------------------
+    def set(self, table: str, key: bytes, value: bytes) -> None:
+        self._write_direct({(table, key): Entry(value)})
+
+    def remove(self, table: str, key: bytes) -> None:
+        self._write_direct({(table, key): Entry(b"", EntryStatus.DELETED)})
+
+    def set_batch(self, table: str, items) -> None:
+        items = list(items)
+        if items:
+            self._write_direct({(table, k): Entry(v) for k, v in items})
+
+    def remove_batch(self, table: str, ks) -> None:
+        ks = list(ks)
+        if ks:
+            self._write_direct({(table, k): Entry(b"", EntryStatus.DELETED)
+                                for k in ks})
+
+    def _write_direct(self, cs: ChangeSet) -> None:
+        with self._lock:
+            self._wal.append(0, cs)
+            self._apply_changeset_locked(cs)
+            need_flush = self._mem_bytes >= self.memtable_bytes
+        if need_flush:
+            self.flush()
+
+    # -- 2PC ---------------------------------------------------------------
+    def prepare(self, block_number: int, changes: ChangeSet) -> None:
+        with self._lock:
+            self._prepared[block_number] = dict(changes)
+
+    def commit(self, block_number: int) -> None:
+        with self._lock:
+            cs = self._prepared.pop(block_number)
+            self._wal.append(block_number, cs)
+            self._apply_changeset_locked(cs)
+            need_flush = self._mem_bytes >= self.memtable_bytes
+            self._publish_commit_gauges_locked()
+        if need_flush:
+            self.flush()
+
+    def rollback(self, block_number: int) -> None:
+        with self._lock:
+            self._prepared.pop(block_number, None)
+
+    # -- flush -------------------------------------------------------------
+    def flush(self) -> bool:
+        """Freeze the memtable and persist it as one sorted segment; on
+        success retire the WAL segments it covers. Crash-safe: until the
+        manifest edge lands, recovery replays the same records from the
+        un-retired WAL tail."""
+        with self._flush_lock:
+            with self._lock:
+                if not self._mem:
+                    return False
+                frozen = self._mem
+                self._mem = {}
+                self._mem_bytes = 0
+                self._frozen.append(frozen)
+                floor = self._wal.rotate()  # frozen lives below this seq
+                seg_id = self._next_seg
+                self._next_seg += 1
+            try:
+                self._maybe_fail("flush-before-sstable")
+                items = ((ck, 1 if v is None else 0, v or b"")
+                         for ck, v in sorted(frozen.items()))
+                stats = write_sstable(self._seg_path(seg_id), items,
+                                      block_bytes=self.block_bytes)
+                self._maybe_fail("flush-before-manifest")
+                reader = SSTableReader(self._seg_path(seg_id))
+                reader.seg_id = seg_id
+                with self._lock:
+                    self._segments.append(reader)
+                    self._frozen.remove(frozen)
+                    self._wal_floor = floor
+                    self._write_manifest_locked()
+                    self._wal.retire_below(floor)
+            except BaseException:
+                # keep the frozen view readable and the WAL un-retired so
+                # a retry (or the next boot) still owns every record
+                with self._lock:
+                    if frozen in self._frozen:
+                        self._frozen.remove(frozen)
+                        # fold back into the live memtable (older data, so
+                        # live entries win on collision)
+                        merged = dict(frozen)
+                        merged.update(self._mem)
+                        self._mem = merged
+                        self._mem_bytes += sum(
+                            len(ck) + (len(v) if v else 0) + 16
+                            for ck, v in frozen.items())
+                raise
+            LOG.info(badge("ENGINE", "flushed", segment=seg_id,
+                           records=stats["records"], bytes=stats["bytes"]))
+            self._publish_gauges()
+            return True
+
+    # -- compaction --------------------------------------------------------
+    def needs_compaction(self) -> bool:
+        with self._lock:
+            return len(self._segments) > self.max_segments
+
+    def compaction_debt_bytes(self) -> int:
+        with self._lock:
+            if len(self._segments) <= 1:
+                return 0
+            return sum(s.file_bytes for s in self._segments)
+
+    def compact_once(self) -> bool:
+        """Merge the current segments into one, dropping tombstones (the
+        captured set always includes the oldest segment, so nothing older
+        can resurrect a deleted row). Returns True if a merge ran.
+
+        Runs WITHOUT the flush lock: a commit crossing the memtable
+        watermark must never stall behind an O(dataset) merge, so flushes
+        land freely during it (their segments are newer than the captured
+        set and keep precedence). Only a whole-state swap (install_rows)
+        can invalidate the merge — detected at the manifest edge, where
+        the merged output is abandoned instead of resurrecting old state."""
+        with self._compact_lock:
+            _, captured = self._pinned_view()  # pinned under the same lock
+            if len(captured) < 2:
+                self._unpin(captured)
+                return False
+            t0 = time.monotonic()
+            with self._lock:
+                seg_id = self._next_seg
+                self._next_seg += 1
+            try:
+                self._maybe_fail("compact-before-sstable")
+
+                def merged():
+                    empty_mem: list = []
+                    for ck, v in self._iter_merged(
+                            b"", sources=(empty_mem, captured)):
+                        if v is not None:
+                            yield ck, 0, v
+                stats = write_sstable(self._seg_path(seg_id), merged(),
+                                      block_bytes=self.block_bytes)
+                self._maybe_fail("compact-before-manifest")
+                reader = SSTableReader(self._seg_path(seg_id))
+                reader.seg_id = seg_id
+                with self._lock:
+                    if any(s not in self._segments for s in captured):
+                        # install_rows swapped the state mid-merge: the
+                        # merged output describes dead state — drop it
+                        reader.close()
+                        try:
+                            os.remove(reader.path)
+                        except OSError:
+                            pass
+                        return False
+                    kept = [s for s in self._segments if s not in captured]
+                    self._segments = [reader] + kept
+                    self._write_manifest_locked()
+                    self._graveyard.extend(captured)
+                    self._sweep_graveyard_locked()
+            finally:
+                self._unpin(captured)
+            for r in captured:
+                try:
+                    os.remove(r.path)
+                except OSError:
+                    pass
+            secs = time.monotonic() - t0
+            self._reg.inc("bcos_storage_compactions_total")
+            self._reg.observe("bcos_storage_compaction_seconds", secs)
+            LOG.info(badge("ENGINE", "compacted", merged=len(captured),
+                           segment=seg_id, records=stats["records"],
+                           bytes=stats["bytes"], ms=int(secs * 1000)))
+            self._publish_gauges()
+            return True
+
+    def _sweep_graveyard_locked(self) -> None:
+        # retired readers keep their fds briefly so in-flight reads finish
+        # (POSIX keeps unlinked data alive while the fd is open); close the
+        # oldest unpinned ones beyond a small cap
+        while len(self._graveyard) > 8:
+            for i, r in enumerate(self._graveyard):
+                if r.pins == 0:
+                    self._graveyard.pop(i).close()
+                    break
+            else:
+                return
+
+    def compact(self) -> None:
+        """Full flush+merge (SnapshotService calls this after pruning so
+        tombstoned history leaves the disk, like WalStorage.compact)."""
+        self.flush()
+        self.compact_once()
+
+    # -- snapshot integration ---------------------------------------------
+    def capture_rows(self):
+        """-> generator over a CONSISTENT (table, key, value) view frozen
+        at call time; call under `_lock` (snapshot export does), iterate
+        OUTSIDE it — rows stream straight from the immutable segments."""
+        mem_items, segs = self._pinned_view()
+
+        def rows():
+            try:
+                for ck, v in self._iter_merged(b"", sources=(mem_items,
+                                                             segs)):
+                    if v is not None:
+                        table, key = split_key(ck)
+                        yield table, key, v
+            finally:
+                self._unpin(segs)
+        return rows()
+
+    def install_rows(self, by_table: dict) -> None:
+        """Snapshot install fast path: write the rows straight to fresh
+        segments and swap the state in one manifest edge — no WAL
+        round-trip of the full snapshot through RAM, atomic under kill -9
+        (before the edge: old state; after: exactly the snapshot). Tables
+        the snapshot does NOT carry (node-private state like the PBFT
+        consensus log) keep their local rows, matching the 2PC install
+        path's table-by-table reconciliation."""
+        with self._flush_lock:
+            items = [(composite_key(t, k), 0, v)
+                     for t, rows in by_table.items()
+                     for k, v in rows.items()]
+            keep = set(by_table)
+            mem_items, segs = self._pinned_view()
+            try:
+                for ck, v in self._iter_merged(b"", sources=(mem_items,
+                                                             segs)):
+                    if v is not None and split_key(ck)[0] not in keep:
+                        items.append((ck, 0, v))
+            finally:
+                self._unpin(segs)
+            items.sort()
+            with self._lock:
+                seg_id = self._next_seg
+                self._next_seg += 1
+            stats = write_sstable(self._seg_path(seg_id),
+                                  iter(items), block_bytes=self.block_bytes)
+            reader = SSTableReader(self._seg_path(seg_id))
+            reader.seg_id = seg_id
+            with self._lock:
+                old = self._segments
+                self._mem = {}
+                self._mem_bytes = 0
+                self._frozen = []
+                self._prepared.clear()
+                self._wal_floor = self._wal.rotate()
+                self._segments = [reader]
+                self._write_manifest_locked()
+                self._wal.retire_below(self._wal_floor)
+                self._graveyard.extend(old)
+                self._sweep_graveyard_locked()
+            for r in old:
+                try:
+                    os.remove(r.path)
+                except OSError:
+                    pass
+            LOG.info(badge("ENGINE", "snapshot-installed",
+                           records=stats["records"], bytes=stats["bytes"]))
+            self._publish_gauges()
+
+    # -- observability -----------------------------------------------------
+    def disk_bytes(self) -> int:
+        with self._lock:
+            seg_bytes = sum(s.file_bytes for s in self._segments)
+        return seg_bytes + self._wal.tail_bytes()
+
+    def stats(self) -> dict:
+        with self._lock:
+            segs = [{"id": s.seg_id, "records": s.nrecords,
+                     "bytes": s.file_bytes} for s in self._segments]
+            mem_bytes = self._mem_bytes
+        probes, skips = self._bloom_probes, self._bloom_skips
+        return {
+            "backend": "disk",
+            "segments": segs,
+            "segment_count": len(segs),
+            "memtable_bytes": mem_bytes,
+            "wal_bytes": self._wal.tail_bytes(),
+            "disk_bytes": self.disk_bytes(),
+            "bloom_probes": probes,
+            "bloom_skips": skips,
+            "bloom_skip_rate": round(skips / probes, 4) if probes else None,
+        }
+
+    def _publish_commit_gauges_locked(self) -> None:
+        self._reg.set_gauge("bcos_storage_memtable_bytes", self._mem_bytes)
+        probes, skips = self._bloom_probes, self._bloom_skips
+        p0, s0 = self._bloom_pub
+        if probes > p0:
+            self._reg.inc("bcos_storage_bloom_probes_total", probes - p0)
+        if skips > s0:
+            self._reg.inc("bcos_storage_bloom_skips_total", skips - s0)
+        self._bloom_pub = (probes, skips)
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            nsegs = len(self._segments)
+            seg_bytes = sum(s.file_bytes for s in self._segments)
+            mem_bytes = self._mem_bytes
+        self._reg.set_gauge("bcos_storage_segments", nsegs)
+        self._reg.set_gauge("bcos_storage_disk_bytes",
+                            seg_bytes + self._wal.tail_bytes())
+        self._reg.set_gauge("bcos_storage_memtable_bytes", mem_bytes)
+        self._reg.set_gauge("bcos_storage_compaction_debt_bytes",
+                            seg_bytes if nsegs > 1 else 0)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._compactor is not None:
+            self._compactor.stop()
+        try:
+            self.flush()  # restart then needs no WAL replay at all
+        except Exception:
+            LOG.exception(badge("ENGINE", "close-flush-failed"))
+        with self._lock:
+            self._wal.close()
+            for r in self._segments + self._graveyard:
+                r.close()

@@ -32,6 +32,190 @@ from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
 _HDR = struct.Struct("<IQ")
 
 
+def pack_payload(block_number: int, cs: ChangeSet) -> bytes:
+    """One WAL record payload for a changeset (format in the module doc)."""
+    parts = [struct.pack("<QI", block_number, len(cs))]
+    for (table, key), e in cs.items():
+        tb = table.encode()
+        parts.append(struct.pack("<BH", 1 if e.deleted else 0, len(tb)))
+        parts.append(tb)
+        parts.append(struct.pack("<I", len(key)))
+        parts.append(key)
+        parts.append(struct.pack("<I", len(e.value)))
+        parts.append(e.value)
+    return b"".join(parts)
+
+
+def unpack_payload(payload: bytes
+                   ) -> tuple[int, list[tuple[bool, str, bytes, bytes]]]:
+    """-> (block_number, [(deleted, table, key, value)])."""
+    (block_number,) = struct.unpack_from("<Q", payload, 0)
+    off = 8
+    (n,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    out = []
+    for _ in range(n):
+        deleted = payload[off]
+        off += 1
+        (tl,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        table = payload[off:off + tl].decode()
+        off += tl
+        (kl,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        key = payload[off:off + kl]
+        off += kl
+        (vl,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        val = payload[off:off + vl]
+        off += vl
+        out.append((bool(deleted), table, key, val))
+    return block_number, out
+
+
+def scan_records(raw: bytes) -> tuple[list[bytes], int]:
+    """-> (payloads, valid_prefix_len): every checksummed record up to the
+    first torn/corrupt one (a kill -9 mid-append leaves a torn tail)."""
+    payloads: list[bytes] = []
+    off = 0
+    while off + _HDR.size <= len(raw):
+        crc, ln = _HDR.unpack_from(raw, off)
+        if off + _HDR.size + ln > len(raw):
+            break
+        payload = raw[off + _HDR.size: off + _HDR.size + ln]
+        if zlib.crc32(payload) != crc:
+            break
+        payloads.append(payload)
+        off += _HDR.size + ln
+    return payloads, off
+
+
+def truncate_torn_tail(path: str, valid_len: int, total_len: int) -> None:
+    """Cut a log back to its valid prefix, preserving the discarded
+    suffix aside (unique evidence file per incident) and logging the cut."""
+    from ..utils.log import LOG, badge
+    corrupt = path + ".corrupt"
+    seq = 1
+    while os.path.exists(corrupt):
+        corrupt = f"{path}.corrupt-{seq}"
+        seq += 1
+    with open(path, "rb") as f:
+        f.seek(valid_len)
+        tail = f.read()
+    with open(corrupt, "wb") as f:
+        f.write(tail)
+    LOG.warning(badge("WAL", "torn-tail-truncated", kept=valid_len,
+                      dropped=total_len - valid_len, saved=corrupt))
+    with open(path, "rb+") as f:
+        f.truncate(valid_len)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class WalCorruptionError(RuntimeError):
+    """Corruption in the MIDDLE of the WAL stream: durable records exist
+    beyond the damage, so replaying past it would silently apply newer
+    changesets over a gap of lost committed writes. Boot must refuse
+    (wipe + snap-sync is the recovery path), unlike a torn FINAL tail,
+    which is routine kill -9 fallout and is truncated."""
+
+
+class SegmentedWal:
+    """Rotated WAL segments for the disk engine (storage/engine.py).
+
+    Files are `wal-<seq>.log` in ascending append order. The engine
+    rotates at every memtable flush and — once the flush is durable in the
+    manifest — retires every segment below the flush floor, so the log
+    stops growing without bound between compactions (ISSUE 9 satellite).
+    Record format is WalStorage's (shared pack/scan helpers above); a new
+    boot always appends to a FRESH segment so recovery never writes behind
+    a truncated tail.
+    """
+
+    PREFIX = "wal-"
+    SUFFIX = ".log"
+
+    def __init__(self, path: str, start_seq: int):
+        self.path = path
+        self.active_seq = start_seq
+        self._f = open(self._segment_path(start_seq), "ab")
+
+    def _segment_path(self, seq: int) -> str:
+        return os.path.join(self.path, f"{self.PREFIX}{seq:08d}{self.SUFFIX}")
+
+    @classmethod
+    def list_segments(cls, path: str) -> list[tuple[int, str]]:
+        out = []
+        for name in os.listdir(path):
+            if name.startswith(cls.PREFIX) and name.endswith(cls.SUFFIX):
+                seq_s = name[len(cls.PREFIX):-len(cls.SUFFIX)]
+                if seq_s.isdigit():
+                    out.append((int(seq_s), os.path.join(path, name)))
+        return sorted(out)
+
+    @classmethod
+    def replay(cls, path: str, from_seq: int
+               ) -> Iterator[tuple[int, bytes]]:
+        """Yield (seq, payload) for every durable record in segments >=
+        from_seq. A torn tail on the FINAL segment is routine crash
+        fallout and is truncated in place; corruption with later records
+        still on disk (mid-segment rot, or a damaged non-final segment)
+        raises WalCorruptionError — replaying past the gap would lose
+        committed writes silently."""
+        segs = [(seq, p) for seq, p in cls.list_segments(path)
+                if seq >= from_seq]
+        for idx, (seq, seg_path) in enumerate(segs):
+            with open(seg_path, "rb") as f:
+                raw = f.read()
+            payloads, valid = scan_records(raw)
+            if valid < len(raw):
+                if idx < len(segs) - 1:
+                    raise WalCorruptionError(
+                        f"{seg_path}: corrupt record at offset {valid} "
+                        f"with {len(segs) - 1 - idx} later WAL segment(s) "
+                        "present — refusing to replay over lost committed "
+                        "records")
+                truncate_torn_tail(seg_path, valid, len(raw))
+            for p in payloads:
+                yield seq, p
+
+    def append(self, block_number: int, cs: ChangeSet) -> None:
+        payload = pack_payload(block_number, cs)
+        self._f.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def rotate(self) -> int:
+        """Close the active segment and start the next; returns the NEW
+        active seq — every record appended before the call lives in
+        segments strictly below it."""
+        self._f.close()
+        self.active_seq += 1
+        self._f = open(self._segment_path(self.active_seq), "ab")
+        return self.active_seq
+
+    def retire_below(self, floor_seq: int) -> int:
+        """Delete segments with seq < floor_seq (never the active one);
+        returns how many files were removed."""
+        removed = 0
+        for seq, seg_path in self.list_segments(self.path):
+            if seq < floor_seq and seq != self.active_seq:
+                try:
+                    os.remove(seg_path)
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    def tail_bytes(self) -> int:
+        return sum(os.path.getsize(p)
+                   for _, p in self.list_segments(self.path)
+                   if os.path.exists(p))
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class WalStorage(TransactionalStorage):
     SNAPSHOT = "snapshot.bin"
     LOG = "wal.log"
@@ -62,41 +246,18 @@ class WalStorage(TransactionalStorage):
         if os.path.exists(logp):
             with open(logp, "rb") as f:
                 raw = f.read()
-            off = 0
-            while off + _HDR.size <= len(raw):
-                crc, ln = _HDR.unpack_from(raw, off)
-                if off + _HDR.size + ln > len(raw):
-                    break  # torn tail record: drop
-                payload = raw[off + _HDR.size : off + _HDR.size + ln]
-                if zlib.crc32(payload) != crc:
-                    break
+            payloads, off = scan_records(raw)
+            for payload in payloads:
                 self._apply_payload(payload)
-                off += _HDR.size + ln
             if off < len(raw):
                 # a kill -9 mid-append leaves a torn/corrupt tail; appends
                 # after it would land BEHIND garbage and be unreadable on
-                # the next recovery — cut the log back to the valid prefix.
-                # The discarded suffix is preserved aside and the cut is
-                # logged: a few torn bytes are routine crash fallout, but a
-                # LARGE suffix means mid-file corruption ate committed
-                # records and an operator must know
-                from ..utils.log import LOG, badge
-                # unique evidence file per incident: a SECOND torn-tail
-                # crash must not overwrite the first one's preserved bytes
-                corrupt = logp + ".corrupt"
-                seq = 1
-                while os.path.exists(corrupt):
-                    corrupt = f"{logp}.corrupt-{seq}"
-                    seq += 1
-                with open(corrupt, "wb") as f:
-                    f.write(raw[off:])
-                LOG.warning(badge("WAL", "torn-tail-truncated",
-                                  kept=off, dropped=len(raw) - off,
-                                  saved=corrupt))
-                with open(logp, "rb+") as f:
-                    f.truncate(off)
-                    f.flush()
-                    os.fsync(f.fileno())
+                # the next recovery — cut the log back to the valid prefix
+                # (suffix preserved aside, cut logged: a few torn bytes are
+                # routine crash fallout, a LARGE suffix means mid-file
+                # corruption ate committed records and an operator must
+                # know)
+                truncate_torn_tail(logp, off, len(raw))
 
     def _load_snapshot(self, body: bytes) -> None:
         off = 0
@@ -217,16 +378,7 @@ class WalStorage(TransactionalStorage):
 
     # -- log/snapshot mechanics -------------------------------------------
     def _append_record(self, block_number: int, cs: ChangeSet) -> None:
-        parts = [struct.pack("<QI", block_number, len(cs))]
-        for (table, key), e in cs.items():
-            tb = table.encode()
-            parts.append(struct.pack("<BH", 1 if e.deleted else 0, len(tb)))
-            parts.append(tb)
-            parts.append(struct.pack("<I", len(key)))
-            parts.append(key)
-            parts.append(struct.pack("<I", len(e.value)))
-            parts.append(e.value)
-        payload = b"".join(parts)
+        payload = pack_payload(block_number, cs)
         self._log.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
         self._log.flush()
         os.fsync(self._log.fileno())

@@ -106,8 +106,13 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     # pipelined block production (scheduler/scheduler.py): off-thread
     # ordered commit + speculative next-height execution
     cp["scheduler"] = {"pipeline": str(cfg.pipeline_commit).lower()}
-    cp["storage"] = {"type": "wal" if cfg.storage_path else "memory",
-                     "path": cfg.storage_path or ""}
+    cp["storage"] = {"backend": cfg.storage_backend,
+                     "path": cfg.storage_path or "",
+                     # disk engine knobs (storage/engine.py)
+                     "memtable_mb": str(cfg.storage_memtable_mb),
+                     "compact_segments": str(cfg.storage_compact_segments),
+                     # reference storage.key_page_size (NodeConfig.cpp:620)
+                     "key_page_size": str(cfg.storage_key_page_size)}
     cp["snapshot"] = {"interval": str(cfg.snapshot_interval),
                       "retention": str(cfg.snapshot_retention),
                       "prune": str(cfg.snapshot_prune).lower(),
@@ -148,6 +153,9 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
     path = cp.get("storage", "path", fallback="") or None
     if path and base_dir and not os.path.isabs(path):
         path = os.path.join(base_dir, path)
+    # legacy configs carry `type = wal|memory` instead of `backend`
+    backend = cp.get("storage", "backend", fallback="") or \
+        cp.get("storage", "type", fallback="auto") or "auto"
     port_s = cp.get("rpc", "listen_port", fallback="")
     metrics_s = cp.get("monitor", "metrics_port", fallback="")
     p2p_port_s = cp.get("p2p", "listen_port", fallback="")
@@ -170,6 +178,13 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         sm_crypto=cp.getboolean("chain", "sm_crypto", fallback=False),
         groups=groups,
         storage_path=path,
+        storage_backend=backend,
+        storage_memtable_mb=cp.getint("storage", "memtable_mb",
+                                      fallback=64),
+        storage_compact_segments=cp.getint("storage", "compact_segments",
+                                           fallback=8),
+        storage_key_page_size=cp.getint("storage", "key_page_size",
+                                        fallback=0),
         txpool_limit=cp.getint("txpool", "limit", fallback=15000),
         block_limit_range=cp.getint("txpool", "block_limit_range",
                                     fallback=600),

@@ -53,6 +53,92 @@ def test_2pc_translate(kp):
     assert kp.get("t", b"c") is None
 
 
+def test_range_scan_reads_one_page(tmp_path):
+    """The property the page layout exists for: a `keys(prefix)` range
+    scan over rows co-resident in one page costs ONE backend page read,
+    not a per-row walk — and pages past the prefix range are never read."""
+    kp = KeyPageStorage(WalStorage(str(tmp_path / "kv")), page_size=4096)
+    for i in range(64):
+        kp.set("t", b"acct%04d" % i, b"balance-%d" % i)
+    for i in range(64):
+        kp.set("other", b"x%04d" % i, b"y")
+    kp.flush_caches()
+    base = kp.stats()["backend_reads"]
+    got = list(kp.keys("t", b"acct001"))
+    assert got == [b"acct%04d" % i for i in range(10, 20)]
+    reads = kp.stats()["backend_reads"] - base
+    # meta row + the page(s) covering the prefix range; with a 4KB page
+    # the 10 matching rows share one page -> 2 backend reads total
+    assert reads <= 2, f"range scan cost {reads} backend reads"
+    # the cached page serves the next scan with ZERO backend reads
+    base = kp.stats()["backend_reads"]
+    assert list(kp.keys("t", b"acct001")) == got
+    assert kp.stats()["backend_reads"] == base
+    kp.close()
+
+
+def test_point_get_reads_one_page(tmp_path):
+    kp = KeyPageStorage(WalStorage(str(tmp_path / "kv")), page_size=2048)
+    for i in range(100):
+        kp.set("t", b"row%04d" % i, b"v" * 40)
+    kp.flush_caches()
+    base = kp.stats()["backend_reads"]
+    assert kp.get("t", b"row0042") == b"v" * 40
+    assert kp.stats()["backend_reads"] - base <= 2  # meta + one page
+    kp.close()
+
+
+def test_tables_passthrough(kp):
+    kp.set("t", b"a", b"1")
+    kp.set("u", b"b", b"2")
+    assert kp.tables() == ["t", "u"]
+
+
+def test_keypage_over_disk_engine(tmp_path):
+    """The engine's value layout for wide tables ([storage] key_page_size):
+    row semantics over DiskStorage, surviving flush+compaction+reopen."""
+    from fisco_bcos_tpu.storage.engine import DiskStorage
+
+    st = DiskStorage(str(tmp_path / "db"), memtable_bytes=1 << 20,
+                     auto_compact=False)
+    kp = KeyPageStorage(st, page_size=1024)
+    for i in range(80):
+        kp.set("wide", b"w%04d" % i, b"v%d" % i)
+    kp.prepare(1, {("wide", b"tx-row"): Entry(b"committed"),
+                   ("wide", b"w0005"): Entry(b"", EntryStatus.DELETED)})
+    kp.commit(1)
+    st.flush()
+    st.compact_once()
+    kp.flush_caches()
+    assert kp.get("wide", b"w0004") == b"v4"
+    assert kp.get("wide", b"w0005") is None
+    assert kp.get("wide", b"tx-row") == b"committed"
+    assert len(list(kp.keys("wide", b"w00"))) == 79  # 80 rows - 1 deleted
+    kp.close()
+
+    st2 = DiskStorage(str(tmp_path / "db"), auto_compact=False)
+    kp2 = KeyPageStorage(st2, page_size=1024)
+    assert kp2.get("wide", b"w0042") == b"v42"
+    assert kp2.get("wide", b"w0005") is None
+    # the engine sees pages, not rows: far fewer backend keys than rows
+    backend_keys = list(st2.keys("wide"))
+    assert META_KEY in backend_keys
+    assert len(backend_keys) < 40
+    kp2.close()
+
+
+def test_make_storage_wires_keypage(tmp_path):
+    from fisco_bcos_tpu.storage import make_storage
+    from fisco_bcos_tpu.storage.engine import DiskStorage
+
+    st = make_storage("disk", str(tmp_path / "db"), key_page_size=2048)
+    assert isinstance(st, KeyPageStorage)
+    assert isinstance(st.backend, DiskStorage)
+    st.set("t", b"k", b"v")
+    assert st.get("t", b"k") == b"v"
+    st.close()
+
+
 def test_persistence_across_reopen(tmp_path):
     st = WalStorage(str(tmp_path / "kv"))
     kp = KeyPageStorage(st, page_size=128)

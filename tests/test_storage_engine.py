@@ -1,0 +1,477 @@
+"""Disk engine (storage/engine.py): 2PC contract parity vs MemoryStorage,
+crash recovery (incl. injected kill -9 points mid-flush/mid-compaction),
+tombstone semantics across flush+compaction, prefix scans spanning
+memtable+segments, WAL segment rotation/retirement, namespace isolation on
+one engine, and the snapshot capture/install fast paths."""
+
+import os
+import random
+import threading
+
+import pytest
+
+from fisco_bcos_tpu.storage import MemoryStorage, NamespacedStorage
+from fisco_bcos_tpu.storage.engine import DiskStorage
+from fisco_bcos_tpu.storage.interface import Entry, EntryStatus
+from fisco_bcos_tpu.storage.wal import SegmentedWal
+
+
+def _engine(tmp_path, name="db", **kw):
+    kw.setdefault("auto_compact", False)
+    kw.setdefault("memtable_bytes", 1 << 20)
+    return DiskStorage(str(tmp_path / name), **kw)
+
+
+def _dump(st, tables=("t", "u")):
+    out = {}
+    for table in tables:
+        for k in st.keys(table):
+            out[(table, k)] = st.get(table, k)
+    return out
+
+
+# -- 2PC contract parity ----------------------------------------------------
+def test_2pc_contract_basics(tmp_path):
+    st = _engine(tmp_path)
+    st.set("t", b"k0", b"v0")
+    cs = {("t", b"k1"): Entry(b"v1"),
+          ("t", b"k0"): Entry(b"", EntryStatus.DELETED)}
+    st.prepare(1, cs)
+    assert st.get("t", b"k1") is None  # not visible before commit
+    st.commit(1)
+    assert st.get("t", b"k1") == b"v1"
+    assert st.get("t", b"k0") is None
+    st.prepare(2, {("t", b"k2"): Entry(b"v2")})
+    st.rollback(2)
+    assert st.get("t", b"k2") is None
+    st.close()
+
+
+def test_randomized_parity_vs_memory(tmp_path):
+    """The same op stream applied to MemoryStorage and DiskStorage (with
+    flushes and compactions interleaved) must be observationally equal."""
+    rng = random.Random(1109)
+    mem = MemoryStorage()
+    disk = _engine(tmp_path)
+    keys = [b"k%03d" % i for i in range(60)]
+    block = 1
+    for step in range(600):
+        op = rng.random()
+        table = rng.choice(["t", "u"])
+        if op < 0.45:
+            k, v = rng.choice(keys), b"v%d" % step
+            mem.set(table, k, v)
+            disk.set(table, k, v)
+        elif op < 0.6:
+            k = rng.choice(keys)
+            mem.remove(table, k)
+            disk.remove(table, k)
+        elif op < 0.8:
+            cs = {(table, rng.choice(keys)): Entry(b"b%d" % step),
+                  (table, rng.choice(keys)): Entry(b"", EntryStatus.DELETED)}
+            mem.prepare(block, cs)
+            disk.prepare(block, cs)
+            if rng.random() < 0.85:
+                mem.commit(block)
+                disk.commit(block)
+            else:
+                mem.rollback(block)
+                disk.rollback(block)
+            block += 1
+        elif op < 0.93:
+            disk.flush()
+        else:
+            disk.flush()
+            disk.compact_once()
+    assert _dump(mem) == _dump(disk)
+    for table in ("t", "u"):
+        assert list(mem.keys(table, b"k0")) == list(disk.keys(table, b"k0"))
+    # ...and the exact same state after a clean restart
+    disk.close()
+    disk2 = _engine(tmp_path)
+    assert _dump(mem) == _dump(disk2)
+    disk2.close()
+
+
+def test_prepared_but_uncommitted_vanishes_on_crash(tmp_path):
+    st = _engine(tmp_path)
+    st.prepare(1, {("t", b"k"): Entry(b"v")})
+    st.commit(1)
+    st.prepare(2, {("t", b"gone"): Entry(b"x")})
+    # kill -9: no close(), reopen the directory cold
+    st2 = _engine(tmp_path)
+    assert st2.get("t", b"k") == b"v"
+    assert st2.get("t", b"gone") is None
+    st2.close()
+    st.close()
+
+
+# -- tombstones across flush + compaction -----------------------------------
+def test_tombstones_across_flush_and_compaction(tmp_path):
+    st = _engine(tmp_path)
+    for i in range(20):
+        st.set("t", b"d%02d" % i, b"v")
+    st.flush()  # rows now live in a segment
+    st.remove("t", b"d07")
+    st.prepare(1, {("t", b"d08"): Entry(b"", EntryStatus.DELETED)})
+    st.commit(1)
+    assert st.get("t", b"d07") is None  # memtable tombstone shadows segment
+    st.flush()  # tombstones now live in a NEWER segment
+    assert st.get("t", b"d07") is None
+    assert st.get("t", b"d08") is None
+    assert st.compact_once()  # full merge drops the tombstones for real
+    assert st.stats()["segment_count"] == 1
+    assert st.get("t", b"d07") is None
+    assert b"d07" not in list(st.keys("t"))
+    # the merged segment must not carry the deleted rows at all
+    seg = st._segments[0]
+    assert all(not k.endswith(b"d07") and not k.endswith(b"d08")
+               for k, _, _ in seg.iter_from())
+    st.close()
+    st2 = _engine(tmp_path)
+    assert st2.get("t", b"d07") is None
+    assert st2.get("t", b"d06") == b"v"
+    st2.close()
+
+
+def test_prefix_scan_spans_memtable_and_segments(tmp_path):
+    st = _engine(tmp_path)
+    for i in range(0, 30, 2):
+        st.set("t", b"p%02d" % i, b"old")
+    st.flush()
+    for i in range(1, 30, 2):
+        st.set("t", b"p%02d" % i, b"new")  # interleaved, memtable-only
+    st.set("t", b"p04", b"updated")        # shadows the segment copy
+    st.remove("t", b"p06")                 # tombstone over the segment copy
+    got = list(st.keys("t", b"p0"))
+    assert got == [b"p00", b"p01", b"p02", b"p03", b"p04", b"p05",
+                   b"p07", b"p08", b"p09"]
+    assert st.get("t", b"p04") == b"updated"
+    assert st.get("t", b"p05") == b"new"
+    assert st.get("t", b"p02") == b"old"
+    st.close()
+
+
+# -- WAL rotation / retirement ----------------------------------------------
+def test_wal_segments_retired_after_flush(tmp_path):
+    st = _engine(tmp_path)
+    for i in range(50):
+        st.prepare(i, {("t", b"w%02d" % i): Entry(b"x" * 100)})
+        st.commit(i)
+    path = st.path
+    pre = SegmentedWal.list_segments(path)
+    assert sum(os.path.getsize(p) for _, p in pre) > 5000
+    st.flush()
+    post = SegmentedWal.list_segments(path)
+    # everything below the flush floor is gone; only the fresh tail remains
+    assert len(post) == 1
+    assert os.path.getsize(post[0][1]) == 0
+    assert post[0][0] > pre[0][0]
+    st.close()
+
+
+def test_restart_replays_only_wal_tail(tmp_path):
+    st = _engine(tmp_path)
+    for i in range(100):
+        st.prepare(i, {("t", b"r%03d" % i): Entry(b"y" * 50)})
+        st.commit(i)
+    st.flush()
+    # a few post-flush commits form the tail
+    for i in range(100, 104):
+        st.prepare(i, {("t", b"r%03d" % i): Entry(b"z")})
+        st.commit(i)
+    # crash (no close); boot must read manifest + 4-record tail only
+    wal_bytes = sum(os.path.getsize(p)
+                    for _, p in SegmentedWal.list_segments(st.path))
+    assert wal_bytes < 500  # tail, not the 100-commit history
+    st2 = _engine(tmp_path)
+    assert st2.get("t", b"r050") == b"y" * 50
+    assert st2.get("t", b"r103") == b"z"
+    assert st2.stats()["segment_count"] == 1
+    st2.close()
+    st.close()
+
+
+def test_torn_final_wal_tail_truncated_and_recovers(tmp_path):
+    st = _engine(tmp_path)
+    st.prepare(1, {("t", b"good"): Entry(b"1")})
+    st.commit(1)
+    # kill -9 mid-append: garbage on the ACTIVE (final) segment
+    segs = SegmentedWal.list_segments(st.path)
+    with open(segs[-1][1], "ab") as f:
+        f.write(b"\xde\xad\xbe\xef\x00\x01")
+    st2 = _engine(tmp_path)
+    assert st2.get("t", b"good") == b"1"
+    st2.close()
+    st.close()
+
+
+def test_mid_stream_wal_corruption_refuses_boot(tmp_path):
+    """Corruption with LATER durable records behind it must refuse boot:
+    replaying over the gap would silently lose committed writes."""
+    from fisco_bcos_tpu.storage.wal import WalCorruptionError
+
+    st = _engine(tmp_path)
+    st.prepare(1, {("t", b"early"): Entry(b"1")})
+    st.commit(1)
+    first_seg = SegmentedWal.list_segments(st.path)[-1][1]
+    st._wal.rotate()
+    st.prepare(2, {("t", b"late"): Entry(b"2")})
+    st.commit(2)
+    # rot a byte in the MIDDLE of the earlier (non-final) segment
+    with open(first_seg, "rb+") as f:
+        f.seek(16)
+        b = f.read(1)
+        f.seek(16)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(WalCorruptionError):
+        _engine(tmp_path, name="db")
+    st.close()
+
+
+# -- injected kill -9 at every flush/compaction edge ------------------------
+@pytest.mark.parametrize("failpoint", [
+    "flush-before-sstable", "flush-before-manifest",
+    "manifest-before-current"])
+def test_kill9_mid_flush_recovers(tmp_path, failpoint):
+    st = _engine(tmp_path)
+    for i in range(30):
+        st.set("t", b"f%02d" % i, b"v%d" % i)
+    st.remove("t", b"f03")
+    st._failpoints.add(failpoint)
+    with pytest.raises(DiskStorage._FailPoint):
+        st.flush()
+    # simulate the crash: abandon the instance, reopen the directory
+    st2 = _engine(tmp_path)
+    assert st2.get("t", b"f00") == b"v0"
+    assert st2.get("t", b"f03") is None
+    assert st2.get("t", b"f29") == b"v29"
+    assert sorted(st2.tables()) == ["t"]
+    # and the recovered instance can flush cleanly
+    assert st2.flush()
+    st3_keys = list(st2.keys("t"))
+    assert len(st3_keys) == 29
+    st2.close()
+
+
+@pytest.mark.parametrize("failpoint", [
+    "compact-before-sstable", "compact-before-manifest"])
+def test_kill9_mid_compaction_recovers(tmp_path, failpoint):
+    st = _engine(tmp_path)
+    for i in range(10):
+        st.set("t", b"c%02d" % i, b"a")
+    st.flush()
+    st.remove("t", b"c04")
+    for i in range(10, 20):
+        st.set("t", b"c%02d" % i, b"b")
+    st.flush()
+    st._failpoints.add(failpoint)
+    with pytest.raises(DiskStorage._FailPoint):
+        st.compact_once()
+    st2 = _engine(tmp_path)
+    assert st2.get("t", b"c00") == b"a"
+    assert st2.get("t", b"c04") is None
+    assert st2.get("t", b"c15") == b"b"
+    assert st2.compact_once()
+    assert st2.get("t", b"c04") is None
+    assert st2.get("t", b"c15") == b"b"
+    st2.close()
+
+
+def test_flush_failure_keeps_live_instance_consistent(tmp_path):
+    """A failed flush folds the frozen memtable back: the SAME instance
+    (not just a reopened one) must still serve every row."""
+    st = _engine(tmp_path)
+    for i in range(10):
+        st.set("t", b"l%02d" % i, b"v")
+    st._failpoints.add("flush-before-sstable")
+    with pytest.raises(DiskStorage._FailPoint):
+        st.flush()
+    st._failpoints.clear()
+    assert st.get("t", b"l05") == b"v"
+    st.set("t", b"l99", b"late")
+    assert st.flush()
+    assert st.get("t", b"l05") == b"v"
+    assert st.get("t", b"l99") == b"late"
+    st.close()
+
+
+# -- namespace isolation on one engine --------------------------------------
+def test_namespace_isolation_on_one_engine(tmp_path):
+    st = _engine(tmp_path)
+    g0 = NamespacedStorage(st, "group0")
+    g1 = NamespacedStorage(st, "group1")
+    g0.set("t", b"k", b"zero")
+    g1.set("t", b"k", b"one")
+    # both groups legitimately prepare the SAME height concurrently
+    g0.prepare(5, {("t", b"h5"): Entry(b"g0")})
+    g1.prepare(5, {("t", b"h5"): Entry(b"g1")})
+    g0.commit(5)
+    g1.commit(5)
+    assert g0.get("t", b"k") == b"zero"
+    assert g1.get("t", b"k") == b"one"
+    assert g0.get("t", b"h5") == b"g0"
+    assert g1.get("t", b"h5") == b"g1"
+    assert g0.tables() == ["t"]
+    st.flush()
+    st.compact_once()
+    assert g0.get("t", b"k") == b"zero"
+    assert g1.get("t", b"k") == b"one"
+    st.close()
+    st2 = _engine(tmp_path)
+    assert NamespacedStorage(st2, "group1").get("t", b"k") == b"one"
+    st2.close()
+
+
+# -- background compaction bounds segments ----------------------------------
+def test_auto_compaction_bounds_segments_and_rss(tmp_path):
+    st = DiskStorage(str(tmp_path / "db"), memtable_bytes=8 << 10,
+                     max_segments=3, auto_compact=False)
+    for i in range(2000):
+        st.set("t", b"big%05d" % i, b"x" * 64)  # auto-flushes many times
+        if st.needs_compaction():
+            st.compact_once()
+    assert st.stats()["segment_count"] <= 4
+    assert st.get("t", b"big00000") == b"x" * 64
+    assert st.get("t", b"big01999") == b"x" * 64
+    assert len(list(st.keys("t", b"big0010"))) == 10
+    # dataset exceeded the memtable cap many times over: bounded memtable
+    assert st.stats()["memtable_bytes"] < 4 * (8 << 10)
+    assert st.stats()["disk_bytes"] > 2000 * 64
+    st.close()
+
+
+def test_reads_survive_concurrent_compaction(tmp_path):
+    st = DiskStorage(str(tmp_path / "db"), memtable_bytes=4 << 10,
+                     max_segments=2, auto_compact=False)
+    for i in range(500):
+        st.set("t", b"cc%04d" % i, b"v" * 32)
+    st.flush()
+    errors = []
+
+    def reader():
+        try:
+            for _ in range(300):
+                i = random.randrange(500)
+                assert st.get("t", b"cc%04d" % i) == b"v" * 32
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    while any(t.is_alive() for t in threads):
+        for i in range(500, 520):
+            st.set("t", b"cc%04d" % i, b"v" * 32)
+        st.flush()
+        st.compact_once()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    st.close()
+
+
+# -- snapshot fast paths -----------------------------------------------------
+def test_capture_rows_consistent_and_streams(tmp_path):
+    st = _engine(tmp_path)
+    for i in range(50):
+        st.set("t", b"s%02d" % i, b"v%d" % i)
+    st.flush()
+    st.set("u", b"mem-only", b"m")
+    rows = st.capture_rows()
+    # writes AFTER capture must not leak into the frozen view
+    first = next(rows)
+    st.set("t", b"s00", b"MUTATED")
+    st.set("z", b"new-table", b"n")
+    got = [first] + list(rows)
+    as_dict = {(t, k): v for t, k, v in got}
+    assert as_dict[("t", b"s00")] == b"v0"
+    assert as_dict[("u", b"mem-only")] == b"m"
+    assert ("z", b"new-table") not in as_dict
+    assert len(got) == 51
+    st.close()
+
+
+def test_install_rows_atomic_swap_preserves_private_tables(tmp_path):
+    st = _engine(tmp_path)
+    st.set("c_balance", b"old-acct", b"1")
+    st.set("c_pbft_log", b"round", b"local-consensus-state")
+    st.flush()
+    st.set("c_balance", b"old-mem", b"2")
+    by_table = {"c_balance": {b"alice": b"100", b"bob": b"7"},
+                "s_current_state": {b"current_number": (9).to_bytes(8, "big")}}
+    st.install_rows(by_table)
+    # snapshot tables replaced wholesale...
+    assert st.get("c_balance", b"old-acct") is None
+    assert st.get("c_balance", b"old-mem") is None
+    assert st.get("c_balance", b"alice") == b"100"
+    # ...tables the snapshot does not carry keep their local rows
+    assert st.get("c_pbft_log", b"round") == b"local-consensus-state"
+    st.close()
+    st2 = _engine(tmp_path)
+    assert st2.get("c_balance", b"alice") == b"100"
+    assert st2.get("c_pbft_log", b"round") == b"local-consensus-state"
+    assert st2.stats()["segment_count"] == 1
+    st2.close()
+
+
+# -- engine under the real scheduler ----------------------------------------
+def test_scheduler_commit_and_restart_on_disk_backend(tmp_path):
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.executor.executor import TransactionExecutor
+    from fisco_bcos_tpu.ledger.ledger import ConsensusNode, Ledger
+    from fisco_bcos_tpu.protocol import Block, BlockHeader
+    from fisco_bcos_tpu.scheduler.scheduler import Scheduler
+
+    suite = make_suite(backend="host")
+    st = _engine(tmp_path)
+    ledger = Ledger(st, suite)
+    kp = suite.generate_keypair(b"disk-node")
+    ledger.build_genesis([ConsensusNode(kp.pub_bytes)])
+    sched = Scheduler(st, ledger, TransactionExecutor(suite), suite, None)
+    blk = Block(header=BlockHeader(number=1, sealer_list=[kp.pub_bytes]))
+    result = sched.execute_block(blk)
+    assert result is not None
+    assert sched.commit_block(result.header)
+    assert ledger.current_number() == 1
+    sched.shutdown()
+    st.close()
+
+    st2 = _engine(tmp_path)
+    led2 = Ledger(st2, suite)
+    assert led2.current_number() == 1
+    h1 = led2.header_by_number(1)
+    assert h1 is not None and h1.hash(suite) == result.header.hash(suite)
+    st2.close()
+
+
+def test_metrics_published_with_group_label(tmp_path):
+    from fisco_bcos_tpu.utils.metrics import MetricsRegistry, for_group
+
+    reg = MetricsRegistry()
+    st = DiskStorage(str(tmp_path / "db"), memtable_bytes=1 << 20,
+                     auto_compact=False,
+                     registry=for_group("group7", reg))
+    for i in range(20):
+        st.set("t", b"m%02d" % i, b"v")
+    st.flush()
+    st.get("t", b"m00")       # segment probe -> bloom accounting
+    st.get("t", b"absent")    # negative lookup -> bloom skip
+    st.set("t", b"extra", b"v")
+    st.prepare(1, {("t", b"c"): Entry(b"x")})
+    st.commit(1)              # commit publishes the bloom counters
+    st.flush()
+    st.compact_once()
+    snap = reg.snapshot()
+    gauges, counters = snap["gauges"], snap["counters"]
+    assert gauges["bcos_storage_segments"] == 1
+    assert gauges["bcos_storage_segments{'group': 'group7'}"] == 1
+    assert gauges["bcos_storage_disk_bytes"] > 0
+    assert "bcos_storage_memtable_bytes" in gauges
+    assert "bcos_storage_compaction_debt_bytes" in gauges
+    assert counters["bcos_storage_compactions_total"] == 1
+    assert any(k.startswith("bcos_storage_bloom_probes_total")
+               for k in counters)
+    assert any(k.startswith("bcos_storage_compaction_seconds")
+               for k in snap["histograms"])
+    st.close()

@@ -54,6 +54,7 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
                 group_id: str = "group0", rpc_base_port: int | None = None,
                 encrypt_passphrase: bytes | None = None,
                 crypto_backend: str = "auto",
+                storage_backend: str = "auto",
                 metrics_base_port: int | None = None,
                 sm_tls: bool = False,
                 p2p_base_port: int | None = None,
@@ -83,6 +84,7 @@ def build_chain(out_dir: str, n_nodes: int, sm_crypto: bool = False,
         cfg = NodeConfig(
             chain_id=chain_id, group_id=group_id, sm_crypto=sm_crypto,
             storage_path="data", consensus=consensus,
+            storage_backend=storage_backend,
             crypto_backend=crypto_backend,
             rpc_port=(rpc_base_port + i) if rpc_base_port is not None else None,
             metrics_port=(metrics_base_port + i)
@@ -156,6 +158,11 @@ def main() -> None:
                     help="per-node Prometheus ports + monitor stack bundle")
     ap.add_argument("--sm-tls", action="store_true",
                     help="issue dual-cert SM-TLS credentials per node")
+    ap.add_argument("--storage", default="auto",
+                    choices=["auto", "memory", "wal", "disk"],
+                    help="[storage] backend: auto = WAL-backed; disk = "
+                         "log-structured engine (restart flat in chain "
+                         "length, datasets beyond RAM)")
     ap.add_argument("--encrypt-key", default=None,
                     help="passphrase to encrypt node keys at rest")
     ap.add_argument("--mode", default="air", choices=["air", "max"],
@@ -170,6 +177,7 @@ def main() -> None:
         group_id=args.group_id, rpc_base_port=args.rpc_base_port,
         p2p_base_port=args.p2p_base_port,
         metrics_base_port=args.metrics_base_port, sm_tls=args.sm_tls,
+        storage_backend=args.storage,
         encrypt_passphrase=args.encrypt_key.encode() if args.encrypt_key else None)
     if args.mode == "max":
         info["max_cluster"] = build_max_cluster(

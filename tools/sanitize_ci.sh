@@ -28,6 +28,13 @@
 #                                   # batch, assert cache-hit metrics
 #                                   # increment and a post-commit query
 #                                   # serves the cached bytes
+#   tools/sanitize_ci.sh --storage  # ONLY the disk-engine smoke: boot a
+#                                   # [storage] backend = disk daemon,
+#                                   # commit writes, kill -9 it, re-boot
+#                                   # and verify manifest + WAL-tail
+#                                   # recovery (no full-log replay) with
+#                                   # identical balances + head, then the
+#                                   # storage_compare bench row
 #   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
 #                                   # daemon hosting two groups ([groups]
 #                                   # ini), disjoint writes routed by the
@@ -393,6 +400,110 @@ EOF
     python benchmark/chain_bench.py --groups 2 --groups-compare \
     --cross-shard-pct 10 -n 1000 --backend host 2>/dev/null \
     | grep '"metric": "groups'
+  exit 0
+fi
+
+if [ "${1:-}" = "--storage" ]; then
+  echo "== [storage] disk-engine smoke: boot disk backend, write," \
+       "SIGKILL, re-boot without replay, verify"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python - <<'EOF'
+import os, re, shutil, signal, subprocess, sys, tempfile, time
+sys.path.insert(0, "tools")
+from build_chain import build_chain
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import SdkClient, TransactionBuilder
+from fisco_bcos_tpu.crypto.suite import make_suite
+
+work = tempfile.mkdtemp(prefix="storage-smoke-")
+proc = None
+try:
+    from fisco_bcos_tpu.testing.chaos import free_port_block
+    port = free_port_block(2)
+    info = build_chain(work, 1, consensus="solo", rpc_base_port=port,
+                       p2p_base_port=port + 1,
+                       crypto_backend="host", storage_backend="disk")
+    node_dir = info["nodes"][0]["dir"]
+    # flush on every commit: kill -9 lands mid-flush/compaction territory
+    cfgp = os.path.join(node_dir, "config.ini")
+    cfg = open(cfgp).read()
+    cfg = cfg.replace("memtable_mb = 64", "memtable_mb = 0")
+    cfg = cfg.replace("compact_segments = 8", "compact_segments = 2")
+    open(cfgp, "w").write(cfg)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+    def boot():
+        return subprocess.Popen(
+            [sys.executable, "-m", "fisco_bcos_tpu", node_dir,
+             "--log-file", os.path.join(node_dir, "daemon.log")],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+
+    def wait_rpc(cli, deadline=120):
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            try:
+                return cli.get_block_number()
+            except Exception:
+                time.sleep(0.25)
+        raise TimeoutError("rpc never came up")
+
+    proc = boot()
+    cli = SdkClient(f"http://127.0.0.1:{port}", group=info["group_id"])
+    wait_rpc(cli)
+    suite = make_suite(False, backend="host")
+    kp = suite.generate_keypair(b"storage-smoke")
+    builder = TransactionBuilder(suite, None, chain_id=info["chain_id"],
+                                 group_id=info["group_id"])
+    for i in range(6):
+        tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                           pc.encode_call("register",
+                                          lambda w, i=i: w.blob(b"sk%d" % i)
+                                          .u64(10 + i)),
+                           nonce=f"ss{i}", block_limit=100)
+        rc = cli.send_transaction(tx, wait=True)
+        assert rc["status"] == 0, rc
+    head = cli.get_block_number()
+    head_hash = cli.request("getBlockHashByNumber",
+                            [info["group_id"], "", head])
+    assert head >= 1
+
+    proc.send_signal(signal.SIGKILL)   # no flush, no goodbye
+    proc.wait(timeout=30)
+    proc = boot()                      # same data dir
+    wait_rpc(cli)
+    log = open(os.path.join(node_dir, "daemon.log")).read()
+    recov = re.findall(r"\[ENGINE\]\[recovered\].*?segments=(\d+)"
+                       r".*?wal_records=(\d+)", log)
+    assert recov, "no engine recovery badge after kill -9"
+    segments, wal_records = map(int, recov[-1])
+    assert segments >= 1, "boot found no durable segments"
+    assert wal_records <= 6, \
+        f"boot replayed {wal_records} WAL records — that is a full replay"
+    assert cli.get_block_number() == head
+    assert cli.request("getBlockHashByNumber",
+                       [info["group_id"], "", head]) == head_hash
+    for i in range(6):
+        out = cli.request("call", [info["group_id"], "",
+                                   "0x" + pc.BALANCE_ADDRESS.hex(),
+                                   "0x" + pc.encode_call(
+                                       "balanceOf",
+                                       lambda w, i=i: w.blob(b"sk%d" % i)
+                                   ).hex()])
+        assert int(out["output"][2:], 16) == 10 + i
+    print("sanitize_ci: STORAGE STAGE CLEAN "
+          f"(head={head}, segments={segments}, "
+          f"wal_tail_records={wal_records})")
+finally:
+    if proc is not None and proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+  echo "== [storage] disk-vs-memory bench row"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python benchmark/chain_bench.py --storage-compare -n 400 \
+    --tx-count-limit 100 --storage-memtable-mb 1 2>/dev/null \
+    | grep '"metric": "storage_compare"'
   exit 0
 fi
 

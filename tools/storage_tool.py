@@ -2,7 +2,9 @@
 """storage-tool — inspect and repair a node's storage offline.
 
 Reference counterpart: /root/reference/tools/storage-tool (RocksDB
-inspection utility). Operates on a stopped node's WAL storage directory.
+inspection utility). Operates on a stopped node's storage directory —
+WAL-backed or the disk engine (auto-detected by its CURRENT manifest
+pointer; `stats` then also reports segments/memtable/bloom counters).
 
 Commands:
   stats  <path>                      table/row/byte counts
@@ -41,6 +43,17 @@ def _open(path: str):
              for s in cluster["shards"]], recover=False)
     if not os.path.isdir(path):
         raise SystemExit(f"no storage directory at {path}")
+    # disk-engine layout: CURRENT manifest pointer, or (before the first
+    # flush ever wrote a manifest) rotated wal-*.log / seg-*.sst files —
+    # opening those as WalStorage would report an empty store
+    names = os.listdir(path)
+    if "CURRENT" in names or any(
+            (n.startswith("wal-") and n.endswith(".log"))
+            or (n.startswith("seg-") and n.endswith(".sst"))
+            for n in names):
+        from fisco_bcos_tpu.storage.engine import DiskStorage
+
+        return DiskStorage(path, auto_compact=False)
     return WalStorage(path)
 
 
@@ -75,6 +88,9 @@ def main() -> None:
                 out[t] = {"rows": len(ks),
                           "bytes": sum(len(k) + len(v or b"")
                                        for k, v in zip(ks, vs))}
+            engine_stats = getattr(st, "stats", None)
+            if engine_stats is not None:
+                out["_engine"] = engine_stats()
             print(json.dumps(out, indent=1))
         elif args.cmd == "scan":
             prefix = bytes.fromhex(args.prefix) if args.prefix else b""
