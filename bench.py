@@ -456,6 +456,29 @@ def main() -> None:
         except Exception:
             pass
         try:
+            # supplementary: the tracing plane's per-stage latency
+            # decomposition + its reconciliation against measured e2e p50
+            # (utils/otrace.py; round 12). BENCH_TRACE_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--trace-profile", "--backend", "host"],
+                "BENCH_TRACE_TIMEOUT", 240)
+            summ = next((r for r in rows
+                         if r.get("metric") == "trace_profile_summary"),
+                        None)
+            if summ:
+                line["trace_e2e_p50_ms"] = summ.get("e2e_p50_ms")
+                line["trace_stage_sum_ms"] = summ.get("stage_sum_ms")
+                line["trace_coverage"] = summ.get("coverage")
+                line["trace_stages_ms"] = {
+                    r["stage"]: r["mean_ms"] for r in rows
+                    if r.get("metric") == "trace_profile"}
+        except _SkipStage:
+            pass
+        except Exception as exc:
+            print(f"[bench] trace-profile bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: concurrent RPC ingest through the
             # continuous-batching lane (txpool/ingest.py) — the serving-
             # stack amortization row. BENCH_INGEST_TIMEOUT=0 skips it

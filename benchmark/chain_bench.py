@@ -151,6 +151,9 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
                                ingest_lane=ingest_lane,
                                ingest_max_wait_ms=max_wait_ms,
                                pipeline_commit=pipeline,
+                               # benches measure the untraced hot path;
+                               # --trace-profile reconfigures explicitly
+                               trace_sample_rate=0.0, trace_slow_ms=0.0,
                                rpc_port=0 if rpc_on_first and i == 0
                                else None),
                     keypair=kp, gateway=gw)
@@ -963,6 +966,120 @@ def _emit_read_mode(args, sm: bool) -> None:
         }), flush=True)
 
 
+def run_trace_profile(sm: bool, backend: str, n_txs: int = 24) -> list:
+    """End-to-end latency decomposition from the tracing plane
+    (utils/otrace.py): a 4-node chain at sample_rate=1, `n_txs` closed-loop
+    transactions each carrying its own trace root, stages aggregated from
+    the INGRESS node's spans. Emits one row per stage plus a summary whose
+    `coverage` reconciles the stage sum against the independently measured
+    submit->receipt p50 — the check that the stages account for the
+    transaction's wall-clock rather than a subset of it."""
+    import statistics as _stats
+
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.utils import otrace
+
+    nodes, gateways, _tls = _build_chain(sm, backend, 1000,
+                                         min_seal_time=0.0)
+    otrace.TRACER.configure(sample_rate=1.0, ring_size=16384, slow_ms=0.0)
+    otrace.TRACER.reset()
+    ingress = nodes[0]
+    suite = ingress.suite
+    kp = suite.generate_keypair(b"trace-profile-client")
+    for node in nodes:
+        node.start()
+    e2e_ms: list[float] = []
+    roots = []
+    try:
+        for i in range(n_txs):
+            tx = Transaction(
+                to=pc.BALANCE_ADDRESS,
+                input=pc.encode_call(
+                    "register", lambda w, _i=i: w.blob(
+                        b"tp%d" % _i).u64(10 + _i)),
+                nonce=f"tp{i}", block_limit=500).sign(suite, kp)
+            root = otrace.TRACER.new_root()
+            tx._otrace = root
+            roots.append(root)
+            t0 = time.perf_counter()
+            res = ingress.send_transaction(tx)
+            rc = ingress.txpool.wait_for_receipt(res.tx_hash, 30)
+            if rc is None:
+                raise RuntimeError(f"tx {i} never committed")
+            e2e_ms.append((time.perf_counter() - t0) * 1000.0)
+        time.sleep(0.3)  # let follower stage spans drain into the ring
+    finally:
+        for node in nodes:
+            node.stop()
+        for gw in set(gateways):
+            gw.stop()
+
+    label = ingress.trace_label
+    # ONE span per (trace, stage), chosen to follow the transaction's
+    # actual PATH across the cluster (every node records its own copy of
+    # the block stages; mixing them would count each stage four times):
+    # admission on the INGRESS node, the gossiped copy's re-admission on
+    # the block's LEADER (its lane coalesce is real path latency — the
+    # tx cannot seal before it), `seal` on the leader, and the block
+    # stages on the ingress node, whose commit+notify is what resolves
+    # the client's receipt wait.
+    per_stage: dict[str, list[float]] = {}
+    stitched_nodes: set = set()
+    for root in roots:
+        spans = otrace.TRACER.get_trace(root.trace_id.hex())
+        leader = next((s["attrs"].get("node") for s in spans
+                       if s["name"] == "seal"), label)
+        chosen: dict[str, dict] = {}
+        for s in spans:
+            node = s["attrs"].get("node")
+            stitched_nodes.add(node or s["attrs"].get("node_idx"))
+            name = s["name"]
+            if name == "ingest.admit":
+                if node == leader and leader != label:
+                    chosen.setdefault("gossip.admit", s)
+                    continue
+                want = label
+            elif name == "seal":
+                want = leader
+            elif name.startswith("stage."):
+                want = label
+            else:
+                continue
+            cur = chosen.get(name)
+            if cur is None or (node == want
+                               and cur["attrs"].get("node") != want):
+                chosen[name] = s
+        for name, s in chosen.items():
+            per_stage.setdefault(name, []).append(s["duration_ms"])
+    rows = []
+    stage_sum = 0.0
+    for name in sorted(per_stage):
+        if name in ("stage.finish", "txpool.admit"):
+            continue  # finish is a zero-width stamp; admit nests in ingest
+        mean = _stats.mean(per_stage[name])
+        stage_sum += mean
+        rows.append({"metric": "trace_profile", "unit": "ms",
+                     "suite": "sm" if sm else "ecdsa", "stage": name,
+                     "mean_ms": round(mean, 3),
+                     "count": len(per_stage[name])})
+    p50 = _stats.median(e2e_ms) if e2e_ms else 0.0
+    rows.append({
+        "metric": "trace_profile_summary", "unit": "ms",
+        "suite": "sm" if sm else "ecdsa",
+        "txs": len(e2e_ms),
+        "stage_sum_ms": round(stage_sum, 3),
+        "e2e_p50_ms": round(p50, 3),
+        "e2e_mean_ms": round(_stats.mean(e2e_ms), 3) if e2e_ms else 0.0,
+        # stage-sum / measured p50: ~1.0 means the decomposition accounts
+        # for the transaction's wall-clock end to end
+        "coverage": round(stage_sum / p50, 3) if p50 else None,
+        "nodes_stitched": len({n for n in stitched_nodes
+                               if n not in (None, "")}),
+    })
+    return rows
+
+
 def run_storage_child(backend: str, n: int, tx_count_limit: int,
                       memtable_mb: int) -> dict:
     """ONE backend's sustained-write run in THIS process (the parent
@@ -1149,6 +1266,13 @@ def main() -> None:
                     help="with --storage-compare: disk-engine memtable cap "
                          "(small by default so the dataset spills to "
                          "segments and RSS boundedness is actually tested)")
+    ap.add_argument("--trace-profile", action="store_true",
+                    help="latency-attribution mode: closed-loop traced "
+                         "txs through a 4-node chain at sample_rate=1; "
+                         "emits the per-stage decomposition table and its "
+                         "reconciliation against measured e2e p50")
+    ap.add_argument("--trace-txs", type=int, default=24,
+                    help="with --trace-profile: closed-loop tx count")
     ap.add_argument("--pipeline-profile", action="store_true",
                     help="direct mode: also emit pipeline_tps and a per-"
                          "stage (fill/execute/roots/consensus_wait/commit) "
@@ -1171,6 +1295,11 @@ def main() -> None:
     if args.sync_bench:
         for sm in suites:
             for row in run_sync_bench(sm, args.sync_blocks):
+                print(json.dumps(row), flush=True)
+        return
+    if args.trace_profile:
+        for sm in suites:
+            for row in run_trace_profile(sm, args.backend, args.trace_txs):
                 print(json.dumps(row), flush=True)
         return
     if args.groups > 0:

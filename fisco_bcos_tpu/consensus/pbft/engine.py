@@ -44,7 +44,9 @@ import numpy as np
 from ...net.front import FrontService
 from ...net.moduleid import ModuleID
 from ...protocol import Block, BlockHeader
+from ...utils import otrace
 from ...utils.log import LOG, badge, metric
+from ...utils.trace import block_trace
 from ...utils.worker import Worker
 from .messages import (
     PacketType,
@@ -68,7 +70,8 @@ class _ProposalCache:
     __slots__ = ("proposal", "proposal_hash", "prepares", "commits",
                  "checkpoints", "checkpoint_msgs", "prepared",
                  "committed_phase", "executed", "executed_hash",
-                 "executed_header", "preprepare_msg")
+                 "executed_header", "preprepare_msg", "trace_ctx",
+                 "t_accept")
 
     def __init__(self):
         self.proposal: Optional[Block] = None
@@ -83,6 +86,11 @@ class _ProposalCache:
         self.executed = False
         self.executed_hash: bytes = b""
         self.executed_header = None  # the FINALISED header (roots filled)
+        # otrace span context of the round's block (leader: adopted from
+        # the sealed block; replicas: from the pre-prepare's p2p envelope)
+        # + the monotonic accept stamp closing the pbft.consensus span
+        self.trace_ctx = None
+        self.t_accept: float = 0.0
 
 
 class PBFTEngine(Worker):
@@ -96,6 +104,9 @@ class PBFTEngine(Worker):
         # aligned clock source (tool/timesync.py median); raw UTC fallback
         self.clock_ms = clock_ms or (lambda: int(time.time() * 1000))
         self.keypair = keypair
+        # node label for the block-trace registry + span attribution (the
+        # same derivation Node uses, so all of a node's layers agree)
+        self.trace_label = keypair.pub_bytes[:4].hex()
         self.front = front
         self.txpool = txpool
         self.sealer = sealer
@@ -317,6 +328,11 @@ class PBFTEngine(Worker):
         except Exception:
             LOG.warning(badge("PBFT", "bad-packet", src=src[:8].hex()))
             return
+        # the frame's span context (front.py scopes the delivery thread)
+        # crosses to the worker pinned on the message object
+        ctx = otrace.current()
+        if ctx is not None:
+            msg._otrace = ctx
         self._inbox.put(("msg", msg))
         self.wakeup()
 
@@ -352,9 +368,13 @@ class PBFTEngine(Worker):
             else:
                 msgs.append(item)  # type: ignore[arg-type]
         for msg in self._batch_checked(msgs):
-            self._dispatch(msg)
+            # handle each packet under its carried span context: votes and
+            # fetches it triggers inherit (and re-propagate) the trace
+            with otrace.ctx_scope(getattr(msg, "_otrace", None)):
+                self._dispatch(msg)
         for block in local:
-            self._broadcast_preprepare(block)
+            with otrace.ctx_scope(getattr(block, "_otrace", None)):
+                self._broadcast_preprepare(block)
         if time.monotonic() > self._deadline:
             self._on_timeout()
 
@@ -490,6 +510,12 @@ class PBFTEngine(Worker):
         cache = self._cache(number)
         cache.proposal = block
         cache.proposal_hash = phash
+        cache.trace_ctx = getattr(block, "_otrace", None) or \
+            otrace.current()
+        cache.t_accept = time.monotonic()
+        if cache.trace_ctx is not None:
+            block_trace(number, owner=self.trace_label).bind(
+                cache.trace_ctx)
         wire_block = block
         if not self.full_proposals and block.transactions:
             # metadata-only broadcast; the full block stays in our cache
@@ -554,6 +580,14 @@ class PBFTEngine(Worker):
         cache.proposal = block
         cache.proposal_hash = msg.proposal_hash
         cache.preprepare_msg = msg
+        # replica-side trace stitch: the leader's span context rode the
+        # pre-prepare's p2p envelope — adopt it for this round so THIS
+        # node's consensus/execute/commit spans land in the same trace
+        cache.trace_ctx = otrace.current()
+        cache.t_accept = time.monotonic()
+        if cache.trace_ctx is not None:
+            block_trace(msg.number, owner=self.trace_label).bind(
+                cache.trace_ctx)
         # mark the proposal's txs sealed so this node's sealer (if it leads
         # a later in-flight height) never packs them into a second proposal
         # (the reference's asyncMarkTxs on proposal receipt)
@@ -643,6 +677,10 @@ class PBFTEngine(Worker):
             return
         if cache.proposal is None:
             return
+        with otrace.ctx_scope(cache.trace_ctx):
+            self._advance_quorums(number, cache)
+
+    def _advance_quorums(self, number: int, cache: _ProposalCache) -> None:
         phash = cache.proposal_hash
         prepares = sum(1 for m in cache.prepares.values()
                        if m.proposal_hash == phash)
@@ -674,6 +712,10 @@ class PBFTEngine(Worker):
                 1, thread_name_prefix="pbft-exec")
         self._executing = number
         proposal, phash = cache.proposal, cache.proposal_hash
+        # latency attribution: time from proposal accept to execution
+        # start (pre-prepare/prepare/commit quorum collection + any
+        # execution-lane queueing) — stamps the shared per-block trace
+        block_trace(number, owner=self.trace_label).stage("consensus_pre")
 
         def run() -> None:
             try:
@@ -718,7 +760,8 @@ class PBFTEngine(Worker):
                                           number, self.index,
                                           cache.executed_hash, seal))
             cache.checkpoint_msgs[self.index] = ck
-            self.front.broadcast(ModuleID.PBFT, ck.encode())
+            with otrace.ctx_scope(cache.trace_ctx):
+                self.front.broadcast(ModuleID.PBFT, ck.encode())
         metric("pbft.executed", number=number,
                ehash=cache.executed_hash[:8].hex())
         self._try_advance(number)
@@ -744,6 +787,15 @@ class PBFTEngine(Worker):
                     cache.checkpoints.pop(i, None)
             return
         cache.committed_phase = True
+        if cache.trace_ctx is not None and cache.t_accept:
+            # one consensus span per node per block: proposal accept ->
+            # checkpoint quorum decided (the durable 2PC is the trace's
+            # stage.commit span) — attributed to this node, so a stitched
+            # trace shows the round on every replica
+            otrace.TRACER.record(
+                "pbft.consensus", cache.trace_ctx, cache.t_accept,
+                attrs={"number": number, "node_idx": self.index,
+                       "node": self.trace_label, "view": self.view})
         # commit the EXECUTED result's header, not the proposal's: the two
         # are the same object for the in-process scheduler (finalised in
         # place) but differ behind a scheduler-service proxy, where the

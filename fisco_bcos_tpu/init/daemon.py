@@ -218,10 +218,15 @@ class NodeDaemon:
             self.rpc_pool = WorkerPool(cfg.rpc_workers)
             self.rpc_pool.start()
         if cfg.rpc_port is not None:
+            from ..rpc.ops import OpsRoutes
             from ..rpc.server import JsonRpcServer
+            # ops surface on the shared edge: /status reports the primary
+            # group's document (it carries the full group registry)
             self.rpc = JsonRpcServer(impl, host=cfg.rpc_host,
                                      port=cfg.rpc_port, pool=self.rpc_pool,
-                                     keepalive_s=cfg.rpc_keepalive_s)
+                                     keepalive_s=cfg.rpc_keepalive_s,
+                                     ops=OpsRoutes(
+                                         status_fn=self.node.system_status))
             self.rpc.start()
         if cfg.ws_port is not None:
             from ..rpc.ws_server import WsRpcServer
@@ -231,7 +236,8 @@ class NodeDaemon:
         if cfg.metrics_port is not None:
             from ..utils.metrics import MetricsServer
             self.metrics = MetricsServer(host=cfg.rpc_host,
-                                         port=cfg.metrics_port)
+                                         port=cfg.metrics_port,
+                                         status_fn=self.node.system_status)
             self.metrics.start()
         LOG.info(badge("DAEMON", "up-multigroup", pid=os.getpid(),
                        node=kp.pub_bytes[:8].hex(),

@@ -122,6 +122,15 @@ class NodeConfig:
     # micro-window for device deployments (0 = merge in-flight only).
     crypto_lane: bool = True
     crypto_lane_wait_ms: float = 0.0
+    # tracing plane ([trace] ini, utils/otrace.py): sample_rate samples
+    # NEW root traces (an incoming sampled traceparent is always honored);
+    # ring_size bounds the in-process span ring served by getTrace and
+    # /trace; spans slower than slow_ms are ALWAYS retained (never
+    # sampled out) in a separate slow ring + logged. sample_rate=0 with
+    # slow_ms=0 turns the whole plane into one branch on the hot path.
+    trace_sample_rate: float = 0.02
+    trace_ring_size: int = 4096
+    trace_slow_ms: float = 1000.0
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
     # serving read plane (rpc/edge.py + rpc/cache.py): one bounded worker
@@ -159,6 +168,15 @@ class Node:
         # totals, so G in-process stacks stay tellable apart
         from ..utils.metrics import for_group
         self.metrics_view = for_group(cfg.group_id)
+        # tracing plane: the process tracer adopts this node's [trace]
+        # knobs (one node per process in deployments; in-process clusters
+        # share the tracer and are told apart by the per-node trace label
+        # stamped on spans)
+        from ..utils import otrace
+        otrace.configure(sample_rate=cfg.trace_sample_rate,
+                         ring_size=cfg.trace_ring_size,
+                         slow_ms=cfg.trace_slow_ms)
+        self.trace_label = self.keypair.pub_bytes[:4].hex()
         # storage injection seam — the reference's StorageInitializer picks
         # RocksDB vs TiKV (libinitializer/Initializer.cpp:145-261); callers
         # pass e.g. a storage.sharded.ShardedStorage cluster for Max mode,
@@ -182,11 +200,13 @@ class Node:
             self.txpool, max_batch=cfg.ingest_max_batch,
             max_wait_ms=cfg.ingest_max_wait_ms,
             queue_cap=cfg.ingest_queue_cap,
-            registry=self.metrics_view) if cfg.ingest_lane else None
+            registry=self.metrics_view,
+            trace_label=self.trace_label) if cfg.ingest_lane else None
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool,
-                                   pipeline=cfg.pipeline_commit)
+                                   pipeline=cfg.pipeline_commit,
+                                   trace_label=self.trace_label)
         from ..tool.timesync import NodeTimeMaintenance
         self.timesync = NodeTimeMaintenance()
         # solo mode commits synchronously inside the proposal callback, so
@@ -197,7 +217,8 @@ class Node:
                              cfg.tx_count_limit, cfg.min_seal_time,
                              clock_ms=self.timesync.aligned_time_ms,
                              max_seal_time=cfg.max_seal_time,
-                             pipeline_busy=busy)
+                             pipeline_busy=busy,
+                             trace_label=self.trace_label)
         self._commit_lock = threading.Lock()
         self.consensus = None  # bound by PBFT wiring in start()
         self.front: Optional[FrontService] = None
@@ -245,10 +266,15 @@ class Node:
             self.rpc_pool = WorkerPool(cfg.rpc_workers)
             impl = self.make_rpc_impl()
             if cfg.rpc_port is not None:
+                # the RPC edge doubles as the ops surface: GET /metrics,
+                # /status, /trace served from the same event loop
+                from ..rpc.ops import OpsRoutes
                 self.rpc = JsonRpcServer(impl, host=cfg.rpc_host,
                                          port=cfg.rpc_port,
                                          pool=self.rpc_pool,
-                                         keepalive_s=cfg.rpc_keepalive_s)
+                                         keepalive_s=cfg.rpc_keepalive_s,
+                                         ops=OpsRoutes(
+                                             status_fn=self.system_status))
             if cfg.ws_port is not None:
                 from ..rpc.ws_server import WsRpcServer
                 self.ws = WsRpcServer(impl, host=cfg.rpc_host,
@@ -257,7 +283,8 @@ class Node:
         if cfg.metrics_port is not None:
             from ..utils.metrics import MetricsServer
             self.metrics = MetricsServer(host=cfg.rpc_host,
-                                         port=cfg.metrics_port)
+                                         port=cfg.metrics_port,
+                                         status_fn=self.system_status)
         self._started = False
 
     # -- RPC impl wiring ---------------------------------------------------
@@ -283,6 +310,41 @@ class Node:
             self.scheduler.on_invalidate.append(self.query_cache.invalidate)
             return impl
         return JsonRpcImpl(self)
+
+    # -- aggregated operational state (getSystemStatus RPC + /status) ------
+    def system_status(self) -> dict:
+        """One group-labeled JSON document collecting what used to be
+        scattered across RPC methods, logs and bench hooks: pipeline
+        occupancy, ingest/crypto-lane/storage/cache stats, sync mode,
+        txpool depth, the group registry and the tracer. Every value is a
+        cheap snapshot read — safe to poll."""
+        from ..utils import otrace
+        cfg = self.config
+        bs = self.blocksync
+        lane = getattr(self.suite, "_lane", None)  # LaneSuite seam
+        storage_stats = getattr(self.storage, "stats", None)
+        reg = self.group_registry
+        out = {
+            "group": cfg.group_id,
+            "chain": cfg.chain_id,
+            "node": self.keypair.pub_bytes.hex(),
+            "blockNumber": self.ledger.current_number(),
+            "syncMode": bs.sync_mode if bs is not None else "replay",
+            "txpool": {**self.txpool.status(),
+                       "unsealed": self.txpool.pending_count()},
+            "ingest": self.ingest.stats() if self.ingest else None,
+            "pipeline": self.scheduler.pipeline_stats(),
+            "storage": storage_stats() if callable(storage_stats)
+            else {"backend": type(self.storage).__name__},
+            "cache": self.query_cache.stats() if self.query_cache else None,
+            "snapshot": self.snapshot.status(),
+            "consensus": self.consensus.status()
+            if self.consensus is not None else None,
+            "cryptoLane": lane.stats() if lane is not None else None,
+            "groups": reg.groups() if reg is not None else [cfg.group_id],
+            "trace": otrace.TRACER.stats(),
+        }
+        return out
 
     # -- genesis -----------------------------------------------------------
     def build_genesis(self, sealers: Optional[list[ConsensusNode]] = None) -> None:

@@ -7,12 +7,22 @@ libinitializer/FrontServiceInitializer.cpp:89-155 (PBFT, TxsSync,
 ConsTxsSync, BlockSync handlers).
 
 Envelope (deterministic wire codec):
-    u16 module | u8 kind (0 push, 1 request, 2 response) | u64 seq | blob payload
+    u16 module | u8 kind (0 push, 1 request, 2 response) | u64 seq
+    | blob payload | [blob trace-context]
 Requests carry a seq the responder echoes; `request()` blocks the caller
 with a timeout (the reference's callback-with-timeout on
 asyncSendMessageByNodeID). Handlers run on the gateway's delivery thread —
 modules that need their own serialisation (PBFT's single worker) enqueue
 internally, matching the reference's thread model.
+
+The optional trailing blob is the sender thread's otrace span context
+(utils/otrace.wire_bytes — 25 bytes, only present when a sampled trace is
+active): this is how ONE transaction's trace stitches across nodes — the
+leader broadcasts its pre-prepare under the block's context, every
+replica's handler runs inside `ctx_scope` of the delivered context, and
+the spans they record (PBFT phases, execute/commit stages) share the
+originating trace_id. Frames from builds without the field parse
+unchanged (the blob is absent, context None).
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ import threading
 from typing import Callable, Optional
 
 from ..codec.wire import Reader, Writer
+from ..utils import otrace
 from ..utils.log import LOG, badge
 from .gateway import Gateway
 from .moduleid import ModuleID
@@ -53,8 +64,11 @@ class FrontService:
     # -- sends -------------------------------------------------------------
     @staticmethod
     def _pack(module: int, kind: int, seq: int, payload: bytes) -> bytes:
-        return (Writer().u16(int(module)).u8(kind).u64(seq)
-                .blob(payload).bytes())
+        w = Writer().u16(int(module)).u8(kind).u64(seq).blob(payload)
+        tb = otrace.wire_bytes()  # sampled span context rides the frame
+        if tb:
+            w.blob(tb)
+        return w.bytes()
 
     def send(self, module: int, dst: bytes, payload: bytes) -> bool:
         return self.gateway.send(self.node_id, dst,
@@ -95,6 +109,12 @@ class FrontService:
             r = Reader(data)
             module, kind, seq = r.u16(), r.u8(), r.u64()
             payload = r.blob()
+            ctx = None
+            if not r.done():  # optional trailing span context
+                try:
+                    ctx = otrace.unpack_ctx(r.blob())
+                except ValueError:
+                    ctx = None
         except ValueError:
             # malformed frame: drop cheaply — a garbage flood must not buy
             # a traceback (or even a log line) per frame; count it and
@@ -126,4 +146,8 @@ class FrontService:
                 self.gateway.send(self.node_id, _src,
                                   self._pack(_module, KIND_RESPONSE, _seq,
                                              resp))
-        handler(src, payload, respond)
+        # the delivered frame's span context scopes the handler: modules
+        # that defer to their own worker (PBFT) pin otrace.current() onto
+        # the queued object before returning
+        with otrace.ctx_scope(ctx):
+            handler(src, payload, respond)

@@ -32,6 +32,7 @@ from typing import Sequence
 
 from ..codec.wire import Reader, Writer
 from ..protocol import Transaction, batch_hash
+from ..utils import otrace
 from ..utils.log import LOG, badge, metric
 from ..utils.worker import Worker
 from .front import FrontService
@@ -99,6 +100,13 @@ class TransactionSync(Worker):
         """Forward locally-submitted txs to all peers (skip per-peer knowns)."""
         if not txs:
             return
+        # trace stitch for gossip: send the batch under the FIRST traced
+        # tx's span context (rides the p2p envelope), so a submission's
+        # trace follows its tx to the node that will seal it. Batches mix
+        # traces; the lead tx's is representative and the block-side
+        # adoption (sealer) re-anchors precisely.
+        ctx = next((c for c in (getattr(t, "_otrace", None) for t in txs)
+                    if c is not None and c.sampled), None)
         payload_cache: dict[frozenset, bytes] = {}
         for peer in self.front.peers():
             with self._lock:
@@ -110,7 +118,9 @@ class TransactionSync(Worker):
             data = payload_cache.get(key)
             if data is None:
                 data = payload_cache[key] = _pack_txs(fresh, self.suite)
-            if self.front.send(ModuleID.TxsSync, peer, data):
+            with otrace.ctx_scope(ctx):  # envelope carries the trace
+                sent = self.front.send(ModuleID.TxsSync, peer, data)
+            if sent:
                 # mark known only once the frame was actually enqueued on a
                 # live session; the anti-entropy sweep covers drops beyond
                 with self._lock:
@@ -160,6 +170,12 @@ class TransactionSync(Worker):
         txs = [Transaction.decode(raw) for h, raw in pairs if h in unknown]
         if not txs:
             return
+        ctx = otrace.current()  # gossip frame's envelope context
+        if ctx is not None and ctx.sampled:
+            # re-pin onto the lead tx (decode strips in-process attrs):
+            # admission + seal adoption on THIS node stay in the
+            # originating trace
+            txs[0]._otrace = ctx
         if self.ingest is not None:
             # continuous-batching lane: this packet coalesces with other
             # peers' packets and concurrent RPC submissions into one

@@ -139,7 +139,8 @@ class _Conn:
         self.out_off = 0    # sent-but-not-compacted prefix of outbuf
         self.next_seq = 0   # seq assigned to the next parsed request
         self.write_seq = 0  # next seq whose response goes on the wire
-        self.ready: dict[int, tuple[int, bytes]] = {}  # seq -> (status, body)
+        # seq -> (status, body, content_type, extra_headers)
+        self.ready: dict[int, tuple] = {}
         self.inflight = 0
         self.close_after: Optional[int] = None  # Connection: close seq
         self.peer_closed = False
@@ -156,11 +157,27 @@ class EventLoopHttpServer:
     on a worker thread and returns the JSON response body (b"" for a
     notification-only payload)."""
 
-    def __init__(self, handler: Callable[[bytes], bytes],
+    def __init__(self, handler: Optional[Callable[[bytes], bytes]],
                  host: str = "127.0.0.1", port: int = 0,
                  pool: Optional[WorkerPool] = None,
-                 keepalive_s: float = 60.0, name: str = "jsonrpc-http"):
+                 keepalive_s: float = 60.0, name: str = "jsonrpc-http",
+                 ops: Optional[Callable[[str],
+                                        tuple[int, str, bytes]]] = None):
         self.handler = handler
+        # operator GET routes (rpc/ops.OpsRoutes): /metrics, /status,
+        # /trace served from THIS loop — no dedicated scrape thread/port
+        self.ops = ops
+        # a handler may take (body) or (body, headers); headers carry the
+        # W3C traceparent for the tracing plane. Decided once, not per
+        # request.
+        self._handler_wants_headers = False
+        if handler is not None:
+            try:
+                import inspect
+                sig = inspect.signature(handler)
+                self._handler_wants_headers = len(sig.parameters) >= 2
+            except (TypeError, ValueError):
+                pass
         self.pool = pool or WorkerPool()
         self._own_pool = pool is None
         self.keepalive_s = keepalive_s
@@ -211,9 +228,10 @@ class EventLoopHttpServer:
 
     # -- worker -> loop completion channel ---------------------------------
     def _complete(self, conn: _Conn, seq: int, status: int,
-                  body: bytes) -> None:
+                  body: bytes, ctype: str = "application/json",
+                  headers: Optional[dict] = None) -> None:
         with self._done_lock:
-            self._done.append((conn, seq, status, body))
+            self._done.append((conn, seq, status, body, ctype, headers))
         self._wakeup()
 
     # -- event loop --------------------------------------------------------
@@ -379,11 +397,16 @@ class EventLoopHttpServer:
             if conn_hdr == "close" or (version == "HTTP/1.0"
                                        and conn_hdr != "keep-alive"):
                 conn.close_after = seq  # last request on this connection
-            if method != "POST":
+            if method == "GET" and self.ops is not None:
+                job = self._make_ops_job(conn, seq, parts[1])
+                if not self.pool.try_submit(job):
+                    self._complete_inline(conn, seq, 503,
+                                          b'{"error": "server busy"}')
+            elif method != "POST" or self.handler is None:
                 self._complete_inline(conn, seq, 405,
                                       b'{"error": "POST only"}')
             else:
-                job = self._make_job(conn, seq, body)
+                job = self._make_job(conn, seq, body, headers)
                 if not self.pool.try_submit(job):
                     # saturated pool: shed THIS request, keep the session
                     self._complete_inline(
@@ -394,23 +417,44 @@ class EventLoopHttpServer:
         if conn in self._conns:
             self._set_interest(conn)
 
-    def _make_job(self, conn: _Conn, seq: int, body: bytes) -> Callable:
+    def _make_job(self, conn: _Conn, seq: int, body: bytes,
+                  headers: dict) -> Callable:
         handler = self.handler
+        wants_headers = self._handler_wants_headers
 
         def job() -> None:
+            hdrs = None
             try:
-                out = handler(body)
+                out = handler(body, headers) if wants_headers \
+                    else handler(body)
+                if isinstance(out, tuple):  # (body, extra response headers)
+                    out, hdrs = out
             except Exception:  # noqa: BLE001 — handler bug, not the edge's
                 LOG.exception(badge("RPC", "handler-failed"))
                 out = (b'{"jsonrpc": "2.0", "id": null, "error": '
                        b'{"code": -32603, "message": "internal error"}}')
-            self._complete(conn, seq, 200, out)
+            self._complete(conn, seq, 200, out, headers=hdrs)
+
+        return job
+
+    def _make_ops_job(self, conn: _Conn, seq: int, target: str) -> Callable:
+        ops = self.ops
+
+        def job() -> None:
+            try:
+                status, ctype, body = ops(target)
+            except Exception:  # noqa: BLE001 — ops bug, not the edge's
+                LOG.exception(badge("RPC", "ops-handler-failed"))
+                status, ctype, body = 500, "application/json", \
+                    b'{"error": "internal error"}'
+            self._complete(conn, seq, status, body, ctype=ctype)
 
         return job
 
     def _complete_inline(self, conn: _Conn, seq: int, status: int,
-                         body: bytes) -> None:
-        conn.ready[seq] = (status, body)
+                         body: bytes, ctype: str = "application/json",
+                         headers: Optional[dict] = None) -> None:
+        conn.ready[seq] = (status, body, ctype, headers)
         self._flush_ready(conn)
 
     def _drain_done(self) -> None:
@@ -418,9 +462,10 @@ class EventLoopHttpServer:
             with self._done_lock:
                 if not self._done:
                     return
-                conn, seq, status, body = self._done.popleft()
+                conn, seq, status, body, ctype, headers = \
+                    self._done.popleft()
             if conn in self._conns:
-                conn.ready[seq] = (status, body)
+                conn.ready[seq] = (status, body, ctype, headers)
                 self._flush_ready(conn)
                 if conn in self._conns and conn.rbuf:
                     # a completion freed pipeline/outbuf room: requests
@@ -431,23 +476,32 @@ class EventLoopHttpServer:
     def _flush_ready(self, conn: _Conn) -> None:
         """Move completed responses to the wire IN REQUEST ORDER."""
         while conn.write_seq in conn.ready:
-            status, body = conn.ready.pop(conn.write_seq)
+            status, body, ctype, headers = conn.ready.pop(conn.write_seq)
             closing = conn.close_after == conn.write_seq
-            conn.outbuf += self._encode(status, body, closing)
+            conn.outbuf += self._encode(status, body, closing, ctype,
+                                        headers)
             conn.write_seq += 1
             conn.inflight -= 1
         self._on_writable(conn)
 
     @staticmethod
-    def _encode(status: int, body: bytes, closing: bool) -> bytes:
-        reason = {200: "OK", 400: "Bad Request", 405: "Method Not Allowed",
-                  411: "Length Required", 413: "Payload Too Large",
-                  431: "Request Header Fields Too Large"}.get(status, "OK")
+    def _encode(status: int, body: bytes, closing: bool,
+                ctype: str = "application/json",
+                headers: Optional[dict] = None) -> bytes:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed", 411: "Length Required",
+                  413: "Payload Too Large",
+                  431: "Request Header Fields Too Large",
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
+        extra = ""
+        if headers:
+            extra = "".join(f"{k}: {v}\r\n" for k, v in headers.items())
         head = (f"HTTP/1.1 {status} {reason}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"Connection: {'close' if closing else 'keep-alive'}\r\n"
-                f"\r\n")
+                f"{extra}\r\n")
         return head.encode("latin-1") + body
 
     def _on_writable(self, conn: _Conn) -> None:

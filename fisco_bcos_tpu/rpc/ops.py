@@ -1,0 +1,73 @@
+"""Operator GET routes shared by every HTTP edge in the process.
+
+The reference ships its observability as a sidecar bundle (Prometheus
+scraping a METRIC log channel + Grafana dashboards under
+tools/BcosBuilder/.../monitor/). Here the node itself serves the operator
+surface, from the SAME event-loop edge that serves JSON-RPC (rpc/edge.py
+routes GET requests to an `OpsRoutes` instance; `utils.metrics.
+MetricsServer` wraps one standalone for deployments that want a separate
+scrape port):
+
+  GET /metrics              Prometheus exposition text (0.0.4)
+  GET /status               one JSON document per node: the same aggregate
+                            the `getSystemStatus` RPC returns
+  GET /trace?id=<trace_id>  every retained span of one trace (otrace ring)
+  GET /trace | /traces      newest-first trace summaries
+                            (?limit=N, ?slow=1 for the slow ring only)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlsplit
+
+PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_CTYPE = "application/json"
+
+
+class OpsRoutes:
+    """Callable route table: path -> (status, content_type, body_bytes).
+    Runs on a bounded worker (never the event loop); every handler is a
+    read-only snapshot render."""
+
+    def __init__(self, registry=None, tracer=None,
+                 status_fn: Optional[Callable[[], dict]] = None):
+        if registry is None:
+            from ..utils.metrics import REGISTRY
+            registry = REGISTRY
+        if tracer is None:
+            from ..utils.otrace import TRACER
+            tracer = TRACER
+        self.registry = registry
+        self.tracer = tracer
+        self.status_fn = status_fn
+
+    def __call__(self, target: str) -> tuple[int, str, bytes]:
+        parts = urlsplit(target)
+        path = parts.path.rstrip("/") or "/metrics"  # GET / keeps scraping
+        q = parse_qs(parts.query)
+        try:
+            if path == "/metrics":
+                return 200, PROM_CTYPE, self.registry.prometheus_text(
+                ).encode()
+            if path == "/status":
+                doc = self.status_fn() if self.status_fn is not None else {
+                    "trace": self.tracer.stats()}
+                return 200, JSON_CTYPE, json.dumps(doc).encode()
+            if path in ("/trace", "/traces"):
+                tid = (q.get("id") or [None])[0]
+                if tid:
+                    spans = self.tracer.get_trace(tid)
+                    return 200, JSON_CTYPE, json.dumps(
+                        {"traceId": tid.lower().removeprefix("0x"),
+                         "spans": spans}).encode()
+                limit = int((q.get("limit") or ["50"])[0])
+                slow = (q.get("slow") or ["0"])[0] not in ("0", "", "false")
+                return 200, JSON_CTYPE, json.dumps(
+                    {"traces": self.tracer.list_traces(
+                        limit=limit, slow_only=slow)}).encode()
+        except Exception as exc:  # noqa: BLE001 — ops surface, stay up
+            return 500, JSON_CTYPE, json.dumps(
+                {"error": str(exc)}).encode()
+        return 404, JSON_CTYPE, b'{"error": "not found"}'

@@ -32,6 +32,7 @@ import time
 from typing import Any, Optional
 
 from ..protocol import Block, BlockHeader, Receipt, Transaction
+from ..utils import otrace
 from ..utils.log import LOG, badge
 from .edge import EventLoopHttpServer, WorkerPool
 
@@ -210,6 +211,10 @@ class JsonRpcImpl:
             "getGroupInfo": self.get_group_info,
             "getGroupInfoList": self.get_group_info_list,
             "getGroupNodeInfo": self.get_group_node_info,
+            # observability plane (utils/otrace.py + Node.system_status)
+            "getTrace": self.get_trace,
+            "listTraces": self.list_traces,
+            "getSystemStatus": self.get_system_status,
         }
 
     # -- dispatch ----------------------------------------------------------
@@ -228,7 +233,23 @@ class JsonRpcImpl:
                 raise JsonRpcError(JSONRPC_METHOD_NOT_FOUND,
                                    f"unknown method {request['method']}")
             params = request.get("params", [])
-            result = fn(*params) if isinstance(params, list) else fn(**params)
+            # tracing: a request-level W3C traceparent member (the WS
+            # transport's context carrier; HTTP also scopes the header at
+            # the edge), the transport's scoped context, or — when the
+            # node samples locally — a fresh root. The untraced,
+            # unsampled path costs one branch.
+            ctx = otrace.parse_traceparent(request.get("traceparent")) \
+                if "traceparent" in request else None
+            tracer = otrace.TRACER
+            if ctx is None and otrace.current() is None and tracer.idle():
+                result = fn(*params) if isinstance(params, list) \
+                    else fn(**params)
+                return {"jsonrpc": "2.0", "id": rid, "result": result}
+            with tracer.span(f"rpc.{request['method']}", parent=ctx,
+                             attrs={"group": params[0] if isinstance(
+                                 params, list) and params else ""}):
+                result = fn(*params) if isinstance(params, list) \
+                    else fn(**params)
             return {"jsonrpc": "2.0", "id": rid, "result": result}
         except JsonRpcError as exc:
             return {"jsonrpc": "2.0", "id": rid,
@@ -270,6 +291,12 @@ class JsonRpcImpl:
                          wait: bool = True, timeout: float = 30.0):
         self._check_group(group)
         tx = Transaction.decode(_unhex(tx_hex))
+        ctx = otrace.current()
+        if ctx is not None:
+            # the span context follows the TX OBJECT from here: ingest
+            # lane entry -> pool admission -> sealer adoption -> (via the
+            # p2p envelope) every node's consensus/execute/commit spans
+            tx._otrace = ctx
         from ..protocol import TransactionStatus
         # the wait budget is CLIENT-supplied: clamp it, or a crafted
         # request parks a shared-pool worker for arbitrary time
@@ -639,6 +666,33 @@ class JsonRpcImpl:
             "blockNumber": self.node.ledger.current_number(),
         }
 
+    # -- observability plane ----------------------------------------------
+    def get_trace(self, group: str, node_name: str = "",
+                  trace_id: str = ""):
+        """Every span this node retained for `trace_id` (hex, with or
+        without 0x). A multi-process chain stitches client-side: query
+        each node and merge by traceId (spans carry a `node` attribute)."""
+        self._check_group(group)
+        tid = trace_id.lower().removeprefix("0x")
+        spans = otrace.TRACER.get_trace(tid)
+        return {"traceId": tid, "spans": spans,
+                "node": _hex(self.node.keypair.pub_bytes)}
+
+    def list_traces(self, group: str, node_name: str = "",
+                    limit: int = 50, slow_only: bool = False):
+        self._check_group(group)
+        return {"traces": otrace.TRACER.list_traces(
+            limit=limit, slow_only=bool(slow_only))}
+
+    def get_system_status(self, group: str = "", node_name: str = ""):
+        """One JSON document aggregating the node's scattered operational
+        state (pipeline occupancy, lane merge stats, storage engine,
+        txpool/ingest depth, sync mode, groups, tracer) — the /status ops
+        endpoint serves the same document."""
+        if group:
+            self._check_group(group)
+        return self.node.system_status()
+
 
 def _proof_json(proof) -> list:
     return [{"siblings": [_hex(s) for s in sibs], "index": pos}
@@ -646,11 +700,19 @@ def _proof_json(proof) -> list:
 
 
 def http_body_handler(impl, max_batch: int = 256):
-    """-> handler(raw_body) -> response bytes, for EventLoopHttpServer.
-    Works with any impl exposing `.handle` (handle_payload_with does the
-    batch framing), so the multigroup and Pro facades serve batches too."""
+    """-> handler(raw_body, headers) -> response bytes (or (bytes,
+    extra-response-headers)), for EventLoopHttpServer. Works with any impl
+    exposing `.handle` (handle_payload_with does the batch framing), so
+    the multigroup and Pro facades serve batches too.
 
-    def handle(raw: bytes) -> bytes:
+    W3C trace context: an incoming `traceparent` header scopes the whole
+    payload's execution (every entry's spans join the client's trace) and
+    is echoed on the response, so callers can correlate without parsing
+    bodies."""
+
+    def handle(raw: bytes, headers: Optional[dict] = None):
+        ctx = otrace.parse_traceparent(
+            headers.get("traceparent")) if headers else None
         try:
             payload = json.loads(raw)
         except Exception:
@@ -658,10 +720,14 @@ def http_body_handler(impl, max_batch: int = 256):
                     "error": {"code": JSONRPC_PARSE_ERROR,
                               "message": "parse error"}}
         else:
-            resp = handle_payload_with(impl, payload, max_batch)
+            with otrace.ctx_scope(ctx):
+                resp = handle_payload_with(impl, payload, max_batch)
             if resp is None:
                 return b""  # notification-only payload: nothing to send
-        return json.dumps(resp).encode()
+        body = json.dumps(resp).encode()
+        if ctx is not None:
+            return body, {"traceparent": ctx.traceparent()}
+        return body
 
     return handle
 
@@ -674,14 +740,14 @@ class JsonRpcServer:
 
     def __init__(self, impl, host: str = "127.0.0.1", port: int = 0,
                  pool: Optional[WorkerPool] = None, workers: int = 8,
-                 keepalive_s: float = 60.0):
+                 keepalive_s: float = 60.0, ops=None):
         self.impl = impl
         max_batch = getattr(impl, "max_batch", 256)
         self._own_pool = pool is None
         self._pool = pool if pool is not None else WorkerPool(workers)
         self._edge = EventLoopHttpServer(
             http_body_handler(impl, max_batch), host=host, port=port,
-            pool=self._pool, keepalive_s=keepalive_s)
+            pool=self._pool, keepalive_s=keepalive_s, ops=ops)
         self.host, self.port = self._edge.host, self._edge.port
 
     def start(self) -> None:

@@ -74,13 +74,15 @@ class ExecutionResult:
 class Scheduler:
     def __init__(self, storage: TransactionalStorage, ledger: Ledger,
                  executor: TransactionExecutor, suite, txpool=None,
-                 pipeline: bool = True):
+                 pipeline: bool = True, trace_label: str = ""):
         self.storage = storage
         self.ledger = ledger
         self.executor = executor
         self.suite = suite
         self.txpool = txpool
         self.pipeline = pipeline
+        # per-node label for the block-trace registry + span attribution
+        self.trace_label = trace_label
         self._lock = threading.RLock()       # bookkeeping dicts below
         self._exec_lock = threading.RLock()  # serialises block execution
         self._commit_2pc = threading.Lock()  # serialises the storage 2PC
@@ -235,7 +237,7 @@ class Scheduler:
             backend = self.storage
 
         from ..utils.trace import block_trace
-        trace = block_trace(header.number)
+        trace = block_trace(header.number, owner=self.trace_label)
         txs = block.transactions
         if not txs and block.tx_hashes:
             if self.txpool is None:
@@ -423,7 +425,7 @@ class Scheduler:
         changes[(T_HEADER, _be8(number))] = Entry(result.header.encode())
         changes[(T_HASH2NUM, hh)] = Entry(_be8(number))
         from ..utils.trace import block_trace, drop_block_trace
-        trace = block_trace(number)
+        trace = block_trace(number, owner=self.trace_label)
         trace.stage("consensus_wait")
         if result.t_executed:
             self._stage("consensus_wait", t0 - result.t_executed)
@@ -479,7 +481,10 @@ class Scheduler:
             nonces = self.ledger.nonces_by_number(number)
             self.txpool.on_block_committed(number, tx_hashes, nonces)
         self._notify_q.put(number)
-        tr = drop_block_trace(number)
+        # receipt waiters are settled by on_block_committed above: stamp
+        # the notify stage before retiring the block's trace
+        trace.stage("notify")
+        tr = drop_block_trace(number, owner=self.trace_label)
         if tr is not None:
             tr.finish()
         metric("scheduler.commit", number=number,

@@ -51,10 +51,14 @@ class Sealer(Worker):
                  min_seal_time: float = 0.5,
                  clock_ms: Callable[[], int] | None = None,
                  max_seal_time: float = 0.5,
-                 pipeline_busy: Callable[[], bool] | None = None):
+                 pipeline_busy: Callable[[], bool] | None = None,
+                 trace_label: str = ""):
         super().__init__("sealer", idle_wait=0.05)
         self.txpool = txpool
         self.suite = suite
+        # node label for the per-block trace registry (utils/trace.py):
+        # in-process clusters stamp per node instead of colliding
+        self.trace_label = trace_label
         # proposal timestamp source: peer-median-aligned when wired to
         # NodeTimeMaintenance (tool/timesync.py), local UTC otherwise
         self.clock_ms = clock_ms or (lambda: int(time.time() * 1000))
@@ -136,6 +140,9 @@ class Sealer(Worker):
         txs, hashes = self.txpool.seal(limit)
         if not txs:
             return
+        t_seal = time.monotonic()
+        queue_wait = (t_seal - self._first_pending_at
+                      if self._first_pending_at is not None else 0.0)
         self._first_pending_at = None
         with self._lock:
             # consume the grant BEFORE submitting: whatever happens next,
@@ -145,6 +152,24 @@ class Sealer(Worker):
         header = BlockHeader(number=number, timestamp=self.clock_ms())
         block = Block(header=header, transactions=list(txs),
                       tx_hashes=list(hashes))
+        # latency attribution: time the block's txs sat unsealed in the
+        # pool, and — when a sealed tx carries a sampled trace context —
+        # adopt that context as the BLOCK's: every downstream stage
+        # (consensus, execute, commit, notify, on every node via the p2p
+        # envelope) records into that one trace
+        from ..utils import otrace
+        from ..utils.trace import block_trace, observe_stage
+        observe_stage("queueing", queue_wait)
+        ctx = next((c for c in (getattr(t, "_otrace", None) for t in txs)
+                    if c is not None and c.sampled), None)
+        tr = block_trace(number, owner=self.trace_label)
+        if ctx is not None:
+            tr.bind(ctx)
+            block._otrace = ctx
+            otrace.TRACER.record(
+                "seal", ctx, t_seal - queue_wait, t_seal,
+                attrs={"number": number, "n_tx": len(txs),
+                       "node": self.trace_label})
         if not self.submit_proposal(block):
             # refused — nothing was broadcast, so the round is re-openable
             # without any vote-split risk. Txs go back to the pool. Solo

@@ -133,6 +133,11 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                  "nodes": ",".join(f"{h}:{p}" for h, p in cfg.p2p_peers)}
     cp["monitor"] = {"metrics_port": ""
                      if cfg.metrics_port is None else str(cfg.metrics_port)}
+    # tracing plane knobs (utils/otrace.py): root sampling rate, span ring
+    # bound, always-retained slow-span threshold
+    cp["trace"] = {"sample_rate": str(cfg.trace_sample_rate),
+                   "ring_size": str(cfg.trace_ring_size),
+                   "slow_ms": str(cfg.trace_slow_ms)}
     cp["executor"] = {}
     cp["crypto"] = {"backend": cfg.crypto_backend,
                     "device_min_batch": str(cfg.device_min_batch),
@@ -222,6 +227,10 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         rpc_cache_mb=cp.getint("rpc", "cache_mb", fallback=64),
         rpc_keepalive_s=cp.getfloat("rpc", "keepalive_s", fallback=60.0),
         metrics_port=int(metrics_s) if metrics_s else None,
+        trace_sample_rate=cp.getfloat("trace", "sample_rate",
+                                      fallback=0.02),
+        trace_ring_size=cp.getint("trace", "ring_size", fallback=4096),
+        trace_slow_ms=cp.getfloat("trace", "slow_ms", fallback=1000.0),
         p2p_host=cp.get("p2p", "listen_ip", fallback="127.0.0.1"),
         p2p_port=int(p2p_port_s) if p2p_port_s else None,
         p2p_peers=peers,

@@ -38,9 +38,11 @@ from collections import deque
 from typing import Optional, Sequence
 
 from ..protocol import Transaction
+from ..utils import otrace
 from ..utils.log import LOG, badge, metric
 from ..utils.metrics import REGISTRY
 from ..utils.task import Task
+from ..utils.trace import observe_stage
 from .txpool import TxSubmitResult
 
 from ..crypto.suite import BUCKETS as _SUITE_BUCKETS
@@ -65,12 +67,18 @@ class LaneStopped(RuntimeError):
 
 
 class _Entry:
-    __slots__ = ("tx", "task", "t_enq")
+    __slots__ = ("tx", "task", "t_enq", "ctx")
 
-    def __init__(self, tx: Transaction, task: Optional[Task]):
+    def __init__(self, tx: Transaction, task: Optional[Task],
+                 ctx=None):
         self.tx = tx
         self.task = task  # None: fire-and-forget (gossip), nobody awaits
         self.t_enq = time.monotonic()
+        # otrace span context of the submitting trace (None when the
+        # submission isn't traced): the dispatcher records this entry's
+        # queue-to-admission span under it, and one batch span LINKS all
+        # coalesced traces
+        self.ctx = ctx
 
 
 class IngestLane:
@@ -79,8 +87,10 @@ class IngestLane:
 
     def __init__(self, txpool, max_batch: int = 4096,
                  max_wait_ms: float = 15.0, queue_cap: int = 8192,
-                 broadcast: bool = True, registry=None):
+                 broadcast: bool = True, registry=None,
+                 trace_label: str = ""):
         self.txpool = txpool
+        self.trace_label = trace_label  # span node attribution
         # metrics sink: a multi-group node passes a group-labeled view
         # (utils.metrics.for_group) so G lanes don't silently aggregate
         self._reg = registry if registry is not None else REGISTRY
@@ -151,7 +161,8 @@ class IngestLane:
     def submit_async(self, tx: Transaction) -> Task:
         """Enqueue one tx; -> Task[TxSubmitResult]. Raises TxPoolIsFull
         when the queue is at capacity (bounded-memory backpressure)."""
-        entry = _Entry(tx, Task())
+        ctx = getattr(tx, "_otrace", None) or otrace.current()
+        entry = _Entry(tx, Task(), ctx=ctx)
         with self._cv:
             if self._stop:
                 raise LaneStopped("ingest lane stopped")
@@ -185,7 +196,8 @@ class IngestLane:
                 return 0
             room = self.queue_cap - len(self._q)
             for tx in txs[:max(0, room)]:
-                self._q.append(_Entry(tx, None))
+                self._q.append(_Entry(tx, None,
+                                      ctx=getattr(tx, "_otrace", None)))
                 accepted += 1
             depth = len(self._q)
             dropped = len(txs) - accepted
@@ -290,6 +302,22 @@ class IngestLane:
         for e, res in zip(batch, results):
             if e.task is not None:
                 e.task.resolve(res)
+        # latency attribution: per-batch coalesce time into the stage
+        # histogram; traced submissions additionally get their own
+        # enqueue-to-admitted span (one per traced entry, linked to the
+        # shared batch by the batch-size attribute)
+        # unlabeled registry on purpose: every bcos_tx_stage_seconds
+        # stage must live in ONE series family or cross-stage shares
+        # (the dashboard's headline panel) skew — the block stages are
+        # unlabeled, so these are too
+        observe_stage("ingest", now - batch[0].t_enq)
+        t_done = time.monotonic()
+        for e in batch:
+            if e.ctx is not None and e.ctx.sampled:
+                otrace.TRACER.record(
+                    "ingest.admit", e.ctx, e.t_enq, t_done,
+                    attrs={"batch": len(batch),
+                           "node": self.trace_label})
         # rate EWMA: arrivals per second over the inter-dispatch gap
         gap = max(1e-6, now - self._last_dispatch)
         self._last_dispatch = now

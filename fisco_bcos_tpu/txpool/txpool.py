@@ -131,7 +131,14 @@ class TxPool:
                     need_verify.append(i)
         if need_verify:
             sub = [txs[i] for i in need_verify]
+            t_rec = time.monotonic()
             _, ok = batch_recover_senders(sub, self.suite)
+            # per-batch signature-recover time -> the latency attribution
+            # plane's "crypto" stage (covers the lane AND direct paths);
+            # unlabeled on purpose — all bcos_tx_stage_seconds stages
+            # share one series family so cross-stage shares stay honest
+            from ..utils.trace import observe_stage
+            observe_stage("crypto", time.monotonic() - t_rec)
             with self._lock:
                 for j, i in enumerate(need_verify):
                     tx, h = txs[i], hashes[i]
@@ -149,9 +156,20 @@ class TxPool:
                         self._known_nonces.add(tx.nonce)
                     results[i] = TxSubmitResult(h, TransactionStatus.OK,
                                                 tx.sender(self.suite))
-        metric("txpool.submit_batch", n=len(txs),
-               ok=sum(1 for r in results if r.status == TransactionStatus.OK),
+        n_ok = sum(1 for r in results
+                   if r.status == TransactionStatus.OK)
+        metric("txpool.submit_batch", n=len(txs), ok=n_ok,
                ms=int((time.monotonic() - t0) * 1000))
+        # traced submissions: one admission span per sampled tx context
+        # (cheap: touched only when a context is actually attached)
+        for tx in txs:
+            ctx = getattr(tx, "_otrace", None)
+            if ctx is not None and ctx.sampled:
+                from ..utils import otrace
+                otrace.TRACER.record(
+                    "txpool.admit", ctx, t0,
+                    attrs={"n": len(txs), "ok": n_ok,
+                           "group": self.group_id})
         self._update_pending_gauge()
         if need_verify:
             self._notify_ready()

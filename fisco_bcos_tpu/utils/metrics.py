@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
@@ -101,10 +100,18 @@ class MetricsRegistry:
 
     # -- export ------------------------------------------------------------
     @staticmethod
-    def _fmt_labels(labels: tuple) -> str:
+    def _esc_label(value) -> str:
+        """Prometheus exposition label-value escaping: backslash, double
+        quote and newline must be escaped or the whole scrape is invalid
+        text (a group id with a quote would silently break every panel)."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @classmethod
+    def _fmt_labels(cls, labels: tuple) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        inner = ",".join(f'{k}="{cls._esc_label(v)}"' for k, v in labels)
         return "{" + inner + "}"
 
     def prometheus_text(self) -> str:
@@ -212,37 +219,35 @@ def for_group(group: str, registry: Optional[MetricsRegistry] = None
 
 
 class MetricsServer:
-    """Prometheus scrape endpoint: GET /metrics."""
+    """Ops scrape endpoint: GET /metrics (Prometheus text), plus the
+    /trace, /traces and /status views of the same single-loop ops server.
+
+    Thin compat wrapper: serving moved off the old thread-per-scrape
+    `ThreadingHTTPServer` onto the shared event-loop edge
+    (rpc/edge.py + rpc/ops.OpsRoutes — one loop thread, two workers);
+    nodes that already run an RPC edge serve the same GET routes from it
+    and don't need this dedicated listener at all."""
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
-                 host: str = "127.0.0.1", port: int = 0):
-        reg = registry
+                 host: str = "127.0.0.1", port: int = 0,
+                 status_fn=None, tracer=None):
+        # runtime imports: rpc.edge imports this module for REGISTRY, so
+        # the dependency must stay one-way at import time
+        from ..rpc.edge import EventLoopHttpServer, WorkerPool
+        from ..rpc.ops import OpsRoutes
 
-        class _H(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_error(404)
-                    return
-                body = reg.prometheus_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *a):
-                pass
-
-        self._server = ThreadingHTTPServer((host, port), _H)
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._pool = WorkerPool(2, name="ops-worker")
+        self._server = EventLoopHttpServer(
+            None, host=host, port=port, pool=self._pool,
+            keepalive_s=30.0, name="ops-http",
+            ops=OpsRoutes(registry=registry, tracer=tracer,
+                          status_fn=status_fn))
+        self.port = self._server.port
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="metrics")
-        self._thread.start()
+        self._pool.start()
+        self._server.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        self._server.stop()
+        self._pool.stop()

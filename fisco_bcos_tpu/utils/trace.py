@@ -9,8 +9,23 @@ be diffed down to the first differing round — exactly the tooling a
 CPU/TPU dual-path system needs when a device kernel and the host oracle
 disagree.
 
-Both sinks write structured METRIC log lines (utils/log.py) so the
-existing metrics registry and log tooling pick them up.
+`BlockTrace` is the per-block stage clock and the ONE seam the latency
+attribution plane rides:
+
+  * every stage stamp still emits a METRIC log line (utils/log.py);
+  * write-path stages additionally feed the
+    `bcos_tx_stage_seconds{stage=...}` histogram — the permanent per-stage
+    decomposition behind `chain_bench --trace-profile` and the Grafana
+    dashboard (tools/dashboards/node.json);
+  * a block whose transactions carried a sampled otrace context
+    (`bind()`) records each stage as a span of THAT trace, so `getTrace`
+    shows one submission's admission -> seal -> consensus -> execute ->
+    commit -> notify path; unbound blocks still get slow-capture
+    (utils/otrace.Tracer.observe_slow).
+
+Traces are registered per (owner, number): `owner` is the node's trace
+label, so in-process multi-node clusters stop stamping each other's
+blocks while real one-node-per-process deployments behave as before.
 """
 
 from __future__ import annotations
@@ -21,25 +36,72 @@ import time
 from typing import Optional
 
 from .log import metric
+from . import otrace
+
+# stages fed into the bcos_tx_stage_seconds{stage=...} histogram (other
+# stamps stay METRIC-line-only); "queueing"/"ingest"/"crypto" ride the
+# same histogram from sealer/ingest/txpool directly
+STAGE_HISTOGRAM = "bcos_tx_stage_seconds"
+_HIST_STAGES = frozenset({"consensus_pre", "fill", "execute", "roots",
+                          "consensus_wait", "commit", "notify"})
+# stage durations live between "instant" and "a slow block": the default
+# time buckets bottom out too low and top out too high
+_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                  0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def observe_stage(stage: str, seconds: float, registry=None) -> None:
+    """One observation into the per-stage latency histogram."""
+    if registry is None:
+        from . import metrics as _m
+        registry = _m.REGISTRY
+    registry.observe(STAGE_HISTOGRAM, seconds, {"stage": stage},
+                     buckets=_STAGE_BUCKETS)
 
 
 class BlockTrace:
     """Per-block stage stamps: trace = BlockTrace(number); trace.stage(
     "seal"); ...; trace.stage("execute"); trace.finish()."""
 
-    def __init__(self, number: int, pipeline: str = "block"):
+    def __init__(self, number: int, pipeline: str = "block",
+                 owner: str = ""):
         self.number = number
         self.pipeline = pipeline
+        self.owner = owner
         self._t0 = time.monotonic()
         self._last = self._t0
         self._stages: list[tuple[str, float]] = []
+        self._ctx = None  # otrace.SpanContext bound via bind()
+
+    def bind(self, ctx) -> None:
+        """Adopt a transaction's span context: stages from here on are
+        recorded as spans of that trace (sealer binds on the leader, the
+        PBFT engine binds on replicas from the pre-prepare's envelope
+        context)."""
+        if ctx is not None and ctx.sampled:
+            self._ctx = ctx
+
+    @property
+    def ctx(self):
+        return self._ctx
 
     def stage(self, name: str) -> None:
         now = time.monotonic()
-        self._stages.append((name, now - self._last))
+        dt = now - self._last
+        self._stages.append((name, dt))
         metric(f"trace.{self.pipeline}", number=self.number, stage=name,
-               ms=round((now - self._last) * 1000, 2),
+               ms=round(dt * 1000, 2),
                total_ms=round((now - self._t0) * 1000, 2))
+        if name in _HIST_STAGES:
+            observe_stage(name, dt)
+        if self._ctx is not None:
+            otrace.TRACER.record(
+                f"stage.{name}", self._ctx, self._last, now,
+                attrs={"number": self.number, "node": self.owner})
+        else:
+            otrace.TRACER.observe_slow(
+                f"stage.{name}", dt,
+                attrs={"number": self.number, "node": self.owner})
         self._last = now
 
     def finish(self) -> dict[str, float]:
@@ -100,22 +162,26 @@ class DmcStepRecorder:
         return h.digest()
 
 
-_block_traces: dict[int, BlockTrace] = {}
+_block_traces: dict[tuple[str, int], BlockTrace] = {}
 _bt_lock = threading.Lock()
 
 
-def block_trace(number: int) -> BlockTrace:
+def block_trace(number: int, owner: str = "") -> BlockTrace:
     """Shared per-height trace so sealer/consensus/scheduler stamp the same
-    object without threading it through every signature."""
+    object without threading it through every signature. Keyed per
+    (owner, number): one node per process stamps `owner=""`-equivalent;
+    in-process clusters pass their node label so stamps don't collide."""
+    key = (owner, number)
     with _bt_lock:
-        tr = _block_traces.get(number)
+        tr = _block_traces.get(key)
         if tr is None:
-            tr = _block_traces[number] = BlockTrace(number)
-            for old in [n for n in _block_traces if n < number - 64]:
+            tr = _block_traces[key] = BlockTrace(number, owner=owner)
+            for old in [k for k in _block_traces
+                        if k[0] == owner and k[1] < number - 64]:
                 del _block_traces[old]
         return tr
 
 
-def drop_block_trace(number: int) -> Optional[BlockTrace]:
+def drop_block_trace(number: int, owner: str = "") -> Optional[BlockTrace]:
     with _bt_lock:
-        return _block_traces.pop(number, None)
+        return _block_traces.pop((owner, number), None)

@@ -35,6 +35,14 @@
 #                                   # recovery (no full-log replay) with
 #                                   # identical balances + head, then the
 #                                   # storage_compare bench row
+#   tools/sanitize_ci.sh --obs      # ONLY the observability smoke: boot a
+#                                   # daemon, submit txs under a client
+#                                   # traceparent, fetch the trace by id
+#                                   # via getTrace, parse /metrics off the
+#                                   # RPC edge, reconcile the
+#                                   # bcos_tx_stage_seconds stage sums
+#                                   # against measured e2e latency, and
+#                                   # emit the trace_profile_summary row
 #   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
 #                                   # daemon hosting two groups ([groups]
 #                                   # ini), disjoint writes routed by the
@@ -504,6 +512,116 @@ EOF
     python benchmark/chain_bench.py --storage-compare -n 400 \
     --tx-count-limit 100 --storage-memtable-mb 1 2>/dev/null \
     | grep '"metric": "storage_compare"'
+  exit 0
+fi
+
+if [ "${1:-}" = "--obs" ]; then
+  echo "== [obs] observability smoke: daemon + client traceparent ->" \
+       "getTrace by id, /metrics parses, stage sums ~ e2e"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python - <<'EOF'
+import http.client, json, os, re, shutil, signal, subprocess, sys
+import tempfile, time
+sys.path.insert(0, "tools")
+from build_chain import build_chain
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import SdkClient, TransactionBuilder
+from fisco_bcos_tpu.crypto.suite import make_suite
+
+work = tempfile.mkdtemp(prefix="obs-smoke-")
+proc = None
+try:
+    from fisco_bcos_tpu.testing.chaos import free_port_block
+    port = free_port_block(2)
+    info = build_chain(work, 1, consensus="solo", rpc_base_port=port,
+                       p2p_base_port=port + 1, crypto_backend="host")
+    node_dir = info["nodes"][0]["dir"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fisco_bcos_tpu", node_dir,
+         "--log-file", os.path.join(node_dir, "daemon.log")],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    cli = SdkClient(f"http://127.0.0.1:{port}", group=info["group_id"])
+    end = time.monotonic() + 120
+    while time.monotonic() < end:
+        try:
+            cli.get_block_number(); break
+        except Exception:
+            time.sleep(0.25)
+    else:
+        raise TimeoutError("rpc never came up")
+
+    suite = make_suite(False, backend="host")
+    kp = suite.generate_keypair(b"obs-smoke")
+    builder = TransactionBuilder(suite, None, chain_id=info["chain_id"],
+                                 group_id=info["group_id"])
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    tid = os.urandom(16).hex()
+    e2e = []
+    for i in range(8):
+        tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                           pc.encode_call("register",
+                                          lambda w, i=i: w.blob(b"ob%d" % i)
+                                          .u64(10 + i)),
+                           nonce=f"ob{i}", block_limit=100)
+        body = json.dumps({"jsonrpc": "2.0", "id": i,
+                           "method": "sendTransaction",
+                           "params": [info["group_id"], "",
+                                      "0x" + tx.encode().hex()]})
+        t0 = time.perf_counter()
+        # client-supplied W3C traceparent, sampled flag SET: the node
+        # must retain this trace regardless of its local sample_rate
+        conn.request("POST", "/", body=body.encode(),
+                     headers={"traceparent":
+                              f"00-{tid}-00f067aa0ba902b7-01"})
+        r = conn.getresponse()
+        assert r.getheader("traceparent", "").startswith(f"00-{tid}")
+        resp = json.loads(r.read())
+        assert resp["result"]["status"] == 0, resp
+        e2e.append(time.perf_counter() - t0)
+
+    # 1) the trace is retrievable BY ID via RPC and covers the write path
+    spans = cli.request("getTrace", [info["group_id"], "", tid])["spans"]
+    names = {s["name"] for s in spans}
+    assert {"rpc.sendTransaction", "stage.execute", "stage.commit",
+            "stage.notify"} <= names, sorted(names)
+
+    # 2) /metrics (served from the RPC event-loop edge) parses cleanly
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_]+="(\\.|[^"\\])*"'
+        r'(,[a-zA-Z_]+="(\\.|[^"\\])*")*\})? [0-9.eE+-]+(\s[0-9]+)?$')
+    bad = [l for l in text.splitlines()
+           if l and not l.startswith("#") and not line_re.match(l)]
+    assert not bad, f"unparseable exposition lines: {bad[:3]}"
+
+    # 3) bcos_tx_stage_seconds stage sums ~ measured e2e: mean per-block
+    # stage-sum must land in the same ballpark as the closed-loop mean
+    sums = {}
+    for m in re.finditer(r'bcos_tx_stage_seconds_sum\{stage="(\w+)"\} '
+                         r'([0-9.eE+-]+)', text):
+        sums[m.group(1)] = float(m.group(2))
+    blocks = cli.get_block_number()
+    stage_mean = sum(v for k, v in sums.items()
+                     if k not in ("crypto",)) / max(1, blocks)
+    e2e_mean = sum(e2e) / len(e2e)
+    ratio = stage_mean / e2e_mean
+    assert 0.2 <= ratio <= 2.0, (sums, stage_mean, e2e_mean)
+    print("sanitize_ci: OBS STAGE CLEAN "
+          f"(spans={len(spans)}, stages={sorted(sums)}, "
+          f"stage_mean={stage_mean*1000:.1f}ms, "
+          f"e2e_mean={e2e_mean*1000:.1f}ms, ratio={ratio:.2f})")
+finally:
+    if proc is not None and proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+  echo "== [obs] trace-profile decomposition row"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python benchmark/chain_bench.py --trace-profile --trace-txs 16 \
+    --backend host 2>/dev/null | grep '"metric": "trace_profile_summary"'
   exit 0
 fi
 
