@@ -42,12 +42,18 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from ..utils.metrics import REGISTRY
 from ..utils.task import Task
 
 # ops the lane merges; everything else delegates straight to the base suite
 _OPS = ("verify", "recover", "hash")
+
+# fault sites (utils/failpoints.py): `dispatch` fires inside the per-batch
+# try (a clean batch rejection), `dispatcher` fires OUTSIDE it — the
+# dispatcher-death path the health plane must surface
+fp.register("crypto.lane.dispatch", "crypto.lane.dispatcher")
 
 
 class _Req:
@@ -89,6 +95,12 @@ class CryptoLane:
         self._cv = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # dispatcher-death observers: callback(event, msg) with event
+        # "died" / "recovered" — the multi-group manager fans these into
+        # every hosted node's health plane (a dead lane starves ALL groups'
+        # crypto, it must not die silently)
+        self.on_fault: list = []
+        self._died = False
         # stats: device calls vs caller requests is the merge ratio; the
         # per-tag request means are what the merged device mean must beat
         # for the lane-merging claim to hold (chain_bench --groups)
@@ -133,23 +145,75 @@ class CryptoLane:
         with self._cv:
             if self._stop:
                 raise RuntimeError("crypto lane stopped")
+            revived = False
             if self._thread is None:
                 # lazy start: constructing a lane (e.g. from a config
-                # default) must not spawn a thread nobody uses
+                # default) must not spawn a thread nobody uses. The same
+                # path SELF-HEALS a dead dispatcher: the next submission
+                # restarts it and clears the fault
                 self._stop = False
                 self._thread = threading.Thread(
                     target=self._run, name="crypto-lane", daemon=True)
                 self._thread.start()
+                revived, self._died = self._died, False
             self._q[op].append(req)
             self._requests += 1
             self._tag_requests[tag] = self._tag_requests.get(tag, 0) + 1
             self._tag_items[tag] = self._tag_items.get(tag, 0) + n
             self._cv.notify_all()
+        if revived:
+            LOG.warning(badge("CRYPTOLANE", "dispatcher-restarted"))
+            self._notify_fault("recovered", "")
         return req.task
+
+    def _notify_fault(self, event: str, msg: str) -> None:
+        for cb in list(self.on_fault):
+            try:
+                cb(event, msg)
+            except Exception:  # noqa: BLE001 — observers must not recurse
+                LOG.exception(badge("CRYPTOLANE", "fault-observer-failed"))
 
     # -- dispatcher --------------------------------------------------------
     def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException as exc:
+            # the shared dispatcher dying starves EVERY group's crypto:
+            # reject whatever is queued (callers unblock with an error
+            # instead of hanging to their timeout), mark the thread dead
+            # so the next submission revives it, and tell the health plane
+            LOG.critical(badge("CRYPTOLANE", "dispatcher-died",
+                               error=repr(exc)))
+            with self._cv:
+                leftovers = [r for op in _OPS for r in self._q[op]]
+                for op in _OPS:
+                    self._q[op].clear()
+                if self._thread is threading.current_thread():
+                    self._thread = None
+                self._died = True
+            # notify BEFORE rejecting: a rejected caller's immediate retry
+            # revives the lane and emits "recovered" — that must not land
+            # ahead of this "died" (a stale degraded would stick). The
+            # observer's probe (dispatcher_ok) self-heals any residual
+            # ordering race.
+            self._notify_fault("died", repr(exc))
+            err = RuntimeError(f"crypto lane dispatcher died: {exc!r}")
+            for r in leftovers:
+                r.task.reject(err)
+
+    def dispatcher_ok(self) -> bool:
+        """True while the dispatcher is alive (or lazily revivable after a
+        clean stop) — the health plane's self-healing probe for the
+        `crypto.lane` fault, immune to died/recovered event reordering."""
+        with self._cv:
+            return not self._died
+
+    def _run_inner(self) -> None:
         while True:
+            # dispatcher-death injection: fires BEFORE any request is
+            # popped, so a killed cycle leaves every queued task for the
+            # death handler to reject (no caller left hanging)
+            fp.fire("crypto.lane.dispatcher")
             with self._cv:
                 while not any(self._q[op] for op in _OPS) and not self._stop:
                     self._cv.wait()
@@ -179,6 +243,7 @@ class CryptoLane:
     def _dispatch(self, batch: list[_Req]) -> None:
         op = batch[0].op
         try:
+            fp.fire("crypto.lane.dispatch")
             if op == "verify":
                 self._do_verify(batch)
             elif op == "recover":

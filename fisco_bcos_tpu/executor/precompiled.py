@@ -225,6 +225,18 @@ def decode_intent(raw: bytes) -> dict:
             "dst": r.blob(), "amount": r.u64()}
 
 
+def encode_inbox_record(src_group: str, dst: bytes, amount: int) -> bytes:
+    """The dedup inbox row `credit` writes — shared with the coordinator
+    (which recognizes an already-landed credit after a crash by it) and
+    the invariant auditor (which balances outbox against inbox)."""
+    return Writer().text(src_group).blob(dst).u64(amount).bytes()
+
+
+def decode_inbox_record(raw: bytes) -> dict:
+    r = Reader(raw)
+    return {"src_group": r.text(), "dst": r.blob(), "amount": r.u64()}
+
+
 class XShardPrecompile(Precompile):
     """Cross-group transfer legs. Balance rows are the same `c_balance`
     table BalancePrecompile serves, so cross-shard value is ordinary value.
@@ -293,7 +305,7 @@ class XShardPrecompile(Precompile):
         dst, amount = r.blob(), r.u64()
         self.touch(ctx, T_BALANCE.encode() + dst,
                    T_XSHARD_IN.encode() + xid)
-        record = (Writer().text(src_group).blob(dst).u64(amount).bytes())
+        record = encode_inbox_record(src_group, dst, amount)
         seen = ctx.state.get(T_XSHARD_IN, xid)
         if seen is not None:
             if seen == record:

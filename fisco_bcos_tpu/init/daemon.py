@@ -161,6 +161,8 @@ class NodeDaemon:
             return
         self.node = load_node(self.node_dir, gateway=self.gateway,
                               storage_passphrase=self.storage_passphrase)
+        # p2p isolation (all peers unreachable) degrades THIS node
+        self.gateway.health = self.node.health
         self.node.start()
         LOG.info(badge("DAEMON", "up", pid=os.getpid(),
                        node=kp.pub_bytes[:8].hex(),
@@ -198,6 +200,9 @@ class NodeDaemon:
         self.manager = GroupManager(shared_gateway=MuxGateway(self.gateway),
                                     chain_id=cfg.chain_id,
                                     storage=self.shared_storage)
+        # shared-plane faults (p2p isolation, shared-store ENOSPC) degrade
+        # every hosted group
+        self.gateway.health = self.manager.health_fanout
         for gid in cfg.groups:
             gcfg = _dc.replace(
                 cfg, group_id=gid, groups=[],
@@ -226,7 +231,9 @@ class NodeDaemon:
                                      port=cfg.rpc_port, pool=self.rpc_pool,
                                      keepalive_s=cfg.rpc_keepalive_s,
                                      ops=OpsRoutes(
-                                         status_fn=self.node.system_status))
+                                         status_fn=self.node.system_status,
+                                         health_fn=self.manager
+                                         .health_snapshot))
             self.rpc.start()
         if cfg.ws_port is not None:
             from ..rpc.ws_server import WsRpcServer
@@ -237,7 +244,9 @@ class NodeDaemon:
             from ..utils.metrics import MetricsServer
             self.metrics = MetricsServer(host=cfg.rpc_host,
                                          port=cfg.metrics_port,
-                                         status_fn=self.node.system_status)
+                                         status_fn=self.node.system_status,
+                                         health_fn=self.manager
+                                         .health_snapshot)
             self.metrics.start()
         LOG.info(badge("DAEMON", "up-multigroup", pid=os.getpid(),
                        node=kp.pub_bytes[:8].hex(),

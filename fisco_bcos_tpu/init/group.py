@@ -53,12 +53,21 @@ class GroupManager:
     def __init__(self, shared_gateway: Optional[Gateway] = None,
                  chain_id: str = "chain0", storage=None,
                  xshard: bool = True):
+        from ..utils.health import HealthFanout
+
         self.chain_id = chain_id
         self.shared_gateway = shared_gateway
         self.shared_storage = storage
         self._nodes: dict[str, Node] = {}
         self._lock = threading.Lock()
         self._lanes: dict[str, "object"] = {}  # crypto kind -> CryptoLane
+        # shared-plane faults (crypto lane death, shared-store ENOSPC) fan
+        # into EVERY hosted group's health — one sick shared plane means
+        # every group's pipeline is sick
+        self.health_fanout = HealthFanout()
+        from ..storage.wal import _SpaceHealth
+        if isinstance(storage, _SpaceHealth) and storage.health is None:
+            storage.health = self.health_fanout
         self.coordinator = None
         if xshard:
             from .xshard import CrossShardCoordinator
@@ -79,8 +88,30 @@ class GroupManager:
                     device_min_batch=config.device_min_batch,
                     mesh_devices=config.crypto_mesh_devices)
                 lane = CryptoLane(base, wait_ms=config.crypto_lane_wait_ms)
+                lane.on_fault.append(self._on_lane_fault)
                 self._lanes[kind] = lane
         return LaneSuite(lane, tag=config.group_id)
+
+    def _on_lane_fault(self, event: str, msg: str) -> None:
+        """Dispatcher death/recovery on a shared lane -> the health plane
+        of every hosted group (the lane self-heals on the next submission;
+        the fault window must still be visible). The probe clears a stale
+        fault even if a racing revival's "recovered" landed first."""
+        if event == "died":
+            if self._lanes_ok():
+                return  # stale event: the lane already revived
+            self.health_fanout.degraded("crypto.lane", msg,
+                                        probe=self._lanes_ok)
+        elif self._lanes_ok():
+            # only clear when EVERY lane kind is back: one lane reviving
+            # must not mask a sibling lane that is still dead (the probe
+            # applies the same all-lanes rule)
+            self.health_fanout.clear("crypto.lane")
+
+    def _lanes_ok(self) -> bool:
+        with self._lock:
+            lanes = list(self._lanes.values())
+        return all(lane.dispatcher_ok() for lane in lanes)
 
     def crypto_lane_stats(self) -> dict:
         with self._lock:
@@ -126,6 +157,7 @@ class GroupManager:
                         storage=storage)
             node.group_registry = self
             self._nodes[config.group_id] = node
+            self.health_fanout.add(node.health)
         if self.coordinator is not None:
             self.coordinator.attach(config.group_id, node)
         LOG.info(badge("GROUPMGR", "group-added", group=config.group_id))
@@ -136,6 +168,7 @@ class GroupManager:
             node = self._nodes.pop(group_id, None)
         if node is None:
             return False
+        self.health_fanout.remove(node.health)
         node.stop()
         return True
 
@@ -146,6 +179,22 @@ class GroupManager:
     def groups(self) -> list[str]:
         with self._lock:
             return sorted(self._nodes)
+
+    def health_snapshot(self) -> dict:
+        """Process-level /healthz document: worst state across the hosted
+        groups, faults prefixed by group id."""
+        from ..utils.health import _RANK
+        state, faults = "ok", {}
+        for gid in self.groups():
+            node = self.node(gid)
+            if node is None:
+                continue
+            snap = node.health.snapshot()
+            if _RANK[snap["state"]] > _RANK[state]:
+                state = snap["state"]
+            for comp, f in snap["faults"].items():
+                faults[f"{gid}:{comp}"] = f
+        return {"state": state, "faults": faults}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

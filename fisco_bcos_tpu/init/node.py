@@ -150,6 +150,10 @@ class NodeConfig:
     p2p_host: str = "127.0.0.1"
     p2p_port: Optional[int] = None  # None = no p2p listener configured
     p2p_peers: list = dataclasses.field(default_factory=list)  # (host, port)
+    # deterministic fault injection ([failpoints] spec, utils/failpoints.py):
+    # `site=action;site2=action` armed at node construction — test/chaos
+    # deployments only; empty (the default) arms nothing
+    failpoints: str = ""
 
 
 class Node:
@@ -168,6 +172,16 @@ class Node:
         # totals, so G in-process stacks stay tellable apart
         from ..utils.metrics import for_group
         self.metrics_view = for_group(cfg.group_id)
+        # health plane (utils/health.py): every subsystem's failure signal
+        # lands here; degraded/failed drives sealing stop + write shedding
+        # and is served via getSystemStatus, /healthz and bcos_node_health
+        from ..utils.health import Health
+        self.health = Health(registry=self.metrics_view,
+                             label=cfg.group_id)
+        self.health.on_change.append(self._on_health_change)
+        if cfg.failpoints:
+            from ..utils import failpoints as _fp
+            _fp.arm_spec(cfg.failpoints)
         # tracing plane: the process tracer adopts this node's [trace]
         # knobs (one node per process in deployments; in-process clusters
         # share the tracer and are told apart by the per-node trace label
@@ -187,7 +201,14 @@ class Node:
             memtable_mb=cfg.storage_memtable_mb,
             compact_segments=cfg.storage_compact_segments,
             key_page_size=cfg.storage_key_page_size,
-            registry=self.metrics_view)
+            registry=self.metrics_view, health=self.health)
+        # injected storage (test fixtures, sharded clusters): adopt its
+        # ENOSPC/flush health seam if the backend has one and nobody
+        # claimed it (multi-group shared bases get a fanout in group.py)
+        from ..storage.wal import _SpaceHealth
+        if isinstance(self.storage, _SpaceHealth) \
+                and self.storage.health is None:
+            self.storage.health = self.health
         # multi-group composition (init/group.py) sets this to the
         # GroupManager so RPC group methods enumerate the real registry
         self.group_registry = None
@@ -206,7 +227,8 @@ class Node:
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool,
                                    pipeline=cfg.pipeline_commit,
-                                   trace_label=self.trace_label)
+                                   trace_label=self.trace_label,
+                                   health=self.health)
         from ..tool.timesync import NodeTimeMaintenance
         self.timesync = NodeTimeMaintenance()
         # solo mode commits synchronously inside the proposal callback, so
@@ -218,7 +240,9 @@ class Node:
                              clock_ms=self.timesync.aligned_time_ms,
                              max_seal_time=cfg.max_seal_time,
                              pipeline_busy=busy,
-                             trace_label=self.trace_label)
+                             trace_label=self.trace_label,
+                             gate=self.health.sealing_allowed,
+                             current_height=self.ledger.current_number)
         self._commit_lock = threading.Lock()
         self.consensus = None  # bound by PBFT wiring in start()
         self.front: Optional[FrontService] = None
@@ -274,7 +298,8 @@ class Node:
                                          pool=self.rpc_pool,
                                          keepalive_s=cfg.rpc_keepalive_s,
                                          ops=OpsRoutes(
-                                             status_fn=self.system_status))
+                                             status_fn=self.system_status,
+                                             health_fn=self.health.snapshot))
             if cfg.ws_port is not None:
                 from ..rpc.ws_server import WsRpcServer
                 self.ws = WsRpcServer(impl, host=cfg.rpc_host,
@@ -284,8 +309,21 @@ class Node:
             from ..utils.metrics import MetricsServer
             self.metrics = MetricsServer(host=cfg.rpc_host,
                                          port=cfg.metrics_port,
-                                         status_fn=self.system_status)
+                                         status_fn=self.system_status,
+                                         health_fn=self.health.snapshot)
         self._started = False
+
+    def _on_health_change(self, old: str, new: str) -> None:
+        """Health transitions drive the degradation policy: the sealer's
+        gate and the write shed read health state directly; this observer
+        adds the operator-facing record and wakes the sealer so a recovery
+        resumes proposals immediately instead of at the next idle tick."""
+        LOG.warning(badge("NODE", "health-transition", old=old, new=new,
+                          group=self.config.group_id,
+                          faults=",".join(
+                              self.health.snapshot()["faults"]) or "-"))
+        if new == "ok":
+            self.sealer.wakeup()
 
     # -- RPC impl wiring ---------------------------------------------------
     def make_rpc_impl(self):
@@ -328,6 +366,7 @@ class Node:
             "group": cfg.group_id,
             "chain": cfg.chain_id,
             "node": self.keypair.pub_bytes.hex(),
+            "health": self.health.snapshot(),
             "blockNumber": self.ledger.current_number(),
             "syncMode": bs.sync_mode if bs is not None else "replay",
             "txpool": {**self.txpool.status(),
@@ -362,6 +401,13 @@ class Node:
         self._started = True
         if self.config.consensus == "solo":
             self.sealer.set_should_seal(True, self.ledger.current_number() + 1)
+            # commits landing OUTSIDE the proposal path (the health
+            # plane's retry probe re-driving a stalled height) must still
+            # roll the solo grant forward, or the sealer would keep
+            # proposing the already-committed height forever. Membership-
+            # guarded: a stop()/start() cycle must not stack duplicates.
+            if self._solo_regrant not in self.scheduler.on_commit:
+                self.scheduler.on_commit.append(self._solo_regrant)
             self.sealer.start()
         elif self.config.consensus == "pbft":
             if self.front is None:
@@ -410,6 +456,18 @@ class Node:
         self.consensus.start()
         self.sealer.start()
 
+    def _solo_regrant(self, number: int) -> None:
+        """Solo-mode commit observer: retire grants at or below the
+        committed height and arm the next one (idempotent with the
+        proposal path's own revoke/grant)."""
+        try:
+            cfg = self.ledger.ledger_config()
+            self.sealer.revoke(number)
+            self.sealer.set_should_seal(True, number + 1,
+                                        max_txs=cfg.block_tx_count_limit)
+        except Exception:  # noqa: BLE001 — observer must not kill notify
+            LOG.exception(badge("NODE", "solo-regrant-failed"))
+
     def _maybe_promote(self, _number: int) -> None:
         """Observer -> sealer promotion at the commit that enacts it."""
         if self.consensus is not None or not self._started:
@@ -446,6 +504,7 @@ class Node:
         if self.front is not None:
             self.front.stop()
         self.scheduler.shutdown()
+        self.health.stop()
         self._started = False
 
     # -- solo-consensus proposal path --------------------------------------
@@ -462,7 +521,20 @@ class Node:
             seal = self.suite.sign(self.keypair,
                                    result.header.hash(self.suite))
             result.header.signature_list = [(0, seal)]
-            ok = self.scheduler.commit_block(result.header)
+            try:
+                ok = self.scheduler.commit_block(result.header)
+            except Exception as exc:  # noqa: BLE001 — deliberate catch
+                # an exception ESCAPING commit_block used to blow through
+                # the sealer worker with the proposal's txs still marked
+                # sealed and the grant consumed — a silently wedged solo
+                # chain. Trip the health plane (degraded + retry probe)
+                # and take the refused-proposal path so the txs return to
+                # the pool.
+                LOG.critical(badge("NODE", "solo-commit-exception",
+                                   number=result.header.number,
+                                   error=repr(exc)))
+                self.scheduler.report_commit_fault(exc)
+                ok = False
             if ok:
                 # prune consumed-round markers (bounded memory; PBFT's
                 # engine does this in _try_commit_ledger)
@@ -477,6 +549,14 @@ class Node:
         """-> TxSubmitResult, ALWAYS (the lightnode wire path and other
         in-process embeddings encode res.status — lane conditions map to
         statuses, they must not escape as exceptions)."""
+        if self.health.writes_shed():
+            # degraded/failed: shed the write with the TYPED status (reads
+            # keep serving). Clients fail fast and route elsewhere instead
+            # of feeding a pipeline that cannot commit.
+            from ..protocol import TransactionStatus
+            from ..txpool.txpool import TxSubmitResult
+            return TxSubmitResult(tx.hash(self.suite),
+                                  TransactionStatus.NODE_DEGRADED)
         if self.ingest is not None and self._started:
             from ..protocol import TransactionStatus
             from ..txpool.ingest import LaneStopped, TxPoolIsFull

@@ -32,10 +32,18 @@ from typing import Optional
 
 from ..executor import precompiled as pc
 from ..protocol import Transaction, TransactionStatus
+from ..utils import failpoints as fp
 from ..utils.log import LOG, badge, metric
 from ..utils.metrics import REGISTRY
 
 _RECEIPT_WAIT = 30.0
+
+# saga-leg fault sites (utils/failpoints.py): a raise between the escrow
+# commit and the credit, or between the credit and the settle, leaves the
+# transfer pending for the next sweep — the matrix asserts it still lands
+# exactly once (idempotent legs + durable pending markers)
+fp.register("xshard.sweep", "xshard.credit.before_submit",
+            "xshard.finish.before_submit")
 
 
 class CrossShardCoordinator:
@@ -96,6 +104,7 @@ class CrossShardCoordinator:
         destination coalesce into shared blocks — and shared verify
         batches through the crypto lane), then the verdicts fan back into
         one wave of `finish` txs the same way."""
+        fp.fire("xshard.sweep")
         driven = 0
         for gid in self.mgr.groups():
             node = self.mgr.node(gid)
@@ -151,6 +160,21 @@ class CrossShardCoordinator:
                                   xid=xid.hex()[:16]))
                 verdicts[xid] = False
                 continue
+            # a prior (crashed) drive may have LANDED the credit already —
+            # its inbox record is the durable verdict. Without this check
+            # a crash between the credit commit and the finish leg parks
+            # the transfer for the whole nonce window: the re-submitted
+            # credit tx reuses the deterministic nonce and is refused with
+            # NONCE_CHECK_FAIL until block_limit_range blocks roll by
+            # (found by the xshard.finish.before_submit failpoint sweep).
+            seen = dst_node.storage.get(pc.T_XSHARD_IN, xid)
+            if seen is not None:
+                verdicts[xid] = seen == pc.encode_inbox_record(
+                    gid, intent["dst"], intent["amount"])
+                continue
+            # the window between the escrow commit and the credit — the
+            # classic lost-in-flight-transfer crash point
+            fp.fire("xshard.credit.before_submit")
             tx = self._leg_tx(
                 dst_node, "credit",
                 lambda w, xid=xid, intent=intent: (
@@ -178,6 +202,8 @@ class CrossShardCoordinator:
             ok = verdicts.get(xid)
             if ok is None:
                 continue
+            # the window between the credit commit and the settle leg
+            fp.fire("xshard.finish.before_submit")
             tx = self._leg_tx(
                 src_node, "finish",
                 lambda w, xid=xid, ok=ok: w.blob(xid).u8(1 if ok else 0),

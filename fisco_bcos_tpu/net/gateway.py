@@ -21,6 +21,7 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from ..utils import failpoints as _fp
 from ..utils.log import LOG, badge
 
 
@@ -252,6 +253,10 @@ class FakeGateway(Gateway):
                     if n != src and n not in self._partitioned]
 
     def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        if _fp.fire_lossy("p2p.send"):
+            return False  # same site the socket gateway crosses: the
+            #               in-process failpoint matrix exercises frame
+            #               loss without real sockets
         with self._lock:
             if (src in self._partitioned or dst in self._partitioned
                     or dst not in self._fronts):
@@ -301,6 +306,8 @@ class FakeGateway(Gateway):
             if item is None:
                 return
             src, data = item
+            if _fp.fire_lossy("p2p.recv"):
+                continue  # injected inbound loss (matches p2p._read_loop)
             with self._lock:
                 front = self._fronts.get(node_id)
                 dead = node_id in self._partitioned

@@ -44,10 +44,29 @@ try:  # zstd frame compression (libp2p/P2PMessageV2.h uses zstd); zlib
 except Exception:  # pragma: no cover — environment without zstandard
     _zstd = None
     _ZC = None
+import random
 from typing import Optional
 
+from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from .gateway import Gateway
+
+# fault sites (utils/failpoints.py): `return_err` at p2p.send drops the
+# outbound frame (the caller sees a refused send), at p2p.recv the inbound
+# frame vanishes before dispatch — exactly a lossy network, deterministic
+fp.register("p2p.send", "p2p.recv")
+
+
+def reconnect_delay(base: float, fails: int, cap: float,
+                    rng: random.Random) -> float:
+    """Exponential backoff with randomized jitter. Without jitter every
+    peer of a healed partition recomputes the SAME schedule and redials in
+    lockstep — a reconnect storm against the just-recovered side. Each
+    delay is drawn uniformly from [0.5, 1.0] x the exponential step, so a
+    fleet's redials spread across half the window while the worst case
+    never exceeds the undithered schedule."""
+    step = min(base * (2.0 ** min(fails, 16)), cap)
+    return step * (0.5 + rng.random() * 0.5)
 
 MAGIC = b"FBTP"
 # v3: capability byte in the hello (zstd negotiation). The handshake is
@@ -258,8 +277,15 @@ class P2PGateway(Gateway):
                  reconnect_interval: float = 1.0,
                  allow_list: Optional[set[bytes]] = None,
                  deny_list: Optional[set[bytes]] = None,
-                 compress_threshold: int = 1024):
+                 compress_threshold: int = 1024,
+                 health=None):
         self.node_id = node_id
+        # health plane (utils/health.py): a node that cannot reach ANY
+        # configured peer reports `p2p.isolated` degraded (writes shed —
+        # they could never commit) and clears on the first session up
+        self.health = health
+        self._isolated = False
+        self._jitter_rng = random.Random()
         self.configured_peers = list(peers or [])
         self.server_ssl = server_ssl
         self.client_ssl = client_ssl
@@ -331,6 +357,8 @@ class P2PGateway(Gateway):
         return 0, data
 
     def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
+        if fp.fire_lossy("p2p.send"):
+            return False  # injected loss: frame dropped before the wire
         flags, payload = self._encode_payload(data)
         frame = _pack_data(flags, MAX_TTL, self.node_id, dst, payload)
         return self._forward(dst, frame)
@@ -433,6 +461,9 @@ class P2PGateway(Gateway):
             self._recompute_codec_locked()
         self._spawn(lambda: self._read_loop(sess, sock),
                     f"p2p-read-{peer_id[:4].hex()}")
+        if self._isolated and self.health is not None:
+            self._isolated = False
+            self.health.clear("p2p.isolated")
         LOG.info(badge("P2P", "session-up", peer=peer_id[:8].hex(),
                        n=len(self._sessions)))
         self._update_session_gauge()
@@ -555,16 +586,54 @@ class P2PGateway(Gateway):
                             sock.close()  # raise: leaked fds accumulate
                         except OSError:   # per retry for a daemon's life
                             pass
-                    # exponent clamped: fails grows forever for a
-                    # permanently-dead peer and 2.0**1025 would overflow,
-                    # killing this thread and all future redials
-                    delay = min(self.reconnect_interval
-                                * (2.0 ** min(fails, 16)),
-                                self.MAX_RECONNECT_BACKOFF)
+                    # exponent clamped inside reconnect_delay: fails grows
+                    # forever for a permanently-dead peer and 2.0**1025
+                    # would overflow, killing this thread and all future
+                    # redials. The jitter keeps a healed partition's peers
+                    # from redialing in lockstep.
+                    delay = reconnect_delay(self.reconnect_interval, fails,
+                                            self.MAX_RECONNECT_BACKOFF,
+                                            self._jitter_rng)
                     backoff[(host, port)] = (fails + 1,
                                              time.monotonic() + delay)
                     continue
+            self._check_isolation(backoff)
             time.sleep(self.reconnect_interval)
+
+    # consecutive dial failures per address before the node may call
+    # itself isolated (one flaky dial must not shed writes)
+    ISOLATION_FAILS = 3
+
+    def _check_isolation(self, backoff: dict) -> None:
+        """Repeated reconnect failure used to be swallowed by the dial
+        loop: a node with configured peers, ZERO sessions, and every
+        address >= ISOLATION_FAILS consecutive failures is partitioned
+        from the whole mesh — report it instead of idling."""
+        if self.health is None:
+            return
+        with self._lock:
+            if self._sessions or not self.configured_peers:
+                return  # clearing happens at session install
+            isolated = all(
+                backoff.get(addr, (0, 0.0))[0] >= self.ISOLATION_FAILS
+                for addr in self.configured_peers)
+            n = len(self.configured_peers)
+            if isolated:
+                self._isolated = True
+        if isolated:
+            # a session installing between the locked check and this call
+            # is healed by the probe (and by _install's own clear)
+            self.health.degraded(
+                "p2p.isolated",
+                f"no session; all {n} configured peer(s) failing >= "
+                f"{self.ISOLATION_FAILS} dials",
+                probe=self._connectivity_ok)
+
+    def _connectivity_ok(self) -> bool:
+        """Self-healing probe for `p2p.isolated`: any live session means
+        the node is reachable again (covers the report/install race)."""
+        with self._lock:
+            return bool(self._sessions)
 
     def _read_loop(self, sess: "_Session", sock: socket.socket) -> None:
         peer_id = sess.peer_id
@@ -576,6 +645,8 @@ class P2PGateway(Gateway):
             if frame is None:
                 self._drop_session(sess)
                 return
+            if fp.fire_lossy("p2p.recv"):
+                continue  # injected loss: inbound frame never dispatched
             try:
                 self._on_frame(peer_id, frame)
             except Exception:

@@ -61,6 +61,10 @@ class TransactionStatus(enum.IntEnum):
     INVALID_GROUPID = 10007
     INVALID_SIGNATURE = 10008
     REQUEST_NOT_BELIEVABLE = 10009
+    # typed write-shed signal from the health plane (utils/health.py): the
+    # node is degraded — reads still serve, writes are refused so clients
+    # fail fast and retry another node instead of feeding a sick pipeline
+    NODE_DEGRADED = 10010
 
 
 @dataclasses.dataclass

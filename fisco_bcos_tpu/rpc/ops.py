@@ -11,6 +11,13 @@ scrape port):
   GET /metrics              Prometheus exposition text (0.0.4)
   GET /status               one JSON document per node: the same aggregate
                             the `getSystemStatus` RPC returns
+  GET /healthz              health state machine (utils/health.py): 200
+                            while `ok`, 503 while degraded/failed — the
+                            LB/orchestrator liveness contract
+  GET /failpoints           the fault-injection surface (utils/failpoints):
+                            registered sites + what is armed; `?arm=site=
+                            action` / `?disarm=site|all` mutate it, TEST
+                            BUILDS ONLY (BCOS_FAILPOINTS_OPS=1)
   GET /trace?id=<trace_id>  every retained span of one trace (otrace ring)
   GET /trace | /traces      newest-first trace summaries
                             (?limit=N, ?slow=1 for the slow ring only)
@@ -32,7 +39,8 @@ class OpsRoutes:
     read-only snapshot render."""
 
     def __init__(self, registry=None, tracer=None,
-                 status_fn: Optional[Callable[[], dict]] = None):
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 health_fn: Optional[Callable[[], dict]] = None):
         if registry is None:
             from ..utils.metrics import REGISTRY
             registry = REGISTRY
@@ -42,6 +50,9 @@ class OpsRoutes:
         self.registry = registry
         self.tracer = tracer
         self.status_fn = status_fn
+        # health snapshot provider (utils/health.py Health.snapshot);
+        # None = this edge serves no node (bare scrape port) -> always ok
+        self.health_fn = health_fn
 
     def __call__(self, target: str) -> tuple[int, str, bytes]:
         parts = urlsplit(target)
@@ -55,6 +66,13 @@ class OpsRoutes:
                 doc = self.status_fn() if self.status_fn is not None else {
                     "trace": self.tracer.stats()}
                 return 200, JSON_CTYPE, json.dumps(doc).encode()
+            if path == "/healthz":
+                doc = self.health_fn() if self.health_fn is not None \
+                    else {"state": "ok", "faults": {}}
+                code = 200 if doc.get("state") == "ok" else 503
+                return code, JSON_CTYPE, json.dumps(doc).encode()
+            if path == "/failpoints":
+                return self._failpoints(q)
             if path in ("/trace", "/traces"):
                 tid = (q.get("id") or [None])[0]
                 if tid:
@@ -71,3 +89,28 @@ class OpsRoutes:
             return 500, JSON_CTYPE, json.dumps(
                 {"error": str(exc)}).encode()
         return 404, JSON_CTYPE, b'{"error": "not found"}'
+
+    def _failpoints(self, q: dict) -> tuple[int, str, bytes]:
+        from ..utils import failpoints as fpl
+
+        arm = (q.get("arm") or [None])[0]
+        disarm = (q.get("disarm") or [None])[0]
+        if arm or disarm:
+            if not fpl.ops_arming_enabled():
+                return 403, JSON_CTYPE, json.dumps(
+                    {"error": "failpoint arming over ops is disabled "
+                              "(test builds set BCOS_FAILPOINTS_OPS=1)"}
+                ).encode()
+            if arm:
+                name, eq, action = arm.partition("=")
+                if not eq:
+                    return 400, JSON_CTYPE, \
+                        b'{"error": "arm=site=action"}'
+                fpl.arm(name, action)
+            elif disarm == "all":
+                fpl.disarm_all()
+            else:
+                fpl.disarm(disarm)
+        return 200, JSON_CTYPE, json.dumps(
+            {"sites": fpl.list_sites(), "armed": fpl.list_armed(),
+             "ops_arming": fpl.ops_arming_enabled()}).encode()

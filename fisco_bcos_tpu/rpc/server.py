@@ -215,6 +215,8 @@ class JsonRpcImpl:
             "getTrace": self.get_trace,
             "listTraces": self.list_traces,
             "getSystemStatus": self.get_system_status,
+            # robustness plane: structural-invariant audit (ops/audit.py)
+            "getAuditReport": self.get_audit_report,
         }
 
     # -- dispatch ----------------------------------------------------------
@@ -290,6 +292,14 @@ class JsonRpcImpl:
                          tx_hex: str = "", require_proof: bool = False,
                          wait: bool = True, timeout: float = 30.0):
         self._check_group(group)
+        from ..protocol import TransactionStatus
+        health = getattr(self.node, "health", None)
+        if health is not None and health.writes_shed():
+            # degraded node: writes are refused with the typed status code
+            # while every read method below keeps serving
+            raise JsonRpcError(int(TransactionStatus.NODE_DEGRADED),
+                               "node degraded: writes shed "
+                               f"({health.state()})")
         tx = Transaction.decode(_unhex(tx_hex))
         ctx = otrace.current()
         if ctx is not None:
@@ -692,6 +702,16 @@ class JsonRpcImpl:
         if group:
             self._check_group(group)
         return self.node.system_status()
+
+    def get_audit_report(self, group: str = "", node_name: str = "",
+                         max_blocks: int = 256):
+        """Structural-invariant audit (ops/audit.py): chain/storage/nonce
+        coherence for this node plus cross-group xshard conservation when
+        the process hosts several groups. The post-chaos-run gate."""
+        if group:
+            self._check_group(group)
+        from ..ops.audit import audit_report
+        return audit_report(self.node, max_blocks=int(max_blocks))
 
 
 def _proof_json(proof) -> list:

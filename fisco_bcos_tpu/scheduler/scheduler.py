@@ -49,7 +49,16 @@ from ..ledger.ledger import Ledger
 from ..protocol import Block, BlockHeader, ParentInfo, Receipt, Transaction
 from ..storage.interface import ChangeSet, Entry, TransactionalStorage
 from ..storage.state import StackedStorageView, StateStorage
+from ..utils import failpoints as fp
 from ..utils.log import LOG, badge, metric
+
+# deterministic fault sites on the commit pipeline (utils/failpoints.py):
+# `commit.entry` fires OUTSIDE commit_block's 2PC try (the uncaught
+# commit-thread-exception path the health plane must catch), the `2pc.*`
+# sites fire inside it (the clean rollback path)
+fp.register("scheduler.commit.handoff", "scheduler.commit.entry",
+            "scheduler.2pc.prepare", "scheduler.2pc.commit",
+            "scheduler.2pc.rollback")
 
 
 @dataclasses.dataclass
@@ -74,13 +83,18 @@ class ExecutionResult:
 class Scheduler:
     def __init__(self, storage: TransactionalStorage, ledger: Ledger,
                  executor: TransactionExecutor, suite, txpool=None,
-                 pipeline: bool = True, trace_label: str = ""):
+                 pipeline: bool = True, trace_label: str = "",
+                 health=None):
         self.storage = storage
         self.ledger = ledger
         self.executor = executor
         self.suite = suite
         self.txpool = txpool
         self.pipeline = pipeline
+        # health plane (utils/health.py): commit failures degrade the node
+        # (with a self-healing retry probe) instead of being swallowed
+        self.health = health
+        self._commit_faulted = False
         # per-node label for the block-trace registry + span attribution
         self.trace_label = trace_label
         self._lock = threading.RLock()       # bookkeeping dicts below
@@ -356,6 +370,7 @@ class Scheduler:
         """Queue a decided block for the commit worker; `done(ok)` fires on
         completion. Strict height ordering comes from FIFO submission plus
         commit_block's committed+1 check."""
+        fp.fire("scheduler.commit.handoff")
         with self._lock:
             r = self._executed.get(header.hash(self.suite))
             if r is not None:
@@ -363,6 +378,20 @@ class Scheduler:
         self._commit_q.put((header, done))
 
     def _commit_loop(self) -> None:
+        try:
+            self._commit_loop_inner()
+        except BaseException as exc:
+            # the dedicated commit thread DYING is fatal for the pipeline:
+            # nothing will ever drain the queue again while the sealer
+            # keeps granting — say so at the top of the health plane
+            # instead of wedging silently
+            LOG.critical(badge("SCHED", "commit-thread-died",
+                               error=repr(exc)))
+            if self.health is not None:
+                self.health.failed("scheduler.commit_thread", repr(exc))
+            raise
+
+    def _commit_loop_inner(self) -> None:
         while True:
             item = self._commit_q.get()
             if item is None:
@@ -372,9 +401,16 @@ class Scheduler:
                 # dynamic lookup so per-instance instrumentation wrappers
                 # (benches, soak tests) see pipelined commits too
                 ok = self.commit_block(header)
-            except Exception:
+            except Exception as exc:
+                # an exception ESCAPING commit_block used to leave the
+                # pipeline silently wedged (the sealer still granting, the
+                # height never landing): log loudly and trip the health
+                # plane with the self-healing retry probe
+                LOG.critical(badge("SCHED", "commit-thread-exception",
+                                   number=header.number, error=repr(exc)))
                 LOG.exception(badge("SCHED", "commit-worker-crashed",
                                     number=header.number))
+                self._commit_fault(exc)
                 ok = False
             if done is not None:
                 try:
@@ -383,12 +419,77 @@ class Scheduler:
                     LOG.exception(badge("SCHED", "commit-done-cb-failed",
                                         number=header.number))
 
+    # -- health plumbing ---------------------------------------------------
+    def report_commit_fault(self, exc: BaseException) -> None:
+        """Public entry for embedders driving commit_block on their own
+        thread (solo mode's proposal path): same degraded-with-retry-probe
+        handling as the pipeline's commit worker."""
+        self._commit_fault(exc)
+
+    def _commit_fault(self, exc: BaseException) -> None:
+        if self.health is None:
+            return
+        self._commit_faulted = True
+        self.health.degraded("scheduler.commit", repr(exc),
+                             probe=self.retry_pending_commit)
+
+    def _commit_healthy(self) -> None:
+        if self._commit_faulted:  # plain-flag guard on the happy path
+            self._commit_faulted = False
+            if self.health is not None:
+                self.health.clear("scheduler.commit")
+
+    def retry_pending_commit(self) -> bool:
+        """Self-healing probe: re-drive the stalled height if a DECIDED
+        execution result (it carries commit seals) is waiting at
+        committed+1. True = healed (retry landed, or nothing is stuck)."""
+        with self._lock:
+            committed = self.ledger.current_number()
+            result = None
+            for h in self._exec_heights.get(committed + 1, ()):
+                r = self._executed.get(h)
+                if r is not None and not r.committing \
+                        and r.header.signature_list:
+                    result = r
+                    break
+        if result is None:
+            return True  # nothing stuck: consensus/sync owns recovery now
+        return self.commit_block(result.header)
+
     def commit_block(self, header: BlockHeader) -> bool:
         """Commit a previously-executed block (by header hash identity).
         Runs on the commit worker in pipeline mode; callable directly for
         sync replay, solo mode and service proxies."""
-        t0 = time.monotonic()
         hh = header.hash(self.suite)
+        with self._lock:
+            guard = self._executed.get(hh)
+        try:
+            return self._commit_block_inner(header, hh)
+        except BaseException:
+            # an exception ESCAPING the commit (injected fault, observer
+            # bug) must not strand the result half-committed: without this
+            # restore, `committing` stayed True forever, the retry probe
+            # saw "nothing stuck", and the node wedged at the height until
+            # sync rescued it (found by the failpoint matrix under load).
+            # Mirror the 2PC-failure restore, and keep the DECIDED
+            # header's commit seals so the retry can land it.
+            if guard is not None:
+                with self._lock:
+                    guard.committing = False
+                    if header.signature_list \
+                            and not guard.header.signature_list:
+                        guard.header.signature_list = header.signature_list
+                    if self._executed.get(hh) is not guard \
+                            and self.ledger.current_number() \
+                            < guard.header.number:
+                        self._executed[hh] = guard
+                        self._exec_heights.setdefault(
+                            guard.header.number, set()).add(hh)
+            raise
+
+    def _commit_block_inner(self, header: BlockHeader, hh: bytes) -> bool:
+        t0 = time.monotonic()
+        fp.fire("scheduler.commit.entry")
         with self._lock:
             result = self._executed.get(hh)
             if result is None:
@@ -442,12 +543,16 @@ class Scheduler:
                 return False
             self._commit_busy = True
             try:
+                fp.fire("scheduler.2pc.prepare")
                 self.storage.prepare(number, changes)
+                fp.fire("scheduler.2pc.commit")
                 self.storage.commit(number)
-            except Exception:
+            except Exception as exc:
                 LOG.exception(badge("SCHED", "commit-2pc-failed",
                                     number=number))
+                fp.fire("scheduler.2pc.rollback")
                 self.storage.rollback(number)
+                self._commit_fault(exc)
                 # put the executed result back: a transient storage failure
                 # must not strand the height (PBFT retries the checkpoint;
                 # without this the node could only recover via block sync).
@@ -461,6 +566,7 @@ class Scheduler:
                 return False
             finally:
                 self._commit_busy = False
+        self._commit_healthy()
         if self._exec_busy:
             with self._lock:
                 self._overlap_commits += 1

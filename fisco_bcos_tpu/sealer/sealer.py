@@ -52,8 +52,21 @@ class Sealer(Worker):
                  clock_ms: Callable[[], int] | None = None,
                  max_seal_time: float = 0.5,
                  pipeline_busy: Callable[[], bool] | None = None,
-                 trace_label: str = ""):
+                 trace_label: str = "",
+                 gate: Callable[[], bool] | None = None,
+                 current_height: Callable[[], int] | None = None):
         super().__init__("sealer", idle_wait=0.05)
+        # health-plane gate (utils/health.py sealing_allowed): a degraded
+        # node stops producing proposals (they would queue behind a sick
+        # pipeline or split votes) while grants stay armed, so sealing
+        # resumes the moment the node heals
+        self.gate = gate
+        # committed-height source (ledger.current_number): grants at or
+        # below it are dead by definition and are dropped before sealing —
+        # without this, a refused proposal re-armed for a height another
+        # path (health retry probe, sync) meanwhile committed would be
+        # re-proposed forever
+        self.current_height = current_height
         self.txpool = txpool
         self.suite = suite
         # node label for the per-block trace registry (utils/trace.py):
@@ -110,6 +123,10 @@ class Sealer(Worker):
 
     # -- worker loop --------------------------------------------------------
     def execute_worker(self) -> None:
+        if self.gate is not None and not self.gate():
+            return  # degraded: hold proposals until the node heals
+        if self.current_height is not None:
+            self.revoke(self.current_height())
         with self._lock:
             if not self._grants:
                 self._first_pending_at = None

@@ -25,10 +25,15 @@ import time
 from typing import Optional
 
 from ..protocol import BlockHeader
+from ..utils import failpoints as _fp
 from ..utils.log import LOG, badge, metric
 from .manifest import SnapshotManifest, is_private_table, pack_chunks
 
 DEFAULT_CHUNK_BYTES = 1 << 20
+
+# checkpoint fault sites (utils/failpoints.py): export fires before the
+# capture, install before any verification/mutation
+_fp.register("snapshot.export", "snapshot.install")
 
 
 class SnapshotExportError(RuntimeError):
@@ -79,6 +84,7 @@ def export_snapshot(storage, ledger, suite,
     an importer can verify it against its own sealer set before trusting a
     single chunk byte.
     """
+    _fp.fire("snapshot.export")
     t0 = time.monotonic()
     lock = getattr(storage, "_lock", None)
     for attempt in range(max_attempts):

@@ -119,6 +119,8 @@ def install_snapshot(manifest: SnapshotManifest, chunks: list[bytes],
     install can never leave current_number pointing at half-written
     tables. Plain storages fall back to per-table batches.
     """
+    from ..utils import failpoints as fp
+    fp.fire("snapshot.install")
     header = verify_snapshot(manifest, chunks, suite, verify_seals,
                              seals_verified=seals_verified)
     hh = header.hash(suite)

@@ -31,7 +31,7 @@ def __getattr__(name):  # lazy: engine pulls in sstable/compact machinery
 
 def make_storage(backend: str, path: Optional[str],
                  memtable_mb: int = 64, compact_segments: int = 8,
-                 key_page_size: int = 0, registry=None
+                 key_page_size: int = 0, registry=None, health=None
                  ) -> TransactionalStorage:
     """Build the node's backing store from the `[storage]` config surface.
 
@@ -39,6 +39,8 @@ def make_storage(backend: str, path: Optional[str],
     is configured, in-memory otherwise); `memory`/`wal`/`disk` force one.
     `key_page_size` > 0 wraps the persistent backend in KeyPageStorage so
     wide-table rows are page-packed (reference KeyPageStorage layout).
+    `health` (utils/health.py) receives the persistent backends' ENOSPC /
+    flush-failure degradation signals.
     """
     if backend in ("", "auto", None):
         backend = "wal" if path else "memory"
@@ -47,11 +49,12 @@ def make_storage(backend: str, path: Optional[str],
     if path is None:
         raise ValueError(f"[storage] backend={backend} needs a data path")
     if backend == "wal":
-        st: TransactionalStorage = WalStorage(path)
+        st: TransactionalStorage = WalStorage(path, health=health)
     elif backend == "disk":
         from .engine import DiskStorage
         st = DiskStorage(path, memtable_bytes=memtable_mb << 20,
-                         max_segments=compact_segments, registry=registry)
+                         max_segments=compact_segments, registry=registry,
+                         health=health)
     else:
         raise ValueError(f"unknown [storage] backend {backend!r}")
     if key_page_size > 0:

@@ -43,13 +43,24 @@ import time
 import zlib
 from typing import Iterator, Optional
 
+from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
 from .sstable import SSTableReader, composite_key, split_key, write_sstable
-from .wal import SegmentedWal, unpack_payload
+from .wal import SegmentedWal, _SpaceHealth, unpack_payload
 
 _MANIFEST_MAGIC = b"FBTPUMAN"
 _TOMBSTONE = None  # memtable value sentinel
+
+# every durability edge the kill -9 suite exercises is a registered global
+# failpoint (utils/failpoints.py); the legacy per-instance `_failpoints`
+# set keeps working for tests that scope a fault to ONE engine
+fp.register("storage.engine.flush_before_sstable",
+            "storage.engine.flush_before_manifest",
+            "storage.engine.manifest_before_current",
+            "storage.engine.compact_before_sstable",
+            "storage.engine.compact_before_manifest",
+            "storage.memtable.flush")
 
 
 class ManifestError(RuntimeError):
@@ -74,14 +85,16 @@ def _unpack_manifest(data: bytes) -> tuple[int, int, list[int]]:
     return next_seg, wal_floor, ids
 
 
-class DiskStorage(TransactionalStorage):
+class DiskStorage(TransactionalStorage, _SpaceHealth):
     CURRENT = "CURRENT"
 
     def __init__(self, path: str, memtable_bytes: int = 64 << 20,
                  max_segments: int = 8, registry=None,
-                 auto_compact: bool = True, block_bytes: int = 4096):
+                 auto_compact: bool = True, block_bytes: int = 4096,
+                 health=None):
         from ..utils.metrics import REGISTRY
         self.path = path
+        self.health = health
         os.makedirs(path, exist_ok=True)
         self.memtable_bytes = memtable_bytes
         self.max_segments = max(2, max_segments)
@@ -119,8 +132,27 @@ class DiskStorage(TransactionalStorage):
         pass
 
     def _maybe_fail(self, name: str) -> None:
+        # process-wide plane first (crash/sleep/enospc actions live there),
+        # then the legacy per-instance raise set
+        fp.fire("storage.engine." + name.replace("-", "_"))
         if name in self._failpoints:
             raise DiskStorage._FailPoint(name)
+
+    def _wal_append(self, block_number: int, cs: ChangeSet) -> None:
+        """WAL append with the ENOSPC -> health edge: a full disk reports
+        `storage.space` degraded (probed until space returns) and the
+        commit fails CLEANLY upstream instead of wedging mid-2PC."""
+        try:
+            self._wal.append(block_number, cs)
+        except OSError as exc:
+            self._space_err(exc)
+            raise
+        self._space_ok()
+
+    def probe_space(self) -> bool:
+        with self._lock:
+            self._wal.append(0, {})
+        return True
 
     # -- manifest ----------------------------------------------------------
     def _manifest_path(self, seq: int) -> str:
@@ -389,11 +421,11 @@ class DiskStorage(TransactionalStorage):
 
     def _write_direct(self, cs: ChangeSet) -> None:
         with self._lock:
-            self._wal.append(0, cs)
+            self._wal_append(0, cs)
             self._apply_changeset_locked(cs)
             need_flush = self._mem_bytes >= self.memtable_bytes
         if need_flush:
-            self.flush()
+            self._flush_after_write()
 
     # -- 2PC ---------------------------------------------------------------
     def prepare(self, block_number: int, changes: ChangeSet) -> None:
@@ -403,12 +435,29 @@ class DiskStorage(TransactionalStorage):
     def commit(self, block_number: int) -> None:
         with self._lock:
             cs = self._prepared.pop(block_number)
-            self._wal.append(block_number, cs)
+            self._wal_append(block_number, cs)
             self._apply_changeset_locked(cs)
             need_flush = self._mem_bytes >= self.memtable_bytes
             self._publish_commit_gauges_locked()
         if need_flush:
+            self._flush_after_write()
+
+    def _flush_after_write(self) -> None:
+        """Watermark-crossing flush AFTER a durable WAL append. A flush
+        failure here must NOT surface as a commit/write failure — the data
+        is already durable in the un-retired WAL; report `storage.flush`
+        degraded and keep retrying via the health probe until it lands."""
+        try:
             self.flush()
+        except Exception as exc:  # noqa: BLE001 — deliberate containment
+            LOG.exception(badge("ENGINE", "flush-failed-after-commit"))
+            if self.health is not None:
+                self.health.degraded("storage.flush", repr(exc),
+                                     probe=self._flush_probe)
+
+    def _flush_probe(self) -> bool:
+        self.flush()  # raises while the fault persists -> stays degraded
+        return True
 
     def rollback(self, block_number: int) -> None:
         with self._lock:
@@ -420,6 +469,7 @@ class DiskStorage(TransactionalStorage):
         success retire the WAL segments it covers. Crash-safe: until the
         manifest edge lands, recovery replays the same records from the
         un-retired WAL tail."""
+        fp.fire("storage.memtable.flush")
         with self._flush_lock:
             with self._lock:
                 if not self._mem:
@@ -629,6 +679,46 @@ class DiskStorage(TransactionalStorage):
             self._publish_gauges()
 
     # -- observability -----------------------------------------------------
+    def audit(self) -> list[str]:
+        """WAL/manifest coherence problems, [] if clean (the invariant
+        auditor's storage check, ops/audit.py): CURRENT must name a
+        readable manifest whose segment list matches the live set, every
+        referenced segment file must exist, and the WAL floor must not
+        have passed the active segment."""
+        problems: list[str] = []
+        with self._lock:
+            seg_ids = [s.seg_id for s in self._segments]
+            wal_floor = self._wal_floor
+            active_seq = self._wal.active_seq
+        cur = os.path.join(self.path, self.CURRENT)
+        man_ids: list[int] = []
+        if not os.path.exists(cur):
+            if seg_ids:
+                problems.append("CURRENT missing with live segments")
+        else:
+            try:
+                with open(cur) as f:
+                    name = f.read().strip()
+                with open(os.path.join(self.path, name), "rb") as f:
+                    _, man_floor, man_ids = _unpack_manifest(f.read())
+                if sorted(man_ids) != sorted(seg_ids):
+                    problems.append(
+                        f"manifest segments {sorted(man_ids)} != live "
+                        f"{sorted(seg_ids)}")
+                if man_floor > active_seq:
+                    problems.append(
+                        f"WAL floor {man_floor} beyond active segment "
+                        f"{active_seq}")
+            except (OSError, ManifestError, ValueError) as exc:
+                problems.append(f"CURRENT/manifest unreadable: {exc}")
+        for sid in seg_ids:
+            if not os.path.exists(self._seg_path(sid)):
+                problems.append(f"segment file seg-{sid:08d}.sst missing")
+        if wal_floor > active_seq:
+            problems.append(f"live WAL floor {wal_floor} beyond active "
+                            f"segment {active_seq}")
+        return problems
+
     def disk_bytes(self) -> int:
         with self._lock:
             seg_bytes = sum(s.file_bytes for s in self._segments)

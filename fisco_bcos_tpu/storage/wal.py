@@ -21,15 +21,50 @@ Record format (all little-endian):
 
 from __future__ import annotations
 
+import errno
 import os
 import struct
 import threading
 import zlib
 from typing import Iterator, Optional
 
+from ..utils import failpoints as fp
 from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
 
 _HDR = struct.Struct("<IQ")
+
+# deterministic fault sites on the durability edges (utils/failpoints.py):
+# append fires INSIDE the write/fsync try of both backends, so an injected
+# `enospc` exercises the exact errno path a full disk takes
+fp.register("storage.wal.append_before_fsync", "storage.wal.rotate")
+
+
+class _SpaceHealth:
+    """Shared ENOSPC -> health plumbing for the WAL-owning backends: report
+    `storage.space` degraded on a full disk, self-heal by probing the same
+    fsync path, clear on the first successful append."""
+
+    health = None  # a utils.health.Health (or fanout), attached by the node
+    _space_faulted = False
+
+    def _space_err(self, exc: BaseException) -> None:
+        if isinstance(exc, OSError) and exc.errno == errno.ENOSPC \
+                and self.health is not None:
+            self._space_faulted = True
+            self.health.degraded("storage.space", str(exc),
+                                 probe=self.probe_space)
+
+    def _space_ok(self) -> None:
+        if self._space_faulted:  # plain-flag guard: zero cost when healthy
+            self._space_faulted = False
+            if self.health is not None:
+                self.health.clear("storage.space")
+
+    def probe_space(self) -> bool:
+        """Try the append path with an empty changeset (a ~20-byte record).
+        True = the disk accepts writes again (the health ticker clears the
+        fault); raises/False = still out of space."""
+        raise NotImplementedError
 
 
 def pack_payload(block_number: int, cs: ChangeSet) -> bytes:
@@ -120,6 +155,25 @@ class WalCorruptionError(RuntimeError):
     which is routine kill -9 fallout and is truncated."""
 
 
+def _rewind_append(f, path: str, off: int):
+    """Recover an append-mode log file after a failed write: drop any
+    buffered/partial bytes by reopening and truncating back to the last
+    good record boundary. Returns the fresh append handle."""
+    try:
+        f.close()  # discards the unflushed buffer; may raise on flush
+    except OSError:
+        pass
+    try:
+        with open(path, "rb+") as t:
+            t.truncate(off)
+            t.flush()
+            os.fsync(t.fileno())
+    except OSError:
+        pass  # truncate needs no space; a failure here leaves the torn
+        #       tail for recovery's truncate_torn_tail to cut at boot
+    return open(path, "ab")
+
+
 class SegmentedWal:
     """Rotated WAL segments for the disk engine (storage/engine.py).
 
@@ -180,15 +234,31 @@ class SegmentedWal:
                 yield seq, p
 
     def append(self, block_number: int, cs: ChangeSet) -> None:
+        fp.fire("storage.wal.append_before_fsync")
         payload = pack_payload(block_number, cs)
-        self._f.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        off = os.fstat(self._f.fileno()).st_size  # buffer empty: every
+        #     prior append flushed or was rewound, so size IS the offset
+        try:
+            self._f.write(_HDR.pack(zlib.crc32(payload), len(payload))
+                          + payload)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError:
+            # a SURVIVED write failure (ENOSPC with the health plane
+            # keeping the node up) must not leave torn bytes in the log:
+            # later appends would land AFTER them and the next restart's
+            # replay would stop at the tear, silently dropping every
+            # acked commit behind it
+            self._f = _rewind_append(self._f,
+                                     self._segment_path(self.active_seq),
+                                     off)
+            raise
 
     def rotate(self) -> int:
         """Close the active segment and start the next; returns the NEW
         active seq — every record appended before the call lives in
         segments strictly below it."""
+        fp.fire("storage.wal.rotate")
         self._f.close()
         self.active_seq += 1
         self._f = open(self._segment_path(self.active_seq), "ab")
@@ -216,12 +286,13 @@ class SegmentedWal:
         self._f.close()
 
 
-class WalStorage(TransactionalStorage):
+class WalStorage(TransactionalStorage, _SpaceHealth):
     SNAPSHOT = "snapshot.bin"
     LOG = "wal.log"
 
-    def __init__(self, path: str, compact_every: int = 1024):
+    def __init__(self, path: str, compact_every: int = 1024, health=None):
         self.path = path
+        self.health = health
         os.makedirs(path, exist_ok=True)
         self._tables: dict[str, dict[bytes, bytes]] = {}
         self._prepared: dict[int, ChangeSet] = {}
@@ -378,10 +449,73 @@ class WalStorage(TransactionalStorage):
 
     # -- log/snapshot mechanics -------------------------------------------
     def _append_record(self, block_number: int, cs: ChangeSet) -> None:
-        payload = pack_payload(block_number, cs)
-        self._log.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
-        self._log.flush()
-        os.fsync(self._log.fileno())
+        try:
+            fp.fire("storage.wal.append_before_fsync")
+            payload = pack_payload(block_number, cs)
+            off = os.fstat(self._log.fileno()).st_size
+            try:
+                self._log.write(_HDR.pack(zlib.crc32(payload),
+                                          len(payload)) + payload)
+                self._log.flush()
+                os.fsync(self._log.fileno())
+            except OSError:
+                # survived write failure: rewind the torn bytes so later
+                # appends (and the next restart's replay) never land
+                # behind an unparseable partial record
+                self._log = _rewind_append(
+                    self._log, os.path.join(self.path, self.LOG), off)
+                raise
+        except OSError as exc:
+            # ENOSPC mid-commit must not kill the node: report, let the
+            # 2PC fail cleanly upstream (scheduler rolls back and the
+            # height retries), and self-heal via the probe once space
+            # returns
+            self._space_err(exc)
+            raise
+        self._space_ok()
+
+    def probe_space(self) -> bool:
+        with self._lock:
+            self._append_record(0, {})
+        return True
+
+    def audit(self) -> list[str]:
+        """Coherence problems with the on-disk log/snapshot, [] if clean
+        (the invariant auditor's storage check, ops/audit.py).
+
+        Only the size capture holds the storage lock: appends are whole
+        records flushed under `_lock`, so every byte below the captured
+        size is a complete record — the O(log) read + parse must not
+        stall commits for the duration of an RPC-triggered audit."""
+        problems: list[str] = []
+        logp = os.path.join(self.path, self.LOG)
+        with self._lock:
+            try:
+                self._log.flush()
+                size = os.path.getsize(logp)
+            except (OSError, ValueError) as exc:  # closed/unreadable
+                return [f"wal.log unreadable: {exc}"]
+        try:
+            with open(logp, "rb") as f:
+                raw = f.read(size)
+            _, valid = scan_records(raw)
+            if valid < len(raw):
+                problems.append(
+                    f"wal.log: {len(raw) - valid} unparseable byte(s) "
+                    f"past offset {valid}")
+        except OSError as exc:
+            problems.append(f"wal.log unreadable: {exc}")
+        snap = os.path.join(self.path, self.SNAPSHOT)
+        if os.path.exists(snap):
+            try:
+                with open(snap, "rb") as f:
+                    data = f.read()
+                if len(data) < 4 or zlib.crc32(data[4:]) != \
+                        struct.unpack("<I", data[:4])[0]:
+                    problems.append("snapshot.bin crc mismatch")
+            except OSError as exc:
+                problems.append(f"snapshot.bin unreadable: {exc}")
+        return problems
 
     def compact(self) -> None:
         """Write a snapshot and truncate the WAL (atomic rename)."""

@@ -29,6 +29,7 @@ import os
 import random
 import signal
 import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -58,13 +59,21 @@ def free_port_block(n: int, tries: int = 64) -> int:
 
 
 class LinkProxy:
-    """TCP forwarder for one p2p link with bounded delay + periodic drops.
+    """TCP forwarder for one p2p link: bounded delay, periodic drops, and
+    runtime-togglable (a)symmetric blackholes.
 
-    Transparent to SM-TLS (it moves opaque bytes), so it models a slow or
-    flapping NETWORK, not a Byzantine peer: every `drop_every` forwarded
-    chunks the connection is cut (both directions), which the gateway's
-    reconnect-with-backoff path must absorb; every chunk is delayed by
-    `delay` seconds (bounded latency)."""
+    Transparent to SM-TLS (it moves opaque bytes), so it models a slow,
+    flapping or PARTITIONED network, not a Byzantine peer: every
+    `drop_every` forwarded chunks the connection is cut (both directions),
+    which the gateway's reconnect-with-backoff path must absorb; every
+    chunk is delayed by `delay` seconds (bounded latency).
+
+    `blackhole(direction)` silently DISCARDS bytes in one or both pump
+    directions — "fwd" is dialer->target, "rev" the reverse — modelling a
+    gray link where A's frames reach B but B's never reach A. Discarding
+    from a TLS/framed stream means the mangled direction's session dies on
+    the next delivered byte after `heal()`, so healing also exercises the
+    jittered reconnect path, exactly like a real partition healing."""
 
     def __init__(self, target_host: str, target_port: int,
                  delay: float = 0.0, drop_every: int = 0):
@@ -74,11 +83,32 @@ class LinkProxy:
         self._chunks = 0
         self._lock = threading.Lock()
         self._stopped = False
+        self._blackholed: set[str] = set()  # "fwd" / "rev"
+        self.discarded = 0  # bytes swallowed by blackholes
         self._listener = socket.create_server(("127.0.0.1", 0))
         self.port = self._listener.getsockname()[1]
         self.drops = 0
         threading.Thread(target=self._accept_loop, name="chaos-proxy",
                          daemon=True).start()
+
+    # -- partition control (runtime-safe) ----------------------------------
+    def blackhole(self, direction: str = "both") -> None:
+        """Start discarding bytes: "fwd" (dialer->target), "rev", "both"."""
+        assert direction in ("fwd", "rev", "both"), direction
+        with self._lock:
+            self._blackholed |= ({"fwd", "rev"} if direction == "both"
+                                 else {direction})
+
+    def heal(self) -> None:
+        with self._lock:
+            self._blackholed.clear()
+
+    def heal_after(self, seconds: float) -> threading.Timer:
+        """Partition-heal schedule: clear the blackhole after `seconds`."""
+        t = threading.Timer(seconds, self.heal)
+        t.daemon = True
+        t.start()
+        return t
 
     def _accept_loop(self) -> None:
         while not self._stopped:
@@ -91,11 +121,13 @@ class LinkProxy:
             except OSError:
                 client.close()
                 continue
-            for a, b in ((client, upstream), (upstream, client)):
-                threading.Thread(target=self._pump, args=(a, b),
+            for a, b, d in ((client, upstream, "fwd"),
+                            (upstream, client, "rev")):
+                threading.Thread(target=self._pump, args=(a, b, d),
                                  daemon=True).start()
 
-    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              direction: str) -> None:
         while not self._stopped:
             try:
                 chunk = src.recv(65536)
@@ -111,8 +143,13 @@ class LinkProxy:
                        and self._chunks % self.drop_every == 0)
                 if cut:
                     self.drops += 1
+                holed = direction in self._blackholed
+                if holed:
+                    self.discarded += len(chunk)
             if cut:
                 break  # fault: sever the whole connection mid-stream
+            if holed:
+                continue  # fault: one-way blackhole — bytes vanish
             try:
                 dst.sendall(chunk)
             except OSError:
@@ -127,6 +164,80 @@ class LinkProxy:
         self._stopped = True
         try:
             self._listener.close()
+        except OSError:
+            pass
+
+
+class ByzantinePeer:
+    """A malicious speaker of the p2p wire protocol, aimed at one node's
+    gateway seam (chains built WITHOUT TLS — with SM-TLS a stranger cannot
+    even finish the transport handshake, which is its own, already-tested
+    defense; this peer exercises the post-transport validation layers).
+
+    It completes the plaintext handshake under a fabricated node id and
+    then emits the adversarial stream the gateway/front/consensus stack
+    must shrug off: garbage frames, corrupt compressed payloads, frames
+    spoofing OTHER nodes' identities, consensus-module payloads that decode
+    to nothing (the equivocating-pre-prepare/bad-seal-block stand-ins —
+    inner signature checks reject anything unsigned-by-a-sealer, so at the
+    gateway seam "signed garbage" and "unsigned equivocation" die in the
+    same validation layer), and block-sync responses full of junk. The
+    assertion is always the same: the chain keeps committing, converges,
+    and `getAuditReport` stays clean."""
+
+    def __init__(self, host: str, port: int, node_id: Optional[bytes] = None):
+        from fisco_bcos_tpu.net import p2p as _p2p
+        self._p2p = _p2p
+        self.node_id = node_id or bytes([0xEE]) * 33
+        self.sock = socket.create_connection((host, port), timeout=5)
+        hello = (_p2p.MAGIC + bytes([_p2p.VERSION, 0]) + self.node_id)
+        _p2p._send_frame(self.sock, hello)
+        _p2p._recv_frame(self.sock)  # victim's hello
+
+    def _raw(self, frame: bytes) -> bool:
+        try:
+            self._p2p._send_frame(self.sock, frame)
+            return True
+        except OSError:
+            return False
+
+    def send_garbage(self, n: int = 64) -> None:
+        """Random byte soup inside valid length prefixes."""
+        rnd = random.Random(0xBAD)
+        for _ in range(n):
+            self._raw(bytes(rnd.randrange(256)
+                            for _ in range(rnd.randrange(1, 512))))
+
+    def send_corrupt_frames(self, dst: bytes, n: int = 32) -> None:
+        """Well-formed DATA frames whose compressed payload is garbage."""
+        p2p = self._p2p
+        rnd = random.Random(0xC0)
+        for _ in range(n):
+            junk = bytes(rnd.randrange(256) for _ in range(200))
+            self._raw(p2p._pack_data(p2p.FLAG_COMPRESSED, p2p.MAX_TTL,
+                                     self.node_id, dst, junk))
+
+    def send_spoofed(self, src: bytes, dst: bytes, payload: bytes,
+                     n: int = 8) -> None:
+        """DATA frames claiming another node's identity as source."""
+        p2p = self._p2p
+        for _ in range(n):
+            self._raw(p2p._pack_data(0, p2p.MAX_TTL, src, dst, payload))
+
+    def send_module_junk(self, dst: bytes, module: int, n: int = 32) -> None:
+        """Frames addressed to a real module (consensus pre-prepares,
+        block-sync responses) with undecodable/unsigned bodies."""
+        p2p = self._p2p
+        rnd = random.Random(module)
+        for _ in range(n):
+            body = struct.pack(">H", module) + bytes(
+                rnd.randrange(256) for _ in range(rnd.randrange(8, 300)))
+            self._raw(p2p._pack_data(0, p2p.MAX_TTL, self.node_id, dst,
+                                     body))
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
         except OSError:
             pass
 
@@ -186,6 +297,7 @@ class ChaosHarness:
         tport = self.info["nodes"][target]["p2p_port"]
         proxy = LinkProxy("127.0.0.1", tport, delay=delay,
                           drop_every=drop_every)
+        proxy.dialer, proxy.target_node = dialer, target
         self.proxies.append(proxy)
         from fisco_bcos_tpu.tool.config import node_config_from_ini
         node_dir = self.info["nodes"][dialer]["dir"]
@@ -196,14 +308,41 @@ class ChaosHarness:
             for h, p in peers])
         return proxy
 
+    def partition_link(self, proxy: LinkProxy, src: int,
+                       dst: Optional[int] = None) -> None:
+        """Asymmetric partition over an injected proxy: drop src->dst
+        traffic (dst defaults to the proxy's other endpoint) while the
+        reverse direction keeps flowing. Symmetric: proxy.blackhole().
+        Heal with proxy.heal() or schedule it with proxy.heal_after()."""
+        direction = "fwd" if src == proxy.dialer else "rev"
+        proxy.blackhole(direction)
+
+    def byzantine_peer(self, i: int) -> ByzantinePeer:
+        """Connect a Byzantine speaker to node i's p2p port (chains built
+        with tls=False only — TLS rejects strangers at the transport)."""
+        assert not self.tls, "ByzantinePeer needs a tls=False chain"
+        return ByzantinePeer("127.0.0.1", self.info["nodes"][i]["p2p_port"])
+
+    def node_id(self, i: int) -> bytes:
+        return bytes.fromhex(self.info["nodes"][i]["node_id"])
+
     # -- process control ---------------------------------------------------
-    def start(self, i: int) -> None:
+    def start(self, i: int, failpoints: str = "") -> None:
+        """(Re)boot node i. `failpoints` arms `site=action;...` at boot
+        via the BCOS_FAILPOINTS env (utils/failpoints.py) — how a crash
+        matrix plants `crash` actions inside a real OS process."""
         assert self.procs[i] is None or self.procs[i].poll() is not None, \
             f"node{i} already running"
         node_dir = self.info["nodes"][i]["dir"]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env["PALLAS_AXON_POOL_IPS"] = ""  # never touch a device tunnel
+        # test build: the ops endpoint may arm/disarm failpoints at runtime
+        env["BCOS_FAILPOINTS_OPS"] = "1"
+        if failpoints:
+            env["BCOS_FAILPOINTS"] = failpoints
+        else:
+            env.pop("BCOS_FAILPOINTS", None)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH",
                                                               "")
         out = open(os.path.join(node_dir, "daemon.out"), "ab")
@@ -300,6 +439,48 @@ class ChaosHarness:
     def snapshot_status(self, i: int) -> dict:
         return self.client(i).request(
             "getSnapshotStatus", [self.info["group_id"], ""])
+
+    # -- robustness plane (ops GET routes + audit RPC) ---------------------
+    def _ops_get(self, i: int, path: str) -> tuple[int, dict]:
+        import urllib.error
+        import urllib.request
+        url = (f"http://127.0.0.1:{self.info['nodes'][i]['rpc_port']}"
+               f"{path}")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:  # 503 healthz still has JSON
+            return exc.code, json.loads(exc.read() or b"{}")
+
+    def arm_failpoint(self, i: int, site: str, action: str) -> dict:
+        """Arm a failpoint on a RUNNING node over its ops endpoint (the
+        harness always starts nodes with BCOS_FAILPOINTS_OPS=1)."""
+        from urllib.parse import quote
+        code, doc = self._ops_get(
+            i, f"/failpoints?arm={quote(site + '=' + action)}")
+        assert code == 200, (code, doc)
+        return doc
+
+    def disarm_failpoints(self, i: int) -> None:
+        self._ops_get(i, "/failpoints?disarm=all")
+
+    def failpoints(self, i: int) -> dict:
+        return self._ops_get(i, "/failpoints")[1]
+
+    def healthz(self, i: int) -> tuple[int, dict]:
+        """-> (http_status, health doc): 200 while ok, 503 degraded."""
+        return self._ops_get(i, "/healthz")
+
+    def metrics_text(self, i: int) -> str:
+        import urllib.request
+        url = (f"http://127.0.0.1:{self.info['nodes'][i]['rpc_port']}"
+               "/metrics")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.read().decode()
+
+    def audit_report(self, i: int) -> dict:
+        return self.client(i).request(
+            "getAuditReport", [self.info["group_id"], ""])
 
     def total_txs(self, i: int) -> int:
         return self.client(i).get_total_transaction_count()[

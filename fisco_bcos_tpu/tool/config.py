@@ -138,6 +138,9 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     cp["trace"] = {"sample_rate": str(cfg.trace_sample_rate),
                    "ring_size": str(cfg.trace_ring_size),
                    "slow_ms": str(cfg.trace_slow_ms)}
+    # deterministic fault injection (utils/failpoints.py) — chaos/test
+    # deployments only; empty arms nothing
+    cp["failpoints"] = {"spec": cfg.failpoints}
     cp["executor"] = {}
     cp["crypto"] = {"backend": cfg.crypto_backend,
                     "device_min_batch": str(cfg.device_min_batch),
@@ -234,6 +237,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         p2p_host=cp.get("p2p", "listen_ip", fallback="127.0.0.1"),
         p2p_port=int(p2p_port_s) if p2p_port_s else None,
         p2p_peers=peers,
+        failpoints=cp.get("failpoints", "spec", fallback=""),
     )
 
 

@@ -66,9 +66,16 @@ class TxPool:
         # pre-seal tombstones: hashes of in-flight proposal txs NOT yet in
         # the pool (see mark_sealed) — promoted to _sealed on arrival
         self._presealed: set[bytes] = set()
-        # rolling nonce filter: block number -> set of nonces
+        # rolling nonce filter: block number -> set of nonces. Seeded from
+        # the ledger at construction: after a WAL-replay restart the
+        # filter used to come up EMPTY, so a different-hash tx reusing a
+        # nonce committed just before the crash was re-admitted inside
+        # the replay-protection window (found by the invariant auditor's
+        # nonce_filter check during the crash-failpoint e2e run). The
+        # snapshot-install path rebuilds the same way.
         self._nonces_by_block: dict[int, set[str]] = {}
         self._known_nonces: set[str] = set()
+        self._rebuild_nonce_filter(self.ledger.current_number())
         self._on_ready: list[Callable[[], None]] = []
         # receipt waits: one condition broadcast per commit. A shared CV
         # (instead of the old per-hash Event dict) survives concurrent
@@ -79,6 +86,22 @@ class TxPool:
         self._async_waiters: dict[bytes, "object"] = {}  # hash -> Task
         # TransactionSync gossip hook (TransactionSync.cpp broadcast path)
         self._broadcast_hooks: list[Callable[[Sequence[Transaction]], None]] = []
+
+    def _rebuild_nonce_filter(self, number: int) -> None:
+        """Rebuild the rolling replay-protection window from the ledger —
+        the ONE copy of this loop, shared by boot (no-op on fresh nodes)
+        and the snapshot-install reconciliation."""
+        self._nonces_by_block = {}
+        self._known_nonces = set()
+        lo = max(1, number - self.block_limit_range + 1)
+        for bn in range(lo, number + 1):
+            try:
+                ns = set(n for n in self.ledger.nonces_by_number(bn) if n)
+            except Exception:  # pruned below a checkpoint floor
+                continue
+            if ns:
+                self._nonces_by_block[bn] = ns
+                self._known_nonces |= ns
 
     # -- notifications -----------------------------------------------------
     def register_unseal_notifier(self, fn: Callable[[], None]) -> None:
@@ -284,6 +307,13 @@ class TxPool:
         with self._lock:
             return {"pending": len(self._pending), "sealed": len(self._sealed)}
 
+    def known_nonces(self) -> frozenset:
+        """Snapshot of the rolling replay-protection filter — read by the
+        invariant auditor (ops/audit.py), which cross-checks it against
+        the nonces the ledger actually committed in the window."""
+        with self._lock:
+            return frozenset(self._known_nonces)
+
     # -- proposal verification (TxPool.cpp:160 asyncVerifyBlock) -----------
     def fill_block(self, tx_hashes: Sequence[bytes]) -> Optional[list[Transaction]]:
         """hashes -> txs from the pool (BlockExecutive::prepare's
@@ -385,14 +415,7 @@ class TxPool:
                 self._pending.pop(h, None)
                 self._sealed.discard(h)
                 self._presealed.discard(h)
-            self._nonces_by_block = {}
-            self._known_nonces = set()
-            lo = max(0, number - self.block_limit_range + 1)
-            for bn in range(lo, number + 1):
-                ns = set(n for n in self.ledger.nonces_by_number(bn) if n)
-                if ns:
-                    self._nonces_by_block[bn] = ns
-                    self._known_nonces |= ns
+            self._rebuild_nonce_filter(number)
             # txs that survived the reconciliation are still pending: their
             # nonces were admitted at submit time and must keep blocking
             # duplicates (they are in no block's nonce table yet)

@@ -230,7 +230,7 @@ class MetricsServer:
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
                  host: str = "127.0.0.1", port: int = 0,
-                 status_fn=None, tracer=None):
+                 status_fn=None, tracer=None, health_fn=None):
         # runtime imports: rpc.edge imports this module for REGISTRY, so
         # the dependency must stay one-way at import time
         from ..rpc.edge import EventLoopHttpServer, WorkerPool
@@ -241,7 +241,7 @@ class MetricsServer:
             None, host=host, port=port, pool=self._pool,
             keepalive_s=30.0, name="ops-http",
             ops=OpsRoutes(registry=registry, tracer=tracer,
-                          status_fn=status_fn))
+                          status_fn=status_fn, health_fn=health_fn))
         self.port = self._server.port
 
     def start(self) -> None:

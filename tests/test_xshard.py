@@ -238,13 +238,18 @@ print("RESULT " + json.dumps(out))
 """
 
 
+@pytest.mark.slow
 def test_kill9_between_prepare_and_commit_recovers_all_or_nothing(tmp_path):
     """Phase A escrows the debit on group0 (committed, WAL-durable) and is
     SIGKILLed before the credit ever reaches group1 — the exact
     prepare->commit window. Phase B reopens the same WAL: replay restores
     the escrow + pending marker on group0 and the untouched balance on
     group1, and the coordinator's recovery sweep lands the credit and
-    settles the escrow. Outcome must be ALL (never half, never double)."""
+    settles the escrow. Outcome must be ALL (never half, never double).
+
+    Slow e2e gate: the fast tier-1 guard for these saga legs is the
+    in-process failpoint sweep in test_faults.py (same crash windows,
+    no subprocess boot)."""
     script = _PHASE_SCRIPT % {"repo": REPO}
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     wal_dir = str(tmp_path / "shared-wal")

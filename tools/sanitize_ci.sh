@@ -9,6 +9,14 @@
 #                                   # 4 OS-process TLS chain, kill -9 a node
 #                                   # mid-stream, assert it rejoins to the
 #                                   # same state root (tests/test_chaos_e2e)
+#   tools/sanitize_ci.sh --faults   # ONLY the failpoint/health smoke: boot
+#                                   # a 4-node chain, arm one storage and
+#                                   # one consensus failpoint at runtime
+#                                   # via the ops endpoint (/failpoints),
+#                                   # assert convergence, a clean
+#                                   # getAuditReport on every node, and
+#                                   # the /healthz + bcos_node_health
+#                                   # gauge round-trip
 #   tools/sanitize_ci.sh --ingest   # ONLY the continuous-batching smoke:
 #                                   # short chain_bench --rpc-clients run,
 #                                   # assert the lane coalesces (mean batch
@@ -622,6 +630,70 @@ EOF
   JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
     python benchmark/chain_bench.py --trace-profile --trace-txs 16 \
     --backend host 2>/dev/null | grep '"metric": "trace_profile_summary"'
+  exit 0
+fi
+
+if [ "${1:-}" = "--faults" ]; then
+  echo "== [faults] failpoint/health smoke: 4-node chain, arm one storage" \
+       "and one consensus failpoint via the ops endpoint, assert" \
+       "convergence + clean getAuditReport + health gauge round-trip"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python - <<'EOF'
+import tempfile, time
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import TransactionBuilder
+from fisco_bcos_tpu.testing.chaos import ChaosHarness
+
+out = tempfile.mkdtemp(prefix="faults-smoke-")
+with ChaosHarness(out, tls=False) as h:
+    h.start_all()
+    for i in range(h.n):
+        h.wait_rpc_up(i)
+    # health gauge round-trip while healthy
+    code, doc = h.healthz(0)
+    assert code == 200 and doc["state"] == "ok", (code, doc)
+    gauge = [ln for ln in h.metrics_text(0).splitlines()
+             if ln.startswith("bcos_node_health")]
+    assert gauge and float(gauge[0].split()[-1]) == 0.0, gauge
+
+    suite = h.suite()
+    kp = suite.generate_keypair(b"faults-smoke")
+    builder = TransactionBuilder(suite, None, chain_id=h.info["chain_id"],
+                                 group_id=h.info["group_id"])
+    sent = 0
+    def burst(n):
+        global sent
+        for _ in range(n):
+            tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                               pc.encode_call("register",
+                                              lambda w: w.blob(b"s%d" % sent)
+                                              .u64(1)),
+                               nonce=f"s-{sent}", block_limit=500)
+            h.client(sent % h.n).send_transaction(tx, wait=False)
+            sent += 1
+    burst(4)
+    h.wait_until(lambda: min(h.total_txs(i) for i in range(h.n)) >= 2,
+                 timeout=180, what="baseline commits")
+
+    # one STORAGE failpoint + one CONSENSUS-pipeline failpoint, armed at
+    # runtime over the ops endpoint, each firing a handful of times
+    h.arm_failpoint(1, "storage.wal.append_before_fsync", "enospc*2")
+    h.arm_failpoint(2, "scheduler.2pc.commit", "raise*2")
+    burst(8)
+    h.wait_until(lambda: min(h.total_txs(i) for i in range(h.n)) >= 8,
+                 timeout=240, what="commits through the armed faults")
+    height = h.wait_converged(range(h.n), min_height=1, timeout=240)
+    for i in range(h.n):
+        rep = h.audit_report(i)
+        assert rep["ok"], (i, rep)
+        fps = h.failpoints(i)
+        assert "scheduler.2pc.commit" in fps["sites"], fps
+    # every node back to ok (faults exhausted their budgets + self-healed)
+    h.wait_until(lambda: all(h.healthz(i)[0] == 200 for i in range(h.n)),
+                 timeout=120, what="health returned to ok on every node")
+    print(f"sanitize_ci: FAULTS STAGE CLEAN (height={height}, "
+          f"txs={min(h.total_txs(i) for i in range(h.n))})")
+EOF
   exit 0
 fi
 
