@@ -598,6 +598,51 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: overload control under sustained saturation
+            # (utils/overload.py + rpc/admission.py + txpool watermarks) —
+            # 4x open-loop goodput vs 1x, fairness share, -32005 reject
+            # latency, and the plane's A/B cost at unsaturated load.
+            # BENCH_OVERLOAD_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--overload", "-n", "800", "--overload-window", "4",
+                 "--overload-ab-runs", "2", "--overload-fairness-s", "8",
+                 "--backend", "host"],
+                "BENCH_OVERLOAD_TIMEOUT", 600)
+            g4 = next((r for r in rows
+                       if r.get("metric") == "overload_goodput"
+                       and r.get("mult") == 4), None)
+            seal = next((r for r in rows
+                         if r.get("metric") == "overload_seal_integrity"),
+                        None)
+            fair = next((r for r in rows
+                         if r.get("metric") == "overload_fairness"), None)
+            ab = next((r for r in rows
+                       if r.get("metric") == "overload_ab"), None)
+            if g4:
+                line["overload_goodput_4x_vs_1x"] = g4.get(
+                    "goodput_vs_1x")
+                line["overload_shed_rate_4x"] = g4.get("shed_rate")
+            if seal:
+                line["overload_expired_after_seal_slot"] = seal.get(
+                    "expired_after_seal_slot")
+            if fair:
+                line["overload_polite_share"] = fair.get("polite_share")
+                line["overload_reject_p99_ms"] = fair.get("reject_p99_ms")
+                line["overload_rate_limited"] = fair.get(
+                    "rate_limited_count")
+            if ab:
+                line["overload_plane_cost_pct"] = ab.get(
+                    "plane_cost_pct")
+            if not (g4 and fair):
+                print(f"[bench] overload bench incomplete (rc={rc})",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] overload bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: persistent storage engine A/B (storage/
             # engine.py) — sustained-write TPS, cold-restart seconds, and
             # peak RSS for memory vs WAL vs disk backends, each in a fresh

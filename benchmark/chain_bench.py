@@ -54,7 +54,8 @@ _SIGN_CHUNK = 250
 
 def _sign_chunk(args) -> list[bytes]:
     """Worker: sign a chunk of register txs (picklable, re-imports)."""
-    sm, seed, start, count, block_limit, group_id, cross = args
+    sm, seed, start, count, block_limit, group_id, cross = args[:7]
+    prefix = args[7] if len(args) > 7 else "cb"
     from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.executor import precompiled as pc
     from fisco_bcos_tpu.protocol import Transaction
@@ -79,7 +80,8 @@ def _sign_chunk(args) -> list[bytes]:
             to = pc.BALANCE_ADDRESS
         tx = Transaction(
             to=to, input=data, group_id=group_id,
-            nonce=f"cb-{'x' if cross else ''}{i}", block_limit=block_limit,
+            nonce=f"{prefix}-{'x' if cross else ''}{i}",
+            block_limit=block_limit,
         ).sign(suite, kp)
         out.append(tx.encode())
     return out
@@ -87,12 +89,13 @@ def _sign_chunk(args) -> list[bytes]:
 
 def _build_workload(sm: bool, n: int, block_limit: int,
                     group_id: str = "group0",
-                    cross: str = "", start: int = 0) -> list[bytes]:
+                    cross: str = "", start: int = 0,
+                    prefix: str = "cb") -> list[bytes]:
     from concurrent.futures import ProcessPoolExecutor
     import multiprocessing
 
     chunks = [(sm, b"chain-bench", s, min(_SIGN_CHUNK, start + n - s),
-               block_limit, group_id, cross)
+               block_limit, group_id, cross, prefix)
               for s in range(start, start + n, _SIGN_CHUNK)]
     workers = os.cpu_count() or 1
     if workers == 1 or len(chunks) == 1:
@@ -106,7 +109,7 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
                  transport: str = "fake", tls: bool = False,
                  rpc_on_first: bool = False, ingest_lane: bool = True,
                  min_seal_time: float = 0.0, max_wait_ms: float = 15.0,
-                 pipeline: bool = True):
+                 pipeline: bool = True, cfg_overrides: dict | None = None):
     """4-node PBFT chain -> (nodes, gateways, tls_effective)."""
     from fisco_bcos_tpu.crypto.suite import make_suite
     from fisco_bcos_tpu.init.node import Node, NodeConfig
@@ -143,20 +146,20 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
     sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
     nodes = []
     for i, (kp, gw) in enumerate(zip(keypairs, gateways)):
-        node = Node(NodeConfig(consensus="pbft", sm_crypto=sm,
-                               crypto_backend=backend,
-                               min_seal_time=min_seal_time,
-                               view_timeout=30.0,
-                               tx_count_limit=tx_count_limit,
-                               ingest_lane=ingest_lane,
-                               ingest_max_wait_ms=max_wait_ms,
-                               pipeline_commit=pipeline,
-                               # benches measure the untraced hot path;
-                               # --trace-profile reconfigures explicitly
-                               trace_sample_rate=0.0, trace_slow_ms=0.0,
-                               rpc_port=0 if rpc_on_first and i == 0
-                               else None),
-                    keypair=kp, gateway=gw)
+        kw = dict(consensus="pbft", sm_crypto=sm,
+                  crypto_backend=backend,
+                  min_seal_time=min_seal_time,
+                  view_timeout=30.0,
+                  tx_count_limit=tx_count_limit,
+                  ingest_lane=ingest_lane,
+                  ingest_max_wait_ms=max_wait_ms,
+                  pipeline_commit=pipeline,
+                  # benches measure the untraced hot path;
+                  # --trace-profile reconfigures explicitly
+                  trace_sample_rate=0.0, trace_slow_ms=0.0,
+                  rpc_port=0 if rpc_on_first and i == 0 else None)
+        kw.update(cfg_overrides or {})
+        node = Node(NodeConfig(**kw), keypair=kp, gateway=gw)
         node.build_genesis(sealers)
         nodes.append(node)
     return nodes, gateways, tls
@@ -1080,6 +1083,459 @@ def run_trace_profile(sm: bool, backend: str, n_txs: int = 24) -> list:
     return rows
 
 
+# -- overload mode (ISSUE 12: proof under fire) ------------------------------
+
+_OVERLOAD_POOL = 2000  # pool sized so the watermarks are reachable in
+#                        seconds of open-loop overload, not minutes
+
+
+def _overload_cfg(plane: bool) -> dict:
+    """NodeConfig overrides for the overload chains. plane=False is the
+    pre-overload-control behavior (the A/B anchor): hard TXPOOL_FULL
+    cliff at the limit, no busy controller, no edge buckets."""
+    base = {"txpool_limit": _OVERLOAD_POOL}
+    if not plane:
+        base.update({"txpool_low_watermark": 1.0,
+                     "txpool_high_watermark": 1.0,
+                     "overload_enabled": False})
+    return base
+
+
+def _expired_in_committed_blocks(ledger) -> int:
+    """Txs that landed in a block AFTER their block_limit — each one paid
+    a seal slot for nothing. The plane's guarantee is that this is ZERO
+    (seal re-checks expiry against the proposal's own height)."""
+    bad = 0
+    for n in range(1, ledger.current_number() + 1):
+        blk = ledger.block_by_number(n, with_txs=True)
+        if blk is None:
+            continue
+        for t in blk.transactions:
+            if t.block_limit < n:
+                bad += 1
+    return bad
+
+
+def _txpool_drop_counters() -> dict:
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+    c = REGISTRY.snapshot()["counters"]
+    return {k: c.get(k, 0) for k in (
+        "bcos_txpool_expired_total", "bcos_txpool_evicted_total",
+        "bcos_txpool_deadline_shed_total",
+        "bcos_ingest_deadline_shed_total")}
+
+
+def _open_loop_window(ingress, wire_txs, rate: float, window_s: float):
+    """Open-loop feeder: every few ms, submit the arrivals the Poisson-
+    mean schedule owes (expected `rate`/s) straight into the ingress
+    node's batch admission; arrivals are NEVER withheld because earlier
+    ones were slow (that is what open-loop means). Returns admission
+    outcome counts, per-call admission latency, and the window's
+    committed throughput."""
+    from fisco_bcos_tpu.protocol import Transaction, TransactionStatus
+
+    txs = [Transaction.decode(raw) for raw in wire_txs]
+    before = _txpool_drop_counters()
+    ledger = ingress.ledger
+    committed0 = ledger.total_tx_count()
+    counts = {"offered": 0, "ok": 0, "full": 0, "deadline": 0, "other": 0}
+    lat: list[float] = []
+    i = 0
+    t0 = time.perf_counter()
+    deadline = t0 + window_s
+    while time.perf_counter() < deadline and i < len(txs):
+        due = int((time.perf_counter() - t0) * rate)
+        k = min(due - counts["offered"], len(txs) - i, 256)
+        if k <= 0:
+            time.sleep(0.002)
+            continue
+        batch = txs[i:i + k]
+        i += k
+        ts = time.perf_counter()
+        results = ingress.txpool.submit_batch(batch)
+        lat.append(time.perf_counter() - ts)
+        counts["offered"] += len(batch)
+        for r in results:
+            if r.status == TransactionStatus.OK:
+                counts["ok"] += 1
+            elif r.status == TransactionStatus.TXPOOL_FULL:
+                counts["full"] += 1
+            elif r.status == TransactionStatus.DEADLINE_UNMEETABLE:
+                counts["deadline"] += 1
+            else:
+                counts["other"] += 1
+    wall = time.perf_counter() - t0
+    committed = ledger.total_tx_count() - committed0
+    lat.sort()
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+    after = _txpool_drop_counters()
+    return {
+        **counts,
+        "wall_seconds": round(wall, 3),
+        "offered_tps": round(counts["offered"] / wall, 1),
+        "committed_tps": round(committed / wall, 1),
+        "shed_rate": round((counts["full"] + counts["deadline"])
+                           / max(1, counts["offered"]), 4),
+        "admission_call_p50_ms": round(pct(0.50) * 1000, 2),
+        "admission_call_p99_ms": round(pct(0.99) * 1000, 2),
+        "drops": {k: after[k] - before[k] for k in after},
+    }
+
+
+def _drain(ingress, timeout: float = 60.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ingress.txpool.pending_count() == 0:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def run_overload_ladder(sm: bool, backend: str, tx_count_limit: int,
+                        n_cap: int, window_s: float,
+                        mults=(1, 2, 4)) -> list:
+    """Capacity calibration + the 1x/2x/4x open-loop overload ladder on
+    ONE plane-enabled 4-node chain (the pool drains between windows)."""
+    from fisco_bcos_tpu.protocol import Transaction
+
+    nodes, gateways, _ = _build_chain(sm, backend, tx_count_limit,
+                                      cfg_overrides=_overload_cfg(True))
+    ingress = nodes[0]
+    rows = []
+    try:
+        for node in nodes:
+            node.start()
+        # capacity: closed-loop chunked burst, committed TPS
+        print(f"overload: calibrating capacity ({n_cap} txs)...",
+              file=sys.stderr, flush=True)
+        cap_wire = _build_workload(sm, n_cap, block_limit=600,
+                                   prefix="cap")
+        t0 = time.perf_counter()
+        admitted = 0
+        for s in range(0, len(cap_wire), 512):
+            results = ingress.txpool.submit_batch(
+                [Transaction.decode(raw) for raw in cap_wire[s:s + 512]])
+            admitted += sum(1 for r in results if int(r.status) == 0)
+        # wait for what was ADMITTED, not n_cap: a large -n can cross the
+        # pool's watermarks during the burst and shed the tail — that is
+        # the plane working, not a wedged chain
+        deadline = time.monotonic() + max(120.0, n_cap / 25)
+        while time.monotonic() < deadline:
+            if ingress.ledger.total_tx_count() >= admitted:
+                break
+            time.sleep(0.05)
+        cap_wall = time.perf_counter() - t0
+        committed = ingress.ledger.total_tx_count()
+        if committed == 0 or committed < admitted // 2:
+            raise RuntimeError(
+                f"calibration wedged at {committed}/{admitted} admitted"
+                f" ({n_cap} offered)")
+        capacity = committed / cap_wall
+        print(f"overload: measured capacity ~{capacity:.0f} TPS",
+              file=sys.stderr, flush=True)
+
+        base_tps = None
+        offset = 0
+        for mult in mults:
+            rate = capacity * mult
+            n_m = int(rate * window_s * 1.15) + 64
+            print(f"overload: {mult}x window ({n_m} txs @ "
+                  f"{rate:.0f}/s)...", file=sys.stderr, flush=True)
+            wire = _build_workload(sm, n_m, block_limit=600,
+                                   start=offset, prefix=f"ov{mult}")
+            offset += n_m
+            committed0 = ingress.ledger.total_tx_count()
+            t_ep = time.perf_counter()
+            win = _open_loop_window(ingress, wire, rate, window_s)
+            drained = _drain(ingress)
+            # SUSTAINED goodput: committed over the whole episode
+            # (window + backlog drain) — under overload the pool keeps
+            # the pipeline fed past the window, and shed load must not
+            # depress what actually commits per second of episode
+            elapsed = time.perf_counter() - t_ep
+            sustained = (ingress.ledger.total_tx_count() - committed0) \
+                / max(elapsed, 1e-9)
+            if base_tps is None:
+                base_tps = sustained
+            rows.append({
+                "metric": "overload_goodput",
+                "suite": "sm" if sm else "ecdsa",
+                "mult": mult,
+                "capacity_tps": round(capacity, 1),
+                "value": round(sustained, 1), "unit": "tx/sec",
+                "goodput_vs_1x": round(sustained / max(base_tps, 0.001),
+                                       3),
+                "episode_seconds": round(elapsed, 3),
+                "drained": drained,
+                **win,
+            })
+        # the plane's hard guarantee, checked over EVERY committed block
+        expired_sealed = _expired_in_committed_blocks(ingress.ledger)
+        rows.append({
+            "metric": "overload_seal_integrity",
+            "suite": "sm" if sm else "ecdsa",
+            "value": expired_sealed, "unit": "txs",
+            "blocks_scanned": ingress.ledger.current_number(),
+            "expired_after_seal_slot": expired_sealed,
+        })
+    finally:
+        for node in nodes:
+            node.stop()
+        for gw in set(gateways):
+            gw.stop()
+    return rows
+
+
+def run_overload_ab(sm: bool, backend: str, tx_count_limit: int,
+                    capacity: float, window_s: float, reps: int) -> dict:
+    """Interleaved plane-off/plane-on 1x open-loop runs (fresh chain per
+    run) -> medians + the plane's measured cost at unsaturated load."""
+    from fisco_bcos_tpu.protocol import Transaction  # noqa: F401
+
+    results: dict[bool, list[float]] = {False: [], True: []}
+    offset = 100_000  # nonce namespace away from the ladder's
+    for rep in range(reps):
+        for plane in (False, True):
+            nodes, gateways, _ = _build_chain(
+                sm, backend, tx_count_limit,
+                cfg_overrides=_overload_cfg(plane))
+            try:
+                for node in nodes:
+                    node.start()
+                n_m = int(capacity * window_s * 1.15) + 64
+                wire = _build_workload(sm, n_m, block_limit=600,
+                                       start=offset,
+                                       prefix=f"ab{rep}{int(plane)}")
+                offset += n_m
+                win = _open_loop_window(nodes[0], wire, capacity,
+                                        window_s)
+                results[plane].append(win["committed_tps"])
+            finally:
+                for node in nodes:
+                    node.stop()
+                for gw in set(gateways):
+                    gw.stop()
+
+    def med(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2] if vals else 0.0
+
+    on, off = med(results[True]), med(results[False])
+    return {
+        "metric": "overload_ab", "unit": "x",
+        "suite": "sm" if sm else "ecdsa",
+        "value": round(on / max(off, 0.001), 3),
+        "tps_plane_on_median": on, "tps_plane_off_median": off,
+        "tps_plane_on_runs": results[True],
+        "tps_plane_off_runs": results[False],
+        "plane_cost_pct": round((1.0 - on / max(off, 0.001)) * 100, 2),
+        "runs": reps,
+    }
+
+
+def run_overload_fairness(sm: bool, backend: str, tx_count_limit: int,
+                          capacity: float, fairness_s: float) -> dict:
+    """Aggressor vs polite through the REAL RPC edge with per-client
+    token buckets: 10:1 offered load, distinct x-api-key identities.
+    Reports the polite client's committed blockspace share, its commit
+    p99, the -32005 count and the reject-answer p99."""
+    import threading
+
+    from fisco_bcos_tpu.protocol import Transaction  # noqa: F401
+    from fisco_bcos_tpu.sdk.client import RpcCallError, SdkClient
+
+    # per-client write rate: a third of capacity each (capped low enough
+    # that the HTTP aggressor threads can actually exceed it) — the chain
+    # can absorb both clients at full budget, the aggressor's excess
+    # cannot get in
+    rate = max(20.0, min(capacity / 3.0, 80.0))
+    polite_rate = 0.8 * rate
+    nodes, gateways, _ = _build_chain(
+        sm, backend, tx_count_limit, rpc_on_first=True,
+        min_seal_time=0.2,
+        cfg_overrides={**_overload_cfg(True),
+                       "client_write_rate": rate})
+    ingress = nodes[0]
+    n_polite = int(polite_rate * fairness_s) + 16
+    n_aggr = int(rate * fairness_s * 3) + 64  # cycles through on rejects
+    print(f"overload: fairness mix (rate={rate:.0f}/client, "
+          f"{n_aggr}+{n_polite} txs)...", file=sys.stderr, flush=True)
+    aggr_wire = _build_workload(sm, n_aggr, block_limit=600, prefix="fa")
+    pol_wire = _build_workload(sm, n_polite, block_limit=600, prefix="fp")
+    try:
+        for node in nodes:
+            node.start()
+        url = f"http://{ingress.rpc.host}:{ingress.rpc.port}"
+        stop = threading.Event()
+        stats = {"aggr_sent": 0, "aggr_ok": 0, "aggr_32005": 0,
+                 "errors": []}
+        reject_lat: list[float] = []
+        pol_submits: dict[bytes, float] = {}
+        pol_lock = threading.Lock()
+
+        stats_lock = threading.Lock()
+
+        def aggressor(worker: int, workers: int = 4):
+            # several threads under ONE api-key identity: the offered
+            # load must exceed the per-client bucket, which a single
+            # synchronous HTTP loop cannot on this host
+            sdk = SdkClient(url, api_key="aggr")
+            i = worker
+            while not stop.is_set():
+                tx_hex = "0x" + aggr_wire[i % len(aggr_wire)].hex()
+                i += workers
+                t0 = time.perf_counter()
+                try:
+                    sdk.request("sendTransaction",
+                                ["group0", "", tx_hex, False, False])
+                    with stats_lock:
+                        stats["aggr_sent"] += 1
+                        stats["aggr_ok"] += 1
+                except RpcCallError as exc:
+                    with stats_lock:
+                        stats["aggr_sent"] += 1
+                        if exc.code == -32005:
+                            stats["aggr_32005"] += 1
+                    # admitted-duplicate and pool statuses: still offered
+                    del t0  # latency measured by the paced prober
+                except Exception as exc:  # noqa: BLE001
+                    stats["errors"].append(f"aggr: {exc}")
+                    return
+
+        def polite():
+            from fisco_bcos_tpu.protocol import Transaction as _Tx
+            sdk = SdkClient(url, api_key="polite")
+            t0 = time.perf_counter()
+            for i, raw in enumerate(pol_wire):
+                if stop.is_set():
+                    return
+                # paced open loop at 0.8x its budget: never throttled
+                due = t0 + i / polite_rate
+                lag = due - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                h = _Tx.decode(raw).hash(ingress.suite)
+                try:
+                    sdk.request("sendTransaction",
+                                ["group0", "", "0x" + raw.hex(),
+                                 False, False])
+                    with pol_lock:
+                        pol_submits[h] = time.perf_counter()
+                except Exception as exc:  # noqa: BLE001
+                    stats["errors"].append(f"polite: {exc}")
+                    return
+
+        def reject_prober():
+            # paced probe under the AGGRESSOR's identity: once its bucket
+            # is drained, every probe answers -32005 — this measures the
+            # edge's reject-answer latency without the aggressor threads'
+            # own client-side CPU starvation polluting the number
+            sdk = SdkClient(url, api_key="aggr")
+            tx_hex = "0x" + aggr_wire[0].hex()
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    sdk.request("sendTransaction",
+                                ["group0", "", tx_hex, False, False])
+                except RpcCallError as exc:
+                    if exc.code == -32005:
+                        reject_lat.append(time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001 — probe only
+                    return
+                time.sleep(0.05)
+
+        pol_commit_lat: list[float] = []
+
+        def pol_watcher():
+            outstanding: dict[bytes, float] = {}
+            while not stop.is_set() or outstanding:
+                with pol_lock:
+                    outstanding.update(pol_submits)
+                    pol_submits.clear()
+                done = []
+                for h, ts in outstanding.items():
+                    if ingress.ledger.receipt(h) is not None:
+                        pol_commit_lat.append(time.perf_counter() - ts)
+                        done.append(h)
+                for h in done:
+                    outstanding.pop(h)
+                if stop.is_set() and not done:
+                    break  # drain attempt after the window: stop polling
+                time.sleep(0.05)
+
+        h0 = ingress.ledger.current_number()
+        threads = [threading.Thread(target=aggressor, args=(w,),
+                                    daemon=True) for w in range(4)]
+        threads += [threading.Thread(target=fn, daemon=True)
+                    for fn in (polite, pol_watcher, reject_prober)]
+        for th in threads:
+            th.start()
+        time.sleep(fairness_s)
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        if stats["errors"]:
+            raise RuntimeError(stats["errors"][0])
+        time.sleep(1.0)  # let in-flight commits land before the scan
+        # committed blockspace share by nonce prefix over the window
+        aggr_c = pol_c = 0
+        for n in range(h0 + 1, ingress.ledger.current_number() + 1):
+            blk = ingress.ledger.block_by_number(n, with_txs=True)
+            if blk is None:
+                continue
+            for t in blk.transactions:
+                if t.nonce.startswith("fa-"):
+                    aggr_c += 1
+                elif t.nonce.startswith("fp-"):
+                    pol_c += 1
+        reject_lat.sort()
+        pol_commit_lat.sort()
+
+        def pct(vals, p):
+            return vals[min(len(vals) - 1, int(p * len(vals)))] \
+                if vals else 0.0
+
+        return {
+            "metric": "overload_fairness", "unit": "share",
+            "suite": "sm" if sm else "ecdsa",
+            "value": round(pol_c / max(1, aggr_c + pol_c), 3),
+            "polite_share": round(pol_c / max(1, aggr_c + pol_c), 3),
+            "polite_committed": pol_c, "aggressor_committed": aggr_c,
+            "polite_commit_p50_ms": round(
+                pct(pol_commit_lat, 0.5) * 1000, 1),
+            "polite_commit_p99_ms": round(
+                pct(pol_commit_lat, 0.99) * 1000, 1),
+            "aggr_offered": stats["aggr_sent"],
+            "aggr_admitted": stats["aggr_ok"],
+            "rate_limited_count": stats["aggr_32005"],
+            "reject_p99_ms": round(pct(reject_lat, 0.99) * 1000, 2),
+            "client_write_rate": rate,
+        }
+    finally:
+        for node in nodes:
+            node.stop()
+        for gw in set(gateways):
+            gw.stop()
+
+
+def _emit_overload_mode(args, sm: bool) -> None:
+    rows = run_overload_ladder(sm, args.backend, args.tx_count_limit,
+                               max(500, args.n),
+                               args.overload_window)
+    capacity = rows[0]["capacity_tps"]
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    ab = run_overload_ab(sm, args.backend, args.tx_count_limit, capacity,
+                         args.overload_window, args.overload_ab_runs)
+    print(json.dumps(ab), flush=True)
+    fair = run_overload_fairness(sm, args.backend, args.tx_count_limit,
+                                 capacity, args.overload_fairness_s)
+    print(json.dumps(fair), flush=True)
+
+
 def run_storage_child(backend: str, n: int, tx_count_limit: int,
                       memtable_mb: int) -> dict:
     """ONE backend's sustained-write run in THIS process (the parent
@@ -1266,6 +1722,18 @@ def main() -> None:
                     help="with --storage-compare: disk-engine memtable cap "
                          "(small by default so the dataset spills to "
                          "segments and RSS boundedness is actually tested)")
+    ap.add_argument("--overload", action="store_true",
+                    help="overload mode: capacity calibration, open-loop "
+                         "1x/2x/4x Poisson ladder (goodput, shed rate, "
+                         "expired-in-pool, admission latency), plane-"
+                         "on/off A/B at 1x, and the 10:1 aggressor-vs-"
+                         "polite fairness mix through the RPC edge")
+    ap.add_argument("--overload-window", type=float, default=5.0,
+                    help="with --overload: seconds per open-loop window")
+    ap.add_argument("--overload-ab-runs", type=int, default=2,
+                    help="with --overload: interleaved plane-off/on reps")
+    ap.add_argument("--overload-fairness-s", type=float, default=10.0,
+                    help="with --overload: fairness-mix duration")
     ap.add_argument("--trace-profile", action="store_true",
                     help="latency-attribution mode: closed-loop traced "
                          "txs through a 4-node chain at sample_rate=1; "
@@ -1296,6 +1764,10 @@ def main() -> None:
         for sm in suites:
             for row in run_sync_bench(sm, args.sync_blocks):
                 print(json.dumps(row), flush=True)
+        return
+    if args.overload:
+        for sm in suites:
+            _emit_overload_mode(args, sm)
         return
     if args.trace_profile:
         for sm in suites:

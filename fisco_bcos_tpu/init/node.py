@@ -59,6 +59,30 @@ class NodeConfig:
     tx_count_limit: int = 1000
     txpool_limit: int = 15000
     block_limit_range: int = 600
+    # txpool watermark admission (txpool/txpool.py): fractions of
+    # txpool_limit. Below low everything admits; between them band-0 txs
+    # must carry deadline slack; at high, admission is by priority
+    # EVICTION of the lowest-band/soonest-expiring pending tx
+    txpool_low_watermark: float = 0.7
+    txpool_high_watermark: float = 0.95
+    # honor the tx attribute's client-declared priority band in eviction
+    # order. Cooperative QoS for identified consortium clients; disable
+    # on edges serving unidentified traffic (the band is unauthenticated)
+    txpool_priority_bands: bool = True
+    # overload-control plane ([overload] ini — utils/overload.py +
+    # rpc/admission.py): the busy/brownout controller and the serving
+    # edge's per-client token buckets. Rates are per client, tokens/sec;
+    # 0 = that class unlimited (fair-share concurrency still applies).
+    overload_enabled: bool = True
+    overload_enter: float = 0.85   # smoothed score entering busy
+    overload_exit: float = 0.5     # smoothed score leaving busy
+    overload_hold_s: float = 0.5   # hysteresis hold on both edges
+    overload_commit_backlog: int = 6  # commit depth scoring 1.0
+    overload_busy_write_factor: float = 0.25  # write-rate shrink while busy
+    client_write_rate: float = 0.0
+    client_write_burst: float = 0.0  # 0 -> 2x rate
+    client_read_rate: float = 0.0
+    client_read_burst: float = 0.0
     # continuous-batching ingest lane (txpool/ingest.py): coalesces
     # concurrent RPC/gossip submissions into device-sized submit_batch
     # calls. ingest_lane=False restores direct per-call submission (the
@@ -216,19 +240,44 @@ class Node:
         self.txpool = TxPool(self.suite, self.ledger, cfg.chain_id,
                              cfg.group_id, cfg.txpool_limit,
                              cfg.block_limit_range,
-                             registry=self.metrics_view)
+                             registry=self.metrics_view,
+                             low_watermark=cfg.txpool_low_watermark,
+                             high_watermark=cfg.txpool_high_watermark,
+                             priority_bands=cfg.txpool_priority_bands)
         self.ingest = IngestLane(
             self.txpool, max_batch=cfg.ingest_max_batch,
             max_wait_ms=cfg.ingest_max_wait_ms,
             queue_cap=cfg.ingest_queue_cap,
             registry=self.metrics_view,
             trace_label=self.trace_label) if cfg.ingest_lane else None
+        # overload controller (utils/overload.py): one busy/brownout state
+        # from the commit backlog, ingest queue and pool occupancy; wired
+        # into the health plane's `busy` step, the edge's write budgets,
+        # and the gossip import gate below
+        self.overload = None
+        if cfg.overload_enabled:
+            from ..utils.overload import OverloadController
+            self.overload = OverloadController(
+                health=self.health, registry=self.metrics_view,
+                label=cfg.group_id, enter=cfg.overload_enter,
+                exit=cfg.overload_exit, hold_s=cfg.overload_hold_s,
+                busy_write_factor=cfg.overload_busy_write_factor)
+            backlog_norm = max(1, cfg.overload_commit_backlog)
+            self.overload.add_signal(
+                "txpool", self.txpool.occupancy_fraction)
+            if self.ingest is not None:
+                self.overload.add_signal("ingest",
+                                         self.ingest.queue_fraction)
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool,
                                    pipeline=cfg.pipeline_commit,
                                    trace_label=self.trace_label,
                                    health=self.health)
+        if self.overload is not None:
+            self.overload.add_signal(
+                "commit_backlog",
+                lambda: self.scheduler.commit_backlog() / backlog_norm)
         from ..tool.timesync import NodeTimeMaintenance
         self.timesync = NodeTimeMaintenance()
         # solo mode commits synchronously inside the proposal callback, so
@@ -253,7 +302,9 @@ class Node:
         if gateway is not None:
             self.front = FrontService(self.keypair.pub_bytes, gateway)
             self.txsync = TransactionSync(self.front, self.txpool,
-                                          self.suite, ingest=self.ingest)
+                                          self.suite, ingest=self.ingest,
+                                          import_gate=self.accepting_remote_txs,
+                                          registry=self.metrics_view)
         # snapshot/checkpoint service: always constructed (RPC status +
         # operator checkpoint() work on any node); its periodic worker only
         # runs when snapshot_interval > 0, and it serves SnapshotSync
@@ -284,10 +335,23 @@ class Node:
         self.ws = None
         self.query_cache = None
         self.rpc_pool = None
+        self.admission = None
         if cfg.rpc_port is not None or cfg.ws_port is not None:
             from ..rpc.edge import WorkerPool
             from ..rpc.server import JsonRpcServer
             self.rpc_pool = WorkerPool(cfg.rpc_workers)
+            # per-client edge admission (rpc/admission.py): token buckets
+            # (reads and writes budgeted separately; 0 = unlimited) +
+            # fair-share concurrency over the bounded worker pool, with
+            # write rates shrunk by the overload controller while busy
+            from ..rpc.admission import ClientAdmission
+            self.admission = ClientAdmission(
+                write_rate=cfg.client_write_rate,
+                write_burst=cfg.client_write_burst,
+                read_rate=cfg.client_read_rate,
+                read_burst=cfg.client_read_burst,
+                fair_capacity=cfg.rpc_workers * 8,
+                overload=self.overload, registry=self.metrics_view)
             impl = self.make_rpc_impl()
             if cfg.rpc_port is not None:
                 # the RPC edge doubles as the ops surface: GET /metrics,
@@ -299,11 +363,13 @@ class Node:
                                          keepalive_s=cfg.rpc_keepalive_s,
                                          ops=OpsRoutes(
                                              status_fn=self.system_status,
-                                             health_fn=self.health.snapshot))
+                                             health_fn=self.health.snapshot),
+                                         admission=self.admission)
             if cfg.ws_port is not None:
                 from ..rpc.ws_server import WsRpcServer
                 self.ws = WsRpcServer(impl, host=cfg.rpc_host,
-                                      port=cfg.ws_port, pool=self.rpc_pool)
+                                      port=cfg.ws_port, pool=self.rpc_pool,
+                                      admission=self.admission)
         self.metrics = None
         if cfg.metrics_port is not None:
             from ..utils.metrics import MetricsServer
@@ -324,6 +390,15 @@ class Node:
                               self.health.snapshot()["faults"]) or "-"))
         if new == "ok":
             self.sealer.wakeup()
+
+    def accepting_remote_txs(self) -> bool:
+        """Gossip import gate (net/txsync.py): False while this node is
+        busy (overload brownout) or degraded — a saturated follower must
+        not amplify load it cannot seal; the anti-entropy sweep re-delivers
+        once it recovers. Consensus fetch-missing is never gated."""
+        if self.health.writes_shed():
+            return False
+        return self.overload is None or self.overload.accepting_remote_txs()
 
     # -- RPC impl wiring ---------------------------------------------------
     def make_rpc_impl(self):
@@ -382,6 +457,10 @@ class Node:
             "cryptoLane": lane.stats() if lane is not None else None,
             "groups": reg.groups() if reg is not None else [cfg.group_id],
             "trace": otrace.TRACER.stats(),
+            "overload": self.overload.stats()
+            if self.overload is not None else None,
+            "admission": self.admission.stats()
+            if self.admission is not None else None,
         }
         return out
 
@@ -429,6 +508,8 @@ class Node:
             self.snapshot.start()  # periodic checkpoint + prune worker
         if self.ingest is not None:
             self.ingest.start()  # continuous-batching front door
+        if self.overload is not None:
+            self.overload.start()  # busy/brownout sampler
         if self.txsync is not None:
             self.txsync.start()  # periodic pool anti-entropy sweep
         if self.rpc_pool is not None:
@@ -493,6 +574,8 @@ class Node:
             self.rpc_pool.stop()  # after the edges: no new submitters
         if self.ingest is not None:
             self.ingest.stop()  # after RPC: no new submitters, drain queue
+        if self.overload is not None:
+            self.overload.stop()
         self.snapshot.stop()
         self.sealer.stop()
         if self.consensus is not None:

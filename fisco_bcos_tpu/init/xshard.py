@@ -38,6 +38,17 @@ from ..utils.metrics import REGISTRY
 
 _RECEIPT_WAIT = 30.0
 
+
+def _wait_receipt(node, h: bytes, timeout: float = _RECEIPT_WAIT):
+    """Receipt or None — a coordinator leg evicted/shed from the pool
+    under overload (TxDropped) is 'unsettled, retry next sweep', exactly
+    like a timeout; the saga's idempotent legs make the retry safe."""
+    from ..txpool.txpool import TxDropped
+    try:
+        return node.txpool.wait_for_receipt(h, timeout)
+    except TxDropped:
+        return None
+
 # saga-leg fault sites (utils/failpoints.py): a raise between the escrow
 # commit and the credit, or between the credit and the settle, leaves the
 # transfer pending for the next sweep — the matrix asserts it still lands
@@ -187,7 +198,7 @@ class CrossShardCoordinator:
             else:
                 waits.append((xid, dst_node, h))
         for xid, dst_node, h in waits:
-            rc = dst_node.txpool.wait_for_receipt(h, _RECEIPT_WAIT)
+            rc = _wait_receipt(dst_node, h)
             if rc is None:
                 verdicts[xid] = None  # unsettled: next sweep retries
             elif rc.status == 0:
@@ -213,7 +224,7 @@ class CrossShardCoordinator:
                 fin.append((xid, ok, h))
         settled = 0
         for xid, ok, h in fin:
-            rc = src_node.txpool.wait_for_receipt(h, _RECEIPT_WAIT)
+            rc = _wait_receipt(src_node, h)
             if rc is not None and rc.status == 0:
                 settled += 1
                 with self._lock:

@@ -199,6 +199,12 @@ class FakeGateway(Gateway):
     (fn(src, dst, data) -> deliver?).
     """
 
+    # per-destination delivery-queue bound (frames): a stalled in-process
+    # node must not buffer its peers' sends without bound — the socket
+    # transport's per-session byte budget, approximated in frames here.
+    # Generous enough that only a genuinely wedged consumer hits it.
+    MAX_QUEUE_FRAMES = 100_000
+
     def __init__(self):
         self._lock = threading.Lock()
         self._fronts: dict[bytes, "object"] = {}
@@ -207,13 +213,14 @@ class FakeGateway(Gateway):
         self._partitioned: set[bytes] = set()
         self._filter: Optional[Callable[[bytes, bytes, bytes], bool]] = None
         self._stopped = False
+        self.dropped = 0
 
     # -- wiring ------------------------------------------------------------
     def register_front(self, node_id: bytes, front) -> None:
         with self._lock:
             self._fronts[node_id] = front
             if node_id not in self._queues:
-                q: queue.Queue = queue.Queue()
+                q: queue.Queue = queue.Queue(self.MAX_QUEUE_FRAMES)
                 t = threading.Thread(target=self._deliver_loop,
                                      args=(node_id, q),
                                      name=f"gw-{node_id[:4].hex()}",
@@ -230,7 +237,24 @@ class FakeGateway(Gateway):
         self._stopped = True
         with self._lock:
             for q in self._queues.values():
-                q.put(None)
+                try:
+                    q.put_nowait(None)
+                except queue.Full:
+                    pass  # _stopped is checked each loop iteration
+
+    def _put(self, q: queue.Queue, dst: bytes, item) -> bool:
+        """Bounded enqueue: a full destination queue DROPS the frame
+        (counted + surfaced like the socket transport's sendq metric)
+        instead of blocking the sender behind a wedged consumer."""
+        try:
+            q.put_nowait(item)
+            return True
+        except queue.Full:
+            self.dropped += 1
+            from ..utils.metrics import REGISTRY
+            REGISTRY.inc("bcos_p2p_sendq_dropped_total",
+                         labels={"peer": dst[:8].hex(), "kind": "fake"})
+            return False
 
     # -- fault injection ---------------------------------------------------
     def partition(self, node_id: bytes, isolated: bool = True) -> None:
@@ -272,23 +296,22 @@ class FakeGateway(Gateway):
         flt = self._filter
         verdict = True if flt is None else flt(src, dst, data)
         if verdict is True:
-            q.put((src, data))
-            return True
+            return self._put(q, dst, (src, data))
         if not verdict:
             # False, None, 0, 0.0 — preserves the original falsy-drop
             # contract (a filter that forgets to return must fail CLOSED)
             return False
         if isinstance(verdict, float):
-            t = threading.Timer(verdict, q.put, args=((src, data),))
+            t = threading.Timer(verdict, self._put,
+                                args=(q, dst, (src, data)))
             t.daemon = True
             t.start()
             return True
         if isinstance(verdict, int) and verdict > 1:
             for _ in range(verdict):
-                q.put((src, data))
+                self._put(q, dst, (src, data))
             return True
-        q.put((src, data))
-        return True
+        return self._put(q, dst, (src, data))
 
     @staticmethod
     def module_of(data: bytes) -> int:
@@ -302,7 +325,13 @@ class FakeGateway(Gateway):
 
     def _deliver_loop(self, node_id: bytes, q: queue.Queue) -> None:
         while not self._stopped:
-            item = q.get()
+            try:
+                # timed get, not a bare block: stop() may fail to enqueue
+                # its None sentinel into a FULL queue — the loop must
+                # still observe _stopped instead of parking forever
+                item = q.get(timeout=1.0)
+            except queue.Empty:
+                continue
             if item is None:
                 return
             src, data = item

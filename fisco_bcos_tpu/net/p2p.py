@@ -29,7 +29,6 @@ identity (enforced by the caller's context verify settings).
 
 from __future__ import annotations
 
-import queue
 import socket
 import ssl
 import struct
@@ -45,11 +44,14 @@ except Exception:  # pragma: no cover — environment without zstandard
     _zstd = None
     _ZC = None
 import random
+from collections import deque
 from typing import Optional
 
 from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
-from .gateway import Gateway
+from .front import KIND_PUSH as _KIND_PUSH
+from .gateway import MUX_MAGIC, Gateway
+from .moduleid import ModuleID
 
 # fault sites (utils/failpoints.py): `return_err` at p2p.send drops the
 # outbound frame (the caller sees a refused send), at p2p.recv the inbound
@@ -82,6 +84,33 @@ MAX_DISTANCE = 8  # drop longer advertised paths (count-to-infinity guard)
 KIND_DATA, KIND_ROUTE = 0, 1
 FLAG_COMPRESSED = 1       # zlib (legacy peers)
 FLAG_ZSTD = 2             # zstd, the reference's P2PMessageV2 codec
+
+
+# gossip-class modules: sheddable under per-peer send-queue pressure (the
+# anti-entropy sweep repairs tx gossip; AMOP pub/sub is best-effort by
+# contract). Consensus (PBFT), BlockSync, ConsTxsSync, SnapshotSync and
+# every other module are protected — never evicted from a send queue.
+_DROPPABLE_MODULES = frozenset({int(ModuleID.TxsSync), int(ModuleID.AMOP)})
+# _KIND_PUSH is net/front.py's KIND_PUSH (the one envelope definition):
+# a stale local copy would shed protected REQUEST/RESPONSE frames if the
+# envelope ever renumbered
+
+
+def _is_gossip(data: bytes) -> bool:
+    """Classify a front-packed payload by its leading module id AND kind,
+    looking through the multi-group mux tag (MUX_MAGIC u8len group) when
+    present. Only PUSH frames are sheddable: TxsSync REQUEST/RESPONSE
+    frames are PBFT's fetch-missing path — dropping one stalls a replica's
+    pre-prepare verification into a view change, exactly what shedding
+    must never do. Unknown shapes classify as NOT gossip — fail toward
+    protecting."""
+    off = 0
+    if len(data) >= 2 and data[0] == MUX_MAGIC:
+        off = 2 + data[1]
+    if len(data) < off + 3:
+        return False
+    return ((data[off] << 8) | data[off + 1]) in _DROPPABLE_MODULES \
+        and data[off + 2] == _KIND_PUSH
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -212,18 +241,31 @@ class _Session:
 
     Backpressure (the reference's Session.cpp send-buffer discipline): the
     caller NEVER blocks on a slow peer's socket — frames queue up to a byte
-    budget and a dedicated writer drains them; beyond the budget the newest
-    frame is dropped (counted) so a stalled peer cannot make this node lag
-    or grow without bound. Consensus floods tolerate loss by design
-    (retransmit/view-change paths)."""
+    budget and a dedicated writer drains them. Past the budget, the OLDEST
+    queued GOSSIP frame is dropped first (a stalled follower's backlog of
+    tx floods is the least valuable bytes in the queue, and the txpool's
+    anti-entropy sweep re-delivers them); consensus/sync frames are never
+    evicted — when no gossip can be shed, the NEWEST frame is refused
+    instead (counted; PBFT's retransmit/view-change paths tolerate loss by
+    design). Either way a slow peer can neither lag this node nor grow its
+    memory without bound. Drops surface as
+    `bcos_p2p_sendq_dropped_total{peer=...,kind=gossip|other}`."""
 
-    def __init__(self, peer_id: bytes, sock: socket.socket, on_dead):
+    def __init__(self, peer_id: bytes, sock: socket.socket, on_dead,
+                 max_queue: int = MAX_SEND_QUEUE):
         self.peer_id = peer_id
         self.sock = sock
         self._on_dead = on_dead  # called with THIS session (identity-safe)
-        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self.max_queue = max_queue
+        # entries are shared mutable [frame, droppable, dead] cells held
+        # by BOTH queues; eviction is LAZY (mark dead, adjust bytes, let
+        # the writer skip it) so overflow handling is O(1) amortized —
+        # a middle-of-deque delete would be O(backlog) under the cv,
+        # stalling every sender to this peer exactly while it is slow
+        self._q: "deque[list]" = deque()
+        self._droppable: "deque[list]" = deque()  # gossip-class entries
+        self._cv = threading.Condition()
         self._bytes = 0
-        self._lock = threading.Lock()
         self._closed = False
         self.dropped = 0
         self._writer = threading.Thread(
@@ -231,38 +273,84 @@ class _Session:
             daemon=True)
         self._writer.start()
 
-    def enqueue(self, frame: bytes) -> bool:
-        with self._lock:
+    def _count_drop(self, kind: str) -> None:
+        self.dropped += 1
+        from ..utils.metrics import REGISTRY
+        REGISTRY.inc("bcos_p2p_sendq_dropped_total",
+                     labels={"peer": self.peer_id[:8].hex(), "kind": kind})
+        if self.dropped in (1, 100, 10000):
+            LOG.warning(badge("P2P", "send-queue-full",
+                              peer=self.peer_id[:8].hex(),
+                              dropped=self.dropped))
+
+    def enqueue(self, frame: bytes, droppable: bool = False) -> bool:
+        """`droppable` marks frames gossip-class (TxsSync/AMOP pushes):
+        sheddable for a slow peer. Everything else (consensus, block
+        sync, fetch-missing request/response, routed transit) is
+        protected — see the class docstring."""
+        drops = 0
+        refused = None
+        with self._cv:
             if self._closed:
                 return False  # writer already gone; don't strand frames
-            if self._bytes + len(frame) > MAX_SEND_QUEUE:
-                self.dropped += 1
-                if self.dropped in (1, 100, 10000):
-                    LOG.warning(badge("P2P", "send-queue-full",
-                                      peer=self.peer_id[:8].hex(),
-                                      dropped=self.dropped))
-                return False
-            self._bytes += len(frame)
-        self._q.put(frame)
+            # drain dead heads (entries the writer already consumed):
+            # without this the droppable index would retain every gossip
+            # frame's bytes for the session's lifetime — amortized O(1)
+            while self._droppable and self._droppable[0][2]:
+                self._droppable.popleft()
+            while self._bytes + len(frame) > self.max_queue \
+                    and self._droppable:
+                # evict the OLDEST live droppable entry: mark dead, the
+                # writer skips it — O(1), no deque surgery
+                e = self._droppable.popleft()
+                if e[2]:
+                    continue  # already sent (or previously evicted)
+                e[2] = True
+                self._bytes -= len(e[0])
+                e[0] = b""  # free the bytes now, not at index drain
+                drops += 1
+            if self._bytes + len(frame) > self.max_queue:
+                refused = "gossip" if droppable else "other"
+            else:
+                self._bytes += len(frame)
+                entry = [frame, droppable, False]
+                self._q.append(entry)
+                if droppable:
+                    self._droppable.append(entry)
+                self._cv.notify()
+        # metrics/logging outside the cv: REGISTRY has its own lock
+        for _ in range(drops):
+            self._count_drop("gossip")
+        if refused is not None:
+            self._count_drop(refused)
+            return False
         return True
 
     def _write_loop(self) -> None:
         while True:
-            frame = self._q.get()
-            if frame is None:
-                return
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                entry = self._q.popleft()
+                if entry[2]:
+                    continue  # evicted while queued: nothing to send
+                entry[2] = True  # consumed: eviction must skip it now
+                frame = entry[0]
+                entry[0] = b""  # the droppable index may still hold the
+                #                 cell — don't pin the bytes through it
+                self._bytes -= len(frame)
             try:
                 _send_frame(self.sock, frame)
             except OSError:
                 self._on_dead(self)
                 return
-            with self._lock:
-                self._bytes -= len(frame)
 
     def close(self) -> None:
-        with self._lock:
+        with self._cv:
             self._closed = True
-        self._q.put(None)
+            self._cv.notify_all()
         try:
             self.sock.close()
         except OSError:
@@ -359,25 +447,30 @@ class P2PGateway(Gateway):
     def send(self, src: bytes, dst: bytes, data: bytes) -> bool:
         if fp.fire_lossy("p2p.send"):
             return False  # injected loss: frame dropped before the wire
+        droppable = _is_gossip(data)  # classified BEFORE compression
         flags, payload = self._encode_payload(data)
         frame = _pack_data(flags, MAX_TTL, self.node_id, dst, payload)
-        return self._forward(dst, frame)
+        return self._forward(dst, frame, droppable)
 
-    def _forward(self, dst: bytes, frame: bytes) -> bool:
+    def _forward(self, dst: bytes, frame: bytes,
+                 droppable: bool = False) -> bool:
         """Hand a DATA frame to the session for dst, or its next hop.
-        Non-blocking: enqueues on the session's bounded writer queue."""
+        Non-blocking: enqueues on the session's bounded writer queue.
+        Transit frames (forwarded for other nodes) default to protected —
+        their compressed payload hides the module id."""
         with self._lock:
             hop = dst if dst in self._sessions else self._router.next_hop(dst)
             sess = self._sessions.get(hop) if hop else None
         if sess is None:
             return False
-        return sess.enqueue(frame)
+        return sess.enqueue(frame, droppable)
 
     def broadcast(self, src: bytes, data: bytes) -> None:
+        droppable = _is_gossip(data)
         flags, payload = self._encode_payload(data)  # compress ONCE
         for dst in self.peers():
             self._forward(dst, _pack_data(flags, MAX_TTL, self.node_id,
-                                          dst, payload))
+                                          dst, payload), droppable)
 
     def _advertise_routes(self) -> None:
         # loop until the vector we just finished enqueueing is still

@@ -63,7 +63,8 @@ class TransactionSync(Worker):
     ANTI_ENTROPY_MAX = 256
 
     def __init__(self, front: FrontService, txpool, suite,
-                 anti_entropy_interval: float = 2.0, ingest=None):
+                 anti_entropy_interval: float = 2.0, ingest=None,
+                 import_gate=None, registry=None):
         super().__init__("tx-sync", idle_wait=0.25)
         self.front = front
         self.txpool = txpool
@@ -72,6 +73,15 @@ class TransactionSync(Worker):
         # packets from many peers coalesce with RPC traffic into one
         # device-sized recover instead of one recover per packet
         self.ingest = ingest
+        # overload brownout gate (utils/overload.py, wired by the node):
+        # while it returns False this node stops IMPORTING remote pending
+        # txs — a saturated follower must not amplify load it could not
+        # seal anyway. Fetch-missing (proposal verification) is NOT gated:
+        # consensus keeps full service. The anti-entropy sweep re-delivers
+        # whatever was skipped once the node recovers.
+        self.import_gate = import_gate
+        from ..utils.metrics import REGISTRY
+        self._reg = registry if registry is not None else REGISTRY
         self.anti_entropy_interval = anti_entropy_interval
         self._last_sweep = 0.0
         self._lock = threading.Lock()
@@ -144,7 +154,11 @@ class TransactionSync(Worker):
         if {h for h, _raw in pairs} != set(hashes):
             return False
         txs = [Transaction.decode(raw) for _h, raw in pairs]
-        results = self.txpool.submit_batch(txs, broadcast=False)
+        # consensus import: proposal verification must succeed even on a
+        # saturated pool — watermark admission does not apply here (the
+        # p2p layer protects these frames for the same reason)
+        results = self.txpool.submit_batch(txs, broadcast=False,
+                                           consensus=True)
         metric("txsync.fetch_missing", n=len(txs), peer=peer[:8].hex())
         from ..protocol import TransactionStatus
         okset = (TransactionStatus.OK, TransactionStatus.ALREADY_IN_TXPOOL,
@@ -157,6 +171,10 @@ class TransactionSync(Worker):
             hashes = Reader(payload).seq(lambda r: r.blob())
             txs = self.txpool.fill_block(hashes) or []
             respond(_pack_txs(txs, self.suite))
+            return
+        if self.import_gate is not None and not self.import_gate():
+            # busy/degraded: drop the gossip push before ANY decode work
+            self._reg.inc("bcos_txsync_import_gated_total")
             return
         pairs = _unpack_txs(payload)
         if not pairs:

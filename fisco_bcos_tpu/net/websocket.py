@@ -60,6 +60,8 @@ class WsConnection:
         self.sock = sock
         self.mask = mask_outgoing  # clients MUST mask (RFC 6455 §5.3)
         self.peer = peer
+        self.headers: dict = {}  # server side: the upgrade request's
+        #                          headers (x-api-key admission identity)
         self._rbuf = initial  # bytes that arrived with the handshake
         self._wlock = threading.Lock()
         self._closed = False
@@ -203,7 +205,7 @@ def _server_handshake(sock: socket.socket) -> bytes:
         b"HTTP/1.1 101 Switching Protocols\r\n"
         b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
         b"Sec-WebSocket-Accept: " + accept.encode() + b"\r\n\r\n")
-    return leftover
+    return leftover, headers
 
 
 class WsServer:
@@ -244,10 +246,13 @@ class WsServer:
     def _serve(self, sock: socket.socket, addr) -> None:
         conn = None
         try:
-            leftover = _server_handshake(sock)
+            leftover, hs_headers = _server_handshake(sock)
             conn = WsConnection(sock, mask_outgoing=False,
                                 peer=f"{addr[0]}:{addr[1]}",
                                 initial=leftover)
+            # retained for the serving layer: the upgrade request's
+            # x-api-key is the client's admission identity (rpc/ws_server)
+            conn.headers = hs_headers
             with self._lock:
                 self._conns.add(conn)
             self.on_open(conn)

@@ -65,6 +65,14 @@ class TransactionStatus(enum.IntEnum):
     # node is degraded — reads still serve, writes are refused so clients
     # fail fast and retry another node instead of feeding a sick pipeline
     NODE_DEGRADED = 10010
+    # overload-control plane (utils/overload.py + txpool watermarks):
+    # TXPOOL_EVICTED — the tx WAS admitted but a higher-priority tx
+    # reclaimed its slot at the high watermark; DEADLINE_UNMEETABLE — the
+    # pool is congested past the low watermark and this tx's block_limit
+    # leaves too little lifetime to realistically seal before expiry, so
+    # admitting it would only burn verify + pool slots it can never repay
+    TXPOOL_EVICTED = 10011
+    DEADLINE_UNMEETABLE = 10012
 
 
 @dataclasses.dataclass

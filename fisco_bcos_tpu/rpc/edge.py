@@ -123,13 +123,16 @@ class WorkerPool:
 
 
 class _Conn:
-    __slots__ = ("sock", "peer", "rbuf", "outbuf", "out_off", "next_seq",
-                 "write_seq", "ready", "inflight", "close_after",
-                 "peer_closed", "last_active", "interest")
+    __slots__ = ("sock", "peer", "peer_ip", "rbuf", "outbuf", "out_off",
+                 "next_seq", "write_seq", "ready", "inflight",
+                 "close_after", "peer_closed", "last_active", "interest")
 
     def __init__(self, sock: socket.socket, peer: str):
         self.sock = sock
         self.peer = peer
+        # admission identity fallback when the client sends no x-api-key:
+        # the peer ADDRESS (not the port — one client, many connections)
+        self.peer_ip = peer.rsplit(":", 1)[0]
         # bytearrays, NOT bytes: the ONE loop thread owns every socket, so
         # buffer growth must be amortized append (bytes += re-copies the
         # whole buffer per recv — O(n^2) for a chunked 32MB body) and
@@ -162,11 +165,16 @@ class EventLoopHttpServer:
                  pool: Optional[WorkerPool] = None,
                  keepalive_s: float = 60.0, name: str = "jsonrpc-http",
                  ops: Optional[Callable[[str],
-                                        tuple[int, str, bytes]]] = None):
+                                        tuple[int, str, bytes]]] = None,
+                 admission=None):
         self.handler = handler
         # operator GET routes (rpc/ops.OpsRoutes): /metrics, /status,
         # /trace served from THIS loop — no dedicated scrape thread/port
         self.ops = ops
+        # per-client admission control (rpc/admission.ClientAdmission):
+        # token buckets + fair-share inflight, checked INLINE on the loop
+        # so a -32005 reject never costs a worker slot. None = open edge.
+        self.admission = admission
         # a handler may take (body) or (body, headers); headers carry the
         # W3C traceparent for the tracing plane. Decided once, not per
         # request.
@@ -406,9 +414,31 @@ class EventLoopHttpServer:
                 self._complete_inline(conn, seq, 405,
                                       b'{"error": "POST only"}')
             else:
-                job = self._make_job(conn, seq, body, headers)
+                lease_key = None
+                if self.admission is not None:
+                    # per-client token bucket + fair share, on the loop:
+                    # an admission reject costs a dict lookup and an
+                    # inline write — that is what keeps reject p99 in the
+                    # microseconds while the node is saturated. Writes are
+                    # classified by a byte scan (no JSON parse pre-admit);
+                    # a batch mixing reads and writes bills as a write.
+                    # The charge is PER BILLABLE ENTRY, not per body — a
+                    # 256-entry batch must not ride on one token and
+                    # multiply the client's budget by max_batch.
+                    from .admission import admit_payload
+                    key = headers.get("x-api-key") or conn.peer_ip
+                    retry = admit_payload(self.admission, key, body)
+                    if retry is not None:
+                        from .admission import rate_limited_body
+                        self._complete_inline(conn, seq, 200,
+                                              rate_limited_body(retry))
+                        continue
+                    lease_key = key
+                job = self._make_job(conn, seq, body, headers, lease_key)
                 if not self.pool.try_submit(job):
                     # saturated pool: shed THIS request, keep the session
+                    if lease_key is not None:
+                        self.admission.release(lease_key)
                     self._complete_inline(
                         conn, seq, 200,
                         b'{"jsonrpc": "2.0", "id": null, "error": '
@@ -418,22 +448,30 @@ class EventLoopHttpServer:
             self._set_interest(conn)
 
     def _make_job(self, conn: _Conn, seq: int, body: bytes,
-                  headers: dict) -> Callable:
+                  headers: dict, lease_key: Optional[str] = None
+                  ) -> Callable:
         handler = self.handler
         wants_headers = self._handler_wants_headers
 
         def job() -> None:
             hdrs = None
             try:
-                out = handler(body, headers) if wants_headers \
-                    else handler(body)
-                if isinstance(out, tuple):  # (body, extra response headers)
-                    out, hdrs = out
-            except Exception:  # noqa: BLE001 — handler bug, not the edge's
-                LOG.exception(badge("RPC", "handler-failed"))
-                out = (b'{"jsonrpc": "2.0", "id": null, "error": '
-                       b'{"code": -32603, "message": "internal error"}}')
-            self._complete(conn, seq, 200, out, headers=hdrs)
+                try:
+                    out = handler(body, headers) if wants_headers \
+                        else handler(body)
+                    if isinstance(out, tuple):  # (body, extra resp headers)
+                        out, hdrs = out
+                except Exception:  # noqa: BLE001 — handler bug, not edge's
+                    LOG.exception(badge("RPC", "handler-failed"))
+                    out = (b'{"jsonrpc": "2.0", "id": null, "error": '
+                           b'{"code": -32603, "message": "internal error"}}')
+                self._complete(conn, seq, 200, out, headers=hdrs)
+            finally:
+                if lease_key is not None:
+                    # the fair-share slot covers WORKER occupancy: freed
+                    # the moment the handler returns, not when the bytes
+                    # drain (outbuf is bounded separately by MAX_OUTBUF)
+                    self.admission.release(lease_key)
 
         return job
 

@@ -12,8 +12,8 @@ scrape port):
   GET /status               one JSON document per node: the same aggregate
                             the `getSystemStatus` RPC returns
   GET /healthz              health state machine (utils/health.py): 200
-                            while `ok`, 503 while degraded/failed — the
-                            LB/orchestrator liveness contract
+                            while `ok`/`busy`, 503 while degraded/failed —
+                            the LB/orchestrator liveness contract
   GET /failpoints           the fault-injection surface (utils/failpoints):
                             registered sites + what is armed; `?arm=site=
                             action` / `?disarm=site|all` mutate it, TEST
@@ -69,7 +69,10 @@ class OpsRoutes:
             if path == "/healthz":
                 doc = self.health_fn() if self.health_fn is not None \
                     else {"state": "ok", "faults": {}}
-                code = 200 if doc.get("state") == "ok" else 503
+                # busy = saturated but serving (overload brownout): the
+                # liveness contract stays 200 — an LB that pulled every
+                # busy node would dogpile the survivors
+                code = 200 if doc.get("state") in ("ok", "busy") else 503
                 return code, JSON_CTYPE, json.dumps(doc).encode()
             if path == "/failpoints":
                 return self._failpoints(q)

@@ -51,6 +51,10 @@ JSONRPC_INTERNAL_ERROR = -32603
 # group routing failure gets its OWN code (the reference's GroupNotExist):
 # clients must be able to tell "no such group" from a malformed request
 JSONRPC_GROUP_NOT_FOUND = -32004
+# edge admission reject: the error object carries a data.retryAfterMs
+# hint; clients back off instead of hammering. ONE definition — the
+# emitters (rpc/admission.py, rpc/ws_server.py) use the same constant.
+from .admission import JSONRPC_RATE_LIMITED  # noqa: F401 — public API
 
 
 def _hex(b: bytes) -> str:
@@ -352,8 +356,15 @@ class JsonRpcImpl:
             return {"transactionHash": _hex(res.tx_hash), "status": None}
         # remaining budget only: admission may have consumed part of the
         # client's timeout — wait=True must not double-spend it
-        rc = self.node.txpool.wait_for_receipt(
-            res.tx_hash, max(0.0, deadline - time.monotonic()))
+        from ..txpool.txpool import TxDropped
+        try:
+            rc = self.node.txpool.wait_for_receipt(
+                res.tx_hash, max(0.0, deadline - time.monotonic()))
+        except TxDropped as exc:
+            # evicted/shed after admission: settle NOW with the typed
+            # status instead of burning the client's full timeout
+            raise JsonRpcError(int(exc.status),
+                               TransactionStatus(exc.status).name)
         if rc is None:
             raise JsonRpcError(JSONRPC_INTERNAL_ERROR,
                                "timed out waiting for receipt")
@@ -760,14 +771,15 @@ class JsonRpcServer:
 
     def __init__(self, impl, host: str = "127.0.0.1", port: int = 0,
                  pool: Optional[WorkerPool] = None, workers: int = 8,
-                 keepalive_s: float = 60.0, ops=None):
+                 keepalive_s: float = 60.0, ops=None, admission=None):
         self.impl = impl
         max_batch = getattr(impl, "max_batch", 256)
         self._own_pool = pool is None
         self._pool = pool if pool is not None else WorkerPool(workers)
         self._edge = EventLoopHttpServer(
             http_body_handler(impl, max_batch), host=host, port=port,
-            pool=self._pool, keepalive_s=keepalive_s, ops=ops)
+            pool=self._pool, keepalive_s=keepalive_s, ops=ops,
+            admission=admission)
         self.host, self.port = self._edge.host, self._edge.port
 
     def start(self) -> None:

@@ -65,9 +65,15 @@ class WsRpcServer:
     group in multi-group mode)."""
 
     def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1",
-                 port: int = 0, pool=None):
+                 port: int = 0, pool=None, admission=None):
         self.impl = impl
         self.node = impl.node
+        # per-client admission (rpc/admission.ClientAdmission), shared
+        # with the HTTP edge: WS traffic must not be the unmetered side
+        # door around the token buckets/fair share. Keyed by peer address
+        # (the WS handshake carries no retained x-api-key), so a client's
+        # HTTP and WS traffic draw from ONE budget.
+        self.admission = admission
         # bounded dispatch offload, shared with the HTTP edge when the
         # node wires one (init/node.py): method calls can block (receipt
         # waits, AMOP round trips), so they never run on the reader
@@ -142,7 +148,9 @@ class WsRpcServer:
             # JSON-RPC 2.0 batch over WS: same framing as HTTP
             # (handle_payload — per-id errors, notifications omitted,
             # order preserved); WS-only methods are not batchable
-            self._offload(self._dispatch_batch, sess, msg)
+            ok, lease = self._try_admit(sess, msg, payload)
+            if ok:
+                self._offload(self._dispatch_batch, sess, msg, lease)
             return
         if not isinstance(msg, dict):
             sess.push({"jsonrpc": "2.0", "id": None,
@@ -162,17 +170,61 @@ class WsRpcServer:
         # waits for a receipt; publishTopic waits for an amopResp that this
         # very reader thread must deliver — inline handling would deadlock a
         # session publishing to a topic it also serves)
-        self._offload(self._dispatch, sess, msg)
+        ok, lease = self._try_admit(sess, msg, payload)
+        if ok:
+            self._offload(self._dispatch, sess, msg, lease)
 
-    def _offload(self, fn, sess: _Session, msg) -> None:
+    def _try_admit(self, sess: _Session, msg, payload: bytes):
+        """Admission check on the reader thread (cheap: a dict lookup).
+        -> (admitted, lease_key). Rejections answer -32005 with the
+        retryAfterMs hint per id; notifications shed silently."""
+        if self.admission is None:
+            return True, None
+        from .admission import admit_payload
+
+        # identity: the upgrade request's x-api-key when the client sent
+        # one (same budget as its HTTP traffic), else the peer address;
+        # classification/billing is admission.admit_payload — the SAME
+        # policy the HTTP edge applies, one owner
+        key = sess.conn.headers.get("x-api-key") \
+            or sess.conn.peer.rsplit(":", 1)[0]
+        retry = admit_payload(self.admission, key, payload)
+        if retry is None:
+            return True, key
+        from .admission import JSONRPC_RATE_LIMITED
+        err = {"code": JSONRPC_RATE_LIMITED, "message": "rate limited",
+               "data": {"retryAfterMs": retry}}
+        if isinstance(msg, list):
+            errs = [{"jsonrpc": "2.0", "id": e.get("id"), "error": err}
+                    for e in msg
+                    if isinstance(e, dict) and e.get("id") is not None]
+            if errs:
+                sess.push(errs)
+        elif isinstance(msg, dict) and msg.get("id") is not None:
+            sess.push({"jsonrpc": "2.0", "id": msg["id"], "error": err})
+        return False, None
+
+    def _offload(self, fn, sess: _Session, msg, lease=None) -> None:
         """Run `fn(sess, msg)` on the shared bounded pool; a saturated (or
         absent) pool falls back to a BOUNDED set of one-off threads so a
         WS session never deadlocks behind HTTP load; past that cap the
-        request is shed with the same busy error HTTP answers."""
+        request is shed with the same busy error HTTP answers. `lease` is
+        the admission inflight slot — released when the job finishes (or
+        is shed below)."""
+        if lease is not None:
+            inner = fn
+
+            def fn(s, m, _inner=inner):  # noqa: F811 — leased wrapper
+                try:
+                    _inner(s, m)
+                finally:
+                    self.admission.release(lease)
         if self.pool is not None and self.pool.try_submit(
                 lambda: fn(sess, msg)):
             return
         if not self._fallback.acquire(blocking=False):
+            if lease is not None:
+                self.admission.release(lease)
             if isinstance(msg, list):
                 # batch shed: per-id errors (order preserved, notifications
                 # silent) so id-correlating clients resolve every waiter —

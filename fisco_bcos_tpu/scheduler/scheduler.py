@@ -170,6 +170,12 @@ class Scheduler:
             self._overlap_commits = 0
             self._speculative_execs = 0
 
+    def commit_backlog(self) -> int:
+        """Decided-but-uncommitted depth: the commit worker's queue plus
+        any in-flight 2PC — the overload controller's commit-stage signal
+        (utils/overload.py). Lock-free snapshot reads."""
+        return self._commit_q.qsize() + (1 if self._commit_busy else 0)
+
     def pipeline_busy(self) -> bool:
         """True while a block is executing or awaiting/undergoing commit —
         the sealer's keep-filling signal (a proposal sealed now would only

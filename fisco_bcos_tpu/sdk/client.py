@@ -39,13 +39,17 @@ class RpcCallError(Exception):
 class SdkClient:
     def __init__(self, url: str, group: str = "group0",
                  node_name: str = "", timeout: float = 60.0,
-                 keepalive: bool = True, retries: int = 2):
+                 keepalive: bool = True, retries: int = 2,
+                 api_key: str = ""):
         self.url = url
         self.group = group
         self.node_name = node_name
         self.timeout = timeout
         self.keepalive = keepalive
         self.retries = max(0, int(retries))
+        # edge admission identity (rpc/admission.py): clients behind one
+        # NAT/host present an x-api-key so their budgets don't pool
+        self.api_key = api_key
         u = urllib.parse.urlsplit(url)
         self._host = u.hostname or "127.0.0.1"
         self._port = u.port or (443 if u.scheme == "https" else 80)
@@ -73,6 +77,8 @@ class SdkClient:
 
     def _post(self, body: bytes) -> bytes:
         headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["X-Api-Key"] = self.api_key
         if not self.keepalive:
             headers["Connection"] = "close"
         last: Optional[BaseException] = None

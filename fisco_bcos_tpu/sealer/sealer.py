@@ -154,7 +154,10 @@ class Sealer(Worker):
                 # per-block overhead, so it ships at min_seal_time (a
                 # burst's tail block must not idle out the window).
                 return
-        txs, hashes = self.txpool.seal(limit)
+        # seal against the height this proposal will OCCUPY: with
+        # pipelining, `number` can run ahead of the committed height, and
+        # a tx expiring between them would burn its seal slot for nothing
+        txs, hashes = self.txpool.seal(limit, for_number=number)
         if not txs:
             return
         t_seal = time.monotonic()

@@ -90,9 +90,14 @@ class TxPoolServer:
         w.u8(1)
 
     def _wait_receipt(self, r: Reader, w: Writer) -> None:
+        from ..txpool.txpool import TxDropped
         tx_hash = r.blob()
         timeout = min(r.u32(), 25)  # bounded park; client re-polls
-        rc = self.txpool.wait_for_receipt(tx_hash, timeout)
+        try:
+            rc = self.txpool.wait_for_receipt(tx_hash, timeout)
+        except TxDropped:
+            rc = None  # wire keeps the empty-blob shape; the submitter
+            #            learned the typed status from its own submit
         w.blob(rc.encode() if rc is not None else b"")
 
 

@@ -93,7 +93,26 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     # empty = single-group node
     cp["groups"] = {"list": ",".join(cfg.groups)}
     cp["txpool"] = {"limit": str(cfg.txpool_limit),
-                    "block_limit_range": str(cfg.block_limit_range)}
+                    "block_limit_range": str(cfg.block_limit_range),
+                    # watermark admission (txpool/txpool.py)
+                    "low_watermark": str(cfg.txpool_low_watermark),
+                    "high_watermark": str(cfg.txpool_high_watermark),
+                    "priority_bands": str(
+                        cfg.txpool_priority_bands).lower()}
+    # overload-control plane (utils/overload.py + rpc/admission.py):
+    # busy thresholds + the edge's per-client read/write token budgets
+    cp["overload"] = {
+        "enabled": str(cfg.overload_enabled).lower(),
+        "enter": str(cfg.overload_enter),
+        "exit": str(cfg.overload_exit),
+        "hold_s": str(cfg.overload_hold_s),
+        "commit_backlog": str(cfg.overload_commit_backlog),
+        "busy_write_factor": str(cfg.overload_busy_write_factor),
+        "client_write_rate": str(cfg.client_write_rate),
+        "client_write_burst": str(cfg.client_write_burst),
+        "client_read_rate": str(cfg.client_read_rate),
+        "client_read_burst": str(cfg.client_read_burst),
+    }
     cp["consensus"] = {"type": cfg.consensus,
                        "min_seal_time": str(cfg.min_seal_time),
                        # busy-pipeline fill ceiling (sealer/sealer.py)
@@ -196,6 +215,29 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         txpool_limit=cp.getint("txpool", "limit", fallback=15000),
         block_limit_range=cp.getint("txpool", "block_limit_range",
                                     fallback=600),
+        txpool_low_watermark=cp.getfloat("txpool", "low_watermark",
+                                         fallback=0.7),
+        txpool_high_watermark=cp.getfloat("txpool", "high_watermark",
+                                          fallback=0.95),
+        txpool_priority_bands=cp.getboolean("txpool", "priority_bands",
+                                            fallback=True),
+        overload_enabled=cp.getboolean("overload", "enabled",
+                                       fallback=True),
+        overload_enter=cp.getfloat("overload", "enter", fallback=0.85),
+        overload_exit=cp.getfloat("overload", "exit", fallback=0.5),
+        overload_hold_s=cp.getfloat("overload", "hold_s", fallback=0.5),
+        overload_commit_backlog=cp.getint("overload", "commit_backlog",
+                                          fallback=6),
+        overload_busy_write_factor=cp.getfloat(
+            "overload", "busy_write_factor", fallback=0.25),
+        client_write_rate=cp.getfloat("overload", "client_write_rate",
+                                      fallback=0.0),
+        client_write_burst=cp.getfloat("overload", "client_write_burst",
+                                       fallback=0.0),
+        client_read_rate=cp.getfloat("overload", "client_read_rate",
+                                     fallback=0.0),
+        client_read_burst=cp.getfloat("overload", "client_read_burst",
+                                      fallback=0.0),
         consensus=cp.get("consensus", "type", fallback="solo"),
         min_seal_time=cp.getfloat("consensus", "min_seal_time",
                                   fallback=0.05),

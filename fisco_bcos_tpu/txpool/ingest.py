@@ -294,6 +294,27 @@ class IngestLane:
 
     def _dispatch(self, batch: list[_Entry]) -> None:
         now = time.monotonic()
+        # deadline shed BEFORE any admission/crypto work: entries whose
+        # block_limit already passed while they sat in the queue can never
+        # commit — settle them with the typed expiry status instead of
+        # spending lane verify + pool slots on work that would be dropped
+        # anyway (they would be rejected by the pool's precheck, but under
+        # overload even carrying them through the batch costs real time)
+        ledger = getattr(self.txpool, "ledger", None)  # test doubles may
+        current = ledger.current_number() if ledger is not None else None
+        shed = [e for e in batch
+                if current is not None and e.tx.block_limit <= current]
+        if shed:
+            from ..protocol import TransactionStatus, batch_hash
+            hs = batch_hash([e.tx for e in shed], self.txpool.suite)
+            for e, h in zip(shed, hs):
+                if e.task is not None:
+                    e.task.resolve(TxSubmitResult(
+                        h, TransactionStatus.BLOCK_LIMIT_CHECK_FAIL))
+            self._reg.inc("bcos_ingest_deadline_shed_total", len(shed))
+            batch = [e for e in batch if e.tx.block_limit > current]
+            if not batch:
+                return
         # one submit_batch == one device recover for the whole drained set
         t0 = time.perf_counter()
         results = self.txpool.submit_batch([e.tx for e in batch],
@@ -343,6 +364,11 @@ class IngestLane:
                rate=int(self._rate))
 
     # -- introspection -----------------------------------------------------
+    def queue_fraction(self) -> float:
+        """Queue occupancy 0..1 — the overload controller's ingest signal
+        (utils/overload.py). Lock-free read of a len()."""
+        return len(self._q) / max(1, self.queue_cap)
+
     def stats(self) -> dict:
         with self._cv:
             txs, batches = self._txs_total, self._batches_total

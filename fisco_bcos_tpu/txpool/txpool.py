@@ -14,6 +14,22 @@ tbb::parallel_for over scalar verifies (TransactionSync.cpp:516-537).
 The single-tx `submit` is the degenerate case. Duplicate-nonce tracking
 follows the reference's TxPoolNonceChecker: nonces of the last `block_limit`
 committed blocks are a rolling filter.
+
+Overload control (the serving-stack watermark discipline): admission is no
+longer a hard `TXPOOL_FULL` cliff at `pool_limit`. Below the LOW watermark
+everything admits; between the watermarks, band-0 txs must carry enough
+remaining `block_limit` lifetime to realistically seal before expiry
+(DEADLINE_UNMEETABLE otherwise — admitting them would only burn verify +
+pool slots they can never repay); at the HIGH watermark an incoming tx
+admits only by EVICTING a strictly lower-priority pending tx
+(TXPOOL_EVICTED), so the pool can never wedge full of stale low-value
+traffic. Priority = (band, block_limit): the `attribute` word's top byte
+is the client-declared priority band (the gas-price-band analogue — this
+chain has no fee market), ties broken toward keeping the later-expiring
+and younger tx. Capacity/priority verdicts are computed BEFORE the batch
+recover, so a congested pool rejects without paying the crypto lane; and
+every admitted-then-dropped tx settles its waiters promptly with the
+typed status (`TxDropped`) instead of letting clients hang to timeout.
 """
 
 from __future__ import annotations
@@ -49,16 +65,64 @@ class SubmitRejected(RuntimeError):
         self.result = result
 
 
+class TxDropped(RuntimeError):
+    """An ADMITTED tx left THIS node's pool without committing — evicted
+    at the high watermark, shed past its deadline, or expired unsealed.
+    Carries the typed status so waiters (wait_for_receipt / submit_async)
+    settle with a wire-mappable reason instead of a timeout.
+
+    The verdict is node-local: the tx was gossiped, so a peer may still
+    seal and commit it. Clients should poll by hash before acting on the
+    drop, and resubmit with a FRESH nonce (the original's stays in the
+    replay filter for the window, exactly as after a timeout)."""
+
+    def __init__(self, tx_hash: bytes, status: TransactionStatus):
+        super().__init__(
+            f"tx dropped: {TransactionStatus(status).name}")
+        self.tx_hash = tx_hash
+        self.status = status
+
+
+# drop-reason -> the counter the overload bench/dashboards read
+_DROP_METRIC = {
+    TransactionStatus.TXPOOL_EVICTED: "bcos_txpool_evicted_total",
+    TransactionStatus.DEADLINE_UNMEETABLE:
+        "bcos_txpool_deadline_shed_total",
+    TransactionStatus.BLOCK_LIMIT_CHECK_FAIL: "bcos_txpool_expired_total",
+}
+
+
 class TxPool:
+    # max extra blocks of remaining lifetime a band-0 tx must carry as the
+    # pool climbs from the low toward the high watermark (linear ramp)
+    DEADLINE_SLACK_BLOCKS = 8
+    # bounded memory for the typed drop records waiters settle against
+    DROPPED_MAX = 8192
+
     def __init__(self, suite, ledger: Ledger, chain_id: str = "chain0",
                  group_id: str = "group0", pool_limit: int = DEFAULT_POOL_LIMIT,
-                 block_limit_range: int = 600, registry=None):
+                 block_limit_range: int = 600, registry=None,
+                 low_watermark: float = 0.7, high_watermark: float = 0.95,
+                 priority_bands: bool = True):
         self.suite = suite
         self._registry = registry  # None -> utils.metrics.REGISTRY
         self.ledger = ledger
         self.chain_id = chain_id
         self.group_id = group_id
         self.pool_limit = pool_limit
+        # watermark admission (module docstring): fractions of pool_limit,
+        # clamped sane — low strictly below high, high at most the limit
+        high_watermark = min(1.0, max(0.01, float(high_watermark)))
+        low_watermark = min(float(low_watermark), high_watermark)
+        self._high_mark = max(1, int(pool_limit * high_watermark))
+        self._low_mark = min(max(0, int(pool_limit * low_watermark)),
+                             self._high_mark - 1)
+        # honor the client-declared priority band (see _band). OFF treats
+        # every tx as band 0 (eviction order = deadline/age only) — for
+        # operators exposing the edge beyond the consortium's own
+        # identified clients, where an unauthenticated band would let any
+        # sender evict others' pending txs for free
+        self.priority_bands = bool(priority_bands)
         self.block_limit_range = block_limit_range
         self._lock = threading.RLock()
         self._pending: "OrderedDict[bytes, Transaction]" = OrderedDict()
@@ -84,6 +148,11 @@ class TxPool:
         # costs one notify_all per BLOCK, not per waiting RPC thread.
         self._receipt_cv = threading.Condition()
         self._async_waiters: dict[bytes, "object"] = {}  # hash -> Task
+        # typed drop records: hash -> TransactionStatus for txs that were
+        # ADMITTED and later evicted/shed/expired — wait_for_receipt and
+        # submit_async settle against these promptly instead of timing out
+        self._dropped: "OrderedDict[bytes, TransactionStatus]" = \
+            OrderedDict()
         # TransactionSync gossip hook (TransactionSync.cpp broadcast path)
         self._broadcast_hooks: list[Callable[[Sequence[Transaction]], None]] = []
 
@@ -134,8 +203,25 @@ class TxPool:
         return self.submit_batch([tx])[0]
 
     def submit_batch(self, txs: Sequence[Transaction],
-                     broadcast: bool = True) -> list[TxSubmitResult]:
-        """Host checks + one TPU batch recover for the survivors."""
+                     broadcast: bool = True,
+                     consensus: bool = False) -> list[TxSubmitResult]:
+        """Host checks + one TPU batch recover for the survivors.
+
+        Watermark/capacity verdicts run in the PRE-crypto phase: a full or
+        congested pool answers TXPOOL_FULL / DEADLINE_UNMEETABLE before
+        the batch recover, so rejected load costs zero lane work (the
+        Blockchain-Machine shed-at-the-front-end discipline). The insert
+        phase re-validates against live state — the lock is dropped across
+        the recover — and performs any planned high-watermark evictions.
+
+        `consensus=True` (the fetch-missing import behind proposal
+        verification) BYPASSES watermark/capacity admission entirely: a
+        saturated replica refusing the leader's proposal txs could not
+        prepare and would view-change exactly while overloaded — the
+        same stall the p2p layer's protected-frame classes prevent. The
+        overshoot is bounded by one proposal's tx count, and the txs
+        arrive pre-sealed (mark_sealed tombstones), so they are not
+        eviction candidates either."""
         t0 = time.monotonic()
         hashes = batch_hash(txs, self.suite)
         results: list[Optional[TxSubmitResult]] = [None] * len(txs)
@@ -143,15 +229,25 @@ class TxPool:
         with self._lock:
             current = self.ledger.current_number()
             seen_batch: set[bytes] = set()
+            occupancy = len(self._pending)
+            victims: Optional[list] = None
+            vi = 0
             for i, (tx, h) in enumerate(zip(txs, hashes)):
                 st = self._precheck(tx, h, current)
                 if st is None and h in seen_batch:
                     st = TransactionStatus.ALREADY_IN_TXPOOL
+                if st is None and not consensus:
+                    if victims is None and occupancy >= min(
+                            self._high_mark, self.pool_limit):
+                        victims = self._victims_locked()
+                    st, _victim, vi, occupancy = self._plan_admission_locked(
+                        occupancy, tx, current, victims, vi)
                 if st is not None:
                     results[i] = TxSubmitResult(h, st)
                 else:
                     seen_batch.add(h)
                     need_verify.append(i)
+        drops: list[tuple[bytes, TransactionStatus, object]] = []
         if need_verify:
             sub = [txs[i] for i in need_verify]
             t_rec = time.monotonic()
@@ -163,15 +259,47 @@ class TxPool:
             from ..utils.trace import observe_stage
             observe_stage("crypto", time.monotonic() - t_rec)
             with self._lock:
+                current = self.ledger.current_number()
+                occupancy = len(self._pending)
+                # the pre-crypto phase's eviction-ordered list carries
+                # over: re-sorting ~pool_limit entries under the lock
+                # twice per saturated batch was measurable GIL-held time
+                # on exactly the hot path. The list may be stale — the
+                # lock was dropped across the recover — but the consumer
+                # skips entries that left the pool or got sealed, and txs
+                # admitted meanwhile are merely missing as candidates
+                # (errs toward rejecting the incomer, never toward
+                # evicting something protected). Consumption restarts at
+                # 0: the pre-phase only SIMULATED its evictions.
+                vi = 0
                 for j, i in enumerate(need_verify):
                     tx, h = txs[i], hashes[i]
                     if not ok[j]:
                         results[i] = TxSubmitResult(h, TransactionStatus.INVALID_SIGNATURE)
                         continue
-                    if len(self._pending) >= self.pool_limit:
-                        results[i] = TxSubmitResult(h, TransactionStatus.TXPOOL_FULL)
-                        continue
+                    victim = None
+                    if not consensus:
+                        if victims is None and occupancy >= min(
+                                self._high_mark, self.pool_limit):
+                            victims = self._victims_locked()
+                        st, victim, vi, occupancy = \
+                            self._plan_admission_locked(occupancy, tx,
+                                                        current, victims,
+                                                        vi)
+                        if st is not None:
+                            results[i] = TxSubmitResult(h, st)
+                            continue
+                    if victim is not None:
+                        # high-watermark exchange: the strictly lower-
+                        # priority tx loses its slot to this one
+                        task = self._drop_locked(
+                            victim, TransactionStatus.TXPOOL_EVICTED)
+                        drops.append((victim,
+                                      TransactionStatus.TXPOOL_EVICTED,
+                                      task))
                     self._pending[h] = tx
+                    self._dropped.pop(h, None)  # re-admission voids a
+                    #                             stale drop record
                     if h in self._presealed:  # already in an in-flight
                         self._presealed.discard(h)  # proposal: arrive sealed
                         self._sealed.add(h)
@@ -179,6 +307,7 @@ class TxPool:
                         self._known_nonces.add(tx.nonce)
                     results[i] = TxSubmitResult(h, TransactionStatus.OK,
                                                 tx.sender(self.suite))
+        self._settle_dropped(drops)
         n_ok = sum(1 for r in results
                    if r.status == TransactionStatus.OK)
         metric("txpool.submit_batch", n=len(txs), ok=n_ok,
@@ -231,18 +360,141 @@ class TxPool:
             return TransactionStatus.NONCE_CHECK_FAIL
         return None
 
+    # -- watermark admission (overload control) ----------------------------
+    def _band(self, tx: Transaction) -> int:
+        """Client-declared priority band: the `attribute` word's top byte
+        (0-255, default 0). The gas-price-band analogue — this chain has
+        no fee market, so priority rides the tx attribute instead.
+
+        TRUST MODEL: the byte is unauthenticated wire data. On a
+        permissioned consortium chain (this chain's deployment shape) it
+        is a cooperative QoS signal among identified clients — an abuser
+        is an access-control problem, and per-client edge budgets
+        (rpc/admission.py) bound what any one identity can push. An
+        operator exposing the edge to unidentified traffic should set
+        `[txpool] priority_bands = false` (bands ignored, eviction by
+        deadline/age only), because a forged band-255 flood could
+        otherwise evict other clients' pending txs for free."""
+        if not self.priority_bands:
+            return 0
+        return (tx.attribute >> 24) & 0xFF
+
+    def _victims_locked(self) -> list:
+        """Unsealed pending txs in eviction order — ascending
+        (band, block_limit): lowest priority band first, then the
+        soonest-expiring, insertion order breaking ties (sort stability
+        over the OrderedDict scan keeps the OLDEST first). Sealed txs are
+        untouchable: they ride in-flight proposals."""
+        return sorted(((self._band(t), t.block_limit, h)
+                       for h, t in self._pending.items()
+                       if h not in self._sealed),
+                      key=lambda v: (v[0], v[1]))
+
+    def _plan_admission_locked(self, occupancy: int, tx: Transaction,
+                               current: int, victims: Optional[list],
+                               vi: int):
+        """One candidate's watermark verdict.
+        -> (status|None, victim_hash|None, vi, occupancy).
+
+        `victims` is the lazily built eviction-ordered list (None while
+        the pool is below the high watermark), consumed through `vi` so a
+        batch's planned evictions never target the same victim twice.
+        Pure decision in the pre-crypto phase (victim ignored); in the
+        insert phase the returned victim is actually evicted. Freshly
+        inserted batch members are not candidates — the scan predates
+        them, which only errs toward keeping the newest txs."""
+        band = self._band(tx)
+        high = min(self._high_mark, self.pool_limit)
+        if occupancy >= high:
+            if victims is not None:
+                while vi < len(victims) and (
+                        victims[vi][2] not in self._pending
+                        or victims[vi][2] in self._sealed):
+                    vi += 1  # went stale since the scan (committed/sealed)
+                if vi < len(victims) \
+                        and victims[vi][:2] < (band, tx.block_limit):
+                    # strictly lower priority pending: exchange slots
+                    return None, victims[vi][2], vi + 1, occupancy
+            return TransactionStatus.TXPOOL_FULL, None, vi, occupancy
+        if occupancy >= self._low_mark and band == 0:
+            # between the watermarks: band-0 txs must carry enough
+            # remaining lifetime to realistically seal before expiry —
+            # the required slack ramps with congestion
+            frac = (occupancy - self._low_mark) / max(
+                1, high - self._low_mark)
+            required = 1 + int(self.DEADLINE_SLACK_BLOCKS * frac)
+            if tx.block_limit - current < required:
+                return (TransactionStatus.DEADLINE_UNMEETABLE, None, vi,
+                        occupancy)
+        return None, None, vi, occupancy + 1
+
+    def _drop_locked(self, h: bytes, status: TransactionStatus):
+        """Remove a pending tx for a TYPED reason and record it so waiters
+        settle promptly. Caller holds the lock; the returned async task
+        (if any) must be rejected OUTSIDE it (via _settle_dropped).
+
+        The nonce is NOT freed: a drop is NODE-LOCAL and the tx was
+        already gossiped — a peer may still seal and commit it, so
+        re-admitting the same nonce here would break replay protection
+        (two same-nonce txs landing in different blocks). Resubmission
+        after a drop uses a FRESH nonce, exactly like after a timeout."""
+        self._pending.pop(h, None)
+        self._sealed.discard(h)
+        self._presealed.discard(h)
+        self._dropped[h] = status
+        while len(self._dropped) > self.DROPPED_MAX:
+            self._dropped.popitem(last=False)
+        return self._async_waiters.pop(h, None)
+
+    def _settle_dropped(self, drops: list) -> None:
+        """Post-lock half of a drop: metrics, receipt-waiter wakeup, async
+        task rejection with the typed TxDropped."""
+        if not drops:
+            return
+        from ..utils.metrics import REGISTRY
+        reg = self._registry or REGISTRY
+        for _h, status, _task in drops:
+            name = _DROP_METRIC.get(status)
+            if name:
+                reg.inc(name)
+        with self._receipt_cv:
+            self._receipt_cv.notify_all()
+        for h, status, task in drops:
+            if task is not None:
+                task.reject(TxDropped(h, status))
+
+    def dropped_status(self, tx_hash: bytes) -> Optional[TransactionStatus]:
+        """Typed reason a formerly admitted tx left the pool uncommitted
+        (None when unknown/still pending/committed)."""
+        with self._lock:
+            return self._dropped.get(tx_hash)
+
+    def occupancy_fraction(self) -> float:
+        """Pool fill against the HIGH watermark (~1.0 = eviction
+        territory) — the overload controller's txpool signal."""
+        with self._lock:
+            return len(self._pending) / max(1, self._high_mark)
+
     # -- sealing (MemoryStorage.cpp:570 batchFetchTxs) ---------------------
-    def seal(self, max_txs: int) -> tuple[list[Transaction], list[bytes]]:
+    def seal(self, max_txs: int, for_number: Optional[int] = None
+             ) -> tuple[list[Transaction], list[bytes]]:
         """Fetch up to max_txs unsealed txs, marking them sealed. Re-checks
-        block_limit against the current height (a tx can expire while queued;
-        the reference re-validates at seal time) and drops expired ones."""
+        block_limit against the height the proposal will OCCUPY
+        (`for_number`; committed+1 when the caller doesn't know) — a tx
+        whose limit falls below it would be expired inside its own block,
+        so it is dropped with the typed expiry status BEFORE consuming a
+        seal slot (with pipelining, proposals run ahead of the committed
+        height, so checking only `current` let near-deadline txs burn
+        verify + seal work and then expire anyway)."""
+        drops: list = []
         with self._lock:
             current = self.ledger.current_number()
+            threshold = for_number if for_number is not None else current + 1
             out, hashes, expired = [], [], []
             for h, tx in self._pending.items():
                 if h in self._sealed:
                     continue
-                if tx.block_limit <= current:
+                if tx.block_limit < threshold:
                     expired.append(h)
                     continue
                 out.append(tx)
@@ -250,14 +502,12 @@ class TxPool:
                 if len(out) >= max_txs:
                     break
             self._sealed.update(hashes)
-            dropped_tasks = []
             for h in expired:
-                self._pending.pop(h, None)
-                t = self._async_waiters.pop(h, None)
-                if t is not None:
-                    dropped_tasks.append(t)
-        for t in dropped_tasks:  # settle, never leak an expired submission
-            t.reject(TimeoutError("tx expired: block_limit passed unsealed"))
+                task = self._drop_locked(
+                    h, TransactionStatus.BLOCK_LIMIT_CHECK_FAIL)
+                drops.append((h, TransactionStatus.BLOCK_LIMIT_CHECK_FAIL,
+                              task))
+        self._settle_dropped(drops)  # never leak an expired submission
         self._update_pending_gauge()
         return out, hashes
 
@@ -305,7 +555,11 @@ class TxPool:
 
     def status(self) -> dict:
         with self._lock:
-            return {"pending": len(self._pending), "sealed": len(self._sealed)}
+            return {"pending": len(self._pending),
+                    "sealed": len(self._sealed),
+                    "lowWatermark": self._low_mark,
+                    "highWatermark": self._high_mark,
+                    "dropped": len(self._dropped)}
 
     def known_nonces(self) -> frozenset:
         """Snapshot of the rolling replay-protection filter — read by the
@@ -455,11 +709,22 @@ class TxPool:
             with self._lock:
                 self._async_waiters.pop(h, None)
             task.resolve(rc)
+            return task
+        st = self.dropped_status(h)  # ...and so can a drop (seal expiry /
+        if st is not None:           # eviction): a _drop_locked that ran
+            with self._lock:         # before the registration above
+                popped = self._async_waiters.pop(h, None)  # popped no
+            if popped is not None:   # waiter — settle it here; if the
+                popped.reject(TxDropped(h, st))  # drop path raced us and
+                #                      took the task, it settles it itself
         return task
 
     # -- RPC receipt waiting ----------------------------------------------
     def wait_for_receipt(self, tx_hash: bytes, timeout: float = 30.0):
         """Block until the tx is committed; -> Receipt or None on timeout.
+        Raises TxDropped the moment the pool records the tx as evicted/
+        shed/expired — a client must not hang to its full timeout for a tx
+        that can no longer commit (the drop path broadcasts the same CV).
 
         Event-driven: parks on `_receipt_cv` (broadcast once per committed
         block from `on_block_committed`) instead of polling the ledger —
@@ -477,6 +742,9 @@ class TxPool:
                 rc = self.ledger.receipt(tx_hash)
                 if rc is not None:
                     return rc
+                st = self.dropped_status(tx_hash)
+                if st is not None:  # receipt checked FIRST: a committed
+                    raise TxDropped(tx_hash, st)  # tx always wins
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return None

@@ -1,4 +1,4 @@
-"""Per-node health state machine: ok -> degraded -> failed, and back.
+"""Per-node health state machine: ok -> busy -> degraded -> failed, and back.
 
 The failure signals this plane collects used to be swallowed (a commit-
 thread exception logged once and forgotten, the sealer still granting), or
@@ -8,6 +8,11 @@ against a named component; the machine aggregates them into one node
 state:
 
     ok         no live faults — full service
+    busy       >= 1 overload report (utils/overload.py): the node is
+               SATURATED, not sick — it keeps sealing, committing and
+               accepting writes, but the serving edge shrinks per-client
+               write budgets and gossip stops importing remote pending
+               txs it could not seal anyway (brownout, not blackout)
     degraded   >= 1 recoverable fault: the node stops sealing and sheds
                writes with a typed error (TransactionStatus.NODE_DEGRADED)
                but keeps answering reads and serving sync/ops traffic
@@ -22,8 +27,10 @@ attempting the same fsync path, so the node returns to `ok` the moment
 space is back, without a restart. Components without probes are cleared
 explicitly by their subsystem on the first success after the fault.
 
-Surfaces: `getSystemStatus.health`, GET `/healthz` (200 ok / 503 not),
-and the `bcos_node_health` gauge (0 ok, 1 degraded, 2 failed).
+Surfaces: `getSystemStatus.health`, GET `/healthz` (200 while ok/busy,
+503 while degraded/failed), and the `bcos_node_health` gauge (0 ok,
+0.5 busy, 1 degraded, 2 failed — busy slots BETWEEN the PR-11 values so
+existing dashboards/alerts on 0/1/2 keep their meaning unchanged).
 """
 
 from __future__ import annotations
@@ -34,8 +41,12 @@ from typing import Callable, Optional
 
 from .log import LOG, badge
 
-OK, DEGRADED, FAILED = "ok", "degraded", "failed"
-_RANK = {OK: 0, DEGRADED: 1, FAILED: 2}
+OK, BUSY, DEGRADED, FAILED = "ok", "busy", "degraded", "failed"
+_RANK = {OK: 0, BUSY: 1, DEGRADED: 2, FAILED: 3}
+# published gauge values: the 0/1/2 contract for ok/degraded/failed
+# predates the busy step and is asserted by dashboards and CI — busy
+# lands between ok and degraded instead of renumbering them
+_GAUGE = {OK: 0.0, BUSY: 0.5, DEGRADED: 1.0, FAILED: 2.0}
 
 
 class _Fault:
@@ -68,6 +79,12 @@ class Health:
         self._publish(OK)
 
     # -- reporting ---------------------------------------------------------
+    def busy(self, component: str, reason: str = "",
+             probe: Optional[Callable[[], bool]] = None) -> None:
+        """Overload report (utils/overload.py): the node is saturated but
+        healthy — full service continues, brownout policies engage."""
+        self._report(component, BUSY, reason, probe)
+
     def degraded(self, component: str, reason: str = "",
                  probe: Optional[Callable[[], bool]] = None) -> None:
         self._report(component, DEGRADED, reason, probe)
@@ -120,7 +137,7 @@ class Health:
 
     def _publish(self, state: str) -> None:
         if self._registry is not None:
-            self._registry.set_gauge("bcos_node_health", _RANK[state])
+            self._registry.set_gauge("bcos_node_health", _GAUGE[state])
 
     # -- queries -----------------------------------------------------------
     def _state_locked(self) -> str:
@@ -136,11 +153,15 @@ class Health:
 
     def writes_shed(self) -> bool:
         """True while writes must be refused with the typed error. Reads
-        are NEVER shed — a degraded node keeps serving queries."""
-        return self.state() != OK
+        are NEVER shed — a degraded node keeps serving queries. A BUSY
+        node is not shedding: it still accepts writes (the overload plane
+        throttles them at the edge instead of refusing them outright)."""
+        return _RANK[self.state()] >= _RANK[DEGRADED]
 
     def sealing_allowed(self) -> bool:
-        return self.state() == OK
+        """Busy nodes KEEP sealing — draining the backlog is the cure for
+        overload; only degraded/failed stop proposing."""
+        return _RANK[self.state()] < _RANK[DEGRADED]
 
     def snapshot(self) -> dict:
         now = time.monotonic()
@@ -210,6 +231,11 @@ class HealthFanout:
             self.sinks.remove(health)
         except ValueError:
             pass
+
+    def busy(self, component: str, reason: str = "",
+             probe: Optional[Callable[[], bool]] = None) -> None:
+        for h in list(self.sinks):
+            h.busy(component, reason, probe)
 
     def degraded(self, component: str, reason: str = "",
                  probe: Optional[Callable[[], bool]] = None) -> None:

@@ -51,6 +51,14 @@
 #                                   # bcos_tx_stage_seconds stage sums
 #                                   # against measured e2e latency, and
 #                                   # emit the trace_profile_summary row
+#   tools/sanitize_ci.sh --overload # ONLY the overload-control smoke:
+#                                   # 4 real daemons with per-client edge
+#                                   # budgets, an aggressor floods while a
+#                                   # polite client keeps committing with
+#                                   # bounded latency, -32005 rejects are
+#                                   # observed, and health returns to ok
+#                                   # after the storm; then the
+#                                   # chain_bench --overload goodput row
 #   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
 #                                   # daemon hosting two groups ([groups]
 #                                   # ini), disjoint writes routed by the
@@ -694,6 +702,117 @@ with ChaosHarness(out, tls=False) as h:
     print(f"sanitize_ci: FAULTS STAGE CLEAN (height={height}, "
           f"txs={min(h.total_txs(i) for i in range(h.n))})")
 EOF
+  exit 0
+fi
+
+if [ "${1:-}" = "--overload" ]; then
+  echo "== [overload] brownout smoke: 4 real daemons, aggressor floods a" \
+       "rate-limited edge while a polite client keeps committing;" \
+       "-32005 observed, health returns to ok after the storm"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python - <<'EOF'
+import tempfile, threading, time
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import RpcCallError, SdkClient, \
+    TransactionBuilder
+from fisco_bcos_tpu.testing.chaos import ChaosHarness
+
+out = tempfile.mkdtemp(prefix="overload-smoke-")
+STORM_S = 8.0
+with ChaosHarness(out, tls=False,
+                  config_overrides={"client_write_rate": 20.0,
+                                    "txpool_limit": 2000}) as h:
+    h.start_all()
+    for i in range(h.n):
+        h.wait_rpc_up(i)
+    suite = h.suite()
+    kp = suite.generate_keypair(b"overload-smoke")
+    builder = TransactionBuilder(suite, None, chain_id=h.info["chain_id"],
+                                 group_id=h.info["group_id"])
+    port = h.info["nodes"][0]["rpc_port"]
+    stop = threading.Event()
+    stats = {"r32005": 0, "aggr_ok": 0, "pol_lat": [], "errors": []}
+
+    def aggressor(w):
+        sdk = SdkClient(f"http://127.0.0.1:{port}",
+                        group=h.info["group_id"], api_key="aggr")
+        i = 0
+        while not stop.is_set():
+            tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                               pc.encode_call("register",
+                                              lambda w2: w2.blob(
+                                                  b"ag%d-%d" % (w, i))
+                                              .u64(1)),
+                               nonce=f"ag-{w}-{i}", block_limit=500)
+            i += 1
+            try:
+                sdk.send_transaction(tx, wait=False)
+                stats["aggr_ok"] += 1
+            except RpcCallError as exc:
+                if exc.code == -32005:
+                    stats["r32005"] += 1
+            except Exception as exc:
+                stats["errors"].append(f"aggr: {exc}")
+                return
+
+    def polite():
+        sdk = SdkClient(f"http://127.0.0.1:{port}",
+                        group=h.info["group_id"], api_key="polite",
+                        timeout=30.0)
+        i = 0
+        while not stop.is_set():
+            tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                               pc.encode_call("register",
+                                              lambda w2: w2.blob(
+                                                  b"po%d" % i).u64(1)),
+                               nonce=f"po-{i}", block_limit=500)
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                sdk.send_transaction(tx, wait=True)  # full commit RTT
+                stats["pol_lat"].append(time.perf_counter() - t0)
+            except Exception as exc:
+                stats["errors"].append(f"polite: {exc}")
+                return
+            time.sleep(0.2)  # ~5/s: well inside its own budget
+
+    threads = [threading.Thread(target=aggressor, args=(w,), daemon=True)
+               for w in range(2)] + [threading.Thread(target=polite,
+                                                      daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(STORM_S)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not stats["errors"], stats["errors"][:3]
+    lat = sorted(stats["pol_lat"])
+    assert lat, "polite client never completed a commit"
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    # the polite client's commits stay bounded THROUGH the storm
+    assert p99 < 10.0, f"polite commit p99 {p99:.1f}s"
+    assert stats["r32005"] > 0, "aggressor was never rate limited"
+    # the overload/admission surfaces are live on the ops plane
+    code, doc = h._ops_get(0, "/status")
+    assert code == 200 and doc.get("admission"), doc.get("admission")
+    assert doc["admission"]["rejected_writes"] > 0 or \
+        doc["admission"]["rejected_fair_share"] > 0, doc["admission"]
+    # after the storm: every node back to ok (busy cleared, nothing stuck)
+    h.wait_until(lambda: all(
+        h.healthz(i)[0] == 200 and h.healthz(i)[1]["state"] == "ok"
+        for i in range(h.n)), timeout=120,
+        what="health back to ok on every node")
+    print(f"sanitize_ci: OVERLOAD STAGE CLEAN "
+          f"(polite_p99={p99*1000:.0f}ms over {len(lat)} commits, "
+          f"rate_limited={stats['r32005']}, "
+          f"aggr_admitted={stats['aggr_ok']})")
+EOF
+  echo "== [overload] chain_bench --overload goodput/fairness rows"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python benchmark/chain_bench.py --overload -n 600 \
+    --overload-window 3 --overload-ab-runs 1 --overload-fairness-s 6 \
+    --backend host 2>/dev/null | grep -E \
+    '"metric": "overload_(goodput|fairness|seal_integrity)"'
   exit 0
 fi
 
