@@ -643,6 +643,31 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: disarmed lockcheck-plane cost (analysis/
+            # lockcheck.py) — interleaved direct-ingest medians with the
+            # blocking markers live vs stubbed, plus ns/crossing; the
+            # <1% acceptance row. BENCH_LOCKCHECK_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--lockcheck-ab", "-n", "600", "--lockcheck-runs", "3",
+                 "--backend", "host"],
+                "BENCH_LOCKCHECK_TIMEOUT", 600)
+            ab = next((r for r in rows
+                       if r.get("metric") == "lockcheck_ab"), None)
+            if ab:
+                line["lockcheck_disarmed_cost_pct"] = ab.get(
+                    "disarmed_cost_pct")
+                line["lockcheck_marker_ns"] = ab.get(
+                    "marker_ns_per_crossing")
+            else:
+                print(f"[bench] lockcheck A/B incomplete (rc={rc})",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] lockcheck A/B failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: persistent storage engine A/B (storage/
             # engine.py) — sustained-write TPS, cold-restart seconds, and
             # peak RSS for memory vs WAL vs disk backends, each in a fresh

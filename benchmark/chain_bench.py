@@ -1336,6 +1336,65 @@ def run_overload_ab(sm: bool, backend: str, tx_count_limit: int,
     }
 
 
+def run_lockcheck_ab(sm: bool, n: int, backend: str, tx_count_limit: int,
+                     reps: int) -> dict:
+    """Disarmed-lockcheck cost on the direct-ingest path.
+
+    The disarmed plane's ONLY steady-state residue is the
+    `note_blocking()` markers on the blocking call sites (the lock
+    factories hand out plain threading primitives at construction, so
+    armed-vs-absent differs by literally nothing at runtime for the
+    locks themselves). A vs B, INTERLEAVED with fresh chains:
+
+      A = the committed tree (markers live, checker disarmed)
+      B = markers stubbed to a bare no-op (the plane-absent anchor)
+
+    plus a micro-measurement of the disarmed marker crossing in ns.
+    The acceptance bar is <1% on the A/B medians."""
+    from fisco_bcos_tpu.analysis import lockcheck
+
+    assert not lockcheck.armed(), \
+        "lockcheck A/B must run DISARMED (unset BCOS_LOCKCHECK)"
+    # micro: ns per disarmed crossing
+    loops = 500_000
+    t0 = time.perf_counter()
+    for _ in range(loops):
+        lockcheck.note_blocking("fsync")
+    marker_ns = (time.perf_counter() - t0) / loops * 1e9
+
+    results: dict[str, list[float]] = {"markers": [], "stubbed": []}
+    real = lockcheck.note_blocking
+    run_chain(sm, min(n, 300), backend, tx_count_limit)  # warm-up,
+    #   discarded: first-run compile/alloc noise lands on neither side
+    for _rep in range(reps):
+        for mode in ("markers", "stubbed"):
+            lockcheck.note_blocking = (
+                real if mode == "markers" else (lambda *a, **k: None))
+            try:
+                row = run_chain(sm, n, backend, tx_count_limit)
+            finally:
+                lockcheck.note_blocking = real
+            results[mode].append(row["tps"])
+
+    def med(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2] if vals else 0.0
+
+    with_m, without = med(results["markers"]), med(results["stubbed"])
+    return {
+        "metric": "lockcheck_ab", "unit": "x",
+        "suite": "sm" if sm else "ecdsa",
+        "value": round(with_m / max(without, 0.001), 3),
+        "tps_markers_median": with_m, "tps_stubbed_median": without,
+        "tps_markers_runs": results["markers"],
+        "tps_stubbed_runs": results["stubbed"],
+        "disarmed_cost_pct": round(
+            (1.0 - with_m / max(without, 0.001)) * 100, 2),
+        "marker_ns_per_crossing": round(marker_ns, 1),
+        "runs": reps,
+    }
+
+
 def run_overload_fairness(sm: bool, backend: str, tx_count_limit: int,
                           capacity: float, fairness_s: float) -> dict:
     """Aggressor vs polite through the REAL RPC edge with per-client
@@ -1741,6 +1800,13 @@ def main() -> None:
                          "reconciliation against measured e2e p50")
     ap.add_argument("--trace-txs", type=int, default=24,
                     help="with --trace-profile: closed-loop tx count")
+    ap.add_argument("--lockcheck-ab", action="store_true",
+                    help="lockcheck-cost mode: interleaved direct-ingest "
+                         "runs with the disarmed blocking markers live vs "
+                         "stubbed out; medians + ns/crossing (the <1%% "
+                         "disarmed-overhead acceptance row)")
+    ap.add_argument("--lockcheck-runs", type=int, default=3, metavar="R",
+                    help="with --lockcheck-ab: interleaved reps per side")
     ap.add_argument("--pipeline-profile", action="store_true",
                     help="direct mode: also emit pipeline_tps and a per-"
                          "stage (fill/execute/roots/consensus_wait/commit) "
@@ -1773,6 +1839,12 @@ def main() -> None:
         for sm in suites:
             for row in run_trace_profile(sm, args.backend, args.trace_txs):
                 print(json.dumps(row), flush=True)
+        return
+    if args.lockcheck_ab:
+        for sm in suites:
+            print(json.dumps(run_lockcheck_ab(
+                sm, args.n, args.backend, args.tx_count_limit,
+                args.lockcheck_runs)), flush=True)
         return
     if args.groups > 0:
         for sm in suites:

@@ -34,16 +34,15 @@ fast view-change join (PBFTCacheProcessor's getViewChangeWeight shortcut).
 from __future__ import annotations
 
 import queue
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 
 from ...net.front import FrontService
 from ...net.moduleid import ModuleID
-from ...protocol import Block, BlockHeader
+from ...protocol import Block
 from ...utils import otrace
 from ...utils.log import LOG, badge, metric
 from ...utils.trace import block_trace

@@ -25,7 +25,6 @@ nodes restarted mid-round.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ...storage.interface import StorageInterface
 

@@ -42,6 +42,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..analysis import lockcheck as lc
 from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from ..utils.metrics import REGISTRY
@@ -92,7 +93,7 @@ class CryptoLane:
         self.host_workers = host_workers or min(4, _os.cpu_count() or 1)
         self._pool = None  # lazy ThreadPoolExecutor
         self._q: dict[str, deque[_Req]] = {op: deque() for op in _OPS}
-        self._cv = threading.Condition()
+        self._cv = lc.make_condition("crypto.lane")
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         # dispatcher-death observers: callback(event, msg) with event

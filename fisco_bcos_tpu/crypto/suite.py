@@ -25,11 +25,12 @@ Signing stays host-side and single-item: a node signs only its own messages
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Sequence
+from typing import Sequence
 
 import numpy as np
 
 from . import refimpl
+from ..analysis import lockcheck as _lc
 from ..ops import bigint, ec, keccak, merkle, sm3
 
 DIGEST = 32
@@ -139,6 +140,7 @@ class CryptoSuite:
     def hash_batch(self, msgs: Sequence[bytes]) -> list[bytes]:
         """Batched hashing. Device path buckets by padded length; host path
         crosses the FFI once for the whole batch."""
+        _lc.note_blocking("suite_batch", "hash_batch")
         if not self._use_device(len(msgs)):
             return self._host_hash_batch(msgs)
         fn = (keccak.keccak256_batch_np if self.kind == "ecdsa"
@@ -245,6 +247,7 @@ class CryptoSuite:
         assert len(sigs) == n and len(pubs) == n
         if n == 0:
             return np.zeros((0,), bool)
+        _lc.note_blocking("suite_batch", "verify_batch")
         rs, ss = self._split_sigs(sigs)
         qx = [int.from_bytes(p[:32], "big") for p in pubs]
         qy = [int.from_bytes(p[32:64], "big") for p in pubs]
@@ -301,6 +304,7 @@ class CryptoSuite:
         """
         n = len(digests)
         assert len(sigs) == n
+        _lc.note_blocking("suite_batch", "recover_batch")
         if n == 0:
             return [], np.zeros((0,), bool)
         if self.kind == "sm":

@@ -59,7 +59,7 @@ import os
 import threading
 from typing import Optional
 
-from ..protocol import LogEntry, TransactionStatus
+from ..protocol import LogEntry
 from ..storage.state import StateStorage
 
 U256 = 1 << 256

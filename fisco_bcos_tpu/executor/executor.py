@@ -23,7 +23,7 @@ from typing import Optional, Sequence
 from ..protocol import Receipt, Transaction, TransactionStatus
 from ..storage.interface import ChangeSet
 from ..storage.state import StateStorage
-from ..utils.log import LOG, badge, metric
+from ..utils.log import metric
 from .precompiled import (
     PRECOMPILED_REGISTRY,
     CallContext,

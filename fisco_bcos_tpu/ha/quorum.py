@@ -41,7 +41,7 @@ import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Optional
+from typing import Optional
 
 from ..codec.wire import Reader, Writer
 from ..services.rpc import ServiceClient, ServiceServer

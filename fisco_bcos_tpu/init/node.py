@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Optional
 
 from ..crypto.suite import CryptoSuite, make_suite

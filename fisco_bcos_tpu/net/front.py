@@ -35,7 +35,8 @@ from ..codec.wire import Reader, Writer
 from ..utils import otrace
 from ..utils.log import LOG, badge
 from .gateway import Gateway
-from .moduleid import ModuleID
+from .moduleid import ModuleID as ModuleID  # re-export: consumers import
+#                                             the module table from front
 
 # handler(src_node_id, payload, respond) — respond is None for pushes,
 # else a callable(bytes) that routes a response back to the requester.

@@ -47,6 +47,7 @@ import random
 from collections import deque
 from typing import Optional
 
+from ..analysis import lockcheck as lc
 from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from .front import KIND_PUSH as _KIND_PUSH
@@ -114,6 +115,7 @@ def _is_gossip(data: bytes) -> bool:
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    lc.note_blocking("socket_send", "p2p._send_frame")
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
@@ -264,7 +266,7 @@ class _Session:
         # stalling every sender to this peer exactly while it is slow
         self._q: "deque[list]" = deque()
         self._droppable: "deque[list]" = deque()  # gossip-class entries
-        self._cv = threading.Condition()
+        self._cv = lc.make_condition("p2p.session")
         self._bytes = 0
         self._closed = False
         self.dropped = 0
@@ -387,13 +389,13 @@ class P2PGateway(Gateway):
         self._sessions: dict[bytes, _Session] = {}
         self._peer_by_addr: dict[tuple[str, int], bytes] = {}
         self._router = RouterTable(node_id)
-        self._lock = threading.Lock()
+        self._lock = lc.make_lock("p2p.gateway")
         # held across build+enqueue of ROUTE frames so two concurrent
         # topology events cannot deliver a stale vector after a newer one.
         # RLock: a full send queue inside the advertise loop drops that
         # session, which re-advertises re-entrantly (bounded — each drop
         # removes a session).
-        self._adv_lock = threading.RLock()
+        self._adv_lock = lc.make_rlock("p2p.adv")
         self._topo_version = 0  # bumped under _lock on any routing change
         self._stopped = False
 

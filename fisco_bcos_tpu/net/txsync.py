@@ -33,7 +33,7 @@ from typing import Sequence
 from ..codec.wire import Reader, Writer
 from ..protocol import Transaction, batch_hash
 from ..utils import otrace
-from ..utils.log import LOG, badge, metric
+from ..utils.log import metric
 from ..utils.worker import Worker
 from .front import FrontService
 from .moduleid import ModuleID

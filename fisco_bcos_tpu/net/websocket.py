@@ -23,6 +23,7 @@ import struct
 import threading
 from typing import Callable, Optional
 
+from ..analysis import lockcheck as _lc
 from ..utils.log import LOG, badge
 
 _GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
@@ -92,6 +93,7 @@ class WsConnection:
         return hdr + payload
 
     def _send_frame(self, op: int, payload: bytes) -> None:
+        _lc.note_blocking("socket_send", "ws._send_frame")
         with self._wlock:
             if self._closed:
                 raise WsError("connection closed")

@@ -30,7 +30,6 @@ non-secret-dependent batch verification in TransactionSync.cpp:516-537.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

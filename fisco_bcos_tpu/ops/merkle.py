@@ -24,7 +24,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from . import keccak as _keccak
 from . import sm3 as _sm3

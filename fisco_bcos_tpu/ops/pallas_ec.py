@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import fp, pallas_fp
-from .fp import LIMB_BITS, MASK, NLIMBS
+from .fp import NLIMBS
 
 WINDOW = 4
 TBL = 1 << WINDOW

@@ -13,9 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-import threading
 from typing import Callable, Optional, Sequence
 
+from ..analysis import lockcheck as lc
 from ..protocol import LogEntry, Receipt
 from ..utils.log import LOG, badge
 
@@ -50,8 +50,11 @@ class _Task:
         self.next_block = flt.from_block
         self.done = False
         # serialises pumps: subscribe()'s historical replay can race the
-        # commit-observer pump on the same task (duplicate deliveries)
-        self.lock = threading.Lock()
+        # commit-observer pump on the same task (duplicate deliveries).
+        # Registered HOT (lockorder.HOT_LOCKS): it is held on the
+        # scheduler's commit-notifier thread, so a blocking delivery
+        # under it stalls EVERY commit observer behind one subscriber
+        self.lock = lc.make_lock("eventsub.task")
 
 
 class EventSub:
@@ -61,7 +64,7 @@ class EventSub:
         self.ledger = ledger
         self._ids = itertools.count(1)
         self._tasks: dict[str, _Task] = {}
-        self._lock = threading.Lock()
+        self._lock = lc.make_lock("eventsub.registry")
         scheduler.on_commit.append(self._on_block)
 
     # -- registration ------------------------------------------------------

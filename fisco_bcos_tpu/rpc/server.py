@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Optional
+from typing import Optional
 
 from ..protocol import Block, BlockHeader, Receipt, Transaction
 from ..utils import otrace

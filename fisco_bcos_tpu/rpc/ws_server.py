@@ -30,6 +30,7 @@ from __future__ import annotations
 import itertools
 import json
 import threading
+from collections import deque
 from typing import Optional
 
 from ..net.websocket import OP_TEXT, WsConnection, WsServer
@@ -41,20 +42,155 @@ _AMOP_REPLY_TIMEOUT = 5.0
 
 
 class _Session:
-    """Per-connection subscription state."""
+    """Per-connection subscription state + bounded push outbox.
+
+    `push()` ENQUEUES; a per-session writer thread drains onto the
+    socket. The synchronous shape (`push -> sendall`) was a real
+    blocking-while-locked finding: event pushes run on the scheduler's
+    commit-NOTIFIER thread while holding the eventsub task lock, so one
+    subscriber with a full TCP window stalled commit notification for
+    every observer on the node (caught by the armed lockcheck plane —
+    `socket_send under eventsub.task`, see analysis/lockcheck.py).
+    Overflow drops the OLDEST queued push (pushes are best-effort
+    deliveries; a reader this far behind has already lost the stream)
+    and a dead socket ends the writer. Same discipline as the p2p
+    session's bounded writer queue."""
+
+    MAX_OUTBOX = 4096  # queued push frames per session
 
     def __init__(self, conn: WsConnection):
         self.conn = conn
         self.event_tasks: set[str] = set()
         self.topics: set[str] = set()
         self.pending: dict[int, tuple[threading.Event, list]] = {}
+        # outbox entries are shared mutable [text, lossless, dead] cells
+        # held by BOTH deques (the p2p _Session lazy-deletion discipline):
+        # eviction marks a cell dead in O(1) and the writer skips it, so
+        # overflow handling never does deque surgery under the cv on the
+        # commit-notifier thread. _live counts cells not yet consumed or
+        # evicted (len(_outbox) would overcount dead cells).
+        self._outbox: "deque[list]" = deque()
+        self._droppable: "deque[list]" = deque()  # live-push cells only
+        self._live = 0
+        self._push_cv = threading.Condition()
+        self._push_dead = False
+        self._writer: Optional[threading.Thread] = None
 
-    def push(self, obj: dict) -> bool:
+    def send_now(self, obj: dict) -> bool:
+        """SYNCHRONOUS, lossless send — JSON-RPC responses and AMOP
+        round-trip frames. These are admitted work a client is waiting
+        on: they must never ride the drop-oldest outbox (a dropped
+        sendTransaction response would orphan a COMMITTED tx), and an
+        immediate False on a dead socket is what lets the AMOP publisher
+        fail over to the next responder instead of burning its 5 s
+        timeout. Callers run on worker-pool/dispatch threads (bounded),
+        exactly as before the outbox existed."""
         try:
             self.conn.send_text(json.dumps(obj))
             return True
         except Exception:
             return False
+
+    def push(self, obj: dict, lossless: bool = False) -> bool:
+        """Queue a server push. Never blocks on the subscriber's socket —
+        event pushes are emitted on the scheduler's commit-NOTIFIER
+        thread under the eventsub task lock, the blocking-while-locked
+        finding this outbox exists to fix.
+
+        LIVE pushes (default) are best-effort: overflow drops the OLDEST
+        droppable frame (a reader this far behind has already lost the
+        stream; counted in bcos_ws_push_dropped_total). `lossless=True`
+        marks frames that carry a contract — the subscribeEvent history
+        replay a client EXPLICITLY requested — which are never silently
+        gapped: if overflow finds nothing droppable (the whole backlog
+        is lossless), the session is closed instead, so the client sees
+        a disconnect it can retry rather than an invisible hole in the
+        range it asked for. One FIFO queue keeps replay/live ordering.
+        Returns False once the session is dead."""
+        text = json.dumps(obj)
+        dropped = 0
+        kill = False
+        with self._push_cv:
+            if self._push_dead:
+                return False
+            if self._writer is None:  # lazy: request-only sessions never
+                self._writer = threading.Thread(  # pay a thread
+                    target=self._push_loop, name="ws-push", daemon=True)
+                self._writer.start()
+            # drain dead heads (consumed/evicted cells) — amortized O(1)
+            while self._droppable and self._droppable[0][2]:
+                self._droppable.popleft()
+            if self._live >= self.MAX_OUTBOX:
+                if self._droppable:
+                    cell = self._droppable.popleft()
+                    cell[2] = True  # writer skips it; O(1), no surgery
+                    cell[0] = ""
+                    self._live -= 1
+                    dropped = 1
+                else:
+                    kill = True  # a client too slow for its own replay
+            if not kill:
+                cell = [text, lossless, False]
+                self._outbox.append(cell)
+                if not lossless:
+                    self._droppable.append(cell)
+                self._live += 1
+                self._push_cv.notify()
+            else:
+                self._push_dead = True
+                self._outbox.clear()
+                self._droppable.clear()
+                self._live = 0
+                self._push_cv.notify_all()
+        if dropped:  # metrics outside the cv: REGISTRY has its own lock
+            from ..utils.metrics import REGISTRY
+            REGISTRY.inc("bcos_ws_push_dropped_total", dropped)
+        if kill:
+            LOG.warning(badge("WSRPC", "push-backlog-overflow",
+                              peer=self.conn.peer))
+            try:
+                # RAW socket close, NOT the graceful CLOSE-frame handshake:
+                # conn.close() sends a frame under _wlock, which the parked
+                # writer may hold — a blocking close here would put the
+                # commit-notifier thread right back in the stall this
+                # outbox exists to prevent. The reader thread sees EOF and
+                # drives _on_close cleanup.
+                self.conn.sock.close()
+            except Exception:
+                pass
+            return False
+        return True
+
+    def _push_loop(self) -> None:
+        while True:
+            with self._push_cv:
+                while not self._outbox and not self._push_dead:
+                    self._push_cv.wait()
+                if self._push_dead:
+                    return
+                cell = self._outbox.popleft()
+                if cell[2]:
+                    continue  # evicted while queued: nothing to send
+                cell[2] = True  # consumed: eviction must skip it now
+                text = cell[0]
+                self._live -= 1
+            try:
+                self.conn.send_text(text)
+            except Exception:
+                with self._push_cv:
+                    self._push_dead = True
+                    self._outbox.clear()
+                    self._droppable.clear()
+                    self._live = 0
+                return
+
+    def close_push(self) -> None:
+        with self._push_cv:
+            self._push_dead = True
+            self._outbox.clear()
+            self._droppable.clear()
+            self._live = 0
+            self._push_cv.notify_all()
 
 
 class WsRpcServer:
@@ -112,6 +248,7 @@ class WsRpcServer:
             sess = self._sessions.pop(conn, None)
         if sess is None:
             return
+        sess.close_push()
         # copies: a concurrent subscribe dispatch may still add entries (it
         # re-checks session liveness afterwards and cleans up its own)
         for task_id in list(sess.event_tasks):
@@ -141,7 +278,7 @@ class WsRpcServer:
         try:
             msg = json.loads(payload)
         except Exception:
-            sess.push({"jsonrpc": "2.0", "id": None,
+            sess.send_now({"jsonrpc": "2.0", "id": None,
                        "error": {"code": -32700, "message": "parse error"}})
             return
         if isinstance(msg, list):
@@ -153,7 +290,7 @@ class WsRpcServer:
                 self._offload(self._dispatch_batch, sess, msg, lease)
             return
         if not isinstance(msg, dict):
-            sess.push({"jsonrpc": "2.0", "id": None,
+            sess.send_now({"jsonrpc": "2.0", "id": None,
                        "error": {"code": -32600,
                                  "message": "invalid request"}})
             return
@@ -162,7 +299,7 @@ class WsRpcServer:
             return
         if "method" not in msg:
             if "id" in msg:  # a notification-shaped frame stays silent
-                sess.push({"jsonrpc": "2.0", "id": msg["id"],
+                sess.send_now({"jsonrpc": "2.0", "id": msg["id"],
                            "error": {"code": -32600,
                                      "message": "invalid request"}})
             return
@@ -199,9 +336,9 @@ class WsRpcServer:
                     for e in msg
                     if isinstance(e, dict) and e.get("id") is not None]
             if errs:
-                sess.push(errs)
+                sess.send_now(errs)
         elif isinstance(msg, dict) and msg.get("id") is not None:
-            sess.push({"jsonrpc": "2.0", "id": msg["id"], "error": err})
+            sess.send_now({"jsonrpc": "2.0", "id": msg["id"], "error": err})
         return False, None
 
     def _offload(self, fn, sess: _Session, msg, lease=None) -> None:
@@ -235,11 +372,11 @@ class WsRpcServer:
                         for e in msg
                         if isinstance(e, dict) and e.get("id") is not None]
                 if errs:
-                    sess.push(errs)
+                    sess.send_now(errs)
                 return
             if isinstance(msg, dict) and "id" not in msg:
                 return  # notification: never answered, even when shed
-            sess.push({"jsonrpc": "2.0", "id": msg.get("id"),
+            sess.send_now({"jsonrpc": "2.0", "id": msg.get("id"),
                        "error": {"code": -32000, "message": "server busy"}})
             return
 
@@ -255,24 +392,24 @@ class WsRpcServer:
     def _dispatch_batch(self, sess: _Session, msgs: list) -> None:
         resp = self.impl.handle_payload(msgs)
         if resp is not None:
-            sess.push(resp)
+            sess.send_now(resp)
 
     def _dispatch(self, sess: _Session, msg: dict) -> None:
         handler = self._ws_methods().get(msg["method"])
         if handler is None:
             resp = self.impl.handle_payload(msg)
             if resp is not None:  # None: notification, nothing to send
-                sess.push(resp)
+                sess.send_now(resp)
             return
         mid = msg.get("id")
         try:
             result = handler(sess, msg.get("params") or [])
-            sess.push({"jsonrpc": "2.0", "id": mid, "result": result})
+            sess.send_now({"jsonrpc": "2.0", "id": mid, "result": result})
         except JsonRpcError as exc:
-            sess.push({"jsonrpc": "2.0", "id": mid,
+            sess.send_now({"jsonrpc": "2.0", "id": mid,
                        "error": {"code": exc.code, "message": exc.message}})
         except Exception as exc:
-            sess.push({"jsonrpc": "2.0", "id": mid,
+            sess.send_now({"jsonrpc": "2.0", "id": mid,
                        "error": {"code": -32603, "message": str(exc)}})
 
     def _ws_methods(self):
@@ -308,7 +445,8 @@ class WsRpcServer:
         holder: list[str] = []
         buffered: list[tuple] = []
 
-        def emit(task_id, number, tx_hash, log_index, log) -> None:
+        def emit(task_id, number, tx_hash, log_index, log,
+                 lossless=False) -> None:
             sess.push({
                 "type": "eventPush",
                 "taskId": task_id,
@@ -318,7 +456,7 @@ class WsRpcServer:
                 "log": {"address": "0x" + log.address.hex(),
                         "topics": ["0x" + t.hex() for t in log.topics],
                         "data": "0x" + log.data.hex()},
-            })
+            }, lossless=lossless)
 
         def cb(number: int, tx_hash: bytes, log_index: int, log) -> None:
             with lk:
@@ -331,7 +469,11 @@ class WsRpcServer:
         with lk:
             holder.append(task_id)
             for args in buffered:
-                emit(task_id, *args)
+                # the buffered frames ARE the history replay the client
+                # explicitly requested: enqueue them lossless — overflow
+                # closes the session rather than silently gapping the
+                # range (live pushes after this flush are best-effort)
+                emit(task_id, *args, lossless=True)
             buffered.clear()
         sess.event_tasks.add(task_id)
         if not self._session_alive(sess):
@@ -402,7 +544,7 @@ class WsRpcServer:
             ev = threading.Event()
             out: list = []
             sess.pending[seq] = (ev, out)
-            ok = sess.push({"type": "amopPush", "seq": seq, "topic": topic,
+            ok = sess.send_now({"type": "amopPush", "seq": seq, "topic": topic,
                             "data": "0x" + data.hex()})
             if not ok:
                 sess.pending.pop(seq, None)

@@ -44,6 +44,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
+from ..analysis import lockcheck as lc
 from ..executor.executor import TransactionExecutor
 from ..ledger.ledger import Ledger
 from ..protocol import Block, BlockHeader, ParentInfo, Receipt, Transaction
@@ -97,9 +98,9 @@ class Scheduler:
         self._commit_faulted = False
         # per-node label for the block-trace registry + span attribution
         self.trace_label = trace_label
-        self._lock = threading.RLock()       # bookkeeping dicts below
-        self._exec_lock = threading.RLock()  # serialises block execution
-        self._commit_2pc = threading.Lock()  # serialises the storage 2PC
+        self._lock = lc.make_rlock("scheduler.state")    # bookkeeping dicts
+        self._exec_lock = lc.make_rlock("scheduler.exec")  # serialises execution
+        self._commit_2pc = lc.make_lock("scheduler.2pc")   # serialises the 2PC
         # executed results awaiting commit: hash -> result, plus a height
         # index so eviction never rebuilds the whole dict under the lock
         self._executed: dict[bytes, ExecutionResult] = {}

@@ -15,6 +15,7 @@ import threading
 from typing import Callable, Optional
 
 from ..net.websocket import OP_TEXT, WsError, ws_connect
+from ..utils.log import LOG, badge
 from .client import RpcCallError, SdkClient
 
 # event callback: (push: dict) -> None        (eventPush object, see server)
@@ -76,7 +77,12 @@ class WsSdkClient(SdkClient):
                     obj = json.loads(payload)
                     self._route(obj)
                 except Exception:
-                    continue  # one bad message must not kill the client
+                    # one bad message must not kill the client, but a
+                    # push-callback bug repeating on every frame must not
+                    # be invisible either (bcoslint
+                    # swallowed-worker-exception finding)
+                    LOG.exception(badge("SDKWS", "message-dropped"))
+                    continue
         finally:
             # fail every in-flight waiter instead of letting it time out
             with self._lock:

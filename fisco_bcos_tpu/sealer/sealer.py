@@ -37,7 +37,7 @@ from typing import Callable, Optional
 
 from ..protocol import Block, BlockHeader
 from ..txpool.txpool import TxPool
-from ..utils.log import LOG, badge, metric
+from ..utils.log import metric
 from ..utils.worker import Worker
 
 # view key used by solo mode's set_should_seal compatibility wrapper

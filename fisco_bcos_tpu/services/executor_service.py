@@ -18,7 +18,7 @@ Pro mode where storage is node-local).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 from ..codec.wire import Reader, Writer
 from ..executor.executor import TransactionExecutor
@@ -26,7 +26,6 @@ from ..protocol import Receipt, Transaction
 from ..scheduler.dmc import DmcExecutor
 from ..storage.interface import StorageInterface
 from ..storage.state import StateStorage
-from ..utils.log import LOG, badge
 from .rpc import ServiceClient, ServiceServer
 from .storage_service import _read_changeset, _write_changeset
 

@@ -22,6 +22,7 @@ import time
 from typing import Callable, Optional
 
 from ..codec.wire import Reader, Writer
+from ..utils.log import LOG, badge
 from .rpc import ServiceClient, ServiceServer
 
 Handler = Callable[[bytes, bytes, Callable[[bytes], None]], None]
@@ -188,7 +189,13 @@ class RemoteFront:
                 try:
                     handler(src, payload, respond)
                 except Exception:
-                    pass
+                    # a raising module handler used to die SILENTLY here —
+                    # the poll loop kept running while the module stopped
+                    # processing its traffic (bcoslint
+                    # swallowed-worker-exception finding; the lane
+                    # dispatcher died the same invisible way in PR 11)
+                    LOG.exception(badge("REMOTEFRONT", "handler-failed",
+                                        module=module))
 
     def send(self, module: int, dst: bytes, payload: bytes) -> bool:
         r = self.client.call("send", lambda w: w.u32(int(module))

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Optional
 
 from ..protocol import BlockHeader
 from ..utils import failpoints as _fp

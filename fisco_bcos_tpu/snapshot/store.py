@@ -18,8 +18,12 @@ import shutil
 import threading
 from typing import Optional
 
+from ..analysis import lockcheck as _lc
+from ..utils import failpoints as _fp
 from ..utils.log import LOG, badge
 from .manifest import SnapshotManifest
+
+_fp.register("snapshot.store.save")
 
 
 class SnapshotStore:
@@ -41,6 +45,8 @@ class SnapshotStore:
             with self._lock:
                 self._mem[manifest.height] = (manifest, list(chunks))
             return
+        _lc.note_blocking("fsync", "SnapshotStore.save")
+        _fp.fire("snapshot.store.save")
         final = os.path.join(self.directory, str(manifest.height))
         if os.path.isdir(final):
             return  # idempotent: same height == same content

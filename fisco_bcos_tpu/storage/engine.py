@@ -38,11 +38,11 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 import time
 import zlib
 from typing import Iterator, Optional
 
+from ..analysis import lockcheck as lc
 from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
@@ -100,9 +100,9 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
         self.max_segments = max(2, max_segments)
         self.block_bytes = block_bytes
         self._reg = registry if registry is not None else REGISTRY
-        self._lock = threading.RLock()
-        self._flush_lock = threading.Lock()    # serialises flush/install
-        self._compact_lock = threading.Lock()  # one merge at a time
+        self._lock = lc.make_rlock("engine.state")
+        self._flush_lock = lc.make_lock("engine.flush")    # flush/install
+        self._compact_lock = lc.make_lock("engine.compact")  # one merge
         self._prepared: dict[int, ChangeSet] = {}
         self._mem: dict[bytes, Optional[bytes]] = {}
         self._mem_bytes = 0

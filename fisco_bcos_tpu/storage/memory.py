@@ -7,18 +7,17 @@ cache layer in libinitializer/StorageInitializer.h.
 
 from __future__ import annotations
 
-import bisect
-import threading
 from typing import Iterator, Optional
 
-from .interface import ChangeSet, Entry, TransactionalStorage
+from ..analysis import lockcheck as lc
+from .interface import ChangeSet, TransactionalStorage
 
 
 class MemoryStorage(TransactionalStorage):
     def __init__(self):
         self._tables: dict[str, dict[bytes, bytes]] = {}
         self._prepared: dict[int, ChangeSet] = {}
-        self._lock = threading.RLock()
+        self._lock = lc.make_rlock("storage.memory")
 
     # -- reads/writes ------------------------------------------------------
     def get(self, table: str, key: bytes) -> Optional[bytes]:

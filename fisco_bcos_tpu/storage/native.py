@@ -21,7 +21,7 @@ import subprocess
 import threading
 from typing import Iterator, Optional
 
-from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
+from .interface import ChangeSet, TransactionalStorage
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))

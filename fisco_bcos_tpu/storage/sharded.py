@@ -46,9 +46,13 @@ import zlib
 from typing import Iterator, Optional
 
 from ..codec.wire import Reader, Writer
+from ..utils import failpoints as fp
 from ..utils.log import LOG, badge
 from ..utils.metrics import REGISTRY
 from .interface import ChangeSet, Entry, TransactionalStorage
+
+fp.register("storage.sharded.fence_before_rename",
+            "storage.sharded.prepare_before_rename")
 
 #: primary-shard table holding one row per committed block (the commit point)
 COMMIT_META = "__commit_meta__"
@@ -147,7 +151,7 @@ class DurablePrepareStorage(TransactionalStorage):
             raise StaleFenceError(
                 f"fence {fence} < shard high-water {self._highest_fence}")
         if fence > self._highest_fence:
-            self._highest_fence = fence
+            fp.fire("storage.sharded.fence_before_rename")
             tmp = self._fence_path + ".tmp"
             with open(tmp, "w") as f:
                 f.write(str(fence))
@@ -155,6 +159,12 @@ class DurablePrepareStorage(TransactionalStorage):
                 os.fsync(f.fileno())  # must survive power loss: a rolled-
                 # back fence would re-admit a deposed master
             os.replace(tmp, self._fence_path)
+            # high-water bumped ONLY after the durable publish: bumping
+            # first let a failed persist (ENOSPC, the failpoint above)
+            # make the RETRY skip the write entirely — prepare would then
+            # succeed with the on-disk fence stale, and a restart would
+            # re-admit a deposed master
+            self._highest_fence = fence
 
     def _sidecar(self, block_number: int) -> str:
         return os.path.join(self.path, f"prepared_{block_number}.bin")
@@ -174,6 +184,7 @@ class DurablePrepareStorage(TransactionalStorage):
         # land a stale sidecar after a newer master raised the fence
         with self._lock:
             self._check_fence(fence)
+            fp.fire("storage.sharded.prepare_before_rename")
             tmp = self._sidecar(block_number) + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(_SIDE_HDR.pack(zlib.crc32(payload), len(payload)))

@@ -34,11 +34,16 @@ import threading
 import zlib
 from typing import Iterable, Iterator, Optional
 
+from ..analysis import lockcheck as _lc
+from ..utils import failpoints as _fp
+
 MAGIC = b"FBTPUSST"
 _FOOTER = struct.Struct("<QQQQI8s")
 DEFAULT_BLOCK_BYTES = 4096
 BLOOM_BITS_PER_KEY = 10
 BLOOM_HASHES = 7
+
+_fp.register("storage.sstable.write")
 
 # composite-key plumbing shared with the engine: one sorted key space for
 # every table, `<table>\x00<key>` — NUL never appears in table names (they
@@ -129,6 +134,8 @@ def write_sstable(path: str,
     Returns {records, bytes, tables}. Tombstones (flag=1) are stored so a
     newer segment can shadow an older one's rows.
     """
+    _lc.note_blocking("fsync", "write_sstable")
+    _fp.fire("storage.sstable.write")
     tmp = path + ".tmp"
     index: list[tuple[bytes, int, int]] = []
     tables: set[str] = set()

@@ -24,10 +24,10 @@ from __future__ import annotations
 import errno
 import os
 import struct
-import threading
 import zlib
 from typing import Iterator, Optional
 
+from ..analysis import lockcheck as lc
 from ..utils import failpoints as fp
 from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
 
@@ -36,7 +36,8 @@ _HDR = struct.Struct("<IQ")
 # deterministic fault sites on the durability edges (utils/failpoints.py):
 # append fires INSIDE the write/fsync try of both backends, so an injected
 # `enospc` exercises the exact errno path a full disk takes
-fp.register("storage.wal.append_before_fsync", "storage.wal.rotate")
+fp.register("storage.wal.append_before_fsync", "storage.wal.rotate",
+            "storage.wal.compact")
 
 
 class _SpaceHealth:
@@ -235,6 +236,7 @@ class SegmentedWal:
 
     def append(self, block_number: int, cs: ChangeSet) -> None:
         fp.fire("storage.wal.append_before_fsync")
+        lc.note_blocking("fsync", "SegmentedWal.append")
         payload = pack_payload(block_number, cs)
         off = os.fstat(self._f.fileno()).st_size  # buffer empty: every
         #     prior append flushed or was rewound, so size IS the offset
@@ -296,7 +298,7 @@ class WalStorage(TransactionalStorage, _SpaceHealth):
         os.makedirs(path, exist_ok=True)
         self._tables: dict[str, dict[bytes, bytes]] = {}
         self._prepared: dict[int, ChangeSet] = {}
-        self._lock = threading.RLock()
+        self._lock = lc.make_rlock("wal.state")
         self._commits_since_compact = 0
         self.compact_every = compact_every
         self._recover()
@@ -451,6 +453,7 @@ class WalStorage(TransactionalStorage, _SpaceHealth):
     def _append_record(self, block_number: int, cs: ChangeSet) -> None:
         try:
             fp.fire("storage.wal.append_before_fsync")
+            lc.note_blocking("fsync", "WalStorage._append_record")
             payload = pack_payload(block_number, cs)
             off = os.fstat(self._log.fileno()).st_size
             try:
@@ -519,6 +522,8 @@ class WalStorage(TransactionalStorage, _SpaceHealth):
 
     def compact(self) -> None:
         """Write a snapshot and truncate the WAL (atomic rename)."""
+        fp.fire("storage.wal.compact")
+        lc.note_blocking("fsync", "WalStorage.compact")
         with self._lock:
             parts = [struct.pack("<I", len(self._tables))]
             for table, rows in self._tables.items():

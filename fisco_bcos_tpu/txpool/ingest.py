@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Optional, Sequence
 
+from ..analysis import lockcheck as lc
 from ..protocol import Transaction
 from ..utils import otrace
 from ..utils.log import LOG, badge, metric
@@ -99,7 +100,7 @@ class IngestLane:
         self.queue_cap = max(1, int(queue_cap))
         self.broadcast = broadcast
         self._q: deque[_Entry] = deque()
-        self._cv = threading.Condition()
+        self._cv = lc.make_condition("ingest.queue")
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         # EWMA arrival rate (txs/sec) and mean dispatched batch size,

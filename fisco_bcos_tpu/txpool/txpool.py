@@ -35,13 +35,13 @@ typed status (`TxDropped`) instead of letting clients hang to timeout.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ..analysis import lockcheck as lc
 from ..ledger.ledger import Ledger
 from ..protocol import Block, Transaction, TransactionStatus, batch_hash, \
     batch_recover_senders
@@ -124,7 +124,7 @@ class TxPool:
         # sender evict others' pending txs for free
         self.priority_bands = bool(priority_bands)
         self.block_limit_range = block_limit_range
-        self._lock = threading.RLock()
+        self._lock = lc.make_rlock("txpool.state")
         self._pending: "OrderedDict[bytes, Transaction]" = OrderedDict()
         self._sealed: set[bytes] = set()  # invariant: subset of _pending
         # pre-seal tombstones: hashes of in-flight proposal txs NOT yet in
@@ -146,7 +146,7 @@ class TxPool:
         # waiters on the same hash — with the dict, the first waiter to
         # time out popped the registration and stranded the others — and
         # costs one notify_all per BLOCK, not per waiting RPC thread.
-        self._receipt_cv = threading.Condition()
+        self._receipt_cv = lc.make_condition("txpool.receipt")
         self._async_waiters: dict[bytes, "object"] = {}  # hash -> Task
         # typed drop records: hash -> TransactionStatus for txs that were
         # ADMITTED and later evicted/shed/expired — wait_for_receipt and

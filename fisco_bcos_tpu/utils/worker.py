@@ -9,9 +9,8 @@ for the module it drives.
 
 from __future__ import annotations
 
-import queue
 import threading
-from typing import Callable, Optional
+from typing import Optional
 
 
 class Worker:

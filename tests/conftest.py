@@ -30,3 +30,25 @@ os.environ["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: skip plugin entirely
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_gate():
+    """Concurrency-correctness gate (analysis/lockcheck.py): when the
+    suite runs with BCOS_LOCKCHECK=1, every hot lock in the tree is the
+    instrumented wrapper, and the whole tier-1 run must finish with ZERO
+    lock-order cycles, canonical-order violations, blocking-while-locked
+    hits and self-deadlocks. Disarmed runs (the default) pay nothing —
+    the factories hand out plain threading primitives."""
+    from fisco_bcos_tpu.analysis import lockcheck
+
+    if not lockcheck.armed():
+        yield
+        return
+    lockcheck.reset()
+    yield
+    # tests that INTENTIONALLY provoke violations (tests/test_lockcheck.py)
+    # reset the plane in their teardown, so anything left here is real
+    lockcheck.assert_clean()

@@ -1,0 +1,396 @@
+"""bcoslint (tools/bcoslint.py): per-rule positive/negative fixtures,
+suppression comments, and the baseline round-trip."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "bcoslint", os.path.join(_REPO, "tools", "bcoslint.py"))
+bcoslint = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("bcoslint", bcoslint)
+_spec.loader.exec_module(bcoslint)
+
+
+def lint(src: str, relpath: str = "fisco_bcos_tpu/example.py"):
+    return bcoslint.lint_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(violations):
+    return sorted(v.rule for v in violations)
+
+
+# -- raw-lock --------------------------------------------------------------
+
+def test_raw_lock_flagged_in_hot_module():
+    src = """
+    import threading
+    class Pool:
+        def __init__(self):
+            self._lock = threading.RLock()
+    """
+    vs = lint(src, "fisco_bcos_tpu/txpool/txpool.py")
+    assert "raw-lock" in rules_of(vs)
+
+
+def test_raw_lock_ignored_outside_hot_modules_and_in_lockcheck():
+    src = """
+    import threading
+    lock = threading.Lock()
+    """
+    assert "raw-lock" not in rules_of(lint(src, "fisco_bcos_tpu/tool/x.py"))
+    assert "raw-lock" not in rules_of(
+        lint(src, "fisco_bcos_tpu/analysis/lockcheck.py"))
+
+
+# -- lock-order ------------------------------------------------------------
+
+def test_lock_order_lexical_inversion_flagged():
+    # in scheduler.py, _lock (scheduler.state) ranks INSIDE _commit_2pc:
+    # nesting the 2PC inside the state lock is the inversion
+    src = """
+    class S:
+        def bad(self):
+            with self._lock:
+                with self._commit_2pc:
+                    pass
+        def good(self):
+            with self._commit_2pc:
+                with self._lock:
+                    pass
+    """
+    vs = lint(src, "fisco_bcos_tpu/scheduler/scheduler.py")
+    order = [v for v in vs if v.rule == "lock-order"]
+    assert len(order) == 1
+    assert order[0].scope == "S.bad"
+
+
+def test_lock_order_ignores_closures_under_with():
+    # a def inside a with runs LATER, not under the lock
+    src = """
+    class S:
+        def ok(self):
+            with self._lock:
+                def cb():
+                    with self._commit_2pc:
+                        pass
+                return cb
+    """
+    vs = lint(src, "fisco_bcos_tpu/scheduler/scheduler.py")
+    assert "lock-order" not in rules_of(vs)
+
+
+# -- blocking-under-lock ---------------------------------------------------
+
+def test_blocking_under_hot_lock_flagged_and_allow_respected():
+    src = """
+    import os
+    class E:
+        def bad(self):
+            with self._lock:
+                self.suite.verify_batch([], [], [])
+        def fine(self):
+            with self._lock:
+                os.fsync(3)
+    """
+    # engine.state allows fsync but not suite_batch
+    vs = lint(src, "fisco_bcos_tpu/storage/engine.py")
+    blocking = [v for v in vs if v.rule == "blocking-under-lock"]
+    assert len(blocking) == 1 and blocking[0].scope == "E.bad"
+
+
+def test_sleep_and_sendall_under_no_blocking_lock():
+    src = """
+    import time
+    class P:
+        def bad(self):
+            with self._cv:
+                self.sock.sendall(b"x")
+                time.sleep(0.1)
+    """
+    vs = lint(src, "fisco_bcos_tpu/net/p2p.py")  # _cv -> p2p.session, allow=∅
+    kinds = [v for v in vs if v.rule == "blocking-under-lock"]
+    assert len(kinds) == 2
+
+
+# -- bare-except / swallowed-worker-exception ------------------------------
+
+def test_bare_except_flagged():
+    src = """
+    def f():
+        try:
+            g()
+        except:
+            pass
+    """
+    assert "bare-except" in rules_of(lint(src))
+
+
+def test_swallowed_worker_exception():
+    src = """
+    class W:
+        def _run(self):
+            while True:
+                try:
+                    self.step()
+                except Exception:
+                    pass
+    """
+    assert "swallowed-worker-exception" in rules_of(lint(src))
+
+
+def test_logged_worker_exception_is_fine():
+    src = """
+    class W:
+        def _run(self):
+            while True:
+                try:
+                    self.step()
+                except Exception:
+                    LOG.exception("step failed")
+    """
+    assert "swallowed-worker-exception" not in rules_of(lint(src))
+
+
+def test_swallow_outside_worker_loop_not_flagged():
+    src = """
+    def lookup(d):
+        try:
+            return d["k"]
+        except Exception:
+            pass
+    """
+    assert "swallowed-worker-exception" not in rules_of(lint(src))
+
+
+# -- wallclock-deadline ----------------------------------------------------
+
+def test_wallclock_deadline_flagged():
+    src = """
+    import time
+    def f():
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            pass
+    """
+    vs = [v for v in lint(src) if v.rule == "wallclock-deadline"]
+    assert len(vs) == 2
+
+
+def test_wallclock_timestamp_not_flagged():
+    src = """
+    import time
+    def f():
+        return int(time.time() * 1000)  # wire timestamp: wall clock is right
+    """
+    assert "wallclock-deadline" not in rules_of(lint(src))
+
+
+# -- fsync-no-failpoint ----------------------------------------------------
+
+def test_fsync_without_failpoint_flagged_in_storage():
+    src = """
+    import os
+    def persist(f):
+        os.fsync(f.fileno())
+    """
+    assert "fsync-no-failpoint" in rules_of(
+        lint(src, "fisco_bcos_tpu/storage/newfile.py"))
+    # same code outside the durability scope: not this rule's business
+    assert "fsync-no-failpoint" not in rules_of(
+        lint(src, "fisco_bcos_tpu/ha/election.py"))
+
+
+def test_fsync_with_failpoint_is_fine():
+    src = """
+    import os
+    from ..utils import failpoints as fp
+    def persist(f):
+        fp.fire("storage.newfile.persist")
+        os.fsync(f.fileno())
+    """
+    assert "fsync-no-failpoint" not in rules_of(
+        lint(src, "fisco_bcos_tpu/storage/newfile.py"))
+
+
+# -- metrics-cardinality ---------------------------------------------------
+
+def test_metrics_cardinality_hex_and_fstring():
+    src = """
+    def f(reg, tx_hash, stage):
+        reg.inc("bcos_x_total", labels={"tx": tx_hash.hex()})
+        reg.observe("bcos_y_seconds", 1.0, labels={"id": f"req-{stage}"})
+        reg.inc("bcos_z_total", labels={"stage": stage})
+    """
+    vs = [v for v in lint(src) if v.rule == "metrics-cardinality"]
+    assert len(vs) == 2  # the bounded Name label is fine
+
+
+# -- mutable-default / dict-iter-mutation ----------------------------------
+
+def test_mutable_default_flagged():
+    src = """
+    def f(x=[]):
+        return x
+    def g(y=None):
+        return y
+    """
+    vs = [v for v in lint(src) if v.rule == "mutable-default"]
+    assert len(vs) == 1
+
+
+def test_dict_iter_mutation_flagged_and_safe_idiom_not():
+    src = """
+    def bad(d):
+        for k in d:
+            d.pop(k)
+    def good(d):
+        for k in [k for k in d if k]:
+            d.pop(k)
+    def also_good(d):
+        for k in list(d):
+            del d[k]
+    """
+    vs = [v for v in lint(src) if v.rule == "dict-iter-mutation"]
+    assert len(vs) == 1 and vs[0].scope == "bad"
+
+
+# -- unused-import ---------------------------------------------------------
+
+def test_unused_import_flagged_and_usage_forms_respected():
+    src = """
+    import os
+    import json
+    from typing import Optional
+
+    __all__ = ["Optional"]
+
+    def f(p) -> None:
+        return os.path.basename(p)
+    """
+    vs = [v for v in lint(src) if v.rule == "unused-import"]
+    assert [v.message for v in vs] == ["import 'json' is never used"]
+
+
+def test_class_scope_import_is_attribute_usage():
+    src = """
+    class C:
+        from .evm import T_CODE
+        def f(self, state):
+            state.set(self.T_CODE, b"k", b"v")
+    """
+    assert "unused-import" not in rules_of(lint(src))
+
+
+def test_init_py_reexports_exempt():
+    src = "from .front import FrontService\n"
+    assert "unused-import" not in rules_of(
+        lint(src, "fisco_bcos_tpu/net/__init__.py"))
+
+
+# -- suppression -----------------------------------------------------------
+
+def test_suppression_same_line_and_line_above():
+    src = """
+    def f(x=[]):  # bcoslint: disable=mutable-default
+        return x
+    # bcoslint: disable=mutable-default
+    def g(y={}):
+        return y
+    def h(z=set()):
+        return z
+    """
+    vs = [v for v in lint(src) if v.rule == "mutable-default"]
+    assert len(vs) == 1 and vs[0].scope == "h"
+
+
+def test_disable_all_suppresses_every_rule():
+    src = """
+    def f(x=[]):  # bcoslint: disable=all
+        return x
+    """
+    assert lint(src) == []
+
+
+def test_suppressing_one_rule_keeps_others():
+    src = """
+    import time
+    def f(x=[]):  # bcoslint: disable=mutable-default
+        return time.time() + 1
+    """
+    assert rules_of(lint(src)) == ["wallclock-deadline"]
+
+
+# -- baseline round-trip ---------------------------------------------------
+
+BAD = textwrap.dedent("""
+    def f(x=[]):
+        return x
+""")
+
+
+def test_baseline_round_trip(tmp_path):
+    target = tmp_path / "victim.py"
+    target.write_text(BAD)
+    base = tmp_path / "baseline.txt"
+
+    # 1) no baseline: the violation fails the gate
+    assert bcoslint.main([str(target), "--baseline", str(base)]) == 1
+    # 2) update-baseline grandfathers it
+    assert bcoslint.main([str(target), "--baseline", str(base),
+                          "--update-baseline"]) == 0
+    assert bcoslint.main([str(target), "--baseline", str(base)]) == 0
+    # justification column survives a rewrite
+    text = base.read_text()
+    text = text.replace("TODO: justify or fix", "fixture: kept on purpose")
+    base.write_text(text)
+    assert bcoslint.main([str(target), "--baseline", str(base),
+                          "--update-baseline"]) == 0
+    assert "fixture: kept on purpose" in base.read_text()
+
+    # 3) a NEW violation still fails while the old one stays grandfathered
+    target.write_text(BAD + "\ndef g(y={}):\n    return y\n")
+    assert bcoslint.main([str(target), "--baseline", str(base)]) == 1
+    # 4) fixing the new one returns the gate to clean
+    target.write_text(BAD)
+    assert bcoslint.main([str(target), "--baseline", str(base)]) == 0
+    # 5) fixing the BASELINED one leaves a stale entry (warned, still 0)
+    target.write_text("def f(x=None):\n    return x\n")
+    assert bcoslint.main([str(target), "--baseline", str(base)]) == 0
+    # 6) --update-baseline prunes it
+    assert bcoslint.main([str(target), "--baseline", str(base),
+                          "--update-baseline"]) == 0
+    assert "mutable-default" not in base.read_text()
+
+
+def test_fingerprint_survives_line_moves(tmp_path):
+    target = tmp_path / "victim.py"
+    target.write_text(BAD)
+    base = tmp_path / "baseline.txt"
+    assert bcoslint.main([str(target), "--baseline", str(base),
+                          "--update-baseline"]) == 0
+    # shift the offending line down 20 lines: key is content, not lineno
+    target.write_text("# pad\n" * 20 + BAD)
+    assert bcoslint.main([str(target), "--baseline", str(base)]) == 0
+
+
+# -- the repo itself gates clean -------------------------------------------
+
+def test_repo_lints_clean_against_committed_baseline():
+    assert bcoslint.main([]) == 0
+
+
+def test_list_rules_names_every_rule():
+    # stable rule ids are the suppression/baseline API — pin them
+    assert set(bcoslint.RULES) == {
+        "raw-lock", "lock-order", "bare-except",
+        "swallowed-worker-exception", "wallclock-deadline",
+        "fsync-no-failpoint", "metrics-cardinality", "mutable-default",
+        "dict-iter-mutation", "unused-import",
+    }

@@ -1,0 +1,407 @@
+"""Runtime lock-discipline checker (fisco_bcos_tpu/analysis/lockcheck.py).
+
+Unit half: the detectors themselves — ABBA cycle, canonical-order
+violation, blocking-while-locked on an injected fsync, self-deadlock,
+condition-wait untracking, disarmed no-op shape, hold-time metrics.
+
+Matrix half: the interleavings past PRs had to debug by hand, driven on
+REAL components with the checker armed — commit-vs-sync on a live node,
+compaction-vs-scan-vs-install on the disk engine, ingest-vs-shutdown,
+admission-vs-release — each asserting a CLEAN report.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from fisco_bcos_tpu.analysis import lockcheck as lc
+from fisco_bcos_tpu.analysis.lockorder import HOT_LOCKS, RANK
+
+
+@pytest.fixture()
+def armed():
+    """Arm for the test, and ALWAYS reset+restore after: deliberate
+    violations must not leak into the session-wide conftest gate."""
+    was = lc.armed()
+    lc.arm()
+    lc.reset()
+    yield
+    lc.reset()
+    if not was:
+        lc.disarm()
+
+
+# -- disarmed: the production state ---------------------------------------
+
+def test_disarmed_factories_return_plain_primitives():
+    was = lc.armed()
+    lc.disarm()
+    try:
+        lock = lc.make_lock("t.plain")
+        rlock = lc.make_rlock("t.plain_r")
+        cv = lc.make_condition("t.plain_cv")
+        assert type(lock) is type(threading.Lock())
+        assert type(rlock) is type(threading.RLock())
+        assert isinstance(cv, threading.Condition)
+        # markers are a single flag branch — and record nothing
+        with lock:
+            lc.note_blocking("fsync", "disarmed")
+        assert lc.report()["blocking"] == []
+    finally:
+        if was:
+            lc.arm()
+
+
+def test_disarmed_marker_is_cheap():
+    was = lc.armed()
+    lc.disarm()
+    try:
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            lc.note_blocking("fsync")
+        per = (time.perf_counter() - t0) / n
+        # one flag branch; generous bound for a loaded CI host
+        assert per < 5e-6, f"disarmed marker costs {per*1e9:.0f}ns"
+    finally:
+        if was:
+            lc.arm()
+
+
+# -- cycle / order detection ----------------------------------------------
+
+def test_abba_cycle_detected(armed):
+    a = lc.make_lock("t.A")
+    b = lc.make_lock("t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = lc.report()
+    assert len(rep["cycles"]) == 1
+    cyc = rep["cycles"][0]
+    assert set(cyc["path"]) == {"t.A", "t.B"}
+    # the closing edge carries its acquisition stack for the report
+    assert any("test_lockcheck" in fr for fr in cyc["stack"])
+    with pytest.raises(AssertionError):
+        lc.assert_clean()
+
+
+def test_canonical_order_violation(armed):
+    # engine.state ranks INSIDE scheduler.2pc; taking them inverted is a
+    # violation even though no full cycle exists yet
+    assert RANK["engine.state"] > RANK["scheduler.2pc"]
+    inner = lc.make_rlock("engine.state")
+    outer = lc.make_lock("scheduler.2pc")
+    with inner:
+        with outer:
+            pass
+    rep = lc.report()
+    assert rep["cycles"] == []
+    assert len(rep["order_violations"]) == 1
+    v = rep["order_violations"][0]
+    assert (v["outer"], v["inner"]) == ("engine.state", "scheduler.2pc")
+
+
+def test_correct_order_is_clean(armed):
+    outer = lc.make_lock("scheduler.2pc")
+    inner = lc.make_rlock("engine.state")
+    with outer:
+        with inner:
+            pass
+    lc.assert_clean()
+
+
+def test_same_name_instances_do_not_self_cycle(armed):
+    # two nodes' txpool locks share the NAME; nesting them must not be
+    # reported as a txpool.state -> txpool.state cycle
+    l1 = lc.make_rlock("txpool.state")
+    l2 = lc.make_rlock("txpool.state")
+    with l1:
+        with l2:
+            pass
+    lc.assert_clean()
+
+
+# -- blocking-while-locked ------------------------------------------------
+
+def test_blocking_under_hot_lock_via_injected_fsync(armed, tmp_path):
+    """A REAL fsync (SegmentedWal.append crosses the marker) while a hot
+    no-blocking lock is held must be reported with both names."""
+    from fisco_bcos_tpu.storage.wal import SegmentedWal
+
+    assert HOT_LOCKS["txpool.state"] == frozenset()
+    wal = SegmentedWal(str(tmp_path), 1)
+    hot = lc.make_rlock("txpool.state")
+    with hot:
+        wal.append(1, {})
+    rep = lc.report()
+    assert len(rep["blocking"]) == 1
+    v = rep["blocking"][0]
+    assert v["lock"] == "txpool.state" and v["kind"] == "fsync"
+    assert v["detail"] == "SegmentedWal.append"
+
+
+def test_allowed_blocking_kind_is_clean(armed, tmp_path):
+    """The engine/WAL locks exist to ORDER durable writes: fsync under
+    them is the contract (lockorder.HOT_LOCKS allow-sets), not a bug."""
+    from fisco_bcos_tpu.storage.wal import SegmentedWal
+
+    wal = SegmentedWal(str(tmp_path), 1)
+    hot = lc.make_rlock("engine.state")
+    with hot:
+        wal.append(1, {})
+    assert lc.report()["blocking"] == []
+    # ...but a device crypto call under the same lock is NOT allowed
+    with hot:
+        lc.note_blocking("suite_batch", "verify_batch")
+    rep = lc.report()
+    assert [b["kind"] for b in rep["blocking"]] == ["suite_batch"]
+
+
+def test_blocking_with_no_lock_held_is_clean(armed):
+    lc.note_blocking("fsync", "free-standing")
+    assert lc.report()["blocking"] == []
+
+
+# -- self-deadlock / reentrancy / conditions -------------------------------
+
+def test_self_deadlock_raises_instead_of_hanging(armed):
+    lock = lc.make_lock("t.self")
+    with lock:
+        with pytest.raises(RuntimeError, match="re-acquired"):
+            lock.acquire()
+    assert len(lc.report()["self_deadlocks"]) == 1
+    lc.reset()  # deliberate violation: do not leak into the session gate
+
+
+def test_rlock_reentrancy_records_no_edge(armed):
+    r = lc.make_rlock("t.re")
+    inner = lc.make_lock("t.re_inner")
+    with r:
+        with r:  # reentrant: no t.re->t.re edge, no self-deadlock
+            with inner:
+                pass
+    rep = lc.report()
+    assert list(rep["edges"]) == ["t.re->t.re_inner"]
+    lc.assert_clean()
+
+
+def test_condition_wait_untracks_the_lock(armed):
+    """A thread parked in cv.wait() has RELEASED the lock: blocking work
+    on other threads meanwhile must not be charged against it."""
+    cv = lc.make_condition("crypto.lane")  # hot, allow=∅
+    parked = threading.Event()
+    done = threading.Event()
+
+    def waiter():
+        with cv:
+            parked.set()
+            cv.wait(5)
+        done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    assert parked.wait(5)
+    time.sleep(0.05)  # let the waiter actually park inside wait()
+    lc.note_blocking("suite_batch", "from-main")  # main holds nothing
+    with cv:
+        cv.notify_all()
+    assert done.wait(5)
+    lc.assert_clean()
+
+
+def test_condition_wait_for_and_reacquire(armed):
+    cv = lc.make_condition("t.cv2")
+    flag = []
+
+    def setter():
+        with cv:
+            flag.append(1)
+            cv.notify_all()
+
+    t = threading.Timer(0.05, setter)
+    t.start()
+    with cv:
+        assert cv.wait_for(lambda: flag, timeout=5)
+    t.join()
+    lc.assert_clean()
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_hold_and_wait_metrics_emitted(armed):
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    name = f"t.metrics_{os.getpid()}"
+    lock = lc.make_lock(name)
+    with lock:
+        time.sleep(0.01)
+    snap = REGISTRY.snapshot()
+    hold = snap["histograms"].get(
+        "bcos_lock_hold_seconds{'lock': '%s'}" % name)
+    assert hold is not None and hold["count"] == 1
+    assert hold["sum"] >= 0.01
+    acq = snap["counters"].get(
+        "bcos_lock_acquisitions_total{'lock': '%s'}" % name)
+    assert acq == 1.0
+
+
+# -- matrix: real components under the armed checker -----------------------
+
+@pytest.fixture()
+def armed_node(armed):
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0))
+    node.start()
+    yield node
+    node.stop()
+
+
+def _register_txs(node, tag, n, block_limit=500):
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.protocol import Transaction
+
+    kp = node.suite.generate_keypair(b"lockcheck-" + tag)
+    return [
+        Transaction(
+            to=pc.BALANCE_ADDRESS,
+            input=pc.encode_call(
+                "register",
+                lambda w, i=i: w.blob(b"%s-%d" % (tag, i)).u64(1)),
+            nonce=f"{tag.decode()}-{i}",
+            block_limit=block_limit).sign(node.suite, kp)
+        for i in range(n)
+    ]
+
+
+def test_matrix_commit_vs_sync_and_admission_vs_release(armed_node):
+    """Concurrent submit bursts (admission) racing commits (release),
+    plus sync-style pokes at the scheduler (retry probe, speculation
+    abort, next_executable) from a separate thread — the PR-6/PR-11
+    interleavings — leave a clean report and a converged chain."""
+    node = armed_node
+    txs = _register_txs(node, b"mx", 60)
+    stop = threading.Event()
+
+    def poker():
+        while not stop.is_set():
+            node.scheduler.retry_pending_commit()
+            node.scheduler.next_executable()
+            node.scheduler.pipeline_stats()
+            node.txpool.pending_count()
+            time.sleep(0.002)
+
+    pk = threading.Thread(target=poker, daemon=True)
+    pk.start()
+    threads = [threading.Thread(
+        target=lambda s=s: node.txpool.submit_batch(txs[s::4]),
+        daemon=True) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if node.ledger.total_tx_count() >= 60:
+            break
+        time.sleep(0.02)
+    stop.set()
+    pk.join(5)
+    assert node.ledger.total_tx_count() >= 60
+    lc.assert_clean()
+
+
+def test_matrix_compaction_vs_scan_vs_install(armed, tmp_path):
+    """The disk engine's three-way race (PR 9's review-wave territory):
+    constant-flush writes, full-table scans, explicit compactions and a
+    whole-state install, concurrently — clean report, no torn reads."""
+    from fisco_bcos_tpu.storage.engine import DiskStorage
+
+    eng = DiskStorage(str(tmp_path), memtable_bytes=256, max_segments=2,
+                      auto_compact=False)
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            eng.set("t_data", b"k%04d" % (i % 200), b"v%d" % i)
+            i += 1
+
+    def scanner():
+        while not stop.is_set():
+            for k in eng.keys("t_data"):
+                eng.get("t_data", k)
+
+    def compactor():
+        while not stop.is_set():
+            eng.compact_once()
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=f, daemon=True)
+               for f in (writer, scanner, compactor)]
+
+    def guard(t):
+        def run():
+            try:
+                t()
+            except Exception as exc:  # surface, don't vanish
+                errors.append(exc)
+        return run
+
+    threads = [threading.Thread(target=guard(f), daemon=True)
+               for f in (writer, scanner, compactor)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    eng.install_rows({"t_fresh": {b"a": b"1"}})
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors, errors[:2]
+    eng.close()
+    lc.assert_clean()
+
+
+def test_matrix_ingest_vs_shutdown(armed_node):
+    """Submitters racing IngestLane.stop() (the PR-12 shed paths): every
+    in-flight future settles (result or typed rejection), nothing hangs,
+    report stays clean."""
+    from fisco_bcos_tpu.txpool.ingest import LaneStopped, TxPoolIsFull
+
+    node = armed_node
+    lane = node.ingest
+    assert lane is not None
+    txs = _register_txs(node, b"sh", 40)
+    outcomes: list = []
+
+    def submitter(mine):
+        for tx in mine:
+            try:
+                task = lane.submit_async(tx)
+                outcomes.append(task.result(30))
+            except (LaneStopped, TxPoolIsFull) as exc:
+                outcomes.append(exc)
+            except RuntimeError as exc:
+                outcomes.append(exc)  # rejected at stop: settled, not hung
+
+    threads = [threading.Thread(target=submitter, args=(txs[s::4],),
+                                daemon=True) for s in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    lane.stop()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "submitter hung across lane shutdown"
+    assert len(outcomes) == 40  # every submission SETTLED one way
+    lc.assert_clean()

@@ -434,6 +434,8 @@ def test_ws_fallback_threads_bounded(batch_node, monkeypatch):
             replies.append(obj)
             return True
 
+        send_now = push  # shed errors are lossless sends (same capture)
+
     try:
         ws._offload(lambda s, m: None, FakeSess(),
                     {"id": 7, "method": "x"})
@@ -491,6 +493,8 @@ def test_ws_shed_keeps_notifications_silent(batch_node, monkeypatch):
             replies.append(obj)
             return True
 
+        send_now = push  # shed errors are lossless sends (same capture)
+
     try:
         ws._offload(lambda s, m: None, FakeSess(),
                     {"jsonrpc": "2.0", "method": "getBlockNumber",
@@ -519,6 +523,8 @@ def test_ws_shed_batch_gets_per_id_errors(batch_node, monkeypatch):
         def push(self, obj):
             replies.append(obj)
             return True
+
+        send_now = push  # shed errors are lossless sends (same capture)
 
     try:
         ws._offload(lambda s, m: None, FakeSess(), [

@@ -440,3 +440,31 @@ def test_keypage_layers_over_sharded_cluster(tmp_path):
                     for _ in sh.keys("t_kp"))
     assert 0 < page_rows < len(ROWS)  # packed: fewer pages than rows
     cluster.close()
+
+
+def test_fence_persist_failure_retried_durably(tmp_path):
+    """A failed fence persist must NOT leave the in-memory high-water
+    ahead of disk: the retry has to re-drive the durable write, or a
+    restart re-admits a deposed master (found by code review when the
+    storage.sharded.fence_before_rename failpoint landed exactly in
+    that window)."""
+    from fisco_bcos_tpu.utils import failpoints as fp
+
+    d = DurablePrepareStorage(WalStorage(str(tmp_path / "wal")),
+                              str(tmp_path / "prep"))
+    with fp.armed("storage.sharded.fence_before_rename", "raise*1"):
+        with pytest.raises(Exception):
+            d.prepare(1, cs(("t", b"x", b"y")), fence=2)
+    # retry with the SAME fence: the durable write must actually run
+    d.prepare(1, cs(("t", b"x", b"y")), fence=2)
+    d.commit(1, fence=2)
+    d.close()
+    # restart: the fence high-water survived on disk, a deposed master
+    # (fence 1) is refused
+    from fisco_bcos_tpu.storage.sharded import StaleFenceError
+
+    d2 = DurablePrepareStorage(WalStorage(str(tmp_path / "wal")),
+                               str(tmp_path / "prep"))
+    with pytest.raises(StaleFenceError):
+        d2.prepare(2, cs(("t", b"a", b"b")), fence=1)
+    d2.close()

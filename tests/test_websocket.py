@@ -186,3 +186,55 @@ def test_ws_amop_self_publish_same_connection(ws_node):
         assert resp == b"me:loop"
     finally:
         cli.close()
+
+
+def test_ws_push_outbox_overflow_policies():
+    """The bounded push outbox (PR-13 blocking-while-locked fix): live
+    pushes drop OLDEST on overflow (counted in the registry); a backlog
+    of LOSSLESS frames (the subscribeEvent history replay) is never
+    silently gapped — overflow closes the session instead."""
+    from fisco_bcos_tpu.rpc.ws_server import _Session
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    class FakeSock:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    class StuckConn:  # writer thread parks forever on the first send
+        peer = "test"
+
+        def __init__(self):
+            import threading
+            self._gate = threading.Event()
+            self.sock = FakeSock()
+
+        def send_text(self, text):
+            self._gate.wait(30)
+
+    # live pushes: drop-oldest, session survives
+    sess = _Session(StuckConn())
+    sess.MAX_OUTBOX = 8
+    before = REGISTRY.snapshot()["counters"].get(
+        "bcos_ws_push_dropped_total", 0.0)
+    for i in range(20):
+        assert sess.push({"type": "eventPush", "n": i}) is True
+    after = REGISTRY.snapshot()["counters"].get(
+        "bcos_ws_push_dropped_total", 0.0)
+    assert after - before >= 10  # overflowed pushes were counted
+    assert not sess.conn.sock.closed
+    sess.close_push()
+
+    # lossless backlog: overflow KILLS the session, nothing is gapped
+    sess2 = _Session(StuckConn())
+    sess2.MAX_OUTBOX = 8
+    ok = True
+    for i in range(20):
+        ok = sess2.push({"type": "eventPush", "n": i}, lossless=True)
+        if not ok:
+            break
+    assert not ok and sess2.conn.sock.closed  # RAW close: no frame sent,
+    #   so the kill path can never block on the writer's _wlock
+    assert sess2.push({"type": "eventPush"}) is False  # dead stays dead

@@ -1,0 +1,702 @@
+#!/usr/bin/env python3
+"""bcoslint — repo-specific concurrency/hygiene invariants as AST passes.
+
+The static half of the concurrency-correctness plane (the runtime half is
+fisco_bcos_tpu/analysis/lockcheck.py): every rule encodes an invariant a
+past PR's review wave had to find by hand. Gating CI (tools/sanitize_ci.sh
+--lint) against the committed baseline keeps the repo at zero NEW
+violations while grandfathered ones carry a written justification.
+
+Usage:
+    python tools/bcoslint.py                    # lint default paths vs baseline
+    python tools/bcoslint.py --list-rules
+    python tools/bcoslint.py --no-baseline      # show EVERY violation
+    python tools/bcoslint.py --update-baseline  # rewrite the baseline file
+    python tools/bcoslint.py path.py ...        # explicit files/dirs
+
+Suppression (same line or the line directly above):
+    something_flagged()  # bcoslint: disable=wallclock-deadline
+    # bcoslint: disable=all
+
+Baseline file format (tools/bcoslint_baseline.txt), one entry per line:
+    rule|path|scope|fingerprint|justification
+`scope` is the enclosing qualname; `fingerprint` is the offending source
+line with whitespace collapsed — entries survive line-number churn. A
+violation matching (rule, path, scope, fingerprint) is grandfathered;
+stale entries are reported as warnings and pruned by --update-baseline.
+
+Rules:
+    raw-lock              threading.Lock/RLock/Condition() constructed in a
+                          hot module instead of the lockcheck factories
+    lock-order            lexically nested `with` over canonical locks in
+                          rank-inverting order (analysis/lockorder.RANK)
+    blocking-under-lock   fsync / socket send / suite batch / subprocess /
+                          sleep lexically inside a `with` over a HOT lock
+                          whose allow-set excludes that kind
+    bare-except           `except:` catches SystemExit/KeyboardInterrupt too
+    swallowed-worker-exception
+                          an except handler that is only pass/continue
+                          inside a worker run()/_loop() — silent thread
+                          death (how the lane dispatcher died in PR 11)
+    wallclock-deadline    time.time() compared or added/subtracted — wall
+                          clock steps under NTP; deadlines/elapsed need
+                          time.monotonic()
+    fsync-no-failpoint    a storage/snapshot function performs fsync or
+                          os.replace but crosses no failpoint site — the
+                          kill -9 matrix cannot reach the new edge
+    metrics-cardinality   a metrics label value built from .hex()/f-string/
+                          str() — unbounded label sets explode Prometheus
+                          series
+    mutable-default       def f(x=[]) / {} / set() — shared across calls
+    dict-iter-mutation    `for k in d:` whose body pops/clears d — dict
+                          mutated during iteration raises at runtime
+    unused-import         import never referenced (hygiene pass)
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib.util
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = ("fisco_bcos_tpu", "tools", "benchmark")
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "bcoslint_baseline.txt")
+
+# analysis/lockorder.py loaded by path: the package __init__ imports jax,
+# which a lint pass must never pay for (or require)
+_spec = importlib.util.spec_from_file_location(
+    "_bcoslint_lockorder",
+    os.path.join(REPO, "fisco_bcos_tpu", "analysis", "lockorder.py"))
+lockorder = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lockorder)
+
+SUPPRESS_RE = re.compile(r"#\s*bcoslint:\s*disable=([a-z\-,\s]+|all)")
+
+# files exempt from raw-lock (the checker itself builds the primitives)
+RAW_LOCK_EXEMPT = ("analysis/lockcheck.py",)
+
+# directories where every fsync/atomic-rename edge must be failpoint-armed
+FSYNC_FP_SCOPE = ("fisco_bcos_tpu/storage/", "fisco_bcos_tpu/snapshot/")
+
+WORKER_FN_RE = re.compile(r"^(_?run\w*|.*_loop|execute_worker|_recv\w*)$")
+
+BLOCKING_ATTRS = {
+    "fsync": "fsync", "fdatasync": "fsync",
+    "sendall": "socket_send", "send_text": "socket_send",
+    "send_binary": "socket_send",
+    "verify_batch": "suite_batch", "recover_batch": "suite_batch",
+    "hash_batch": "suite_batch",
+}
+SUBPROCESS_ATTRS = {"run", "check_call", "check_output", "call", "Popen"}
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str        # repo-relative
+    line: int
+    scope: str
+    text: str        # raw source line (stripped)
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return " ".join(self.text.split())
+
+    @property
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.scope, self.fingerprint)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}\n"
+                f"    {self.text.strip()}  (scope: {self.scope})")
+
+
+@dataclass
+class FileCtx:
+    path: str              # absolute
+    relpath: str           # repo-relative, /-separated
+    src: str
+    lines: list[str]
+    tree: ast.Module
+    scopes: dict[int, str] = field(default_factory=dict)  # id(node)->qualname
+    lock_attrs: dict[str, str] = field(default_factory=dict)
+
+    def scope_of(self, node: ast.AST) -> str:
+        return self.scopes.get(id(node), "<module>")
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        for ln in (lineno, lineno - 1):
+            m = SUPPRESS_RE.search(self.line_text(ln))
+            if m:
+                rules = m.group(1).strip()
+                if rules == "all" or rule in [r.strip()
+                                              for r in rules.split(",")]:
+                    return True
+        return False
+
+
+def _build_scopes(ctx: FileCtx) -> None:
+    def walk(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            q = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{qual}.{child.name}" if qual != "<module>" \
+                    else child.name
+            ctx.scopes[id(child)] = q
+            walk(child, q)
+    ctx.scopes[id(ctx.tree)] = "<module>"
+    walk(ctx.tree, "<module>")
+
+
+def _make_ctx(src: str, path: str, rel: str) -> FileCtx:
+    ctx = FileCtx(path=path, relpath=rel, src=src,
+                  lines=src.splitlines(), tree=ast.parse(src, filename=path))
+    _build_scopes(ctx)
+    for suffix, attrs in lockorder.MODULE_LOCK_ATTRS.items():
+        if rel.endswith(suffix):
+            ctx.lock_attrs = attrs
+            break
+    return ctx
+
+
+def load_file(path: str) -> Optional[FileCtx]:
+    rel = os.path.relpath(os.path.abspath(path), REPO).replace(os.sep, "/")
+    try:
+        src = open(path, encoding="utf-8").read()
+        return _make_ctx(src, path, rel)
+    except (OSError, SyntaxError) as exc:
+        print(f"bcoslint: cannot parse {rel}: {exc}", file=sys.stderr)
+        return None
+
+
+def lint_source(src: str, relpath: str) -> list[Violation]:
+    """Lint a source STRING as if it lived at repo-relative `relpath`
+    (path-scoped rules key off it). The test suite's entry point."""
+    ctx = _make_ctx(src, relpath, relpath)
+    out: list[Violation] = []
+    for fn in RULES.values():
+        out.extend(fn(ctx))
+    return out
+
+
+def _v(ctx: FileCtx, rule: str, node: ast.AST, message: str
+       ) -> Optional[Violation]:
+    line = getattr(node, "lineno", 1)
+    if ctx.suppressed(line, rule):
+        return None
+    return Violation(rule=rule, path=ctx.relpath, line=line,
+                     scope=ctx.scope_of(node),
+                     text=ctx.line_text(line).strip(), message=message)
+
+
+# -- rule: raw-lock --------------------------------------------------------
+
+def rule_raw_lock(ctx: FileCtx) -> Iterator[Violation]:
+    if not ctx.lock_attrs or any(ctx.relpath.endswith(e)
+                                 for e in RAW_LOCK_EXEMPT):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("Lock", "RLock", "Condition") and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "threading":
+            v = _v(ctx, "raw-lock", node,
+                   f"threading.{node.func.attr}() in a hot module — use "
+                   "analysis.lockcheck.make_lock/make_rlock/make_condition")
+            if v:
+                yield v
+
+
+# -- rules: lock-order + blocking-under-lock (shared with-stack walk) ------
+
+def _lock_name_of(ctx: FileCtx, expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return ctx.lock_attrs.get(expr.attr)
+    if isinstance(expr, ast.Attribute):  # e.g. task.lock
+        return ctx.lock_attrs.get(expr.attr)
+    return None
+
+
+def _blocking_kind(node: ast.Call) -> Optional[tuple[str, str]]:
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    kind = BLOCKING_ATTRS.get(fn.attr)
+    if kind:
+        return kind, fn.attr
+    root = fn.value
+    if isinstance(root, ast.Name):
+        if root.id == "time" and fn.attr == "sleep":
+            return "sleep", "time.sleep"
+        if root.id == "subprocess" and fn.attr in SUBPROCESS_ATTRS:
+            return "subprocess", f"subprocess.{fn.attr}"
+        if root.id == "os" and fn.attr == "replace":
+            return "fsync", "os.replace"
+    return None
+
+
+def rule_with_locks(ctx: FileCtx) -> Iterator[Violation]:
+    if not ctx.lock_attrs:
+        return
+    out: list[Violation] = []
+
+    def walk(node: ast.AST, stack: tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            entered = list(stack)
+            for item in node.items:
+                name = _lock_name_of(ctx, item.context_expr)
+                if name is None:
+                    continue
+                for held in entered:
+                    ra = lockorder.RANK.get(held)
+                    rb = lockorder.RANK.get(name)
+                    if held != name and ra is not None and rb is not None \
+                            and ra >= rb:
+                        v = _v(ctx, "lock-order", node,
+                               f"acquires {name} (rank {rb}) while "
+                               f"holding {held} (rank {ra}) — canonical "
+                               "order is outer-before-inner "
+                               "(analysis/lockorder.py)")
+                        if v:
+                            out.append(v)
+                entered.append(name)
+            for child in node.body:
+                walk(child, tuple(entered))
+            return
+        if isinstance(node, ast.Call) and stack:
+            bk = _blocking_kind(node)
+            if bk is not None:
+                kind, what = bk
+                for held in stack:
+                    allow = lockorder.HOT_LOCKS.get(held)
+                    if allow is not None and kind not in allow:
+                        v = _v(ctx, "blocking-under-lock", node,
+                               f"{what} ({kind}) inside `with` over hot "
+                               f"lock {held} — move the blocking work "
+                               "outside the lock")
+                        if v:
+                            out.append(v)
+        # nested defs start with an EMPTY stack: the closure runs later,
+        # not under the lexically enclosing with
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                walk(child, ())
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack)
+
+    walk(ctx.tree, ())
+    yield from out
+
+
+# -- rule: bare-except -----------------------------------------------------
+
+def rule_bare_except(ctx: FileCtx) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            v = _v(ctx, "bare-except", node,
+                   "bare `except:` also catches SystemExit/"
+                   "KeyboardInterrupt — name the exception class")
+            if v:
+                yield v
+
+
+# -- rule: swallowed-worker-exception --------------------------------------
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in handler.body)
+
+
+def rule_swallowed_worker(ctx: FileCtx) -> Iterator[Violation]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not WORKER_FN_RE.match(fn.name):
+            continue
+        for loop in ast.walk(fn):
+            if not isinstance(loop, ast.While):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.ExceptHandler) and \
+                        _handler_swallows(node):
+                    v = _v(ctx, "swallowed-worker-exception", node,
+                           f"exception swallowed (pass/continue) inside "
+                           f"worker loop {fn.name}() — a dying handler "
+                           "is invisible; log it (LOG.exception)")
+                    if v:
+                        yield v
+
+
+# -- rule: wallclock-deadline ----------------------------------------------
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def rule_wallclock(ctx: FileCtx) -> Iterator[Violation]:
+    flagged: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        hit = None
+        if isinstance(node, ast.Compare):
+            ops = [node.left] + list(node.comparators)
+            if any(_is_time_time(o) for o in ops):
+                hit = node
+        elif isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Add, ast.Sub)):
+            if _is_time_time(node.left) or _is_time_time(node.right):
+                hit = node
+        if hit is not None and hit.lineno not in flagged:
+            flagged.add(hit.lineno)
+            v = _v(ctx, "wallclock-deadline", hit,
+                   "time.time() used for a deadline/elapsed computation — "
+                   "wall clock steps under NTP; use time.monotonic() "
+                   "(wall-clock timestamps for wire/display are fine)")
+            if v:
+                yield v
+
+
+# -- rule: fsync-no-failpoint ----------------------------------------------
+
+def _has_failpoint_ref(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("fire",
+                                                           "fire_lossy"):
+                return True
+            if isinstance(f, ast.Attribute) and f.attr == "_maybe_fail":
+                return True
+    return False
+
+
+def rule_fsync_failpoint(ctx: FileCtx) -> Iterator[Violation]:
+    if not any(ctx.relpath.startswith(p) for p in FSYNC_FP_SCOPE):
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_edge = False
+        edge_node = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "os" and \
+                    node.func.attr in ("fsync", "fdatasync", "replace"):
+                has_edge = True
+                edge_node = node
+                break
+        if has_edge and not _has_failpoint_ref(fn):
+            v = _v(ctx, "fsync-no-failpoint", edge_node,
+                   f"{fn.name}() crosses a durability edge "
+                   "(fsync/atomic rename) with no failpoint site — the "
+                   "kill -9 matrix cannot exercise it "
+                   "(utils/failpoints.py)")
+            if v:
+                yield v
+
+
+# -- rule: metrics-cardinality ---------------------------------------------
+
+def _label_value_hazard(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.JoinedStr):
+        return "f-string"
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Attribute) and f.attr == "hex":
+            return ".hex()"
+        if isinstance(f, ast.Name) and f.id in ("str", "repr", "hex"):
+            return f"{f.id}()"
+    return None
+
+
+def rule_metrics_cardinality(ctx: FileCtx) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "set_gauge", "observe")):
+            continue
+        labels = None
+        for kw in node.keywords:
+            if kw.arg == "labels":
+                labels = kw.value
+        if labels is None and len(node.args) >= 3:
+            labels = node.args[2]
+        if not isinstance(labels, ast.Dict):
+            continue
+        for k, val in zip(labels.keys, labels.values):
+            hazard = _label_value_hazard(val)
+            if hazard:
+                kn = getattr(k, "value", "?")
+                v = _v(ctx, "metrics-cardinality", node,
+                       f"label {kn!r} built from {hazard} — unbounded "
+                       "values explode Prometheus series; use a bounded "
+                       "enum or drop the label")
+                if v:
+                    yield v
+                break
+
+
+# -- rule: mutable-default -------------------------------------------------
+
+def rule_mutable_default(ctx: FileCtx) -> Iterator[Violation]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for d in list(fn.args.defaults) + list(fn.args.kw_defaults):
+            if d is None:
+                continue
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set"))
+            if bad:
+                v = _v(ctx, "mutable-default", d,
+                       f"mutable default argument in {fn.name}() is "
+                       "shared across calls — default to None")
+                if v:
+                    yield v
+
+
+# -- rule: dict-iter-mutation ----------------------------------------------
+
+def rule_dict_iter_mutation(ctx: FileCtx) -> Iterator[Violation]:
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, ast.For) or not isinstance(loop.iter,
+                                                           ast.Name):
+            continue
+        target = loop.iter.id
+        for node in ast.walk(loop):
+            mutates = False
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("pop", "popitem", "clear") and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == target:
+                mutates = True
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == target:
+                        mutates = True
+            if mutates:
+                v = _v(ctx, "dict-iter-mutation", node,
+                       f"`{target}` mutated while `for ... in {target}:` "
+                       "iterates it — materialise the keys first "
+                       "(`for k in list(d):`)")
+                if v:
+                    yield v
+                break
+
+
+# -- rule: unused-import ---------------------------------------------------
+
+def rule_unused_import(ctx: FileCtx) -> Iterator[Violation]:
+    if ctx.relpath.endswith("__init__.py"):
+        return  # re-export surface: bindings ARE the API
+    # class-scope imports bind CLASS ATTRIBUTES (referenced as self.X /
+    # cls.X) — usage is attribute access the Name scan below cannot see,
+    # so they are exempt
+    class_scope: set[int] = set()
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef):
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                    class_scope.add(id(stmt))
+    bound: dict[str, ast.stmt] = {}
+    for node in ast.walk(ctx.tree):
+        if id(node) in class_scope:
+            continue
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                bound[name] = node
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                if a.asname == a.name:
+                    continue  # explicit re-export convention
+                bound[a.asname or a.name] = node
+    if not bound:
+        return
+    used: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # names exported via __all__ count as used
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            used.add(el.value)
+    for name, node in sorted(bound.items()):
+        if name not in used:
+            v = _v(ctx, "unused-import", node,
+                   f"import {name!r} is never used")
+            if v:
+                yield v
+
+
+RULES = {
+    "raw-lock": rule_raw_lock,
+    "lock-order": rule_with_locks,       # emits lock-order AND
+    #                                      blocking-under-lock violations
+    "bare-except": rule_bare_except,
+    "swallowed-worker-exception": rule_swallowed_worker,
+    "wallclock-deadline": rule_wallclock,
+    "fsync-no-failpoint": rule_fsync_failpoint,
+    "metrics-cardinality": rule_metrics_cardinality,
+    "mutable-default": rule_mutable_default,
+    "dict-iter-mutation": rule_dict_iter_mutation,
+    "unused-import": rule_unused_import,
+}
+
+
+def lint_file(path: str) -> list[Violation]:
+    ctx = load_file(path)
+    if ctx is None:
+        return []
+    out: list[Violation] = []
+    for fn in RULES.values():
+        out.extend(fn(ctx))
+    return out
+
+
+def iter_py_files(paths: list[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+# -- baseline --------------------------------------------------------------
+
+def load_baseline(path: str) -> dict[tuple, str]:
+    out: dict[tuple, str] = {}
+    if not os.path.exists(path):
+        return out
+    for ln in open(path, encoding="utf-8"):
+        ln = ln.rstrip("\n")
+        if not ln or ln.startswith("#"):
+            continue
+        parts = ln.split("|", 4)
+        if len(parts) != 5:
+            print(f"bcoslint: malformed baseline entry ignored: {ln!r}",
+                  file=sys.stderr)
+            continue
+        rule, p, scope, fpr, just = parts
+        out[(rule, p, scope, fpr)] = just
+    return out
+
+
+def write_baseline(path: str, violations: list[Violation],
+                   old: dict[tuple, str]) -> None:
+    lines = [
+        "# bcoslint baseline — grandfathered violations with justifications.",
+        "# Format: rule|path|scope|fingerprint|justification",
+        "# A NEW violation (not listed here) fails the lint gate. Prefer",
+        "# FIXING over baselining; every entry must say WHY it is correct.",
+    ]
+    seen: set[tuple] = set()
+    for v in sorted(violations, key=lambda v: (v.rule, v.path, v.line)):
+        if v.key in seen:
+            continue
+        seen.add(v.key)
+        just = old.get(v.key, "TODO: justify or fix")
+        lines.append(f"{v.rule}|{v.path}|{v.scope}|{v.fingerprint}|{just}")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation (ignore the baseline)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current violations, "
+                    "keeping existing justifications")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        extra = {"lock-order": " (also emits blocking-under-lock)"}
+        for r in RULES:
+            print(f"{r:<{width}}{extra.get(r, '')}")
+        return 0
+
+    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+    violations: list[Violation] = []
+    nfiles = 0
+    for f in iter_py_files(paths):
+        nfiles += 1
+        violations.extend(lint_file(f))
+
+    if args.update_baseline:
+        old = load_baseline(args.baseline)
+        write_baseline(args.baseline, violations, old)
+        print(f"bcoslint: baseline rewritten with "
+              f"{len({v.key for v in violations})} entr(y/ies) -> "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    fresh = [v for v in violations if v.key not in baseline]
+    stale = set(baseline) - {v.key for v in violations}
+
+    for v in fresh:
+        print(v.render())
+    if stale:
+        print(f"bcoslint: {len(stale)} stale baseline entr(y/ies) — "
+              "run --update-baseline to prune:", file=sys.stderr)
+        for key in sorted(stale):
+            print(f"    {key[0]}|{key[1]}|{key[2]}", file=sys.stderr)
+    grandfathered = len(violations) - len(fresh)
+    print(f"bcoslint: {nfiles} files, {len(fresh)} new violation(s), "
+          f"{grandfathered} grandfathered, {len(stale)} stale")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

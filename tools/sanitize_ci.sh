@@ -5,6 +5,13 @@
 #
 #   tools/sanitize_ci.sh            # full gate: ASan+UBSan, TSan, fuzz
 #   tools/sanitize_ci.sh --fast     # skip the @slow deep differential fuzz
+#   tools/sanitize_ci.sh --lint     # ONLY the concurrency-correctness
+#                                   # plane: bcoslint clean against the
+#                                   # committed baseline, then an ARMED
+#                                   # (BCOS_LOCKCHECK=1) 4-node smoke
+#                                   # asserting zero lock-order cycles and
+#                                   # zero blocking-while-locked hits with
+#                                   # bcos_lock_* hold metrics live
 #   tools/sanitize_ci.sh --chaos    # ONLY the multi-process fault gate:
 #                                   # 4 OS-process TLS chain, kill -9 a node
 #                                   # mid-stream, assert it rejoins to the
@@ -78,6 +85,64 @@ cd "$(dirname "$0")/.."
 
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
+
+run_lint_stage() {
+  echo "== [lint] bcoslint: repo invariants vs the committed baseline"
+  python tools/bcoslint.py
+  echo "== [lint] armed lockcheck smoke: 4-node chain under BCOS_LOCKCHECK=1"
+  BCOS_LOCKCHECK=1 JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+    timeout -k 10 600 python - <<'EOF'
+import sys, time
+sys.path.insert(0, "benchmark")
+from fisco_bcos_tpu.analysis import lockcheck as lc
+assert lc.armed(), "BCOS_LOCKCHECK=1 did not arm the checker"
+from chain_bench import _build_chain
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.protocol import Transaction
+
+nodes, gateways, _ = _build_chain(False, "host", 50)
+suite = nodes[0].suite
+kp = suite.generate_keypair(b"lint-smoke")
+txs = [Transaction(to=pc.BALANCE_ADDRESS,
+                   input=pc.encode_call(
+                       "register",
+                       lambda w, i=i: w.blob(b"ls%d" % i).u64(1 + i)),
+                   nonce=f"ls-{i}", block_limit=300).sign(suite, kp)
+       for i in range(120)]
+for node in nodes:
+    node.start()
+try:
+    for s in range(0, 120, 30):
+        nodes[(s // 30) % 4].txpool.submit_batch(txs[s:s + 30])
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if all(n.ledger.total_tx_count() >= 120 for n in nodes):
+            break
+        time.sleep(0.05)
+    assert all(n.ledger.total_tx_count() >= 120 for n in nodes), \
+        [n.ledger.total_tx_count() for n in nodes]
+finally:
+    for node in nodes:
+        node.stop()
+    for gw in set(gateways):
+        gw.stop()
+rep = lc.report()
+assert rep["edges"], "armed run recorded no lock-order edges at all"
+lc.assert_clean()
+from fisco_bcos_tpu.utils.metrics import REGISTRY
+snap = REGISTRY.snapshot()
+holds = [k for k in snap["histograms"] if k.startswith("bcos_lock_hold")]
+assert holds, "no bcos_lock_hold_seconds series emitted"
+print("sanitize_ci: LINT STAGE CLEAN "
+      f"(edges={len(rep['edges'])}, cycles=0, blocking=0, "
+      f"lock_series={len(holds)})")
+EOF
+}
+
+if [ "${1:-}" = "--lint" ]; then
+  run_lint_stage
+  exit 0
+fi
 
 if [ "${1:-}" = "--ingest" ]; then
   echo "== [ingest] continuous-batching lane smoke: 4 HTTP clients," \
@@ -824,6 +889,10 @@ if [ "${1:-}" = "--chaos" ]; then
   echo "sanitize_ci: CHAOS STAGE CLEAN"
   exit 0
 fi
+
+# default full gate: the static/lint plane runs FIRST (cheapest, catches
+# the most common regression class before any sanitizer rebuild)
+run_lint_stage
 
 LIBASAN="$(g++ -print-file-name=libasan.so)"
 LIBTSAN="$(g++ -print-file-name=libtsan.so)"

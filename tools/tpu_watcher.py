@@ -129,7 +129,10 @@ def main() -> None:
         if healthy:
             state["healthy_probes"] += 1
             log(f"probe: HEALTHY platform={diag} n={ndev}")
-            fresh_needed = (time.time() - last_sweep_ok_at
+            # monotonic, not wall clock: an NTP step used to be able to
+            # suppress (or force) a sweep for hours (bcoslint
+            # wallclock-deadline finding)
+            fresh_needed = (time.monotonic() - last_sweep_ok_at
                             > args.refresh_interval)
             if fresh_needed:
                 log("launching device sweep "
@@ -146,7 +149,7 @@ def main() -> None:
                     sweep_ok = r.returncode == 0
                     if sweep_ok:
                         state["sweeps_ok"] += 1
-                        last_sweep_ok_at = time.time()
+                        last_sweep_ok_at = time.monotonic()
                         log(f"sweep OK:\n{tail}")
                         prof = _run_profile()
                         if prof:
