@@ -479,6 +479,44 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: ZK proof plane (fisco_bcos_tpu/zk/) — batched
+            # Poseidon device-vs-host and proofs rendered/served/verified
+            # per second (round 14). BENCH_ZK_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--proof-bench", "--proof-txs", "120",
+                 "--backend", "host"],
+                "BENCH_ZK_TIMEOUT", 600)
+            pos = next((r for r in rows
+                        if r.get("metric") == "poseidon_hashes_per_sec"),
+                       None)
+            if pos:
+                line["poseidon_hashes_per_sec"] = pos.get("device")
+                line["poseidon_host_loop_per_sec"] = pos.get("host_loop")
+                line["poseidon_speedup"] = pos.get("speedup")
+                line["poseidon_batch"] = pos.get("batch")
+                line["poseidon_backend"] = pos.get("device_backend")
+            for name, key in (("proofs_rendered_per_sec", "value"),
+                              ("proofs_served_per_sec", "value")):
+                row = next((r for r in rows if r.get("metric") == name),
+                           None)
+                if row:
+                    line[name] = row.get(key)
+            ver = next((r for r in rows
+                        if r.get("metric") == "proofs_verified_per_sec"),
+                       None)
+            if ver:
+                line["proofs_verified_per_sec"] = ver.get("batched")
+                line["proofs_verified_scalar_per_sec"] = ver.get("scalar")
+            if not pos and not ver:
+                print(f"[bench] proof bench produced no rows (rc={rc})",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass
+        except Exception as exc:
+            print(f"[bench] proof bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: concurrent RPC ingest through the
             # continuous-batching lane (txpool/ingest.py) — the serving-
             # stack amortization row. BENCH_INGEST_TIMEOUT=0 skips it

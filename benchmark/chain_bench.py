@@ -1083,6 +1083,185 @@ def run_trace_profile(sm: bool, backend: str, n_txs: int = 24) -> list:
     return rows
 
 
+def run_proof_bench(sm: bool, backend: str, n_txs: int = 120,
+                    hash_batches=None) -> list:
+    """ZK proof plane bench (ISSUE 14): batched Poseidon hashing
+    device-vs-host, plus proof rendering/serving/verification rates on a
+    live solo chain.
+
+    Honesty rules (PERF.md convention): the "device" Poseidon path is
+    whatever jax backend is present — on a CPU-only host the vectorized
+    XLA path LOSES to the Python bigint loop (the backend's per-op cost
+    model, PERF.md r4) and the row says so via `device_backend` and a
+    speedup < 1. The host-loop baseline is measured on a bounded
+    subsample and scaled linearly (a pure per-item loop)."""
+    import statistics as _stats
+
+    import jax
+    import numpy as np
+
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.ops import merkle as om
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.rpc.cache import QueryCache
+    from fisco_bcos_tpu.zk import poseidon as zp
+    from fisco_bcos_tpu.zk import poseidon_jax as pj
+    from fisco_bcos_tpu.zk import proof as zkproof
+
+    suite_name = "sm" if sm else "ecdsa"
+    platform = jax.devices()[0].platform
+    if hash_batches is None:
+        # CPU interpreters pay ~4 s/1k lanes on this path: keep the sweep
+        # tiny there; a real device runs the full ladder
+        hash_batches = (1024, 16384, 65536) if platform == "tpu" \
+            else (512,)
+    rows = []
+    rng = np.random.default_rng(1)
+
+    # -- part 1: batched Poseidon, device path vs host loop -----------------
+    for B in hash_batches:
+        lefts = [rng.bytes(32) for _ in range(B)]
+        rights = [rng.bytes(32) for _ in range(B)]
+        pj.hash2_batch(lefts, rights)  # compile warm-up
+        t0 = time.perf_counter()
+        dev_out = pj.hash2_batch(lefts, rights)
+        dev_dt = time.perf_counter() - t0
+        m = min(B, 1024)
+        t0 = time.perf_counter()
+        host_out = zp.hash2_batch_host(lefts[:m], rights[:m])
+        host_dt = time.perf_counter() - t0
+        assert dev_out[:m] == host_out  # bit-identity before any number
+        dev_rate = B / dev_dt
+        host_rate = m / host_dt
+        rows.append({
+            "metric": "poseidon_hashes_per_sec", "unit": "hashes/sec",
+            "suite": suite_name, "batch": B,
+            "device": round(dev_rate, 1), "host_loop": round(host_rate, 1),
+            "speedup": round(dev_rate / host_rate, 3),
+            "device_backend": platform,
+            "host_subsample": m,
+        })
+    # Poseidon-Merkle tree (zk/merkle.py): the off-chain prover's
+    # workload — B leaves, one batched hash call per level, then the
+    # whole proof set verified in ONE batched call
+    B = hash_batches[-1]
+    leaves = [rng.bytes(32) for _ in range(B)]
+    from fisco_bcos_tpu.zk import merkle as zmerkle
+    levels = zmerkle.build_levels(leaves, hasher=pj.hash2_batch)  # warm
+    t0 = time.perf_counter()
+    levels = zmerkle.build_levels(leaves, hasher=pj.hash2_batch)
+    tree_dt = time.perf_counter() - t0
+    nprove = min(B, 256)
+    items = [(leaves[i], zmerkle.proof_from_levels(levels, i),
+              levels[-1][0]) for i in range(nprove)]
+    t0 = time.perf_counter()
+    okz = zmerkle.verify_batch(items, hasher=pj.hash2_batch)
+    zver_dt = time.perf_counter() - t0
+    assert okz.all()
+    rows.append({
+        "metric": "poseidon_merkle_tree", "unit": "leaves/sec",
+        "suite": suite_name, "leaves": B, "levels": len(levels),
+        "build_leaves_per_sec": round(B / tree_dt, 1),
+        "verify_proofs_per_sec": round(nprove / zver_dt, 1),
+        "device_backend": platform,
+    })
+
+    # -- part 2: proof serving on a live chain ------------------------------
+    node = Node(NodeConfig(sm_crypto=sm, crypto_backend=backend,
+                           min_seal_time=0.0))
+    impl = node.make_rpc_impl()
+    node.start()
+    try:
+        suite = node.suite
+        kp = suite.generate_keypair(b"proof-bench")
+        hashes: list[bytes] = []
+        per_block = 40
+        for s in range(0, n_txs, per_block):
+            txs = [Transaction(
+                to=pc.BALANCE_ADDRESS,
+                input=pc.encode_call(
+                    "register",
+                    lambda w, i=i: w.blob(b"pb%d" % i).u64(1)),
+                nonce=f"pb-{i}",
+                block_limit=node.ledger.current_number() + 200
+                ).sign(suite, kp)
+                for i in range(s, min(s + per_block, n_txs))]
+            node.txpool.submit_batch(txs)
+            for tx in txs:
+                h = tx.hash(suite)
+                if node.txpool.wait_for_receipt(h, 60) is None:
+                    raise RuntimeError("proof-bench tx never committed")
+                hashes.append(h)
+        numbers = sorted({node.ledger.receipt(h).block_number
+                          for h in hashes})
+
+        # render rate: both trees per block, every tx's bundle, into a
+        # fresh cache (what the commit-time prime pays per block)
+        cache = QueryCache(max_entries=4 * n_txs)
+        t0 = time.perf_counter()
+        rendered = sum(zkproof.render_block_proofs(
+            node, cache, n, cache.generation()) for n in numbers)
+        render_dt = time.perf_counter() - t0
+        rows.append({
+            "metric": "proofs_rendered_per_sec", "unit": "proofs/sec",
+            "suite": suite_name, "txs": rendered,
+            "blocks": len(numbers),
+            "value": round(rendered / render_dt, 1),
+        })
+
+        # served rate: getProof against the primed cache (the steady state)
+        docs = [impl.get_proof("group0", tx_hash="0x" + h.hex())
+                for h in hashes]  # warm/populate
+        t0 = time.perf_counter()
+        for h in hashes:
+            impl.get_proof("group0", tx_hash="0x" + h.hex())
+        serve_dt = time.perf_counter() - t0
+        rows.append({
+            "metric": "proofs_served_per_sec", "unit": "proofs/sec",
+            "suite": suite_name, "txs": len(hashes),
+            "value": round(len(hashes) / serve_dt, 1),
+        })
+
+        # verification: batched (one hash call for every level of every
+        # proof) vs the scalar per-proof loop
+        items = [(h, zkproof.w16_proof_from_json(d["txProof"]),
+                  bytes.fromhex(d["txsRoot"][2:]))
+                 for h, d in zip(hashes, docs)]
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ok = zkproof.verify_inclusion_batch(suite, items)
+        batch_dt = (time.perf_counter() - t0) / reps
+        assert ok.all()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            scal = [om.verify_merkle_proof(leaf, proof, root,
+                                           suite.hash_name)
+                    for leaf, proof, root in items]
+        scal_dt = (time.perf_counter() - t0) / reps
+        assert all(scal)
+        rows.append({
+            "metric": "proofs_verified_per_sec", "unit": "proofs/sec",
+            "suite": suite_name, "n_proofs": len(items),
+            "batched": round(len(items) / batch_dt, 1),
+            "scalar": round(len(items) / scal_dt, 1),
+            "speedup": round(scal_dt / batch_dt, 3),
+        })
+        lane_note = node.system_status()["zk"]
+        rows.append({
+            "metric": "proof_bench_summary", "unit": "-",
+            "suite": suite_name,
+            "zk_status": lane_note,
+            "e2e_block_mean_txs": round(_stats.mean(
+                len(node.ledger.tx_hashes_by_number(n))
+                for n in numbers), 1),
+        })
+    finally:
+        node.stop()
+    return rows
+
+
 # -- overload mode (ISSUE 12: proof under fire) ------------------------------
 
 _OVERLOAD_POOL = 2000  # pool sized so the watermarks are reachable in
@@ -1793,6 +1972,12 @@ def main() -> None:
                     help="with --overload: interleaved plane-off/on reps")
     ap.add_argument("--overload-fairness-s", type=float, default=10.0,
                     help="with --overload: fairness-mix duration")
+    ap.add_argument("--proof-bench", action="store_true",
+                    help="ZK proof plane: batched Poseidon device-vs-host "
+                         "sweep + proofs rendered/served/verified per sec "
+                         "on a live solo chain")
+    ap.add_argument("--proof-txs", type=int, default=120,
+                    help="committed txs backing the proof-serving rows")
     ap.add_argument("--trace-profile", action="store_true",
                     help="latency-attribution mode: closed-loop traced "
                          "txs through a 4-node chain at sample_rate=1; "
@@ -1838,6 +2023,11 @@ def main() -> None:
     if args.trace_profile:
         for sm in suites:
             for row in run_trace_profile(sm, args.backend, args.trace_txs):
+                print(json.dumps(row), flush=True)
+        return
+    if args.proof_bench:
+        for sm in suites:
+            for row in run_proof_bench(sm, args.backend, args.proof_txs):
                 print(json.dumps(row), flush=True)
         return
     if args.lockcheck_ab:

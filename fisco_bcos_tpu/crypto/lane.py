@@ -49,7 +49,9 @@ from ..utils.metrics import REGISTRY
 from ..utils.task import Task
 
 # ops the lane merges; everything else delegates straight to the base suite
-_OPS = ("verify", "recover", "hash")
+# ("poseidon" is the ZK proof plane's batched arity-2 hash — every group's
+# proof traffic lands in single device calls exactly like verify/recover)
+_OPS = ("verify", "recover", "hash", "poseidon")
 
 # fault sites (utils/failpoints.py): `dispatch` fires inside the per-batch
 # try (a clean batch rejection), `dispatcher` fires OUTSIDE it — the
@@ -111,6 +113,8 @@ class CryptoLane:
         self._merged_calls = 0  # device calls that served >1 request
         self._tag_items: dict[str, int] = {}
         self._tag_requests: dict[str, int] = {}
+        self._op_calls: dict[str, int] = {}
+        self._op_items: dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -249,6 +253,8 @@ class CryptoLane:
                 self._do_verify(batch)
             elif op == "recover":
                 self._do_recover(batch)
+            elif op == "poseidon":
+                self._do_poseidon(batch)
             else:
                 self._do_hash(batch)
         except Exception as exc:  # noqa: BLE001 — lane must survive
@@ -263,11 +269,20 @@ class CryptoLane:
             self._device_items += n_items
             if len(batch) > 1:
                 self._merged_calls += 1
+            self._op_calls[op] = self._op_calls.get(op, 0) + 1
+            self._op_items[op] = self._op_items.get(op, 0) + n_items
         REGISTRY.inc("bcos_crypto_lane_calls_total")
         REGISTRY.inc("bcos_crypto_lane_items_total", n_items)
         REGISTRY.inc("bcos_crypto_lane_requests_total", len(batch))
         REGISTRY.observe("bcos_crypto_lane_batch_size", n_items,
                          buckets=(1, 8, 64, 512, 4096, 16384, 65536))
+        if op == "poseidon":
+            # the ZK plane's own series: merge count + batch occupancy
+            REGISTRY.inc("bcos_zk_lane_calls_total")
+            REGISTRY.inc("bcos_zk_lane_items_total", n_items)
+            REGISTRY.inc("bcos_zk_lane_requests_total", len(batch))
+            REGISTRY.observe("bcos_zk_poseidon_batch_size", n_items,
+                             buckets=(1, 8, 64, 512, 4096, 16384, 65536))
 
     def _host_chunks(self, n: int) -> Optional[list[tuple[int, int]]]:
         """[(offset, len)] when the merged host batch should fan out
@@ -348,6 +363,22 @@ class CryptoLane:
             r.task.resolve(out[off:off + r.n])
             off += r.n
 
+    def _do_poseidon(self, batch: list[_Req]) -> None:
+        lefts, rights = [], []
+        for r in batch:
+            a, b = r.args
+            lefts.extend(a)
+            rights.extend(b)
+        # no host fan-out here: the Poseidon host oracle is pure-Python
+        # bigint code that never releases the GIL (unlike the native FFI
+        # verify/recover/hash paths _host_chunks exists for), so a pool
+        # split would serialize anyway and only add dispatch overhead
+        out = self.suite.poseidon_batch(lefts, rights)
+        off = 0
+        for r in batch:
+            r.task.resolve(out[off:off + r.n])
+            off += r.n
+
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         with self._cv:
@@ -361,6 +392,10 @@ class CryptoLane:
                 "per_tag_mean_batch": {
                     t: round(self._tag_items[t] / n, 2)
                     for t, n in self._tag_requests.items() if n},
+                "per_op": {
+                    op: {"calls": c,
+                         "mean_batch": round(self._op_items[op] / c, 2)}
+                    for op, c in self._op_calls.items() if c},
             }
 
 
@@ -419,6 +454,14 @@ class LaneSuite:
             return self._base.hash_batch(msgs)
         return self._lane.submit("hash", (list(msgs),), n,
                                  self._tag).result(self._timeout)
+
+    def poseidon_batch(self, lefts: Sequence[bytes],
+                       rights: Sequence[bytes]):
+        n = len(lefts)
+        if not self._merge(n):
+            return self._base.poseidon_batch(lefts, rights)
+        return self._lane.submit("poseidon", (list(lefts), list(rights)),
+                                 n, self._tag).result(self._timeout)
 
     def verify(self, pub_bytes: bytes, digest: bytes, sig: bytes) -> bool:
         return bool(np.asarray(self.verify_batch([digest], [sig],

@@ -147,6 +147,28 @@ class CryptoSuite:
               else sm3.sm3_batch_np)
         return [bytes(row) for row in fn(list(msgs))]
 
+    def poseidon_batch(self, lefts: Sequence[bytes],
+                       rights: Sequence[bytes]) -> list[bytes]:
+        """Batched Poseidon arity-2 compression over the BN254 scalar
+        field (zk/poseidon.py reference; zk/poseidon_jax.py lane-major
+        batch path) — the SNARK-friendly hash the ZK proof plane builds
+        its Merkle trees from. Inputs are 32-byte big-endian values
+        (arbitrary digests canonicalize via one mod-r reduction); outputs
+        are canonical field elements. Device gating follows hash_batch:
+        the JAX path at/above device_min_batch, the host oracle below."""
+        n = len(lefts)
+        assert len(rights) == n
+        if n == 0:
+            return []
+        _lc.note_blocking("suite_batch", "poseidon_batch")
+        if not self._use_device(n):
+            from ..zk import poseidon
+
+            return poseidon.hash2_batch_host(lefts, rights)
+        from ..zk import poseidon_jax
+
+        return poseidon_jax.hash2_batch(lefts, rights)
+
     def merkle_root(self, leaves: Sequence[bytes]) -> bytes:
         """Deterministic width-16 Merkle root over 32-byte leaf digests
         (protocol definition in ops.merkle; replaces BlockImpl.h:111,156)."""

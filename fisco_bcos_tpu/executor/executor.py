@@ -42,6 +42,18 @@ TX_GAS = 21_000  # flat per-tx gas for precompile calls (EVM meters its own)
 WASM_GAS_LIMIT = 2_000_000  # per-call interpreter budget (instruction units)
 
 
+def state_leaf_payload(table: str, key: bytes, value: bytes,
+                       deleted: bool = False) -> bytes:
+    """The canonical preimage of one state-root leaf: a changeset entry
+    serialized as table \\0 key \\0 tag value. ONE definition shared by
+    the root computation below and every state-proof verifier
+    (zk/proof.py, the light client, sanitize_ci --zk) — a verifier
+    recomputes H(payload) from the claimed value and checks the digest's
+    inclusion under header.state_root."""
+    tag = b"\x01" if deleted else b"\x00"
+    return table.encode() + b"\x00" + key + b"\x00" + tag + value
+
+
 class WasmHostContext:
     """Contract I/O bridge the interpreter's env imports resolve against
     (the reference's BCOS host interface for liquid contracts: input,
@@ -592,13 +604,23 @@ class TransactionExecutor:
 
     # -- state root (device Merkle over changeset digests) -----------------
     def state_root(self, changes: ChangeSet) -> bytes:
+        return self.state_root_with_leaves(changes)[0]
+
+    def state_root_with_leaves(self, changes: ChangeSet
+                               ) -> tuple[bytes, list]:
+        """-> (root, [(table, key, leaf_digest)]) over the sorted
+        changeset. The leaf list is the block's state-proof index
+        (zk/proof.py + Ledger.state_proof): persisting it alongside the
+        block lets `getProof` serve changeset-inclusion proofs anchored
+        at this root without re-reading (or retaining) the values — the
+        digests here are a free by-product of the root computation."""
         if not changes:
-            return b"\x00" * 32
+            return b"\x00" * 32, []
         items = sorted(changes.items(), key=lambda kv: (kv[0][0], kv[0][1]))
-        payloads = []
-        for (table, key), entry in items:
-            tag = b"\x01" if entry.deleted else b"\x00"
-            payloads.append(table.encode() + b"\x00" + key + b"\x00" + tag
-                            + entry.value)
+        payloads = [state_leaf_payload(table, key, entry.value,
+                                       entry.deleted)
+                    for (table, key), entry in items]
         leaves = self.suite.hash_batch(payloads)
-        return self.suite.merkle_root(leaves)
+        return (self.suite.merkle_root(leaves),
+                [(tk[0], tk[1], leaf)
+                 for (tk, _e), leaf in zip(items, leaves)])

@@ -173,6 +173,12 @@ class NodeConfig:
     p2p_host: str = "127.0.0.1"
     p2p_port: Optional[int] = None  # None = no p2p listener configured
     p2p_peers: list = dataclasses.field(default_factory=list)  # (host, port)
+    # ZK proof plane (fisco_bcos_tpu/zk/): persist per-block state-leaf
+    # digest indexes (changeset-inclusion proofs via getProof) and render
+    # every committed tx's proof bundle into the query cache at commit.
+    # Poseidon hashing itself is always available via suite.poseidon_batch
+    # regardless of this knob.
+    zk_proofs: bool = True
     # deterministic fault injection ([failpoints] spec, utils/failpoints.py):
     # `site=action;site2=action` armed at node construction — test/chaos
     # deployments only; empty (the default) arms nothing
@@ -272,7 +278,13 @@ class Node:
                                    self.suite, self.txpool,
                                    pipeline=cfg.pipeline_commit,
                                    trace_label=self.trace_label,
-                                   health=self.health)
+                                   health=self.health,
+                                   state_index=cfg.zk_proofs)
+        # ZK proof plane bookkeeping (zk/proof.py): commit-time render
+        # counts, proof cache hit rate, batched-verify volume — behind
+        # bcos_zk_* and the getSystemStatus "zk" section
+        from ..zk.proof import ZkPlane
+        self.zk = ZkPlane(self)
         if self.overload is not None:
             self.overload.add_signal(
                 "commit_backlog",
@@ -454,6 +466,7 @@ class Node:
             "consensus": self.consensus.status()
             if self.consensus is not None else None,
             "cryptoLane": lane.stats() if lane is not None else None,
+            "zk": self.zk.stats(),
             "groups": reg.groups() if reg is not None else [cfg.group_id],
             "trace": otrace.TRACER.stats(),
             "overload": self.overload.stats()

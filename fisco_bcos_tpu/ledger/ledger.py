@@ -45,6 +45,11 @@ SYS_CONSENSUS = "s_consensus"
 # snapshot/pruning bookkeeping (snapshot/ subsystem): blocks with
 # number < pruned_below keep only their header + hash->number row
 T_SNAPSHOT = "s_snapshot_state"
+# ZK proof plane (zk/proof.py): number(be8) -> the block's state-proof
+# index [(table, key, leaf_digest)] — the sorted changeset's leaf digests
+# as computed for header.state_root. DERIVED data (written after the root,
+# never covered by it); pruned with the block bodies.
+T_STATEIDX = "s_number_2_statehash"
 
 K_CURRENT = b"current_number"
 K_TOTAL_TX = b"total_transaction_count"
@@ -298,6 +303,7 @@ class Ledger:
             txs += len(tx_keys)
             self.storage.remove_batch(T_TX, tx_keys)
             self.storage.remove_batch(T_RECEIPT, tx_keys)
+            self.storage.remove_batch(T_STATEIDX, batch)
             self.storage.remove_batch(T_NUM2TXS, batch)
         nonce_floor = floor - keep_nonces
         nonce_keys = [k for k in self.storage.keys(T_NONCES)
@@ -311,7 +317,8 @@ class Ledger:
 
     # -- proofs (Ledger.cpp:759-844) --------------------------------------
     def tx_proof(self, tx_hash: bytes):
-        """-> (proof, root) for the tx's inclusion in its block, or None."""
+        """-> (proof, root) for the tx's inclusion in its block, or None
+        (unknown hash, or body rows lost to a concurrent prune sweep)."""
         from ..ops import merkle as m
         rc = self.receipt(tx_hash)
         if rc is None:
@@ -319,9 +326,11 @@ class Ledger:
         hashes = self.tx_hashes_by_number(rc.block_number)
         if tx_hash not in hashes:
             return None
+        header = self.header_by_number(rc.block_number)
+        if header is None:
+            return None
         idx = hashes.index(tx_hash)
         proof = m.merkle_proof(hashes, idx, self.suite.hash_name)
-        header = self.header_by_number(rc.block_number)
         return proof, header.txs_root
 
     def receipt_proof(self, tx_hash: bytes):
@@ -330,12 +339,79 @@ class Ledger:
         if rc is None:
             return None
         hashes = self.tx_hashes_by_number(rc.block_number)
+        if tx_hash not in hashes:
+            return None  # body rows raced a prune sweep: typed, not a tear
         receipts = [self.receipt(h) for h in hashes]
+        header = self.header_by_number(rc.block_number)
+        if header is None or any(r is None for r in receipts):
+            return None
+        from ..protocol import prefill_hashes
+        prefill_hashes(receipts, lambda r: r.encode(), self.suite)
         leaves = [r.hash(self.suite) for r in receipts]
         idx = hashes.index(tx_hash)
         proof = m.merkle_proof(leaves, idx, self.suite.hash_name)
-        header = self.header_by_number(rc.block_number)
         return proof, header.receipts_root
+
+    # -- state-changeset proofs (ZK proof plane) ---------------------------
+    def write_state_index(self, state: StorageInterface, n: int,
+                          entries: Sequence[tuple[str, bytes, bytes]]
+                          ) -> None:
+        """Stage block n's state-proof index [(table, key, leaf_digest)]
+        into the commit overlay (scheduler calls this AFTER computing
+        header.state_root — the row is derived data the root does not
+        cover, identical on every node running the same schedule)."""
+        w = Writer()
+        w.seq(entries, lambda ww, e: (
+            ww.text(e[0]), ww.blob(e[1]), ww.blob(e[2])))
+        state.set(T_STATEIDX, _be8(n), w.bytes())
+
+    def state_leaf_index(self, n: int
+                         ) -> Optional[list[tuple[str, bytes, bytes]]]:
+        """Block n's [(table, key, leaf_digest)] or None (pre-feature
+        block, pruned, or state indexing disabled)."""
+        v = self.storage.get(T_STATEIDX, _be8(n))
+        if not v:
+            return None
+        r = Reader(v)
+        return r.seq(lambda rr: (rr.text(), rr.blob(), rr.blob()))
+
+    def state_proofs(self, n: int,
+                     keys: Sequence[tuple[str, bytes]]):
+        """Changeset-inclusion proofs that block n wrote each (table,
+        key): -> [ (proof, state_root, leaf_digest, leaf_index) | None
+        (key not written in block n) ] aligned with `keys`, or None
+        when NO index exists for the block (pruned / pre-feature /
+        zk_proofs off — proves nothing about any key). BATCHED: one
+        index decode and one tree-level build serve every requested key.
+        The VALUE is not part of a proof — a verifier recomputes the
+        leaf digest from the claimed value via
+        executor.state_leaf_payload and checks it equals `leaf_digest`
+        before walking the proof."""
+        from ..ops import merkle as m
+        entries = self.state_leaf_index(n)
+        header = self.header_by_number(n)
+        if not entries or header is None:
+            return None
+        pos = {(t, k): i for i, (t, k, _d) in enumerate(entries)}
+        digests = [d for _t, _k, d in entries]
+        levels = None
+        out = []
+        for table, key in keys:
+            idx = pos.get((table, key))
+            if idx is None:
+                out.append(None)
+                continue
+            if levels is None:  # built once, first hit
+                levels = m.merkle_levels_host(digests,
+                                              self.suite.hash_name)
+            out.append((m.proof_from_levels(levels, idx),
+                        header.state_root, digests[idx], idx))
+        return out
+
+    def state_proof(self, n: int, table: str, key: bytes):
+        """Single-key convenience over `state_proofs`."""
+        got = self.state_proofs(n, [(table, key)])
+        return got[0] if got else None
 
     # -- system config / consensus-node tables -----------------------------
     def set_system_config(self, state: StorageInterface, key: str, value: str,

@@ -1,1 +1,8 @@
-from .lightnode import LightNodeClient, LightNodeServer  # noqa: F401
+from .lightnode import (  # noqa: F401
+    LightNodeClient,
+    LightNodeServer,
+    Pruned,
+    RESP_MISSING,
+    RESP_OK,
+    RESP_PRUNED,
+)

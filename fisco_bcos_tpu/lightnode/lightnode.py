@@ -8,28 +8,66 @@ dedicated ModuleIDs 4000-4006 (bcos-framework protocol/Protocol.h:80-87).
 The light client holds no state database. It learns the chain head from
 peers, verifies block headers by their commit-seal quorum (2f+1 of the
 configured consensus set over the header hash — the same check
-BlockValidator.cpp:141 does on synced blocks, batched through the
-CryptoSuite), verifies transactions/receipts against the header's Merkle
-roots (width-16 canonical tree, ops.merkle), and forwards writes
-(sendTransaction) and reads (call) to a full node.
+BlockValidator.cpp:141 does on synced blocks), verifies transactions/
+receipts against the header's Merkle roots (width-16 canonical tree,
+ops.merkle), and forwards writes (sendTransaction) and reads (call) to a
+full node.
+
+Batch-first verification (ZK proof plane, PR 14): the span APIs
+(`header_range`, `transactions`, `receipts`) verify a whole request span
+with ONE batched call per crypto kind — one `verify_batch` covering every
+header's full seal set, one `hash_batch` for tx/receipt identities, one
+`hash_batch` for every proof level of every item (the flat independent-
+levels check in zk/proof.py). The single-item APIs are the span APIs at
+span 1, so nothing in this module ever loops scalar crypto.
+
+Pruned history (PR 4) answers TYPED: a server whose body rows are below
+its prune floor responds flag RESP_PRUNED + the floor instead of an
+empty/torn payload, and the client surfaces it as a `Pruned` result —
+"cannot serve, history below N pruned" is distinct from "unknown hash".
 
 Wire formats use the framework codec; every exchange is a front
-request/response on its ModuleID.
+request/response on its ModuleID. The lightnode wire format is
+version-locked to the release — client and server ship from the same
+tree (the repo's convention for every internal protocol), so format
+evolution (the PR-14 entry flags, the ranged GET_BLOCK form) carries no
+cross-version negotiation; responses that don't parse are rejected
+whole, per-request, never crashed on.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import threading
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Union
 
 import numpy as np
 
 from ..codec.wire import Reader, Writer
 from ..net.front import FrontService
 from ..net.moduleid import ModuleID
-from ..ops import merkle
-from ..protocol import Block, BlockHeader, Receipt, Transaction
+from ..protocol import Block, BlockHeader, Receipt, Transaction, \
+    batch_hash, prefill_hashes
 from ..utils.log import LOG, badge
+from ..zk import proof as zkproof
+
+# entry flags on every lightnode body/proof response
+RESP_MISSING = 0   # unknown hash / no such block
+RESP_OK = 1        # payload + proof follow
+RESP_PRUNED = 2    # i64 prune floor follows — history below it is gone
+
+
+@dataclasses.dataclass(frozen=True)
+class Pruned:
+    """Typed 'cannot serve' result: the peer pruned bodies below
+    `below`, so it can neither serve this item NOR prove its absence —
+    on a pruned chain an absent hash might be pruned history or might
+    never have existed, and the server has no index to tell them apart.
+    Distinct from None (which, from an UNpruned peer, does mean unknown)
+    so wallets/indexers know to retarget an archive peer before
+    concluding anything."""
+
+    below: int
 
 
 class LightNodeServer:
@@ -55,15 +93,104 @@ class LightNodeServer:
         w.i64(n).blob(header.encode() if header else b"")
         respond(w.bytes())
 
+    # span cap per ranged GET_BLOCK request (bounds one response's size)
+    BLOCK_RANGE_MAX = 256
+
     def _block(self, src, payload, respond):
+        """Single block (legacy shape) or, with a trailing u32 count, a
+        RANGE of consecutive blocks in one round trip — the light
+        client's span APIs fetch N headers as ceil(N/256) requests
+        instead of N."""
         if respond is None:
             return
         r = Reader(payload)
         number, with_txs = r.i64(), r.u8()
-        blk = self.node.ledger.block_by_number(number, with_txs=bool(with_txs))
+        count = r.u32() if not r.done() else 0
         w = Writer()
-        w.blob(blk.encode() if blk else b"")
+        if count:
+            span = range(number, number + min(count, self.BLOCK_RANGE_MAX))
+            w.seq(span, lambda ww, n: self._block_entry(ww, n, with_txs))
+        else:
+            self._block_entry(w, number, with_txs)
         respond(w.bytes())
+
+    def _block_entry(self, ww: Writer, number: int, with_txs: int) -> None:
+        ledger = self.node.ledger
+        floor = ledger.pruned_below()
+        if with_txs and number < floor:
+            # headers survive pruning; BODY requests below the floor get
+            # the typed answer instead of a silently-empty block
+            ww.u8(RESP_PRUNED).i64(floor)
+            return
+        blk = ledger.block_by_number(number, with_txs=bool(with_txs))
+        if blk is None:
+            ww.u8(RESP_MISSING)
+        else:
+            ww.u8(RESP_OK).blob(blk.encode())
+
+    def _block_levels(self, memo: dict, number: int, want_tx: bool):
+        """(hashes, levels, root) for one block's tx or receipt tree,
+        built ONCE per request (an N-hash span over one block costs one
+        level build, not N — the same share-the-levels move as the
+        commit-time renderer's ops/merkle.proof_from_levels)."""
+        key = (number, want_tx)
+        if key in memo:
+            return memo[key]
+        from ..ops import merkle as m
+        ledger = self.node.ledger
+        suite = self.node.suite
+        hashes = ledger.tx_hashes_by_number(number)
+        header = ledger.header_by_number(number)
+        ctx = None
+        if hashes and header is not None:
+            # {hash: index} once per block, so an N-hash span over one
+            # block stays O(N), not O(N^2) of 32-byte compares
+            idx = {h: i for i, h in enumerate(hashes)}
+            if want_tx:
+                ctx = (idx, m.merkle_levels_host(hashes,
+                                                 suite.hash_name),
+                       header.txs_root)
+            else:
+                receipts = [ledger.receipt(h) for h in hashes]
+                if not any(r is None for r in receipts):
+                    prefill_hashes(receipts, lambda r: r.encode(), suite)
+                    leaves = [r.hash(suite) for r in receipts]
+                    ctx = (idx, m.merkle_levels_host(leaves,
+                                                     suite.hash_name),
+                           header.receipts_root)
+        memo[key] = ctx
+        return ctx
+
+    def _body_entry(self, ww: Writer, h: bytes, want_tx: bool,
+                    memo: dict) -> None:
+        """One tx/receipt response entry: payload + proof, or the typed
+        pruned/missing flags (never a torn payload, even mid-prune)."""
+        from ..ops import merkle as m
+        ledger = self.node.ledger
+        tx = ledger.transaction(h) if want_tx else None
+        rc = ledger.receipt(h)
+        floor = ledger.pruned_below()
+        if rc is None or (want_tx and tx is None):
+            if floor > 0 and (rc is None or rc.block_number < floor):
+                # absent on a pruned chain: might be pruned history,
+                # might never have existed — we cannot prove either way,
+                # so answer the typed floor (see Pruned's contract)
+                ww.u8(RESP_PRUNED).i64(floor)
+            else:
+                ww.u8(RESP_MISSING)
+            return
+        ctx = self._block_levels(memo, rc.block_number, want_tx)
+        if ctx is None or h not in ctx[0]:
+            if floor > 0:
+                ww.u8(RESP_PRUNED).i64(floor)
+            else:
+                ww.u8(RESP_MISSING)  # rollback/unknown, not pruned
+            return
+        idx_of, levels, root = ctx
+        proof = m.proof_from_levels(levels, idx_of[h])
+        payload = tx.encode() if want_tx else rc.encode()
+        ww.u8(RESP_OK).i64(rc.block_number).blob(payload)
+        _encode_proof(ww, proof, root)
 
     def _txs(self, src, payload, respond):
         if respond is None:
@@ -71,18 +198,8 @@ class LightNodeServer:
         r = Reader(payload)
         hashes = r.seq(lambda rr: rr.blob())
         w = Writer()
-
-        def one(ww: Writer, h: bytes) -> None:
-            tx = self.node.ledger.transaction(h)
-            rc = self.node.ledger.receipt(h)
-            if tx is None or rc is None:
-                ww.u8(0)
-                return
-            proof, root = self.node.ledger.tx_proof(h)
-            ww.u8(1).i64(rc.block_number).blob(tx.encode())
-            _encode_proof(ww, proof, root)
-
-        w.seq(hashes, one)
+        memo: dict = {}
+        w.seq(hashes, lambda ww, h: self._body_entry(ww, h, True, memo))
         respond(w.bytes())
 
     def _receipts(self, src, payload, respond):
@@ -91,17 +208,8 @@ class LightNodeServer:
         r = Reader(payload)
         hashes = r.seq(lambda rr: rr.blob())
         w = Writer()
-
-        def one(ww: Writer, h: bytes) -> None:
-            rc = self.node.ledger.receipt(h)
-            if rc is None:
-                ww.u8(0)
-                return
-            proof, root = self.node.ledger.receipt_proof(h)
-            ww.u8(1).i64(rc.block_number).blob(rc.encode())
-            _encode_proof(ww, proof, root)
-
-        w.seq(hashes, one)
+        memo: dict = {}
+        w.seq(hashes, lambda ww, h: self._body_entry(ww, h, False, memo))
         respond(w.bytes())
 
     def _send(self, src, payload, respond):
@@ -167,18 +275,39 @@ class LightNodeClient:
         return None
 
     # -- header verification ----------------------------------------------
+    def verify_headers(self, headers: Sequence[BlockHeader]) -> np.ndarray:
+        """-> bool[len(headers)]: each header carries a 2f+1 commit-seal
+        quorum from the configured consensus set. EVERY seal of EVERY
+        header rides ONE `verify_batch` — the span path costs one lane
+        call whether it checks one header or a thousand."""
+        prefill_hashes(headers, lambda h: h.encode_core(), self.suite)
+        digests: list[bytes] = []
+        sigs: list[bytes] = []
+        pubs: list[bytes] = []
+        spans: list[tuple[int, int]] = []
+        for header in headers:
+            hh = header.hash(self.suite)
+            start = len(sigs)
+            seen: set[int] = set()
+            for idx, seal in header.signature_list:
+                # dedup by sealer index: quorum counts DISTINCT sealers —
+                # one compromised sealer's seal repeated 2f+1 times must
+                # never authenticate a header
+                if 0 <= idx < len(self.sealers) and idx not in seen:
+                    seen.add(idx)
+                    digests.append(hh)
+                    sigs.append(seal)
+                    pubs.append(self.sealers[idx])
+            spans.append((start, len(sigs)))
+        ok = np.asarray(self.suite.verify_batch(digests, sigs, pubs)) \
+            if sigs else np.zeros(0, bool)
+        out = np.zeros(len(headers), bool)
+        for i, (lo, hi) in enumerate(spans):
+            out[i] = int(ok[lo:hi].sum()) >= self.quorum
+        return out
+
     def verify_header(self, header: BlockHeader) -> bool:
-        """2f+1 valid commit seals from the configured consensus set."""
-        hh = header.hash(self.suite)
-        sigs, pubs = [], []
-        for idx, seal in header.signature_list:
-            if 0 <= idx < len(self.sealers):
-                sigs.append(seal)
-                pubs.append(self.sealers[idx])
-        if len(sigs) < self.quorum:
-            return False
-        ok = np.asarray(self.suite.verify_batch([hh] * len(sigs), sigs, pubs))
-        return int(ok.sum()) >= self.quorum
+        return bool(self.verify_headers([header])[0])
 
     # -- API ---------------------------------------------------------------
     def status(self) -> Optional[int]:
@@ -187,69 +316,234 @@ class LightNodeClient:
             return None
         return Reader(resp).i64()
 
+    def _fetch_headers(self, lo: int, hi: int
+                       ) -> list[Union[BlockHeader, Pruned, None]]:
+        """Unverified headers lo..hi: ONE ranged GET_BLOCK request per
+        256-block slice instead of one round trip per height."""
+        out: list[Union[BlockHeader, Pruned, None]] = []
+        n = lo
+        while n <= hi:
+            cnt = min(LightNodeServer.BLOCK_RANGE_MAX, hi - n + 1)
+            w = Writer()
+            w.i64(n).u8(0).u32(cnt)
+            resp = self._ask(ModuleID.LIGHTNODE_GET_BLOCK, w.bytes())
+            got: list[Union[BlockHeader, Pruned, None]] = []
+            if resp is not None:
+                try:
+                    r = Reader(resp)
+                    k = r.u32()
+                    for _ in range(min(k, cnt)):
+                        flag = r.u8()
+                        if flag == RESP_PRUNED:
+                            got.append(Pruned(r.i64()))
+                        elif flag == RESP_OK:
+                            raw = r.blob()
+                            got.append(Block.decode(raw).header if raw
+                                       else None)
+                        else:
+                            got.append(None)
+                except Exception:  # noqa: BLE001 — untrusted peer bytes
+                    # truncated/garbage response: reject the slice whole
+                    # rather than crash the caller (ByzantinePeer sends
+                    # exactly this shape)
+                    got = []
+            got.extend([None] * (cnt - len(got)))
+            out.extend(got)
+            n += cnt
+        return out
+
+    def _fetch_header(self, number: int
+                      ) -> Union[BlockHeader, Pruned, None]:
+        return self._fetch_headers(number, number)[0]
+
     def header(self, number: int, verify: bool = True
                ) -> Optional[BlockHeader]:
+        got = self.header_range(number, number, verify=verify)
+        return got[0] if got and isinstance(got[0], BlockHeader) else None
+
+    def header_range(self, lo: int, hi: int, verify: bool = True
+                     ) -> list[Union[BlockHeader, Pruned, None]]:
+        """Headers lo..hi inclusive; with verify, the WHOLE span's seals
+        go through one `verify_batch` and failed headers become None."""
+        out: list[Union[BlockHeader, Pruned, None]] = \
+            self._fetch_headers(lo, hi)
+        if not verify:
+            return out
+        todo = [i for i, h in enumerate(out)
+                if isinstance(h, BlockHeader)]
+        if todo:
+            ok = self.verify_headers([out[i] for i in todo])
+            for i, good in zip(todo, ok):
+                if not good:
+                    LOG.warning(badge("LIGHT", "header-verify-failed",
+                                      number=lo + i))
+                    out[i] = None
+        return out
+
+    def _fetch_entries(self, module: int, tx_hashes: Sequence[bytes],
+                       decode):
+        """-> [(number, obj, proof, root) | Pruned | None] per hash."""
         w = Writer()
-        w.i64(number).u8(0)
-        resp = self._ask(ModuleID.LIGHTNODE_GET_BLOCK, w.bytes())
+        w.seq(tx_hashes, lambda ww, h: ww.blob(h))
+        resp = self._ask(module, w.bytes())
         if resp is None:
-            return None
-        raw = Reader(resp).blob()
-        if not raw:
-            return None
-        header = Block.decode(raw).header
-        if verify and not self.verify_header(header):
-            LOG.warning(badge("LIGHT", "header-verify-failed", number=number))
-            return None
-        return header
+            return [None] * len(tx_hashes)
+        try:
+            r = Reader(resp)
+            n = r.u32()
+            if n > len(tx_hashes):
+                # over-long response: malformed/malicious — reject whole
+                return [None] * len(tx_hashes)
+            entries: list = []
+            for _ in range(n):
+                flag = r.u8()
+                if flag == RESP_OK:
+                    number = r.i64()
+                    obj = decode(r.blob())
+                    proof, root = _decode_proof(r)
+                    entries.append((number, obj, proof, root))
+                elif flag == RESP_PRUNED:
+                    entries.append(Pruned(r.i64()))
+                else:
+                    entries.append(None)
+        except Exception:  # noqa: BLE001 — untrusted peer bytes
+            # truncated/garbage payload anywhere in the stream: reject
+            # the whole response instead of crashing the wallet caller
+            return [None] * len(tx_hashes)
+        entries.extend([None] * (len(tx_hashes) - len(entries)))
+        return entries
+
+    def _verified_headers_for(self, numbers) -> dict:
+        """number -> quorum-verified header for a set of heights: each
+        contiguous run fetched as a ranged request, the WHOLE set's
+        seals in one verify_batch. Unfetchable/unverified heights are
+        simply absent."""
+        nums = sorted(numbers)
+        fetched: dict = {}
+        i = 0
+        while i < len(nums):  # contiguous runs -> one request each
+            j = i
+            while j + 1 < len(nums) and nums[j + 1] == nums[j] + 1:
+                j += 1
+            for n, h in zip(nums[i:j + 1],
+                            self._fetch_headers(nums[i], nums[j])):
+                fetched[n] = h
+            i = j + 1
+        headed = {n: h for n, h in fetched.items()
+                  if isinstance(h, BlockHeader)}
+        ok_h = self.verify_headers(list(headed.values())) \
+            if headed else np.zeros(0, bool)
+        return {n: h for (n, h), ok in zip(headed.items(), ok_h) if ok}
+
+    def _verified_span(self, entries, leaves: dict, root_of):
+        """Shared span verification: quorum-check every involved header
+        (ONE verify_batch), then every entry's inclusion proof (ONE
+        hash_batch over all levels via zk/proof.py). `leaves` maps entry
+        index -> expected leaf digest; `root_of` picks the anchoring root
+        off a verified header."""
+        found = [i for i, e in enumerate(entries) if isinstance(e, tuple)]
+        good_headers = self._verified_headers_for(
+            {entries[i][0] for i in found})
+        items = [(leaves[i], entries[i][2], entries[i][3]) for i in found]
+        ok_p = zkproof.verify_inclusion_batch(self.suite, items) \
+            if items else np.zeros(0, bool)
+        out: list = list(entries)
+        for k, i in enumerate(found):
+            number, obj, _proof, root = entries[i]
+            header = good_headers.get(number)
+            if (header is None or not ok_p[k]
+                    or root != root_of(header)):
+                out[i] = None
+            else:
+                out[i] = obj
+        return out
+
+    def transactions(self, tx_hashes: Sequence[bytes], verify: bool = True
+                     ) -> list[Union[Transaction, Pruned, None]]:
+        """Batch fetch + verify: N transactions cost one body request,
+        one header quorum batch, one identity hash batch, one proof hash
+        batch — regardless of N."""
+        entries = self._fetch_entries(ModuleID.LIGHTNODE_GET_TRANSACTIONS,
+                                      tx_hashes, Transaction.decode)
+        if not verify:
+            return [e[1] if isinstance(e, tuple) else e for e in entries]
+        found = [i for i, e in enumerate(entries) if isinstance(e, tuple)]
+        # identity: the decoded tx must hash to the hash we asked for
+        # (one batched call fills every cache)
+        batch_hash([entries[i][1] for i in found], self.suite)
+        leaves = {}
+        for i in found:
+            leaf = entries[i][1].hash(self.suite)
+            leaves[i] = leaf
+            if leaf != tx_hashes[i]:
+                entries[i] = None
+        return self._verified_span(entries, leaves,
+                                   lambda h: h.txs_root)
+
+    def receipts(self, tx_hashes: Sequence[bytes], verify: bool = True
+                 ) -> list[Union[Receipt, Pruned, None]]:
+        """Batch fetch + verify receipts, BOUND to the requested tx: a
+        receipt carries no tx-hash field, so inclusion under
+        receipts_root alone would let a peer serve a different (valid)
+        receipt from the same block. The binding: fetch the transactions
+        for the same hashes, verify BOTH inclusion proofs (one combined
+        hash batch), and require the receipt proof's per-level positions
+        to equal the tx proof's — both trees index leaves in block
+        order, so equal positions means THIS tx's receipt."""
+        entries = self._fetch_entries(ModuleID.LIGHTNODE_GET_RECEIPTS,
+                                      tx_hashes, Receipt.decode)
+        if not verify:
+            return [e[1] if isinstance(e, tuple) else e for e in entries]
+        tx_entries = self._fetch_entries(
+            ModuleID.LIGHTNODE_GET_TRANSACTIONS, tx_hashes,
+            Transaction.decode)
+        out: list = list(entries)
+        found = [i for i, e in enumerate(entries)
+                 if isinstance(e, tuple) and isinstance(tx_entries[i],
+                                                        tuple)]
+        for i, e in enumerate(entries):
+            if isinstance(e, tuple) and not isinstance(tx_entries[i],
+                                                       tuple):
+                # unbindable receipt: surface the tx side's typed pruned
+                # answer when there is one, else reject
+                out[i] = tx_entries[i] if isinstance(tx_entries[i],
+                                                     Pruned) else None
+        prefill_hashes([entries[i][1] for i in found],
+                       lambda rc: rc.encode(), self.suite)
+        batch_hash([tx_entries[i][1] for i in found], self.suite)
+        good_headers = self._verified_headers_for(
+            {entries[i][0] for i in found})
+        items = []
+        for i in found:  # receipt proof + tx proof, ONE combined batch
+            items.append((entries[i][1].hash(self.suite),
+                          entries[i][2], entries[i][3]))
+            items.append((tx_entries[i][1].hash(self.suite),
+                          tx_entries[i][2], tx_entries[i][3]))
+        ok_p = zkproof.verify_inclusion_batch(self.suite, items) \
+            if items else np.zeros(0, bool)
+        for k, i in enumerate(found):
+            number, rc_obj, r_proof, r_root = entries[i]
+            t_number, tx_obj, t_proof, t_root = tx_entries[i]
+            header = good_headers.get(number)
+            good = (header is not None and t_number == number
+                    and bool(ok_p[2 * k]) and bool(ok_p[2 * k + 1])
+                    and r_root == header.receipts_root
+                    and t_root == header.txs_root
+                    and tx_obj.hash(self.suite) == tx_hashes[i]
+                    and [p for _s, p in t_proof]
+                    == [p for _s, p in r_proof])
+            out[i] = rc_obj if good else None
+        return out
 
     def transaction(self, tx_hash: bytes, verify: bool = True
                     ) -> Optional[Transaction]:
-        w = Writer()
-        w.seq([tx_hash], lambda ww, h: ww.blob(h))
-        resp = self._ask(ModuleID.LIGHTNODE_GET_TRANSACTIONS, w.bytes())
-        if resp is None:
-            return None
-        r = Reader(resp)
-        if r.u32() != 1 or r.u8() != 1:
-            return None
-        number = r.i64()
-        tx = Transaction.decode(r.blob())
-        proof, root = _decode_proof(r)
-        if verify:
-            # anchor the proof root to a quorum-verified header — a peer-
-            # supplied root alone proves nothing
-            header = self.header(number)
-            if header is None or root != header.txs_root:
-                return None
-            leaf = tx.hash(self.suite)
-            if tx_hash != leaf or not merkle.verify_merkle_proof(
-                    leaf, proof, root, self.suite.hash_name):
-                return None
-        return tx
+        got = self.transactions([tx_hash], verify=verify)[0]
+        return got if isinstance(got, Transaction) else None
 
     def receipt(self, tx_hash: bytes, verify: bool = True
                 ) -> Optional[Receipt]:
-        w = Writer()
-        w.seq([tx_hash], lambda ww, h: ww.blob(h))
-        resp = self._ask(ModuleID.LIGHTNODE_GET_RECEIPTS, w.bytes())
-        if resp is None:
-            return None
-        r = Reader(resp)
-        if r.u32() != 1 or r.u8() != 1:
-            return None
-        number = r.i64()
-        rc = Receipt.decode(r.blob())
-        proof, root = _decode_proof(r)
-        if verify:
-            header = self.header(number)
-            if header is None or root != header.receipts_root:
-                return None
-            leaf = rc.hash(self.suite)
-            if not merkle.verify_merkle_proof(leaf, proof, root,
-                                              self.suite.hash_name):
-                return None
-        return rc
+        got = self.receipts([tx_hash], verify=verify)[0]
+        return got if isinstance(got, Receipt) else None
 
     def send_transaction(self, tx: Transaction):
         resp = self._ask(ModuleID.LIGHTNODE_SEND_TRANSACTION, tx.encode(),

@@ -264,7 +264,18 @@ class _FieldBase:
         self.name = name
         self.n_int = n
         self.limbs = to_limbs(n)
-        assert 2 * n > 1 << BITS, "modulus must exceed 2^255"
+        # The curve fields are all > 2^255 (one conditional subtract fully
+        # canonicalizes any value < 2^256); SNARK scalar fields sit lower
+        # (BN254 r ~ 2^253.8). Everything in the shared ring-op layer is
+        # correct for any n > 2^253: canonical inputs keep a+b < 2n < 2^255
+        # (no carry), and Montgomery REDC's output stays < 2n — hence
+        # canonical after one conditional subtract — whenever AT LEAST ONE
+        # operand is canonical (< n): t <= (a*b + R*n)/R < 2n for a < R,
+        # b < n. Two loose operands can exceed that bound, so loose values
+        # may only ever meet canonical ones (to_rep pairs reduce_loose(a)
+        # with r2 < n). Only `reduce_loose` itself weakens (see its
+        # docstring) — its other callers are 2n > 2^256 fields (ops/ec.py).
+        assert n > 1 << (BITS - 3), "modulus must exceed 2^253"
 
     def mul(self, a, b):
         if _use_pallas():
@@ -323,8 +334,10 @@ class _FieldBase:
         return select(is_zero(a), a, d)
 
     def reduce_loose(self, a):
-        """Any exact-limb value < 2^256 -> canonical (< n); one conditional
-        subtract suffices because 2n > 2^256."""
+        """One conditional subtract: any exact-limb value < 2^256 becomes
+        canonical (< n) when 2n > 2^256 (every curve field); for smaller
+        moduli (BN254 r) the result is merely < 2^256 - n — callers there
+        must tolerate a loose value (MontField.to_rep's REDC does)."""
         d, brw = sub_limbs(a, _col(self.limbs))
         return select(brw == 0, d, a)
 

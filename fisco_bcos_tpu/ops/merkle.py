@@ -150,9 +150,11 @@ def merkle_levels_host(leaves: list[bytes], alg: str = "keccak256") -> list[list
     return levels
 
 
-def merkle_proof(leaves: list[bytes], index: int, alg: str = "keccak256"):
-    """Inclusion proof: list of (siblings_bytes, position) per level."""
-    levels = merkle_levels_host(leaves, alg)
+def proof_from_levels(levels: list[list[bytes]], index: int):
+    """Inclusion proof for leaf `index` out of prebuilt levels — the
+    shared walk for `merkle_proof` and the commit-time batch renderer
+    (zk/proof.py), which builds the levels ONCE per block instead of once
+    per transaction."""
     proof = []
     idx = index
     for level in levels[:-1]:
@@ -164,6 +166,11 @@ def merkle_proof(leaves: list[bytes], index: int, alg: str = "keccak256"):
         proof.append((sibs, idx % WIDTH))
         idx = group
     return proof
+
+
+def merkle_proof(leaves: list[bytes], index: int, alg: str = "keccak256"):
+    """Inclusion proof: list of (siblings_bytes, position) per level."""
+    return proof_from_levels(merkle_levels_host(leaves, alg), index)
 
 
 def verify_merkle_proof(leaf: bytes, proof, root: bytes, alg: str = "keccak256") -> bool:

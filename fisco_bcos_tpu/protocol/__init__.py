@@ -16,6 +16,7 @@ from .types import (
     TransactionStatus,
     batch_hash,
     batch_recover_senders,
+    prefill_hashes,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "TransactionStatus",
     "batch_hash",
     "batch_recover_senders",
+    "prefill_hashes",
 ]

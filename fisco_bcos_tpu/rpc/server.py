@@ -215,6 +215,9 @@ class JsonRpcImpl:
             "getGroupInfo": self.get_group_info,
             "getGroupInfoList": self.get_group_info_list,
             "getGroupNodeInfo": self.get_group_node_info,
+            # ZK proof plane (fisco_bcos_tpu/zk/): verifiable serving
+            "getProof": self.get_proof,
+            "verifyProofs": self.verify_proofs,
             # observability plane (utils/otrace.py + Node.system_status)
             "getTrace": self.get_trace,
             "listTraces": self.list_traces,
@@ -370,9 +373,9 @@ class JsonRpcImpl:
                                "timed out waiting for receipt")
         out = _receipt_json(rc, res.tx_hash)
         if require_proof:
-            proof, root = self.node.ledger.receipt_proof(res.tx_hash)
-            out["receiptProof"] = _proof_json(proof)
-            out["receiptsRoot"] = _hex(root)
+            self._attach_proof(out, res.tx_hash, "receiptProof",
+                               "receiptsRoot",
+                               self.node.ledger.receipt_proof)
         return out
 
     def call(self, group: str, node_name: str = "", to: str = "",
@@ -393,10 +396,24 @@ class JsonRpcImpl:
             return None
         if require_proof:
             out = dict(out)  # cached values are frozen; annotate a copy
-            proof, root = self.node.ledger.tx_proof(h)
-            out["txProof"] = _proof_json(proof)
-            out["txsRoot"] = _hex(root)
+            self._attach_proof(out, h, "txProof", "txsRoot",
+                               self.node.ledger.tx_proof)
         return out
+
+    def _attach_proof(self, out: dict, h: bytes, proof_key: str,
+                      root_key: str, builder) -> None:
+        """Annotate a response with an inclusion proof — or, when the
+        body rows are gone (pruned history; the builders return None
+        instead of tearing), a typed null proof + the prune floor, never
+        a TypeError-shaped internal error."""
+        pr = builder(h)
+        if pr is None:
+            out[proof_key] = None
+            out["prunedBelow"] = self.node.ledger.pruned_below()
+            return
+        proof, root = pr
+        out[proof_key] = _proof_json(proof)
+        out[root_key] = _hex(root)
 
     def _tx_json_cached(self, h: bytes):
         cache = self.cache
@@ -423,9 +440,8 @@ class JsonRpcImpl:
             return None
         if require_proof:
             out = dict(out)  # cached values are frozen; annotate a copy
-            proof, root = self.node.ledger.receipt_proof(h)
-            out["receiptProof"] = _proof_json(proof)
-            out["receiptsRoot"] = _hex(root)
+            self._attach_proof(out, h, "receiptProof", "receiptsRoot",
+                               self.node.ledger.receipt_proof)
         return out
 
     def _receipt_json_cached(self, h: bytes):
@@ -546,8 +562,98 @@ class JsonRpcImpl:
             for rc, tx in zip(block.receipts, block.transactions):
                 h = tx.hash(suite)
                 cache.put(("rc", h), _receipt_json(rc, h), gen)
+            # ZK proof plane: render every tx's getProof bundle (both
+            # trees' levels built once) so proof hits cost zero walks
+            zk = getattr(self.node, "zk", None)
+            if zk is not None and getattr(self.node.config, "zk_proofs",
+                                          True):
+                zk.prime(number, gen, cache)
         except Exception:  # noqa: BLE001 — priming is best-effort
             LOG.exception(badge("RPC", "cache-prime-failed", number=number))
+
+    # -- ZK proof plane ----------------------------------------------------
+    def get_proof(self, group: str, node_name: str = "", tx_hash: str = "",
+                  state_keys=None, number: Optional[int] = None):
+        """Verifiable proof bundle. `tx_hash` -> the tx's inclusion proof
+        under txsRoot + its receipt's under receiptsRoot, served from the
+        commit-time rendered cache (zero tree walks on a hit). Optional
+        `state_keys` = [[table, hex_key], ...] adds changeset-inclusion
+        proofs against block `number`'s (default: head) state_root —
+        proving "block N wrote this key", per the state-root trust model
+        (README "ZK proof plane": the root covers the block's OWN
+        changeset, not cumulative state)."""
+        self._check_group(group)
+        from ..zk import proof as zkproof
+        ledger = self.node.ledger
+        zk = getattr(self.node, "zk", None)
+        out: dict = {}
+        if tx_hash:
+            h = _unhex(tx_hash)
+            cache = self.cache
+            doc = cache.get(("proof", h)) if cache is not None else None
+            hit = doc is not None
+            if doc is None:
+                gen = cache.generation() if cache is not None else None
+                doc = zkproof.render_proof_doc(ledger, h)
+                if doc is not None and cache is not None:
+                    cache.put(("proof", h), doc, gen)
+            if zk is not None:
+                zk.note_proof(hit)
+            if doc is None:
+                # typed not-found; the state section below still serves
+                out["found"] = False
+                out["prunedBelow"] = ledger.pruned_below()
+            else:
+                out.update(doc)
+                out["found"] = True
+        if state_keys:
+            n = int(number) if number is not None \
+                else ledger.current_number()
+            # batched: one index decode + one level build for all keys
+            proofs = ledger.state_proofs(
+                n, [(t, _unhex(k)) for t, k in state_keys])
+            indexed = proofs is not None
+            entries = []
+            for (table, key_hex), sp in zip(
+                    state_keys, proofs or [None] * len(state_keys)):
+                if sp is None:
+                    # `indexed` disambiguates "block N did not write this
+                    # key" (provable absence from the index) from "no
+                    # index exists" (pruned / pre-feature / zk_proofs
+                    # off) — the latter proves NOTHING about the key
+                    entries.append({"table": table, "key": key_hex,
+                                    "present": False,
+                                    "indexed": indexed})
+                    continue
+                proof, root, leaf, idx = sp
+                entries.append({
+                    "table": table, "key": key_hex, "present": True,
+                    "indexed": True,
+                    "leafDigest": _hex(leaf), "leafIndex": idx,
+                    "stateRoot": _hex(root),
+                    "stateProof": zkproof.w16_proof_json(proof)})
+            out["stateBlockNumber"] = n
+            out["stateEntries"] = entries
+        return out
+
+    def verify_proofs(self, group: str, node_name: str = "",
+                      proofs=None):
+        """Batched verification: N width-16 inclusion proofs (each
+        {leaf, proof, root} in getProof's JSON shape) checked with ONE
+        batched hash call through the crypto lane — the server-side
+        counterpart of the light client's span verification, for
+        gateways validating proofs fetched from untrusted archives."""
+        self._check_group(group)
+        from ..zk import proof as zkproof
+        items = [(_unhex(p["leaf"]),
+                  zkproof.w16_proof_from_json(p["proof"]),
+                  _unhex(p["root"])) for p in (proofs or [])]
+        ok = zkproof.verify_inclusion_batch(self.node.suite, items)
+        zk = getattr(self.node, "zk", None)
+        if zk is not None and items:
+            zk.note_verified(len(items), int(ok.sum()))
+        return {"results": [bool(v) for v in ok],
+                "verified": int(ok.sum())}
 
     def get_block_hash_by_number(self, group: str, node_name: str = "",
                                  number: int = 0):

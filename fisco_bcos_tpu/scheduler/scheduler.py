@@ -85,13 +85,19 @@ class Scheduler:
     def __init__(self, storage: TransactionalStorage, ledger: Ledger,
                  executor: TransactionExecutor, suite, txpool=None,
                  pipeline: bool = True, trace_label: str = "",
-                 health=None):
+                 health=None, state_index: bool = True):
         self.storage = storage
         self.ledger = ledger
         self.executor = executor
         self.suite = suite
         self.txpool = txpool
         self.pipeline = pipeline
+        # ZK proof plane: persist each block's state-leaf digest index
+        # (ledger.write_state_index) so getProof can serve changeset-
+        # inclusion proofs anchored at state_root. The digests are a free
+        # by-product of the root computation; the row is derived data the
+        # root never covers, so mixed-setting fleets stay root-compatible.
+        self.state_index = state_index
         # health plane (utils/health.py): commit failures degrade the node
         # (with a self-healing retry probe) instead of being swallowed
         self.health = health
@@ -288,7 +294,15 @@ class Scheduler:
         changes = state.changeset()
         # per-CHANGESET root, deliberately NOT cumulative: identical whether
         # the parent's changeset is durable or still speculative
-        header.state_root = self.executor.state_root(changes)
+        if self.state_index:
+            root, leaf_index = self.executor.state_root_with_leaves(changes)
+            header.state_root = root
+            # staged AFTER the root so the row never feeds its own tree;
+            # re-export picks it up for the same 2PC commit
+            self.ledger.write_state_index(state, header.number, leaf_index)
+            changes = state.changeset()
+        else:
+            header.state_root = self.executor.state_root(changes)
         trace.stage("roots")
         header.gas_used = sum(r.gas_used for r in receipts)
         header.invalidate()

@@ -1,0 +1,23 @@
+"""ZK proof plane — SNARK-friendly hashing + verifiable proof serving.
+
+Three coordinated pieces (ROADMAP item 5; the ZK-hashing papers in
+PAPERS.md — arXiv:2407.03511, 2409.01976 — benchmark Poseidon-class
+hashing as the dominant cost of blockchain proving, exactly the workload
+where the 64k-lane batch advantage applies directly):
+
+  * `poseidon` / `poseidon_jax` — the Poseidon permutation over the BN254
+    scalar field: a host reference pinned against the published
+    poseidonperm_x5_254_3 parameter set, and a vectorized JAX path on the
+    `ops/fp.py` lane-major limb substrate (Pallas-fused multiplies on
+    TPU), bit-identical to the host at every padding bucket.
+  * `merkle` — a binary Poseidon-Merkle tree (batched level hashing,
+    pair-carrying proofs that verify N-at-a-time in ONE batched call).
+  * `proof` — the verifiable-serving glue: block proof bundles rendered
+    once at commit into the RPC QueryCache, flat batched verification of
+    width-16 ledger proofs and Poseidon proofs, and the ZkPlane counters
+    behind `bcos_zk_*` / getSystemStatus.
+"""
+
+from . import merkle, poseidon, poseidon_jax, proof  # noqa: F401
+
+__all__ = ["poseidon", "poseidon_jax", "merkle", "proof"]

@@ -1,0 +1,175 @@
+"""Batched Poseidon on the lane-major limb substrate (ops/fp.py).
+
+The permutation is ~828 BN254 field multiplies; a host loop pays them one
+Python bigint at a time, this path pays them as full-width vector ops over
+a lane-minor batch — the same layout decision that took ECDSA verify to
+95k sigs/s (PERF.md). The field is `fp.MontField(P)`, so every multiply
+dispatches to the Pallas-fused REDC kernel on TPU and the XLA body on
+CPU, bit-identically.
+
+Structure per compiled executable (one per padding bucket):
+
+  * inputs arrive as raw 32-byte big-endian values; `to_rep` maps ANY
+    x < 2^256 to the canonical Montgomery form of x mod P in one REDC —
+    the host reference's `to_field` reduction for free, no Python bigint
+    loop on ingest;
+  * the whole state stays in the Montgomery domain across all 65 rounds
+    (constants and MDS entries are pre-encoded), one `from_rep` at the
+    end converts the digest row back;
+  * rounds run as three `lax.scan`s (4 full / 57 partial / 4 full) over
+    the round-constant arrays, so the trace holds ONE round body per
+    phase instead of 65 unrolled copies — compile time stays flat in
+    R_P.
+
+Bit-identity with `zk.poseidon` at every padding bucket is a pinned test
+(tests/test_zk_poseidon.py); padded lanes run the permutation on zero
+states and are sliced off before returning.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import numpy as np
+
+from ..ops import fp
+from . import poseidon as ref
+
+NLIMBS = fp.NLIMBS
+# padding buckets: one compiled executable each; 128-multiples keep every
+# bucket Pallas-eligible (pallas_fp.pallas_ok) on TPU
+BUCKETS = (128, 512, 4096, 16384, 65536)
+CHUNK = 65536
+
+
+@functools.lru_cache(maxsize=None)
+def field() -> fp.MontField:
+    """The BN254 scalar field on the limb substrate (module-lazy: building
+    it touches no backend; first mul does)."""
+    return fp.MontField(ref.P, "bn254r")
+
+
+@functools.lru_cache(maxsize=None)
+def _consts() -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Montgomery-encoded schedule: (rc_begin [R_f, T, L, 1],
+    rc_partial [R_P, T, L, 1], rc_end [R_f, T, L, 1], mds [T, T, L, 1])."""
+    f = field()
+    rc, mds = ref.params()
+    enc = np.stack([f.encode_int(v) for v in rc]).reshape(
+        ref.R_F + ref.R_P, ref.T, NLIMBS, 1)
+    half = ref.R_F // 2
+    mds_enc = np.stack(
+        [f.encode_int(v) for row in mds for v in row]).reshape(
+        ref.T, ref.T, NLIMBS, 1)
+    return (enc[:half], enc[half:half + ref.R_P], enc[half + ref.R_P:],
+            mds_enc)
+
+
+def _bucket(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    raise AssertionError(f"chunking bounds n <= {CHUNK}, got {n}")
+
+
+def _x5(f: fp.MontField, s):
+    return f.mul(f.sqr(f.sqr(s)), s)
+
+
+def _mds_mul(f: fp.MontField, mds_c, s):
+    """state [T, L, B] -> MDS @ state, rows reduced with exact-limb adds."""
+    import jax.numpy as jnp
+
+    prods = f.mul(mds_c, jnp.broadcast_to(s[None], mds_c.shape[:1] + s.shape))
+    out = prods[:, 0]
+    for j in range(1, ref.T):
+        out = f.add(out, prods[:, j])
+    return out
+
+
+def _permute_mont(states):
+    """Montgomery-domain permutation of [T, NLIMBS, B] (jit per bucket)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = field()
+    rc_begin, rc_partial, rc_end, mds = (jnp.asarray(c) for c in _consts())
+
+    def full_round(s, rc):
+        s = f.add(s, rc)
+        s = _x5(f, s)
+        return _mds_mul(f, mds, s), None
+
+    def partial_round(s, rc):
+        s = f.add(s, rc)
+        s0 = _x5(f, s[0])
+        s = jnp.concatenate([s0[None], s[1:]], axis=0)
+        return _mds_mul(f, mds, s), None
+
+    s, _ = jax.lax.scan(full_round, states, rc_begin)
+    s, _ = jax.lax.scan(partial_round, s, rc_partial)
+    s, _ = jax.lax.scan(full_round, s, rc_end)
+    return s
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_hash2():
+    """[2, NLIMBS, B] raw (non-Montgomery) inputs -> [NLIMBS, B] digest
+    row, everything device-side: to_rep canonicalizes (x mod P included),
+    the capacity row starts at Montgomery zero."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(inputs):
+        f = field()
+        rate = f.to_rep(inputs)  # [2, L, B]
+        cap = jnp.zeros_like(rate[0])[None]
+        out = _permute_mont(jnp.concatenate([cap, rate], axis=0))
+        return f.from_rep(out[0])
+
+    return jax.jit(run)
+
+
+# -- byte <-> limb plumbing (vectorized, no Python bigints) ------------------
+
+_LO_IDX = 31 - 2 * np.arange(NLIMBS)
+_HI_IDX = 30 - 2 * np.arange(NLIMBS)
+
+
+def bytes_to_limbs(vals: Sequence[bytes]) -> np.ndarray:
+    """32-byte big-endian values -> lane-major uint32[NLIMBS, B]."""
+    arr = np.frombuffer(b"".join(vals), dtype=np.uint8).reshape(-1, 32)
+    return ((arr[:, _HI_IDX].astype(np.uint32) << 8)
+            | arr[:, _LO_IDX]).T.copy()
+
+
+def limbs_to_bytes(limbs: np.ndarray) -> list[bytes]:
+    """uint32[NLIMBS, B] -> list of 32-byte big-endian values."""
+    b = limbs.shape[-1]
+    arr = np.zeros((b, 32), np.uint8)
+    arr[:, _LO_IDX] = (limbs & 0xFF).T
+    arr[:, _HI_IDX] = (limbs >> 8).T
+    flat = arr.tobytes()
+    return [flat[i * 32:(i + 1) * 32] for i in range(b)]
+
+
+def hash2_batch(lefts: Sequence[bytes],
+                rights: Sequence[bytes]) -> list[bytes]:
+    """Batched H(l, r) (zk.poseidon.hash2_bytes semantics), padded to the
+    bucket grid, chunked above CHUNK so one compiled executable pipelines
+    arbitrarily large batches."""
+    n = len(lefts)
+    assert len(rights) == n
+    if n == 0:
+        return []
+    out: list[bytes] = []
+    for off in range(0, n, CHUNK):
+        ln = min(CHUNK, n - off)
+        b = _bucket(ln)
+        limbs = np.zeros((2, NLIMBS, b), np.uint32)
+        limbs[0, :, :ln] = bytes_to_limbs(lefts[off:off + ln])
+        limbs[1, :, :ln] = bytes_to_limbs(rights[off:off + ln])
+        digest = np.asarray(_jitted_hash2()(limbs))
+        out.extend(limbs_to_bytes(digest[:, :ln]))
+    return out

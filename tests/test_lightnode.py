@@ -84,3 +84,219 @@ def test_lightnode_rejects_bad_quorum():
     finally:
         node.stop()
         gw.stop()
+
+
+class _CountingSuite:
+    """Delegating wrapper counting the batch crypto entry points — the
+    instrument behind the span-verification call-count contract."""
+
+    def __init__(self, suite):
+        self._suite = suite
+        self.verify_calls = 0
+        self.hash_calls = 0
+        self.verify_sizes = []
+
+    def __getattr__(self, name):
+        return getattr(self._suite, name)
+
+    def verify_batch(self, digests, sigs, pubs):
+        self.verify_calls += 1
+        self.verify_sizes.append(len(digests))
+        return self._suite.verify_batch(digests, sigs, pubs)
+
+    def hash_batch(self, msgs):
+        self.hash_calls += 1
+        return self._suite.hash_batch(msgs)
+
+
+def _commit_block(node, kp, tag, n=4):
+    """One batch-submitted cohort -> at least one multi-tx block; returns
+    the tx hashes."""
+    txs = [Transaction(to=pc.BALANCE_ADDRESS,
+                       input=pc.encode_call(
+                           "register",
+                           lambda w, i=i: w.blob(b"%s%d" % (tag, i)).u64(1)),
+                       nonce=f"{tag.decode()}-{i}",
+                       block_limit=node.ledger.current_number() + 100
+                       ).sign(node.suite, kp) for i in range(n)]
+    for res in node.txpool.submit_batch(txs):
+        assert int(res.status) == 0, res
+    hashes = [tx.hash(node.suite) for tx in txs]
+    for h in hashes:
+        assert node.txpool.wait_for_receipt(h, 20) is not None
+    return hashes
+
+
+def test_lightnode_span_verification_call_counts():
+    """The ZK-plane contract: a whole request span verifies with ONE
+    verify_batch (every header's full seal set) and bounded hash batches
+    (one for payload identity, one for every proof level of every item)."""
+    from fisco_bcos_tpu.lightnode import LightNodeClient
+
+    gw, node, _ = _setup()
+    try:
+        kp = node.suite.generate_keypair(b"light-span")
+        hashes = _commit_block(node, kp, b"sp", n=4)
+        for i in range(2):  # a few more single-tx blocks for the range
+            tx = Transaction(to=pc.BALANCE_ADDRESS,
+                             input=pc.encode_call(
+                                 "register",
+                                 lambda w, i=i: w.blob(b"sr%d" % i).u64(1)),
+                             nonce=f"sr-{i}",
+                             block_limit=node.ledger.current_number() + 100
+                             ).sign(node.suite, kp)
+            node.send_transaction(tx)
+            assert node.txpool.wait_for_receipt(
+                tx.hash(node.suite), 20) is not None
+        head = node.ledger.current_number()
+        counting = _CountingSuite(node.suite)
+        lfront = FrontService(b"C" * 32, gw)
+        sealers = [n.node_id
+                   for n in node.ledger.ledger_config().consensus_nodes]
+        client = LightNodeClient(lfront, counting, sealers)
+
+        headers = client.header_range(1, head)
+        assert all(h is not None for h in headers)
+        assert counting.verify_calls == 1, counting.verify_calls
+        assert counting.verify_sizes[0] >= head  # every seal, one call
+
+        counting.verify_calls = 0
+        counting.hash_calls = 0
+        counting.verify_sizes = []
+        got = client.transactions(hashes)
+        assert all(tx is not None for tx in got)
+        assert [t.nonce for t in got] == [f"sp-{i}" for i in range(4)]
+        # one header-quorum batch + exactly three hash batches (payload
+        # identity, header-hash prefill, proof levels) for the whole
+        # 4-tx span — constant in span size
+        assert counting.verify_calls == 1, counting.verify_calls
+        assert counting.hash_calls == 3, counting.hash_calls
+
+        counting.hash_calls = 0
+        counting.verify_calls = 0
+        rcs = client.receipts(hashes)
+        assert all(rc is not None for rc in rcs)
+        # receipts pay one extra hash batch over transactions(): receipt
+        # prefill + tx identity + header prefill + the COMBINED
+        # receipt/tx proof batch (the tx proofs ride along to bind each
+        # receipt to its tx's tree index)
+        assert counting.verify_calls == 1 and counting.hash_calls == 4
+    finally:
+        node.stop()
+        gw.stop()
+
+
+def test_lightnode_rejects_tampered_proof_root():
+    """A peer serving a proof whose root does not match the quorum-sealed
+    header is rejected in the span path."""
+    gw, node, client = _setup()
+    try:
+        kp = node.suite.generate_keypair(b"light-tamper")
+        hashes = _commit_block(node, kp, b"tp", n=3)
+        got = client.transactions(hashes)
+        assert all(tx is not None for tx in got)
+        # forge the server's root at the level-build seam
+        orig = node.lightnode_server._block_levels
+
+        def lying(memo, number, want_tx):
+            ctx = orig(memo, number, want_tx)
+            if ctx is None:
+                return None
+            return (ctx[0], ctx[1], b"\x13" * 32)
+        node.lightnode_server._block_levels = lying
+        got = client.transactions(hashes)
+        assert all(tx is None for tx in got)
+    finally:
+        node.stop()
+        gw.stop()
+
+
+def test_lightnode_pruned_history_is_typed():
+    """Body/proof requests against pruned history answer RESP_PRUNED +
+    floor — a typed Pruned result, never a decode failure (regression:
+    receipt_proof used to raise mid-encode when T_NUM2TXS was swept)."""
+    from fisco_bcos_tpu.ledger.ledger import T_NUM2TXS
+    from fisco_bcos_tpu.lightnode import Pruned
+
+    gw, node, client = _setup()
+    try:
+        kp = node.suite.generate_keypair(b"light-prune")
+        old = _commit_block(node, kp, b"pr", n=2)
+        new = _commit_block(node, kp, b"pn", n=2)
+        cut = node.ledger.receipt(new[0]).block_number
+        node.ledger.prune_block_data(cut, keep_nonces=0)
+        assert node.ledger.pruned_below() == cut
+
+        got = client.transactions(old)
+        assert all(isinstance(e, Pruned) and e.below == cut for e in got), got
+        rcs = client.receipts(old)
+        assert all(isinstance(e, Pruned) and e.below == cut for e in rcs)
+        # headers below the floor still serve and verify (they survive)
+        assert client.header(1) is not None
+        # recent history still fully verifiable
+        assert client.transaction(new[0]) is not None
+
+        # crash-window tear: body list swept, receipt row lingering —
+        # the server answers typed instead of raising mid-encode
+        num = node.ledger.receipt(new[0]).block_number
+        node.storage.remove_batch(T_NUM2TXS, [num.to_bytes(8, "big")])
+        got = client.receipts([new[0]])
+        assert isinstance(got[0], Pruned), got
+    finally:
+        node.stop()
+        gw.stop()
+
+
+def test_lightnode_quorum_counts_distinct_sealers():
+    """Review fix: one compromised sealer's valid seal repeated 2f+1
+    times must NOT authenticate a header — quorum counts DISTINCT sealer
+    indices."""
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.lightnode import LightNodeClient
+    from fisco_bcos_tpu.protocol import BlockHeader
+
+    suite = make_suite(backend="host")
+    kps = [suite.generate_keypair(b"q%d" % i) for i in range(4)]
+    sealers = [kp.pub_bytes for kp in kps]
+    client = LightNodeClient(front=None, suite=suite,
+                             consensus_nodes=sealers)
+    assert client.quorum == 3
+    header = BlockHeader(number=7, extra_data=b"forged")
+    hh = header.hash(suite)
+    # sealer 0 compromised: its one valid seal replayed under every index
+    # slot it controls (same idx repeated)
+    idx0 = client.sealers.index(kps[0].pub_bytes)
+    seal0 = suite.sign(kps[0], hh)
+    header.signature_list = [(idx0, seal0)] * 3
+    assert not client.verify_header(header)
+    # the honest shape — three distinct sealers — still verifies
+    header.signature_list = [
+        (client.sealers.index(kp.pub_bytes), suite.sign(kp, hh))
+        for kp in kps[:3]]
+    assert client.verify_header(header)
+
+
+def test_lightnode_rejects_garbage_responses():
+    """Untrusted peer bytes: truncated/garbage responses reject whole
+    (per-request None results), never raise out of the client."""
+    gw, node, client = _setup()
+    try:
+        kp = node.suite.generate_keypair(b"light-garb")
+        hashes = _commit_block(node, kp, b"gb", n=2)
+        assert client.transaction(hashes[0]) is not None  # sane baseline
+
+        def garbage(module, peer, payload, timeout=5.0):
+            return b"\xff\xff\xff\xff\x00\x01garbage"
+        orig = client.front.request
+        client.front.request = garbage
+        try:
+            assert client.transactions(hashes) == [None, None]
+            assert client.receipts(hashes) == [None, None]
+            assert client.header_range(1, 2) == [None, None]
+            assert client.header(1) is None
+        finally:
+            client.front.request = orig
+        assert client.transaction(hashes[0]) is not None  # recovered
+    finally:
+        node.stop()
+        gw.stop()
