@@ -734,7 +734,29 @@ def main() -> None:
             print(f"[bench] storage bench failed: "
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
+        try:
+            # host-weather stamp (analysis/hostweather.py): PSI, steal,
+            # spin-calibration — the co-tenant context this line was
+            # measured under, consumed by tools/perf_gate.py's bands
+            from fisco_bcos_tpu.analysis import hostweather
+            line["host_weather"] = hostweather.sample()
+        except Exception:  # noqa: BLE001 — stamp must never kill the line
+            pass
         print(json.dumps(line), flush=True)
+        try:
+            # perf gate, report-only (tools/perf_gate.py): compare this
+            # line against BENCH_LAST_GOOD + the recorded trajectory with
+            # noise-derived bands; the report goes to stderr so the stdout
+            # contract (one JSON line) is untouched. PERF_GATE=0 skips.
+            import subprocess as _sp
+            if os.environ.get("PERF_GATE", "1") != "0":
+                _sp.run([sys.executable,
+                         os.path.join(_REPO, "tools", "perf_gate.py"),
+                         "--candidate", "-", "--report-only"],
+                        input=json.dumps(line), text=True, timeout=120,
+                        stdout=sys.stderr, stderr=sys.stderr)
+        except Exception:  # noqa: BLE001 — advisory only
+            pass
     except Exception as exc:  # always emit a parseable line
         print(json.dumps({
             "metric": "secp256k1_batch_verify",

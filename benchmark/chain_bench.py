@@ -51,6 +51,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _SIGN_CHUNK = 250
 
+# host-weather stamping (analysis/hostweather.py): every emitted bench row
+# carries the PSI/steal/spin-score stamp it was measured under, so the
+# perf gate (tools/perf_gate.py) can widen its bands on a noisy host and
+# the documented 1.45-1.6x run-to-run swings become explainable. Sampled
+# once per emission wave (a ~50 ms spin probe must not run between timed
+# windows more than it has to) and refreshed if older than 60 s.
+_WEATHER: dict | None = None
+_WEATHER_AT = 0.0
+
+
+def _weather() -> dict:
+    global _WEATHER, _WEATHER_AT
+    now = time.monotonic()
+    if _WEATHER is None or now - _WEATHER_AT > 60.0:
+        from fisco_bcos_tpu.analysis import hostweather
+        _WEATHER = hostweather.sample()
+        _WEATHER_AT = now
+    return _WEATHER
+
+
+def _dumps(row) -> str:
+    """json.dumps for bench rows, stamping host weather on each."""
+    if isinstance(row, dict) and "metric" in row:
+        row.setdefault("host_weather", _weather())
+    return json.dumps(row)
+
 
 def _sign_chunk(args) -> list[bytes]:
     """Worker: sign a chunk of register txs (picklable, re-imports)."""
@@ -869,7 +895,7 @@ def _emit_groups_mode(args, sm: bool) -> None:
             res.update({"metric": f"{name}_tps{suffix}",
                         "value": res["tps"], "unit": "tx/sec", "run": rep})
             rows[name].append(res)
-            print(json.dumps(res), flush=True)
+            print(_dumps(res), flush=True)
 
     def median_tps(name: str) -> float:
         vals = sorted(r["tps"] for r in rows[name])
@@ -885,7 +911,7 @@ def _emit_groups_mode(args, sm: bool) -> None:
                       if r["lane_mean_device_batch"]]
         lane_mean = (sorted(lane_means)[len(lane_means) // 2]
                      if lane_means else 0.0)
-        print(json.dumps({
+        print(_dumps({
             "metric": f"groups_scaling{suffix}", "unit": "x",
             "value": round(multi_med / max(base_med, 0.001), 2),
             "groups": multi["groups"], "runs": reps,
@@ -920,13 +946,13 @@ def _emit_rpc_mode(args, sm: bool) -> None:
         res.update({"metric": f"{name}_tps{suffix}", "value": res["tps"],
                     "unit": "tx/sec"})
         rows[name] = res
-        print(json.dumps(res), flush=True)
+        print(_dumps(res), flush=True)
     if args.rpc_compare:
         base, lane_row = rows["rpc_ingest_baseline"], rows["rpc_ingest"]
         amort = (base["recover_calls_per_tx"] /
                  lane_row["recover_calls_per_tx"]) \
             if lane_row["recover_calls_per_tx"] else float("inf")
-        print(json.dumps({
+        print(_dumps({
             "metric": "rpc_ingest_amortization", "unit": "x",
             "value": round(amort, 1),
             "verify_calls_per_tx_baseline": base["recover_calls_per_tx"],
@@ -949,16 +975,16 @@ def _emit_read_mode(args, sm: bool) -> None:
         base.update({"metric": f"rpc_read_baseline_qps{suffix}",
                      "value": base["qps"], "unit": "req/sec"})
         rows["base"] = base
-        print(json.dumps(base), flush=True)
+        print(_dumps(base), flush=True)
     res = run_rpc_read(sm, args.backend, args.read_clients,
                        args.read_requests)
     res.update({"metric": f"rpc_read_qps{suffix}", "value": res["qps"],
                 "unit": "req/sec"})
     rows["read"] = res
-    print(json.dumps(res), flush=True)
+    print(_dumps(res), flush=True)
     if args.read_compare:
         base = rows["base"]
-        print(json.dumps({
+        print(_dumps({
             "metric": f"rpc_read_speedup{suffix}", "unit": "x",
             "value": round(res["qps"] / max(base["qps"], 0.001), 2),
             "qps_baseline": base["qps"], "qps": res["qps"],
@@ -1574,6 +1600,167 @@ def run_lockcheck_ab(sm: bool, n: int, backend: str, tx_count_limit: int,
     }
 
 
+def run_profile_attrib(sm: bool, backend: str, n: int = 1500,
+                       tx_count_limit: int = 1000, reps: int = 2) -> list:
+    """GIL-holder attribution + profiler self-cost on the direct solo
+    ingest path — the instrument for PERF r10's ~0.19 ms-GIL-per-tx
+    ceiling (ROADMAP item 1 needs the FUNCTION names, not the total).
+
+    Two measurements, one invocation:
+
+      1. attribution run: solo chain, profiler armed at a high-resolution
+         hz, `n` txs submitted direct (txpool.submit_batch). Process CPU
+         is measured independently via getrusage; the profiler must
+         attribute >= 80% of it to named functions/stages or the summary
+         row says so. Emits the top-GIL-holders table per stage.
+      2. interleaved A/B: the ALWAYS-ON default hz vs disarmed (no
+         sampler thread), `reps` runs each, fresh chain per run, medians
+         — the < 3% self-overhead acceptance row.
+    """
+    import resource
+
+    from fisco_bcos_tpu.analysis import profiler as prof
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol import Transaction
+
+    blocks_needed = -(-n // max(1, tx_count_limit))
+    block_limit = min(600, max(100, 2 * blocks_needed + 20))
+    print(f"signing {n} txs (excluded from every timed window)...",
+          file=sys.stderr, flush=True)
+    wire_txs = _build_workload(sm, n, block_limit=block_limit,
+                               prefix="pa")
+
+    def solo_run(profile_hz: float) -> tuple[float, int]:
+        """One fresh solo chain, direct-ingest `n` txs -> (tps, committed).
+        The profiler state is whatever `profile_hz` arms (0 = disarmed,
+        no sampler thread — the plane-absent anchor)."""
+        node = Node(NodeConfig(
+            consensus="solo", sm_crypto=sm, crypto_backend=backend,
+            min_seal_time=0.0, tx_count_limit=tx_count_limit,
+            trace_sample_rate=0.0, trace_slow_ms=0.0,
+            profile_hz=profile_hz, profile_burst_hz=0.0))
+        txs = [Transaction.decode(raw) for raw in wire_txs]
+        node.start()
+        try:
+            t0 = time.perf_counter()
+            for s in range(0, len(txs), 512):
+                node.txpool.submit_batch(txs[s:s + 512])
+            deadline = time.monotonic() + max(120.0, n / 25)
+            while time.monotonic() < deadline:
+                if node.ledger.total_tx_count() >= n:
+                    break
+                time.sleep(0.02)
+            t1 = time.perf_counter()
+            committed = node.ledger.total_tx_count()
+        finally:
+            node.stop()
+        return committed / max(1e-9, t1 - t0), committed
+
+    rows = []
+    suite_name = "sm" if sm else "ecdsa"
+
+    # -- 1) attribution run (high-res sampling + independent CPU meter) ----
+    node = Node(NodeConfig(
+        consensus="solo", sm_crypto=sm, crypto_backend=backend,
+        min_seal_time=0.0, tx_count_limit=tx_count_limit,
+        trace_sample_rate=0.0, trace_slow_ms=0.0,
+        profile_hz=53.0, profile_ring=4096, profile_burst_hz=0.0))
+    txs = [Transaction.decode(raw) for raw in wire_txs]
+    node.start()
+    try:
+        prof.PROFILER.reset()
+        ru0 = resource.getrusage(resource.RUSAGE_SELF)
+        t0 = time.perf_counter()
+        for s in range(0, len(txs), 512):
+            node.txpool.submit_batch(txs[s:s + 512])
+        deadline = time.monotonic() + max(120.0, n / 25)
+        while time.monotonic() < deadline:
+            if node.ledger.total_tx_count() >= n:
+                break
+            time.sleep(0.02)
+        t1 = time.perf_counter()
+        ru1 = resource.getrusage(resource.RUSAGE_SELF)
+        committed = node.ledger.total_tx_count()
+        attrib = prof.PROFILER.attribution()
+    finally:
+        node.stop()
+    # measured GIL-held CPU: whole-process rusage over the window, minus
+    # the sampler's own measured burn (it is overhead, not workload)
+    cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
+    workload_cpu = max(1e-9, cpu_s - attrib["profiler_cpu_seconds"])
+    attributed = attrib["attributed_cpu_seconds"]
+    for r in attrib["rows"][:12]:
+        rows.append({
+            "metric": "profile_attrib", "unit": "ms/tx",
+            "suite": suite_name,
+            "role": r["role"], "stage": r["stage"], "func": r["func"],
+            "cpu_ms_per_tx": round(1000.0 * r["cpu_seconds"]
+                                   / max(1, committed), 4),
+            "cpu_share_pct": round(100.0 * r["cpu_seconds"]
+                                   / workload_cpu, 1),
+        })
+    rows.append({
+        "metric": "profile_attrib_summary", "unit": "ms/tx",
+        "suite": suite_name, "txs": int(committed),
+        "tps": round(committed / max(1e-9, t1 - t0), 1),
+        "gil_ms_per_tx": round(1000.0 * workload_cpu
+                               / max(1, committed), 4),
+        "attributed_ms_per_tx": round(1000.0 * attributed
+                                      / max(1, committed), 4),
+        # the >= 80% acceptance number: named-function coverage of the
+        # measured per-tx CPU (independent meters — rusage vs /proc scan)
+        "attributed_pct": round(100.0 * attributed / workload_cpu, 1),
+        "profiler_cpu_seconds": attrib["profiler_cpu_seconds"],
+        "samples": attrib["samples"],
+        "by_stage_ms_per_tx": {
+            k: round(1000.0 * v / max(1, committed), 4)
+            for k, v in list(attrib["by_stage"].items())[:8]},
+    })
+
+    # -- 2) interleaved A/B: always-on default hz vs no sampler thread -----
+    import gc
+
+    results: dict[str, list[float]] = {"armed": [], "disarmed": []}
+    ratios: list[float] = []
+    solo_run(0.0)  # warm-up, discarded (compile/alloc noise lands on
+    #                neither side)
+    for rep in range(reps):
+        # alternate which side goes first, and compare WITHIN each rep
+        # pair: the documented run-to-run drift on this host (PERF r10's
+        # 1.45x swings, plus monotonic allocator growth inside one
+        # process) is far larger than the effect under test, so the
+        # honest statistic is the median of adjacent-pair ratios, not a
+        # ratio of cross-run medians
+        order = ("armed", "disarmed") if rep % 2 == 0 \
+            else ("disarmed", "armed")
+        pair = {}
+        for mode in order:
+            gc.collect()
+            tps, _ = solo_run(5.0 if mode == "armed" else 0.0)
+            results[mode].append(tps)
+            pair[mode] = tps
+        ratios.append(pair["armed"] / max(pair["disarmed"], 0.001))
+
+    def med(vals):
+        # true median: an upper-element pick on even run counts would
+        # systematically report the more favorable pair ratio
+        return statistics.median(vals) if vals else 0.0
+
+    value = med(ratios)
+    rows.append({
+        "metric": "profiler_overhead_ab", "unit": "x",
+        "suite": suite_name, "value": round(value, 3),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "tps_armed_median": round(med(results["armed"]), 1),
+        "tps_disarmed_median": round(med(results["disarmed"]), 1),
+        "tps_armed_runs": [round(v, 1) for v in results["armed"]],
+        "tps_disarmed_runs": [round(v, 1) for v in results["disarmed"]],
+        "overhead_pct": round((1.0 - value) * 100, 2),
+        "hz": 5.0, "runs": reps,
+    })
+    return rows
+
+
 def run_overload_fairness(sm: bool, backend: str, tx_count_limit: int,
                           capacity: float, fairness_s: float) -> dict:
     """Aggressor vs polite through the REAL RPC edge with per-client
@@ -1765,13 +1952,13 @@ def _emit_overload_mode(args, sm: bool) -> None:
                                args.overload_window)
     capacity = rows[0]["capacity_tps"]
     for row in rows:
-        print(json.dumps(row), flush=True)
+        print(_dumps(row), flush=True)
     ab = run_overload_ab(sm, args.backend, args.tx_count_limit, capacity,
                          args.overload_window, args.overload_ab_runs)
-    print(json.dumps(ab), flush=True)
+    print(_dumps(ab), flush=True)
     fair = run_overload_fairness(sm, args.backend, args.tx_count_limit,
                                  capacity, args.overload_fairness_s)
-    print(json.dumps(fair), flush=True)
+    print(_dumps(fair), flush=True)
 
 
 def run_storage_child(backend: str, n: int, tx_count_limit: int,
@@ -1873,16 +2060,16 @@ def _emit_storage_compare(args) -> None:
             if ln.startswith("{"):
                 row = json.loads(ln)
         if row is None:
-            print(json.dumps({"metric": "storage_backend_run",
+            print(_dumps({"metric": "storage_backend_run",
                               "backend": backend, "error":
                               f"child rc={r.returncode}"}), flush=True)
             continue
         rows[backend] = row
-        print(json.dumps(row), flush=True)
+        print(_dumps(row), flush=True)
     disk, mem = rows.get("disk"), rows.get("memory")
     wal = rows.get("wal")
     if disk and mem:
-        print(json.dumps({
+        print(_dumps({
             "metric": "storage_compare", "value": disk["tps"],
             "unit": "tx/sec", "n": args.n,
             "memtable_mb": args.storage_memtable_mb,
@@ -1985,6 +2172,15 @@ def main() -> None:
                          "reconciliation against measured e2e p50")
     ap.add_argument("--trace-txs", type=int, default=24,
                     help="with --trace-profile: closed-loop tx count")
+    ap.add_argument("--profile-attrib", action="store_true",
+                    help="GIL-holder attribution on the direct solo "
+                         "ingest path (top functions per stage vs an "
+                         "independent rusage CPU meter) plus the "
+                         "armed-vs-disarmed profiler self-cost A/B "
+                         "(analysis/profiler.py)")
+    ap.add_argument("--profile-runs", type=int, default=2, metavar="R",
+                    help="with --profile-attrib: interleaved A/B "
+                         "repetitions per side (default 2)")
     ap.add_argument("--lockcheck-ab", action="store_true",
                     help="lockcheck-cost mode: interleaved direct-ingest "
                          "runs with the disarmed blocking markers live vs "
@@ -2004,7 +2200,7 @@ def main() -> None:
     suites = [False, True] if args.suite == "both" else \
         [args.suite == "sm"]
     if args.storage_child:
-        print(json.dumps(run_storage_child(
+        print(_dumps(run_storage_child(
             args.storage_child, args.n, args.tx_count_limit,
             args.storage_memtable_mb)), flush=True)
         return
@@ -2014,7 +2210,7 @@ def main() -> None:
     if args.sync_bench:
         for sm in suites:
             for row in run_sync_bench(sm, args.sync_blocks):
-                print(json.dumps(row), flush=True)
+                print(_dumps(row), flush=True)
         return
     if args.overload:
         for sm in suites:
@@ -2023,16 +2219,23 @@ def main() -> None:
     if args.trace_profile:
         for sm in suites:
             for row in run_trace_profile(sm, args.backend, args.trace_txs):
-                print(json.dumps(row), flush=True)
+                print(_dumps(row), flush=True)
+        return
+    if args.profile_attrib:
+        for sm in suites:
+            for row in run_profile_attrib(sm, args.backend, args.n,
+                                          args.tx_count_limit,
+                                          args.profile_runs):
+                print(_dumps(row), flush=True)
         return
     if args.proof_bench:
         for sm in suites:
             for row in run_proof_bench(sm, args.backend, args.proof_txs):
-                print(json.dumps(row), flush=True)
+                print(_dumps(row), flush=True)
         return
     if args.lockcheck_ab:
         for sm in suites:
-            print(json.dumps(run_lockcheck_ab(
+            print(_dumps(run_lockcheck_ab(
                 sm, args.n, args.backend, args.tx_count_limit,
                 args.lockcheck_runs)), flush=True)
         return
@@ -2059,9 +2262,9 @@ def main() -> None:
         pstats = res.pop("pipeline_stats", None)
         res.update({"metric": f"chain_tps_4node_{res['suite']}" + suffix,
                     "value": res["tps"], "unit": "tx/sec"})
-        print(json.dumps(res), flush=True)
+        print(_dumps(res), flush=True)
         if args.pipeline_profile:
-            print(json.dumps({
+            print(_dumps({
                 "metric": "pipeline_tps", "value": res["tps"],
                 "unit": "tx/sec", "suite": res["suite"],
                 "pipeline": res["pipeline"], "blocks": res["blocks"],
@@ -2070,7 +2273,7 @@ def main() -> None:
             }), flush=True)
             wall = max(res["wall_seconds"], 1e-9)
             stages = (pstats or {}).get("stages", {})
-            print(json.dumps({
+            print(_dumps({
                 "metric": "pipeline_profile", "unit": "occupancy",
                 "suite": res["suite"], "pipeline": res["pipeline"],
                 "wall_seconds": res["wall_seconds"],

@@ -4,6 +4,14 @@
 Isolates the per-stage host cost of one N-tx block on ONE node (no
 consensus, no gossip) so the chain-TPS work targets the real hot spots.
 Run with --profile to get a cProfile breakdown of the execute+commit path.
+
+NOTE: cProfile instruments every call (10-30% distortion) and needs a
+dev checkout. For the question "which functions hold the GIL on a LIVE
+chain" use the always-on sampling plane instead: `chain_bench
+--profile-attrib` (per-function CPU vs an independent rusage meter) or
+`GET /profile?fmt=flame` on any running node (analysis/profiler.py).
+This script stays for micro-level call-graph drilling where call counts
+matter more than wall fidelity.
 """
 
 from __future__ import annotations

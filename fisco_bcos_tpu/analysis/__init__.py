@@ -22,6 +22,21 @@ and the PR-12 admission-LRU self-eviction, made permanent and automatic):
 
 The canonical lock-ordering declarations both layers check against live
 in `analysis/lockorder.py`.
+
+The package also hosts the **continuous-profiling plane** (ISSUE 15):
+
+  * **profiler** — always-on low-hz sampling profiler (folded stacks by
+    thread role + pipeline stage, per-thread GIL-held CPU attribution
+    from /proc, slow-span burst captures linked to trace ids, the
+    zero-dependency flamegraph renderer behind `GET /profile`);
+  * **hostweather** — the PSI/steal/spin-score stamp every bench row
+    carries, consumed by `tools/perf_gate.py`'s noise-aware bands.
+
+Both are imported lazily by their call sites (Node construction, the
+ops routes, chain_bench) via `from ..analysis import profiler` — not
+eagerly here, so `import analysis` keeps zero side effects for the
+lint/lockcheck consumers; they are intentionally absent from __all__
+for the same reason.
 """
 
 from . import lockcheck, lockorder  # noqa: F401

@@ -1,0 +1,129 @@
+"""hostweather — name the co-tenant noise a bench row was measured under.
+
+PERF rounds 9/10/13 document the problem this solves: A/B medians on the
+CI host flip sign inside a 1.45–1.6x run-to-run swing while /proc/loadavg
+reads 0.00 — the load average can't see co-tenant VMs stealing the core or
+cgroup throttling. Every bench row therefore records a *weather stamp*:
+
+  * `/proc/pressure/cpu` (PSI) — some-avg10/avg60: the kernel's own
+    "tasks waited for CPU" signal, visible even when loadavg is 0;
+  * steal time share from `/proc/stat` — hypervisor co-tenancy, the
+    signal for "another VM has the core";
+  * a ~50 ms spin-calibration micro-score — how many iterations of a
+    fixed arithmetic loop THIS moment actually buys, the direct "how fast
+    is the machine right now" probe that needs no kernel support;
+  * loadavg + core count for context.
+
+tools/perf_gate.py widens its tolerance bands when the candidate's stamp
+(or the gate's own fresh sample) says the host is noisy, so a regression
+verdict never rests on weather the row itself disclosed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def _read_psi_cpu() -> dict | None:
+    """{'avg10': float, 'avg60': float} from /proc/pressure/cpu (the
+    `some` line), or None where PSI is unavailable."""
+    try:
+        with open("/proc/pressure/cpu") as f:
+            for line in f:
+                if line.startswith("some"):
+                    fields = dict(kv.split("=") for kv in line.split()[1:])
+                    return {"avg10": float(fields.get("avg10", 0.0)),
+                            "avg60": float(fields.get("avg60", 0.0))}
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _read_cpu_line() -> tuple[int, int] | None:
+    """(steal_ticks, total_ticks) from /proc/stat's aggregate cpu line."""
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()
+        if parts[0] != "cpu":
+            return None
+        vals = [int(v) for v in parts[1:]]
+        return (vals[7] if len(vals) > 7 else 0), sum(vals)
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _steal_pct_over(before: tuple[int, int] | None,
+                    after: tuple[int, int] | None) -> float | None:
+    """Steal share (%) over the [before, after] interval. A since-boot
+    ratio would be useless here: on a host up for weeks, a co-tenant
+    stealing half the core for the whole bench run moves the cumulative
+    share by thousandths of a percent — only the live interval names
+    the weather the row was measured under."""
+    if not before or not after:
+        return None
+    d_total = after[1] - before[1]
+    if d_total <= 0:
+        return None
+    return round(100.0 * (after[0] - before[0]) / d_total, 3)
+
+
+def spin_score(ms: float = 50.0) -> int:
+    """Iterations of a fixed integer loop completed in ~`ms` of wall time.
+    Deliberately GIL-held pure Python: it measures exactly the resource
+    the chain's per-tx hot path competes for. Compare scores ACROSS runs
+    on the same host — a 1.5x lower score explains a 1.5x slower median."""
+    deadline = time.perf_counter() + ms / 1000.0
+    x, n = 1, 0
+    while time.perf_counter() < deadline:
+        # fixed chunk per clock check so the loop body, not the clock,
+        # dominates what is measured
+        for _ in range(1000):
+            x = (x * 1103515245 + 12345) & 0x7FFFFFFF
+        n += 1000
+    return n
+
+
+def sample(spin_ms: float = 50.0) -> dict:
+    """One weather stamp. ~spin_ms wall cost — call once per bench row,
+    never on a hot path. Steal is measured over the spin window itself
+    (the only interval this function owns), not since boot."""
+    try:
+        la1, la5, _ = os.getloadavg()
+    except (AttributeError, OSError):
+        la1 = la5 = None
+    before = _read_cpu_line()
+    spin = spin_score(spin_ms)
+    return {
+        "psi_cpu": _read_psi_cpu(),
+        "steal_pct": _steal_pct_over(before, _read_cpu_line()),
+        "spin_score": spin,
+        "loadavg_1m": round(la1, 2) if la1 is not None else None,
+        "loadavg_5m": round(la5, 2) if la5 is not None else None,
+        "cores": os.cpu_count(),
+        "sampled_at": round(time.time(), 1),
+    }
+
+
+def noisy(stamp: dict | None,
+          reference_spin: int | None = None) -> tuple[bool, str]:
+    """(is_noisy, why) — the perf gate's band-widening predicate.
+
+    Deliberately NOT based on PSI: a saturating bench elevates
+    /proc/pressure/cpu with its own load (on the 1-core CI host the
+    attribution run alone pushes some-avg10 past 20), so a PSI
+    threshold would widen the bands on every honest run. The stamp
+    keeps PSI for the human reading the row; the predicate uses the
+    two signals our own single process cannot fake: hypervisor steal
+    over the spin window, and the spin score itself (`reference_spin`
+    is the best score on record for this host — a live score under 80%
+    of it means the core is partly elsewhere)."""
+    if not stamp:
+        return False, ""
+    steal = stamp.get("steal_pct")
+    if steal is not None and steal > 1.0:
+        return True, f"steal={steal}%"
+    spin = stamp.get("spin_score")
+    if reference_spin and spin and spin < 0.8 * reference_spin:
+        return True, f"spin_score {spin} < 80% of {reference_spin}"
+    return False, ""

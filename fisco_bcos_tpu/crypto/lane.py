@@ -115,6 +115,11 @@ class CryptoLane:
         self._tag_requests: dict[str, int] = {}
         self._op_calls: dict[str, int] = {}
         self._op_items: dict[str, int] = {}
+        # occupancy telemetry (ISSUE 15): padding-bucket fill/waste, merge
+        # occupancy and dispatch timing per op — the evidence base for the
+        # 64k-lane batch advantage claims, served via stats()["occupancy"]
+        # (getSystemStatus) and the bcos_lane_* metric series
+        self._occ: dict[str, dict] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -247,6 +252,7 @@ class CryptoLane:
 
     def _dispatch(self, batch: list[_Req]) -> None:
         op = batch[0].op
+        t0 = time.perf_counter()
         try:
             fp.fire("crypto.lane.dispatch")
             if op == "verify":
@@ -263,7 +269,24 @@ class CryptoLane:
             for r in batch:
                 r.task.reject(exc)
             return
+        dt = time.perf_counter() - t0
         n_items = sum(r.n for r in batch)
+        # padding-bucket fill/waste: the device path pads row-bucketed ops
+        # up to the next compiled bucket (suite._bucket_for); the padded
+        # rows are pure waste the merged batch must amortise — the series
+        # operators watch to judge whether traffic fills the 64k lanes
+        fill, waste = None, None
+        if op in ("verify", "recover"):
+            use_device = getattr(self.suite, "_use_device", None)
+            bucket_for = getattr(self.suite, "_bucket_for", None)
+            if use_device is not None and bucket_for is not None \
+                    and use_device(n_items):
+                try:
+                    bucket = max(1, int(bucket_for(n_items)))
+                    fill = n_items / bucket
+                    waste = max(0, bucket - n_items)
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         with self._cv:
             self._device_calls += 1
             self._device_items += n_items
@@ -271,11 +294,37 @@ class CryptoLane:
                 self._merged_calls += 1
             self._op_calls[op] = self._op_calls.get(op, 0) + 1
             self._op_items[op] = self._op_items.get(op, 0) + n_items
+            occ = self._occ.setdefault(op, {
+                "calls": 0, "items": 0, "requests": 0, "dispatch_s": 0.0,
+                "dispatch_s_max": 0.0, "fill_sum": 0.0, "fill_n": 0,
+                "waste_items": 0})
+            occ["calls"] += 1
+            occ["items"] += n_items
+            occ["requests"] += len(batch)
+            occ["dispatch_s"] += dt
+            occ["dispatch_s_max"] = max(occ["dispatch_s_max"], dt)
+            if fill is not None:
+                occ["fill_sum"] += fill
+                occ["fill_n"] += 1
+                occ["waste_items"] += waste
         REGISTRY.inc("bcos_crypto_lane_calls_total")
         REGISTRY.inc("bcos_crypto_lane_items_total", n_items)
         REGISTRY.inc("bcos_crypto_lane_requests_total", len(batch))
         REGISTRY.observe("bcos_crypto_lane_batch_size", n_items,
                          buckets=(1, 8, 64, 512, 4096, 16384, 65536))
+        # per-op occupancy series (bcos_lane_*): merge occupancy, batch
+        # size, device-dispatch latency, padding fill/waste
+        lab = {"op": op}
+        REGISTRY.observe("bcos_lane_dispatch_seconds", dt, labels=lab)
+        REGISTRY.observe("bcos_lane_merge_requests", len(batch), labels=lab,
+                         buckets=(1, 2, 4, 8, 16, 32, 64))
+        REGISTRY.observe("bcos_lane_batch_items", n_items, labels=lab,
+                         buckets=(1, 8, 64, 512, 4096, 16384, 65536))
+        if fill is not None:
+            REGISTRY.observe("bcos_lane_bucket_fill", fill, labels=lab,
+                             buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
+            REGISTRY.inc("bcos_lane_bucket_waste_items_total", waste,
+                         labels=lab)
         if op == "poseidon":
             # the ZK plane's own series: merge count + batch occupancy
             REGISTRY.inc("bcos_zk_lane_calls_total")
@@ -396,6 +445,22 @@ class CryptoLane:
                     op: {"calls": c,
                          "mean_batch": round(self._op_items[op] / c, 2)}
                     for op, c in self._op_calls.items() if c},
+                "occupancy": {
+                    op: {
+                        "device_calls": o["calls"],
+                        "mean_batch": round(o["items"] / o["calls"], 2),
+                        "mean_merge": round(o["requests"] / o["calls"], 2),
+                        "dispatch_ms_mean": round(
+                            1000.0 * o["dispatch_s"] / o["calls"], 3),
+                        "dispatch_ms_max": round(
+                            1000.0 * o["dispatch_s_max"], 3),
+                        "mean_bucket_fill": round(
+                            o["fill_sum"] / o["fill_n"], 3)
+                        if o["fill_n"] else None,
+                        "bucket_waste_items": o["waste_items"],
+                    }
+                    for op, o in self._occ.items() if o["calls"]},
+                "max_batch": self.max_batch,
             }
 
 

@@ -154,6 +154,17 @@ class NodeConfig:
     trace_sample_rate: float = 0.02
     trace_ring_size: int = 4096
     trace_slow_ms: float = 1000.0
+    # continuous profiling plane ([profile] ini, analysis/profiler.py):
+    # hz samples every thread's stack + per-thread CPU at a LOW rate
+    # always-on (folded stacks served via GET /profile, GIL-holder CPU
+    # attribution in getSystemStatus); ring bounds the retained distinct
+    # stacks; a [TRACE][slow-span] firing captures a burst_s burst at
+    # burst_hz linked to the trace id (getTrace returns it). hz=0 disarms
+    # the whole plane — no sampler thread, one dict write per block stage.
+    profile_hz: float = 5.0
+    profile_ring: int = 2048
+    profile_burst_hz: float = 97.0
+    profile_burst_s: float = 1.0
     rpc_port: Optional[int] = None  # None = no RPC server; 0 = ephemeral
     rpc_host: str = "127.0.0.1"
     # serving read plane (rpc/edge.py + rpc/cache.py): one bounded worker
@@ -220,6 +231,12 @@ class Node:
                          ring_size=cfg.trace_ring_size,
                          slow_ms=cfg.trace_slow_ms)
         self.trace_label = self.keypair.pub_bytes[:4].hex()
+        # continuous profiling plane: process-wide like the tracer; armed
+        # at a low always-on hz by default, disarmed entirely at hz=0
+        from ..analysis import profiler as _profiler
+        _profiler.configure(hz=cfg.profile_hz, ring=cfg.profile_ring,
+                            burst_hz=cfg.profile_burst_hz,
+                            burst_s=cfg.profile_burst_s)
         # storage injection seam — the reference's StorageInitializer picks
         # RocksDB vs TiKV (libinitializer/Initializer.cpp:145-261); callers
         # pass e.g. a storage.sharded.ShardedStorage cluster for Max mode,
@@ -442,6 +459,7 @@ class Node:
         occupancy, ingest/crypto-lane/storage/cache stats, sync mode,
         txpool depth, the group registry and the tracer. Every value is a
         cheap snapshot read — safe to poll."""
+        from ..analysis import profiler as _prof
         from ..utils import otrace
         cfg = self.config
         bs = self.blocksync
@@ -469,6 +487,7 @@ class Node:
             "zk": self.zk.stats(),
             "groups": reg.groups() if reg is not None else [cfg.group_id],
             "trace": otrace.TRACER.stats(),
+            "profile": _prof.PROFILER.stats(),
             "overload": self.overload.stats()
             if self.overload is not None else None,
             "admission": self.admission.stats()

@@ -19,8 +19,18 @@ scrape port):
                             action` / `?disarm=site|all` mutate it, TEST
                             BUILDS ONLY (BCOS_FAILPOINTS_OPS=1)
   GET /trace?id=<trace_id>  every retained span of one trace (otrace ring)
+                            plus the burst profile captured for it, if a
+                            slow-span firing triggered one
   GET /trace | /traces      newest-first trace summaries
-                            (?limit=N, ?slow=1 for the slow ring only)
+                            (?limit=N, ?slow=1 for the slow ring only);
+                            entries carry `profiled: true` when a burst
+                            profile is retrievable for them
+  GET /profile              the continuous profiler's folded stacks
+                            (analysis/profiler.py); `?seconds=N` takes a
+                            fresh high-hz capture of N seconds instead;
+                            `?fmt=flame` renders the self-contained
+                            flamegraph HTML; `?id=<trace_id>` serves the
+                            burst profile linked to that trace
 """
 
 from __future__ import annotations
@@ -77,21 +87,58 @@ class OpsRoutes:
             if path == "/failpoints":
                 return self._failpoints(q)
             if path in ("/trace", "/traces"):
+                from ..analysis import profiler
                 tid = (q.get("id") or [None])[0]
                 if tid:
-                    spans = self.tracer.get_trace(tid)
-                    return 200, JSON_CTYPE, json.dumps(
+                    doc = profiler.attach_burst(
                         {"traceId": tid.lower().removeprefix("0x"),
-                         "spans": spans}).encode()
+                         "spans": self.tracer.get_trace(tid)}, tid)
+                    return 200, JSON_CTYPE, json.dumps(doc).encode()
                 limit = int((q.get("limit") or ["50"])[0])
                 slow = (q.get("slow") or ["0"])[0] not in ("0", "", "false")
+                traces = profiler.flag_profiled(self.tracer.list_traces(
+                    limit=limit, slow_only=slow))
                 return 200, JSON_CTYPE, json.dumps(
-                    {"traces": self.tracer.list_traces(
-                        limit=limit, slow_only=slow)}).encode()
+                    {"traces": traces}).encode()
+            if path == "/profile":
+                return self._profile(q)
         except Exception as exc:  # noqa: BLE001 — ops surface, stay up
             return 500, JSON_CTYPE, json.dumps(
                 {"error": str(exc)}).encode()
         return 404, JSON_CTYPE, b'{"error": "not found"}'
+
+    def _profile(self, q: dict) -> tuple[int, str, bytes]:
+        """GET /profile — folded stacks or flamegraph HTML from the
+        process profiler. A `seconds=N` capture runs ON THIS bounded
+        worker (clamped; the event loop never blocks on it)."""
+        from ..analysis import profiler as prof
+
+        fmt = (q.get("fmt") or ["folded"])[0]
+        tid = (q.get("id") or [None])[0]
+        if tid:
+            burst = prof.PROFILER.burst_profile(tid)
+            if burst is None:
+                return 404, JSON_CTYPE, json.dumps(
+                    {"error": f"no burst profile for trace {tid}"}).encode()
+            folded, title = burst["folded"], f"burst {tid[:16]}"
+        else:
+            seconds = float((q.get("seconds") or ["0"])[0])
+            if seconds > 0:
+                try:
+                    folded = prof.PROFILER.capture(seconds)
+                except RuntimeError as exc:
+                    # single-flight: a concurrent capture must not tie up
+                    # the ops pool's second worker too
+                    return 429, JSON_CTYPE, json.dumps(
+                        {"error": str(exc)}).encode()
+                title = f"capture {seconds:g}s"
+            else:
+                folded = prof.PROFILER.folded()
+                title = "continuous profile"
+        if fmt == "flame":
+            return 200, "text/html; charset=utf-8", prof.flame_html(
+                folded, title=title).encode()
+        return 200, "text/plain; charset=utf-8", folded.encode()
 
     def _failpoints(self, q: dict) -> tuple[int, str, bytes]:
         from ..utils import failpoints as fpl

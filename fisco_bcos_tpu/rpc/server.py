@@ -800,16 +800,23 @@ class JsonRpcImpl:
         without 0x). A multi-process chain stitches client-side: query
         each node and merge by traceId (spans carry a `node` attribute)."""
         self._check_group(group)
+        from ..analysis import profiler
         tid = trace_id.lower().removeprefix("0x")
         spans = otrace.TRACER.get_trace(tid)
-        return {"traceId": tid, "spans": spans,
-                "node": _hex(self.node.keypair.pub_bytes)}
+        # slow-span burst linking: when this trace tripped the slow ring
+        # and a high-hz burst captured it, the function-level evidence
+        # rides along with the spans
+        return profiler.attach_burst(
+            {"traceId": tid, "spans": spans,
+             "node": _hex(self.node.keypair.pub_bytes)}, tid)
 
     def list_traces(self, group: str, node_name: str = "",
                     limit: int = 50, slow_only: bool = False):
         self._check_group(group)
-        return {"traces": otrace.TRACER.list_traces(
-            limit=limit, slow_only=bool(slow_only))}
+        from ..analysis import profiler
+        traces = otrace.TRACER.list_traces(limit=limit,
+                                           slow_only=bool(slow_only))
+        return {"traces": profiler.flag_profiled(traces)}
 
     def get_system_status(self, group: str = "", node_name: str = ""):
         """One JSON document aggregating the node's scattered operational

@@ -45,6 +45,7 @@ from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
 from ..analysis import lockcheck as lc
+from ..analysis.profiler import stage as _prof_stage
 from ..executor.executor import TransactionExecutor
 from ..ledger.ledger import Ledger
 from ..protocol import Block, BlockHeader, ParentInfo, Receipt, Transaction
@@ -218,7 +219,11 @@ class Scheduler:
         with self._exec_lock:
             self._exec_busy = True
             try:
-                return self._execute_locked(block, sealer_list, t0)
+                # profiler stage mark (analysis/profiler.py): samples of
+                # whatever thread drives execution (sealer, PBFT worker,
+                # sync) carry stage=execute — two dict writes per block
+                with _prof_stage("execute"):
+                    return self._execute_locked(block, sealer_list, t0)
             finally:
                 self._exec_busy = False
 
@@ -485,7 +490,8 @@ class Scheduler:
         with self._lock:
             guard = self._executed.get(hh)
         try:
-            return self._commit_block_inner(header, hh)
+            with _prof_stage("commit"):
+                return self._commit_block_inner(header, hh)
         except BaseException:
             # an exception ESCAPING the commit (injected fault, observer
             # bug) must not strand the result half-committed: without this

@@ -157,6 +157,13 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
     cp["trace"] = {"sample_rate": str(cfg.trace_sample_rate),
                    "ring_size": str(cfg.trace_ring_size),
                    "slow_ms": str(cfg.trace_slow_ms)}
+    # continuous profiling plane knobs (analysis/profiler.py): always-on
+    # sampling hz (0 disarms), folded-stack ring bound, slow-span burst
+    # capture rate + duration
+    cp["profile"] = {"hz": str(cfg.profile_hz),
+                     "ring": str(cfg.profile_ring),
+                     "burst_hz": str(cfg.profile_burst_hz),
+                     "burst_s": str(cfg.profile_burst_s)}
     # deterministic fault injection (utils/failpoints.py) — chaos/test
     # deployments only; empty arms nothing
     cp["failpoints"] = {"spec": cfg.failpoints}
@@ -276,6 +283,10 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
                                       fallback=0.02),
         trace_ring_size=cp.getint("trace", "ring_size", fallback=4096),
         trace_slow_ms=cp.getfloat("trace", "slow_ms", fallback=1000.0),
+        profile_hz=cp.getfloat("profile", "hz", fallback=5.0),
+        profile_ring=cp.getint("profile", "ring", fallback=2048),
+        profile_burst_hz=cp.getfloat("profile", "burst_hz", fallback=97.0),
+        profile_burst_s=cp.getfloat("profile", "burst_s", fallback=1.0),
         p2p_host=cp.get("p2p", "listen_ip", fallback="127.0.0.1"),
         p2p_port=int(p2p_port_s) if p2p_port_s else None,
         p2p_peers=peers,

@@ -317,9 +317,11 @@ class IngestLane:
             if not batch:
                 return
         # one submit_batch == one device recover for the whole drained set
+        from ..analysis.profiler import stage as _prof_stage
         t0 = time.perf_counter()
-        results = self.txpool.submit_batch([e.tx for e in batch],
-                                           broadcast=self.broadcast)
+        with _prof_stage("ingest.admit"):
+            results = self.txpool.submit_batch([e.tx for e in batch],
+                                               broadcast=self.broadcast)
         dt = time.perf_counter() - t0
         for e, res in zip(batch, results):
             if e.task is not None:

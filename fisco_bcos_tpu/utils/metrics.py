@@ -220,7 +220,9 @@ def for_group(group: str, registry: Optional[MetricsRegistry] = None
 
 class MetricsServer:
     """Ops scrape endpoint: GET /metrics (Prometheus text), plus the
-    /trace, /traces and /status views of the same single-loop ops server.
+    /trace, /traces, /status, /healthz, /failpoints and /profile views
+    of the same single-loop ops server (rpc/ops.OpsRoutes — including
+    the continuous profiler's folded stacks and flamegraph HTML).
 
     Thin compat wrapper: serving moved off the old thread-per-scrape
     `ThreadingHTTPServer` onto the shared event-loop edge

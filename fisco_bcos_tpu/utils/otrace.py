@@ -231,6 +231,10 @@ class Tracer:
     def __init__(self, sample_rate: float = 0.0, ring_size: int = 4096,
                  slow_ms: float = 0.0, slow_ring: int = 512):
         self._lock = threading.Lock()
+        # slow-span observers: callback(span_dict), fired OUTSIDE the ring
+        # lock on the already-slow path only (the profiler's burst-capture
+        # trigger, analysis/profiler.py). Observers must never raise.
+        self.on_slow: list = []
         self.configure(sample_rate=sample_rate, ring_size=ring_size,
                        slow_ms=slow_ms, slow_ring=slow_ring)
 
@@ -365,6 +369,11 @@ class Tracer:
             LOG.warning(badge("TRACE", "slow-span", name=name,
                               ms=span["duration_ms"],
                               trace=span["traceId"][:16]))
+            for cb in list(self.on_slow):
+                try:
+                    cb(span)
+                except Exception:  # noqa: BLE001 — observers must not
+                    pass           # break span recording
 
     # -- queries (getTrace / listTraces / /trace) --------------------------
     def get_trace(self, trace_id: str) -> list[dict]:

@@ -74,6 +74,16 @@
 #                                   # proof/value/root, round-trip the
 #                                   # batched verifyProofs entry, then the
 #                                   # chain_bench --proof-bench rows
+#   tools/sanitize_ci.sh --profile  # ONLY the continuous-profiling smoke:
+#                                   # real 4-node daemon chain, /profile
+#                                   # returns folded stacks naming a
+#                                   # scheduler + lane frame, a slow-span
+#                                   # burst profile is retrievable by its
+#                                   # trace id via getTrace, bcos_lane_*
+#                                   # occupancy series live on /metrics,
+#                                   # chain_bench --profile-attrib row,
+#                                   # then tools/perf_gate.py report-only
+#                                   # against the recorded trajectory
 #   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
 #                                   # daemon hosting two groups ([groups]
 #                                   # ini), disjoint writes routed by the
@@ -147,8 +157,205 @@ print("sanitize_ci: LINT STAGE CLEAN "
 EOF
 }
 
+run_profile_stage() {
+  echo "== [profile] continuous-profiling smoke: real 4-node daemon chain," \
+       "/profile folded stacks + flamegraph, slow-span burst by trace id,"
+  echo "==           bcos_profile_*//bcos_lane_* series, perf gate" \
+       "report-only vs the recorded trajectory"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python - <<'EOF'
+import configparser, http.client, json, os, re, shutil, signal
+import subprocess, sys, tempfile, time
+sys.path.insert(0, "tools")
+from build_chain import build_chain
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import SdkClient, TransactionBuilder
+from fisco_bcos_tpu.crypto.suite import make_suite
+
+work = tempfile.mkdtemp(prefix="profile-smoke-")
+procs = []
+try:
+    from fisco_bcos_tpu.testing.chaos import free_port_block
+    port = free_port_block(8)
+    info = build_chain(work, 4, consensus="pbft", rpc_base_port=port,
+                       p2p_base_port=port + 4, crypto_backend="host")
+    # arm the plane's burst path deterministically: sampled client traces
+    # + a slow-span threshold every sendTransaction span clears
+    for ent in info["nodes"]:
+        ini = os.path.join(ent["dir"], "config.ini")
+        cp = configparser.ConfigParser(strict=False)
+        cp.read(ini)
+        cp["trace"]["slow_ms"] = "5"
+        cp["profile"]["hz"] = "19"
+        cp["profile"]["burst_s"] = "0.5"
+        with open(ini, "w") as f:
+            cp.write(f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    for ent in info["nodes"]:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "fisco_bcos_tpu", ent["dir"],
+             "--log-file", os.path.join(ent["dir"], "daemon.log")],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env))
+    cli = SdkClient(f"http://127.0.0.1:{port}", group=info["group_id"])
+    end = time.monotonic() + 120
+    while time.monotonic() < end:
+        try:
+            cli.get_block_number(); break
+        except Exception:
+            time.sleep(0.25)
+    else:
+        raise TimeoutError("rpc never came up")
+
+    suite = make_suite(False, backend="host")
+    kp = suite.generate_keypair(b"profile-smoke")
+    builder = TransactionBuilder(suite, None, chain_id=info["chain_id"],
+                                 group_id=info["group_id"])
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    tid = os.urandom(16).hex()
+    for i in range(6):
+        tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                           pc.encode_call("register",
+                                          lambda w, i=i: w.blob(b"pf%d" % i)
+                                          .u64(10 + i)),
+                           nonce=f"pf{i}", block_limit=100)
+        body = json.dumps({"jsonrpc": "2.0", "id": i,
+                           "method": "sendTransaction",
+                           "params": [info["group_id"], "",
+                                      "0x" + tx.encode().hex()]})
+        conn.request("POST", "/", body=body.encode(),
+                     headers={"traceparent":
+                              f"00-{tid}-00f067aa0ba902b7-01"})
+        resp = json.loads(conn.getresponse().read())
+        assert resp["result"]["status"] == 0, resp
+
+    # 1) /profile (rpc edge): non-empty folded stacks naming at least one
+    # scheduler and one lane frame (the continuous-batching ingest lane
+    # dispatcher IS resident on every node; role prefix `ingest`)
+    conn.request("GET", "/profile?seconds=2")
+    r = conn.getresponse(); folded = r.read().decode()
+    assert r.status == 200 and folded.strip(), (r.status, folded[:200])
+    assert "scheduler.py:" in folded, folded[:800]
+    assert "ingest;" in folded and "ingest.py:" in folded, folded[:800]
+    # 2) the flamegraph renderer serves self-contained HTML
+    conn.request("GET", "/profile?fmt=flame")
+    r = conn.getresponse(); html = r.read().decode()
+    assert r.status == 200 and "<html" in html and "FOLDED" in html
+
+    # 3) slow-span burst: retrievable BY TRACE ID via getTrace (poll — the
+    # burst runs 0.5 s after the span fires) and flagged in listTraces
+    deadline = time.monotonic() + 30
+    prof = None
+    while time.monotonic() < deadline:
+        doc = cli.request("getTrace", [info["group_id"], "", tid])
+        prof = doc.get("profile")
+        if prof:
+            break
+        time.sleep(0.5)
+    assert prof and prof["folded"].strip(), "no burst profile for trace"
+    assert prof["traceId"] == tid and prof["samples"] > 0, prof
+    lst = cli.request("listTraces", [info["group_id"], "", 50])
+    flagged = [t for t in lst["traces"] if t.get("profiled")]
+    assert any(t["traceId"] == tid for t in flagged), lst["traces"][:3]
+
+    # 4) profiler + getSystemStatus surfaces
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    assert "bcos_profile_samples_total" in text, text[:400]
+    st = cli.request("getSystemStatus", [info["group_id"], ""])
+    assert st["profile"]["armed"] and st["profile"]["samples"] > 0, \
+        st["profile"]
+    print("sanitize_ci: PROFILE daemon smoke clean "
+          f"(folded_lines={len(folded.splitlines())}, "
+          f"burst_samples={prof['samples']}, "
+          f"profiled_traces={len(flagged)})")
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=30)
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+  echo "== [profile] crypto-lane occupancy telemetry: 2 groups, one shared" \
+       "lane, bcos_lane_* series live"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python - <<'EOF'
+import shutil, tempfile, threading, time
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.init.daemon import NodeDaemon
+from fisco_bcos_tpu.init.node import NodeConfig
+from fisco_bcos_tpu.protocol import Transaction
+from fisco_bcos_tpu.tool.config import ChainConfig, save_node_config
+from fisco_bcos_tpu.utils.metrics import REGISTRY
+from fisco_bcos_tpu.crypto.suite import make_suite
+
+work = tempfile.mkdtemp(prefix="lane-occ-smoke-")
+try:
+    suite = make_suite(False, backend="host")
+    kp = suite.generate_keypair(b"lane-occ")
+    cfg = NodeConfig(groups=["group0", "group1"], consensus="solo",
+                     crypto_backend="host", min_seal_time=0.0,
+                     storage_path="data", rpc_port=0, p2p_port=0)
+    chain = ChainConfig(consensus_type="solo", sealers=[kp.pub_bytes])
+    save_node_config(work, cfg, chain, kp.secret)
+    daemon = NodeDaemon(work)
+    daemon.start()
+    try:
+        nodes = [daemon.manager.node(g) for g in ("group0", "group1")]
+        bursts = [[Transaction(to=pc.BALANCE_ADDRESS,
+                               input=pc.encode_call(
+                                   "register",
+                                   lambda w, i=i: w.blob(
+                                       b"%s-%d" % (g.encode(), i)).u64(1)),
+                               nonce=f"lo-{g}-{i}", group_id=g,
+                               block_limit=100).sign(suite, kp)
+                   for i in range(64)]
+                  for g in ("group0", "group1")]
+        ths = [threading.Thread(
+            target=lambda n=n, b=b: n.txpool.submit_batch(b), daemon=True)
+            for n, b in zip(nodes, bursts)]
+        for t in ths: t.start()
+        for t in ths: t.join(60)
+        time.sleep(0.5)  # let the lane dispatcher drain its last batch
+        # occupancy telemetry on the shared lane (crypto/lane.py)
+        lane = daemon.manager.crypto_lane_stats()["ecdsa"]
+        occ = lane["occupancy"]
+        assert occ and any(o["device_calls"] > 0 for o in occ.values()), occ
+        text = REGISTRY.prometheus_text()
+        for series in ("bcos_lane_dispatch_seconds", "bcos_lane_batch_items",
+                       "bcos_lane_merge_requests"):
+            assert series in text, f"missing {series}"
+        # the lane dispatcher thread shows up under the `lane` role in a
+        # live capture (the profiler names the crypto lane frame)
+        from fisco_bcos_tpu.analysis.profiler import PROFILER
+        folded = PROFILER.capture(1.0)
+        assert "lane;" in folded and "lane.py:" in folded, folded[:800]
+        print("sanitize_ci: PROFILE lane-occupancy clean "
+              f"(ops={sorted(occ)}, "
+              f"mean_batch={lane['mean_device_batch']})")
+    finally:
+        daemon.shutdown()
+finally:
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+  echo "== [profile] chain_bench --profile-attrib: GIL-holder table +" \
+       "self-cost A/B"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
+    python benchmark/chain_bench.py --profile-attrib -n 2000 \
+    --profile-runs 1 --backend host 2>/dev/null \
+    | grep '"metric": "profile_attrib_summary"'
+  echo "== [profile] perf gate, report-only, vs the recorded trajectory"
+  python tools/perf_gate.py \
+    --candidate "$(ls BENCH_r*.json | tail -1)" --report-only
+}
+
 if [ "${1:-}" = "--lint" ]; then
   run_lint_stage
+  exit 0
+fi
+
+if [ "${1:-}" = "--profile" ]; then
+  run_profile_stage
+  echo "sanitize_ci: PROFILE STAGE CLEAN"
   exit 0
 fi
 
@@ -1031,10 +1238,10 @@ LIBASAN="$(g++ -print-file-name=libasan.so)"
 LIBTSAN="$(g++ -print-file-name=libtsan.so)"
 LIBSTDCPP="$(g++ -print-file-name=libstdc++.so.6)"
 
-echo "== [1/4] ASan+UBSan build (nevm, ncrypto, bcoskv)"
+echo "== [1/5] ASan+UBSan build (nevm, ncrypto, bcoskv)"
 make -C native SANITIZE=address -j"$(nproc)"
 
-echo "== [2/4] ASan+UBSan: native EVM + EC + storage suites"
+echo "== [2/5] ASan+UBSan: native EVM + EC + storage suites"
 # libstdc++ must ride LD_PRELOAD beside libasan: the EVM's C++ exceptions
 # trip the __cxa_throw interceptor CHECK under dlopen otherwise (runtime
 # artifact, not a library bug)
@@ -1047,21 +1254,24 @@ ASAN_OPTIONS=detect_leaks=0 \
       tests/test_native_storage.py -q -x
 
 if [ "$FAST" = 0 ]; then
-  echo "== [3/4] ASan+UBSan: deep differential fuzz (Python vs native EVM)"
+  echo "== [3/5] ASan+UBSan: deep differential fuzz (Python vs native EVM)"
   ASAN_OPTIONS=detect_leaks=0 \
     LD_PRELOAD="$LIBASAN $LIBSTDCPP" \
     FBTPU_NEVM_LIB=native/build/libnevm.asan.so \
     python -m pytest tests/test_nevm.py -q -x -m slow
 else
-  echo "== [3/4] SKIPPED (--fast): deep differential fuzz"
+  echo "== [3/5] SKIPPED (--fast): deep differential fuzz"
 fi
 
-echo "== [4/4] TSan build + native-storage race stress"
+echo "== [4/5] TSan build + native-storage race stress"
 make -C native SANITIZE=thread -j"$(nproc)"
 TSAN_OPTIONS="ignore_noninstrumented_modules=1" \
   LD_PRELOAD="$LIBTSAN $LIBSTDCPP" \
   FBTPU_BCOSKV_LIB=native/build/libbcoskv.tsan.so \
   python -m pytest tests/test_native_storage.py tests/test_race_stress.py \
       -q -x
+
+echo "== [5/5] continuous-profiling smoke + perf gate (report-only)"
+run_profile_stage
 
 echo "sanitize_ci: ALL STAGES CLEAN"
