@@ -21,6 +21,7 @@ The observed topology the ranks encode (who nests inside whom):
   scheduler.2pc    holds across the storage 2PC: engine/WAL fsyncs
   txpool.receipt   receipt waiters read pool drop-records + the ledger
   scheduler.state  scheduler bookkeeping; ledger reads under it
+  sealer.state     grant/round bookkeeping; txpool.seal runs OUTSIDE it
   ingest.queue     leaf: dispatch happens OUTSIDE the cv
   txpool.state     pool admission; ledger (storage) reads under it
   engine.flush     serialises flush/install; engine.state inside
@@ -42,6 +43,7 @@ CANONICAL_ORDER: tuple[str, ...] = (
     "scheduler.2pc",
     "txpool.receipt",
     "scheduler.state",
+    "sealer.state",
     "ingest.queue",
     "txpool.state",
     "eventsub.task",
@@ -73,6 +75,7 @@ MODULE_LOCK_ATTRS: dict[str, dict[str, str]] = {
         "_receipt_cv": "txpool.receipt",
     },
     "txpool/ingest.py": {"_cv": "ingest.queue"},
+    "sealer/sealer.py": {"_lock": "sealer.state"},
     "storage/engine.py": {
         "_lock": "engine.state",
         "_flush_lock": "engine.flush",

@@ -273,6 +273,12 @@ class _Session:
         self._writer = threading.Thread(
             target=self._write_loop, name=f"p2p-w-{peer_id[:4].hex()}",
             daemon=True)
+        # NOT started here: a thread launched mid-__init__ races the
+        # publication of the fields it reads (bcoslint:
+        # thread-start-in-ctor). The owner calls start() once the
+        # session is fully constructed and registered.
+
+    def start(self) -> None:
         self._writer.start()
 
     def _count_drop(self, kind: str) -> None:
@@ -554,6 +560,7 @@ class P2PGateway(Gateway):
             self._router.neighbor_up(peer_id)
             self._topo_version += 1
             self._recompute_codec_locked()
+        sess.start()  # writer thread, after full construction
         self._spawn(lambda: self._read_loop(sess, sock),
                     f"p2p-read-{peer_id[:4].hex()}")
         if self._isolated and self.health is not None:

@@ -51,10 +51,15 @@ class _Task:
         self.done = False
         # serialises pumps: subscribe()'s historical replay can race the
         # commit-observer pump on the same task (duplicate deliveries).
-        # Registered HOT (lockorder.HOT_LOCKS): it is held on the
-        # scheduler's commit-notifier thread, so a blocking delivery
-        # under it stalls EVERY commit observer behind one subscriber
+        # Registered HOT (lockorder.HOT_LOCKS) and guards ONLY the drain
+        # handoff (pending_head/draining): ledger scans and subscriber
+        # callbacks run off-lock in _pump's drain loop, so a blocking
+        # delivery can no longer stall the commit-notifier thread while
+        # it HOLDS this lock (the PR-13 wedge shape, now caught
+        # statically by bcosflow's lock-blocking-interproc pass).
         self.lock = lc.make_lock("eventsub.task")
+        self.pending_head: Optional[int] = None
+        self.draining = False
 
 
 class EventSub:
@@ -96,11 +101,38 @@ class EventSub:
                 self.unsubscribe(task.task_id)
 
     def _pump(self, task: _Task, head: int) -> None:
-        """Deliver matches for blocks [task.next_block, head]."""
-        with task.lock:
-            self._pump_locked(task, head)
+        """Deliver matches for blocks [task.next_block, head].
 
-    def _pump_locked(self, task: _Task, head: int) -> None:
+        Drain pattern: exactly one thread is the task's drainer at a
+        time; a concurrent pump parks its head under the lock and
+        returns (the active drainer re-checks before exiting, so no
+        head is lost). Per-task delivery ORDER is what the old
+        hold-the-lock-across-delivery scheme bought — this keeps it
+        while moving the ledger reads and the subscriber callback
+        OFF the hot eventsub.task lock."""
+        with task.lock:
+            if task.pending_head is None or head > task.pending_head:
+                task.pending_head = head
+            if task.draining:
+                return
+            task.draining = True
+        while True:
+            with task.lock:
+                hd = task.pending_head
+                task.pending_head = None
+                if hd is None:
+                    task.draining = False
+                    return
+            try:
+                self._deliver(task, hd)
+            except BaseException:
+                with task.lock:
+                    task.draining = False
+                raise
+
+    def _deliver(self, task: _Task, head: int) -> None:
+        # cursor state (next_block/done) is owned by the active drainer
+        # — the draining flag makes that single-threaded
         flt = task.filter
         hi = head if flt.to_block < 0 else min(head, flt.to_block)
         while task.next_block <= hi:

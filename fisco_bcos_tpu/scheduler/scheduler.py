@@ -141,7 +141,6 @@ class Scheduler:
         self._notify_q: "queue.Queue[Optional[int]]" = queue.Queue()
         self._notifier = threading.Thread(target=self._notify_loop,
                                           daemon=True, name="sched-notify")
-        self._notifier.start()
         # the commit stage: only materialised in pipeline mode — callers
         # probe `commit_async` (None = synchronous commit path)
         self.commit_async: Optional[Callable] = None
@@ -151,7 +150,14 @@ class Scheduler:
             self.commit_async = self._commit_async
             self._commit_thread = threading.Thread(
                 target=self._commit_loop, daemon=True, name="sched-commit")
-            self._commit_thread.start()
+        # workers launch LAST, after every field above is assigned, so
+        # neither loop can observe a partially-built scheduler. An explicit
+        # owner-side start() is impractical here — the ctor has many
+        # external construction sites (node init, tests, benches) and a
+        # forgotten start() silently stalls commit notification.
+        self._notifier.start()  # bcoslint: disable=thread-start-in-ctor
+        if self._commit_thread is not None:
+            self._commit_thread.start()  # bcoslint: disable=thread-start-in-ctor
 
     # -- stage accounting --------------------------------------------------
     def _stage(self, name: str, dt: float) -> None:

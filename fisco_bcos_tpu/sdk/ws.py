@@ -37,7 +37,12 @@ class WsSdkClient(SdkClient):
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop,
                                         name="sdk-ws-reader", daemon=True)
-        self._reader.start()
+        # reader starts as the ctor's FINAL statement (every field the
+        # loop touches is assigned above): the SDK contract is that a
+        # constructed client is already receiving pushes — a server event
+        # arriving between construction and a separate start() would be
+        # dropped on the floor
+        self._reader.start()  # bcoslint: disable=thread-start-in-ctor
 
     # -- transport ---------------------------------------------------------
     def request(self, method: str, params: list):

@@ -31,10 +31,10 @@ min_seal_time exactly as before.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Optional
 
+from ..analysis import lockcheck as lc
 from ..protocol import Block, BlockHeader
 from ..txpool.txpool import TxPool
 from ..utils.log import metric
@@ -83,7 +83,10 @@ class Sealer(Worker):
         # callable -> True while a block is executing/committing (wired to
         # Scheduler.pipeline_busy); None disables busy-aware filling
         self.pipeline_busy = pipeline_busy
-        self._lock = threading.Lock()
+        # ranked lockcheck lock (sealer.state): grant/round bookkeeping
+        # only — sealing itself (txpool.seal, consensus submit) runs
+        # outside it, and the runtime lock checker now sees this lock
+        self._lock = lc.make_lock("sealer.state")
         # height -> (view, max_txs): heights consensus wants proposals for
         self._grants: dict[int, tuple[int, int]] = {}
         # (height, view) pairs already sealed — never seal a round twice

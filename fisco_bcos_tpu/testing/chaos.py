@@ -88,8 +88,12 @@ class LinkProxy:
         self._listener = socket.create_server(("127.0.0.1", 0))
         self.port = self._listener.getsockname()[1]
         self.drops = 0
-        threading.Thread(target=self._accept_loop, name="chaos-proxy",
-                         daemon=True).start()
+        # accept loop starts as the ctor's FINAL statement: every field it
+        # (and the pumps it spawns) touches is assigned above, and chaos
+        # harness objects are built-then-used inside a single test
+        threading.Thread(  # bcoslint: disable=thread-start-in-ctor
+            target=self._accept_loop, name="chaos-proxy",
+            daemon=True).start()
 
     # -- partition control (runtime-safe) ----------------------------------
     def blackhole(self, direction: str = "both") -> None:

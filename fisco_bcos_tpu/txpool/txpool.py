@@ -139,7 +139,8 @@ class TxPool:
         # snapshot-install path rebuilds the same way.
         self._nonces_by_block: dict[int, set[str]] = {}
         self._known_nonces: set[str] = set()
-        self._rebuild_nonce_filter(self.ledger.current_number())
+        self._install_nonce_filter(
+            self._fetch_nonce_window(self.ledger.current_number()))
         self._on_ready: list[Callable[[], None]] = []
         # receipt waits: one condition broadcast per commit. A shared CV
         # (instead of the old per-hash Event dict) survives concurrent
@@ -156,12 +157,14 @@ class TxPool:
         # TransactionSync gossip hook (TransactionSync.cpp broadcast path)
         self._broadcast_hooks: list[Callable[[Sequence[Transaction]], None]] = []
 
-    def _rebuild_nonce_filter(self, number: int) -> None:
-        """Rebuild the rolling replay-protection window from the ledger —
+    def _fetch_nonce_window(self, number: int) -> dict:
+        """Read the rolling replay-protection window from the ledger —
         the ONE copy of this loop, shared by boot (no-op on fresh nodes)
-        and the snapshot-install reconciliation."""
-        self._nonces_by_block = {}
-        self._known_nonces = set()
+        and the snapshot-install reconciliation. Pure ledger reads:
+        callers run this OFF txpool.state (a window of storage lookups
+        under the pool's hot lock would stall every submit/seal for the
+        duration) and install the result via _install_nonce_filter."""
+        by_block: dict[int, set] = {}
         lo = max(1, number - self.block_limit_range + 1)
         for bn in range(lo, number + 1):
             try:
@@ -169,8 +172,15 @@ class TxPool:
             except Exception:  # pruned below a checkpoint floor
                 continue
             if ns:
-                self._nonces_by_block[bn] = ns
-                self._known_nonces |= ns
+                by_block[bn] = ns
+        return by_block
+
+    def _install_nonce_filter(self, by_block: dict) -> None:
+        """Swap in a prefetched nonce window (txpool.state held)."""
+        self._nonces_by_block = by_block
+        self._known_nonces = set()
+        for ns in by_block.values():
+            self._known_nonces |= ns
 
     # -- notifications -----------------------------------------------------
     def register_unseal_notifier(self, fn: Callable[[], None]) -> None:
@@ -226,14 +236,19 @@ class TxPool:
         hashes = batch_hash(txs, self.suite)
         results: list[Optional[TxSubmitResult]] = [None] * len(txs)
         need_verify: list[int] = []
+        # ledger reads OUTSIDE txpool.state: with a remote ledger/storage
+        # frontend these are RPCs, and even in-process they are GIL-held
+        # time every other submitter serialises behind (bcosflow:
+        # lock-blocking-interproc on the txpool.state hot lock)
+        current = self.ledger.current_number()
+        on_chain = [self.ledger.receipt(h) is not None for h in hashes]
         with self._lock:
-            current = self.ledger.current_number()
             seen_batch: set[bytes] = set()
             occupancy = len(self._pending)
             victims: Optional[list] = None
             vi = 0
             for i, (tx, h) in enumerate(zip(txs, hashes)):
-                st = self._precheck(tx, h, current)
+                st = self._precheck(tx, h, current, on_chain[i])
                 if st is None and h in seen_batch:
                     st = TransactionStatus.ALREADY_IN_TXPOOL
                 if st is None and not consensus:
@@ -251,15 +266,15 @@ class TxPool:
         if need_verify:
             sub = [txs[i] for i in need_verify]
             t_rec = time.monotonic()
-            _, ok = batch_recover_senders(sub, self.suite)
+            senders, ok = batch_recover_senders(sub, self.suite)
             # per-batch signature-recover time -> the latency attribution
             # plane's "crypto" stage (covers the lane AND direct paths);
             # unlabeled on purpose — all bcos_tx_stage_seconds stages
             # share one series family so cross-stage shares stay honest
             from ..utils.trace import observe_stage
             observe_stage("crypto", time.monotonic() - t_rec)
+            current = self.ledger.current_number()  # off-lock, as above
             with self._lock:
-                current = self.ledger.current_number()
                 occupancy = len(self._pending)
                 # the pre-crypto phase's eviction-ordered list carries
                 # over: re-sorting ~pool_limit entries under the lock
@@ -305,8 +320,13 @@ class TxPool:
                         self._sealed.add(h)
                     if tx.nonce:
                         self._known_nonces.add(tx.nonce)
+                    # the batch recover above already produced the
+                    # sender — re-deriving via tx.sender(suite) under
+                    # txpool.state puts a suite_batch recover on the
+                    # hot lock's worst-case path (cache miss = crypto
+                    # under the lock every submitter waits on)
                     results[i] = TxSubmitResult(h, TransactionStatus.OK,
-                                                tx.sender(self.suite))
+                                                senders[j])
         self._settle_dropped(drops)
         n_ok = sum(1 for r in results
                    if r.status == TransactionStatus.OK)
@@ -342,12 +362,17 @@ class TxPool:
                                             n=len(accepted)))
         return [r for r in results]
 
-    def _precheck(self, tx: Transaction, h: bytes,
-                  current: int) -> Optional[TransactionStatus]:
-        """Cheap host-side validation (TxValidator.cpp:33-51 semantics)."""
+    def _precheck(self, tx: Transaction, h: bytes, current: int,
+                  on_chain: bool) -> Optional[TransactionStatus]:
+        """Cheap host-side validation (TxValidator.cpp:33-51 semantics).
+
+        `on_chain` is the ledger dup-check verdict, computed by the
+        caller BEFORE acquiring txpool.state: the ledger read may be a
+        storage lookup (or, split-service, an RPC) and must not run
+        under the pool's hot lock."""
         if h in self._pending or h in self._sealed:
             return TransactionStatus.ALREADY_IN_TXPOOL
-        if self.ledger.receipt(h) is not None:
+        if on_chain:
             return TransactionStatus.ALREADY_KNOWN
         if tx.chain_id != self.chain_id:
             return TransactionStatus.INVALID_CHAINID
@@ -487,8 +512,8 @@ class TxPool:
         height, so checking only `current` let near-deadline txs burn
         verify + seal work and then expire anyway)."""
         drops: list = []
+        current = self.ledger.current_number()  # ledger read off-lock
         with self._lock:
-            current = self.ledger.current_number()
             threshold = for_number if for_number is not None else current + 1
             out, hashes, expired = [], [], []
             for h, tx in self._pending.items():
@@ -614,12 +639,15 @@ class TxPool:
         _, ok = batch_recover_senders(todo, self.suite)
         if not bool(np.all(ok)):
             return False
-        # import the newly-verified txs so commit can prune them
+        # import the newly-verified txs so commit can prune them; the
+        # ledger reads and hashing stay OFF the txpool.state hot lock
+        todo_hashes = batch_hash(todo, self.suite)
+        current = self.ledger.current_number()
+        todo_known = [self.ledger.receipt(h) is not None
+                      for h in todo_hashes]
         with self._lock:
-            current = self.ledger.current_number()
-            for tx in todo:
-                h = tx.hash(self.suite)
-                if self._precheck(tx, h, current) is None:
+            for tx, h, known in zip(todo, todo_hashes, todo_known):
+                if self._precheck(tx, h, current, known) is None:
                     self._pending[h] = tx
                     self._sealed.add(h)
                     self._presealed.discard(h)
@@ -664,12 +692,13 @@ class TxPool:
         # duration); the pops below re-check membership anyway
         committed = [h for h in candidates
                      if self.ledger.receipt(h) is not None]
+        nonce_window = self._fetch_nonce_window(number)  # off-lock too
         with self._lock:
             for h in committed:
                 self._pending.pop(h, None)
                 self._sealed.discard(h)
                 self._presealed.discard(h)
-            self._rebuild_nonce_filter(number)
+            self._install_nonce_filter(nonce_window)
             # txs that survived the reconciliation are still pending: their
             # nonces were admitted at submit time and must keep blocking
             # duplicates (they are in no block's nonce table yet)

@@ -1,0 +1,413 @@
+"""bcosflow (tools/bcosflow.py): per-pass fixture tests over the
+interprocedural analyzer, plus self-checks against the real repo
+(resolution floor, CI time budget, zero jax import, baseline gate)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "bcosflow", os.path.join(_REPO, "tools", "bcosflow.py"))
+bcosflow = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("bcosflow", bcosflow)
+_spec.loader.exec_module(bcosflow)
+
+
+def flow(sources: dict[str, str]):
+    """{relpath: src} -> (findings, graph), with dedented sources."""
+    return bcosflow.analyze_sources(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()})
+
+
+def rules_of(findings):
+    return sorted(v.rule for v in findings)
+
+
+# -- pass: plane-blocking (thread-spawn roots) ------------------------------
+
+_INGEST_FSYNC = {
+    "fisco_bcos_tpu/txpool/mini.py": """
+    import os
+    import threading
+
+    class MiniLane:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._run,
+                                            name="tx-ingest", daemon=True)
+
+        def _run(self):
+            self._persist()
+
+        def _persist(self):
+            os.fsync(3)
+    """,
+}
+
+
+def test_plane_blocking_interprocedural_from_spawn_root():
+    # the fsync is one call HOP below the thread body: only transitive
+    # effect propagation can see it from the ingest plane
+    findings, graph = flow(_INGEST_FSYNC)
+    pb = [v for v in findings if v.rule == "plane-blocking"]
+    assert len(pb) == 1
+    assert pb[0].scope == "MiniLane._persist"
+    assert "'ingest' plane" in pb[0].message
+    assert any(p == "ingest" for _, p, _ in graph.roots)
+
+
+def test_plane_blocking_suppression_comment():
+    srcs = {k: v.replace("os.fsync(3)",
+                         "os.fsync(3)  # bcosflow: disable=plane-blocking")
+            for k, v in _INGEST_FSYNC.items()}
+    findings, _ = flow(srcs)
+    assert "plane-blocking" not in rules_of(findings)
+
+
+def test_plane_blocking_callback_registration():
+    # the PR-13 shape: a commit observer reaches a socket send through
+    # one indirection layer — the callback-registration edge must carry
+    # the 'notify' plane onto the registered function
+    findings, _ = flow({
+        "fisco_bcos_tpu/rpc/pump.py": """
+        class Pump:
+            def __init__(self, sched, sock):
+                self.sock = sock
+                sched.add_commit_observer(self._on_commit)
+
+            def _on_commit(self, number):
+                self._push(number)
+
+            def _push(self, number):
+                self.sock.sendall(b"x")
+        """,
+    })
+    pb = [v for v in findings if v.rule == "plane-blocking"]
+    assert len(pb) == 1
+    assert pb[0].scope == "Pump._push"
+    assert "'notify' plane" in pb[0].message
+
+
+# -- pass: lock-blocking-interproc ------------------------------------------
+
+_LOCK_FIXTURE = """
+import os
+from ..analysis import lockcheck as lc
+
+class Pool:
+    def __init__(self):
+        self._lock = lc.make_lock("txpool.state")
+
+    def admit(self):
+        with self._lock:
+            self._flush()
+
+    def _flush(self):
+        os.fsync(3)
+
+    def direct(self):
+        with self._lock:
+            os.fsync(3)
+"""
+
+
+def test_lock_blocking_across_calls():
+    findings, _ = flow({"fisco_bcos_tpu/txpool/pool2.py": _LOCK_FIXTURE})
+    lb = [v for v in findings if v.rule == "lock-blocking-interproc"]
+    assert len(lb) == 1
+    assert lb[0].scope == "Pool._flush"
+    assert "txpool.state" in lb[0].message
+
+
+def test_lock_blocking_depth_zero_left_to_bcoslint():
+    # `direct` blocks INSIDE its own with-block: that is bcoslint's
+    # lexical blocking-under-lock rule, not an interprocedural finding —
+    # the analyzer must not double-report it
+    findings, _ = flow({"fisco_bcos_tpu/txpool/pool2.py": _LOCK_FIXTURE})
+    lb = [v for v in findings if v.rule == "lock-blocking-interproc"]
+    assert all(v.scope != "Pool.direct" for v in lb)
+
+
+# -- pass: lock-order-interproc ---------------------------------------------
+
+def test_lock_order_inversion_across_calls():
+    # txpool.state ranks INSIDE scheduler.state: acquiring the scheduler
+    # lock in a callee while the pool lock is held inverts the canonical
+    # order one call away from the `with`
+    findings, _ = flow({
+        "fisco_bcos_tpu/txpool/pool3.py": """
+        from ..analysis import lockcheck as lc
+
+        class P:
+            def __init__(self):
+                self._lock = lc.make_lock("txpool.state")
+                self._sched = lc.make_lock("scheduler.state")
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._sched:
+                    pass
+        """,
+    })
+    lo = [v for v in findings if v.rule == "lock-order-interproc"]
+    assert len(lo) == 1
+    assert lo[0].scope == "P.inner"
+    assert "scheduler.state" in lo[0].message
+
+
+def test_lock_order_correct_nesting_not_flagged():
+    findings, _ = flow({
+        "fisco_bcos_tpu/txpool/pool4.py": """
+        from ..analysis import lockcheck as lc
+
+        class P:
+            def __init__(self):
+                self._lock = lc.make_lock("txpool.state")
+                self._sched = lc.make_lock("scheduler.state")
+
+            def outer(self):
+                with self._sched:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """,
+    })
+    assert "lock-order-interproc" not in rules_of(findings)
+
+
+# -- pass: fsync-path-unarmed -----------------------------------------------
+
+_FSYNC_LEAF = """
+import os
+
+def write_segment(fd):
+    os.fsync(fd)
+"""
+
+
+def test_fsync_unarmed_entry_chain_flagged():
+    findings, _ = flow({"fisco_bcos_tpu/storage/seg.py": _FSYNC_LEAF})
+    fu = [v for v in findings if v.rule == "fsync-path-unarmed"]
+    assert len(fu) == 1
+    assert fu[0].scope == "write_segment"
+
+
+def test_fsync_covered_when_every_caller_is_armed():
+    findings, _ = flow({
+        "fisco_bcos_tpu/storage/seg2.py": _FSYNC_LEAF + """
+
+    def append(fd):
+        fire("storage.append.pre")
+        write_segment(fd)
+    """,
+    })
+    assert "fsync-path-unarmed" not in rules_of(findings)
+
+
+def test_fsync_outside_storage_scope_ignored():
+    findings, _ = flow({"fisco_bcos_tpu/utils/misc.py": _FSYNC_LEAF})
+    assert "fsync-path-unarmed" not in rules_of(findings)
+
+
+# -- pass: lane-host-sync ---------------------------------------------------
+
+_LANE_SRC = """
+import threading
+
+class Dispatcher:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run,
+                                        name="crypto-lane", daemon=True)
+
+    def _run(self):
+        from ..ops.mix import merge
+        merge(None)
+"""
+
+
+def test_lane_host_sync_outside_boundary_flagged():
+    findings, _ = flow({
+        "fisco_bcos_tpu/crypto/lane9.py": _LANE_SRC,
+        "fisco_bcos_tpu/ops/mix.py": """
+        def merge(x):
+            x.block_until_ready()
+        """,
+    })
+    hs = [v for v in findings if v.rule == "lane-host-sync"]
+    assert len(hs) == 1
+    assert hs[0].path == "fisco_bcos_tpu/ops/mix.py"
+
+
+def test_lane_host_sync_inside_crypto_boundary_sanctioned():
+    # crypto/ IS the sanctioned demux boundary: materialising a merged
+    # batch there is the dispatcher's job, not a mid-pipeline stall
+    findings, _ = flow({
+        "fisco_bcos_tpu/crypto/lane9.py": """
+        import threading
+
+        class Dispatcher:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run,
+                                                name="crypto-lane",
+                                                daemon=True)
+
+            def _run(self):
+                self.demux(None)
+
+            def demux(self, x):
+                x.block_until_ready()
+        """,
+    })
+    assert "lane-host-sync" not in rules_of(findings)
+
+
+# -- pass: jit purity -------------------------------------------------------
+
+def test_jit_impure_and_shape_branch():
+    findings, _ = flow({
+        "fisco_bcos_tpu/ops/kern.py": """
+        import os
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            os.fsync(3)
+            if x.shape[0] > 4:
+                return x
+            return x
+
+        def plain(x):
+            os.fsync(3)
+            if x.shape[0] > 4:
+                return x
+            return x
+        """,
+    })
+    by_rule = rules_of(findings)
+    assert "jit-impure" in by_rule
+    assert "jit-shape-branch" in by_rule
+    # the un-jitted twin triggers NEITHER rule
+    assert all(v.scope == "kernel" for v in findings
+               if v.rule in ("jit-impure", "jit-shape-branch"))
+
+
+# -- pass: hot-loop-alloc ---------------------------------------------------
+
+def test_hot_loop_alloc_on_ingest_path():
+    findings, _ = flow({
+        "fisco_bcos_tpu/txpool/mini2.py": """
+        import threading
+
+        class Item:
+            def __init__(self, x):
+                self.x = x
+
+        class MiniLane:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run,
+                                                name="tx-ingest",
+                                                daemon=True)
+
+            def _run(self):
+                out = []
+                for x in range(4):
+                    out.append(Item(x))
+        """,
+    })
+    ha = [v for v in findings if v.rule == "hot-loop-alloc"]
+    assert len(ha) == 1
+    assert ha[0].scope == "MiniLane._run"
+
+
+def test_alloc_in_raise_is_loop_exit_not_per_item():
+    findings, _ = flow({
+        "fisco_bcos_tpu/txpool/mini3.py": """
+        import threading
+
+        class PoolFull(Exception):
+            def __init__(self, x):
+                super().__init__(x)
+
+        class MiniLane:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run,
+                                                name="tx-ingest",
+                                                daemon=True)
+
+            def _run(self):
+                for x in range(4):
+                    if x > 2:
+                        raise PoolFull(x)
+        """,
+    })
+    assert "hot-loop-alloc" not in rules_of(findings)
+
+
+# -- graph dump shape -------------------------------------------------------
+
+def test_graph_dump_structure():
+    _, graph = flow(_INGEST_FSYNC)
+    d = graph.dump()
+    assert set(d) == {"stats", "roots", "functions", "edges", "ref_edges"}
+    assert any(r["plane"] == "ingest" for r in d["roots"])
+    quals = {f["qual"] for f in d["functions"]}
+    assert any(q.endswith("MiniLane._persist") for q in quals)
+    assert any(s.endswith("._run") and t.endswith("._persist")
+               for s, t in d["edges"])
+
+
+# -- self-checks against the real repo --------------------------------------
+
+def test_repo_resolution_floor_and_roots():
+    paths = [os.path.join(_REPO, "fisco_bcos_tpu")]
+    summaries, _ = bcosflow.load_summaries(paths, None)
+    graph = bcosflow.Graph(summaries)
+    assert graph.resolution_rate() >= 0.90, (
+        f"call-edge resolution fell to {graph.resolution_rate():.1%} — "
+        "new code defeats the receiver-typing heuristics; extend "
+        "tools/bcosflow.py resolution before baselining around it")
+    assert len(graph.roots) >= 10  # plane roots, not a degenerate graph
+
+
+def test_cli_green_vs_committed_baseline_within_budget():
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bcosflow.py"),
+         "--no-cache"],
+        capture_output=True, text=True, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout + proc.stderr
+    assert elapsed < 30.0, f"bcosflow took {elapsed:.1f}s (CI budget 30s)"
+
+
+def test_analysis_never_imports_jax():
+    # the lint gate must stay runnable on machines with no accelerator
+    # stack; loading planes/profiler/lockorder happens by file path
+    code = textwrap.dedent(f"""
+        import importlib.util, os, sys
+        spec = importlib.util.spec_from_file_location(
+            "bcosflow", {os.path.join(_REPO, 'tools', 'bcosflow.py')!r})
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        summaries, _ = m.load_summaries(
+            [{os.path.join(_REPO, 'fisco_bcos_tpu')!r}], None)
+        m.Analyzer(m.Graph(summaries)).run()
+        assert "jax" not in sys.modules, "analysis imported jax"
+        assert "jaxlib" not in sys.modules, "analysis imported jaxlib"
+    """)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -294,6 +294,108 @@ def test_init_py_reexports_exempt():
         lint(src, "fisco_bcos_tpu/net/__init__.py"))
 
 
+# -- thread-start-in-ctor --------------------------------------------------
+
+def test_thread_start_in_ctor_flagged():
+    # all three shapes: inline, via self-attr, via local
+    src = """
+    import threading
+    class A:
+        def __init__(self):
+            threading.Thread(target=self._run, daemon=True).start()
+    class B:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+    class C:
+        def __init__(self):
+            t = threading.Thread(target=self._run)
+            t.start()
+    """
+    vs = [v for v in lint(src) if v.rule == "thread-start-in-ctor"]
+    assert sorted(v.scope for v in vs) == \
+        ["A.__init__", "B.__init__", "C.__init__"]
+
+
+def test_thread_start_in_ctor_self_start_on_worker_subclass():
+    src = """
+    class Miner(Worker):
+        def __init__(self):
+            super().__init__("miner")
+            self.start()
+    """
+    vs = [v for v in lint(src) if v.rule == "thread-start-in-ctor"]
+    assert len(vs) == 1
+
+
+def test_thread_start_outside_ctor_ok():
+    # the fixed p2p shape: build in __init__, start from an owner-called
+    # start() — and self.start() on a NON-thread class is not a spawn
+    src = """
+    import threading
+    class A:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run)
+        def start(self):
+            self._t.start()
+    class B:
+        def __init__(self):
+            self.start()
+        def start(self):
+            pass
+    """
+    assert "thread-start-in-ctor" not in rules_of(lint(src))
+
+
+# -- log-in-hot-loop -------------------------------------------------------
+
+def test_log_in_hot_loop_fstring_flagged():
+    src = """
+    from ..utils.log import LOG
+    def dispatch(entries):
+        for e in entries:
+            LOG.debug(f"dispatching {e}")
+    """
+    vs = [v for v in lint(src, "fisco_bcos_tpu/txpool/ingest.py")
+          if v.rule == "log-in-hot-loop"]
+    assert len(vs) == 1 and vs[0].scope == "dispatch"
+
+
+def test_log_in_hot_loop_lazy_args_and_cold_modules_ok():
+    lazy = """
+    from ..utils.log import LOG
+    def dispatch(entries):
+        for e in entries:
+            LOG.debug("dispatching %s", e)
+        LOG.info(f"done: {len(entries)}")
+    """
+    assert "log-in-hot-loop" not in rules_of(
+        lint(lazy, "fisco_bcos_tpu/txpool/ingest.py"))
+    hot = """
+    from ..utils.log import LOG
+    def dispatch(entries):
+        for e in entries:
+            LOG.debug(f"dispatching {e}")
+    """
+    # same f-string loop OUTSIDE the hot-path scope: connection plumbing
+    # logs per connection, not per item
+    assert "log-in-hot-loop" not in rules_of(
+        lint(hot, "fisco_bcos_tpu/net/p2p.py"))
+
+
+def test_log_in_hot_loop_closure_inside_loop_ok():
+    src = """
+    from ..utils.log import LOG
+    def dispatch(entries):
+        for e in entries:
+            def cb():
+                LOG.debug(f"later {e}")
+            e.on_done(cb)
+    """
+    assert "log-in-hot-loop" not in rules_of(
+        lint(src, "fisco_bcos_tpu/txpool/ingest.py"))
+
+
 # -- suppression -----------------------------------------------------------
 
 def test_suppression_same_line_and_line_above():
@@ -392,5 +494,6 @@ def test_list_rules_names_every_rule():
         "raw-lock", "lock-order", "bare-except",
         "swallowed-worker-exception", "wallclock-deadline",
         "fsync-no-failpoint", "metrics-cardinality", "mutable-default",
-        "dict-iter-mutation", "unused-import",
+        "dict-iter-mutation", "unused-import", "thread-start-in-ctor",
+        "log-in-hot-loop",
     }

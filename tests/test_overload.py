@@ -658,6 +658,7 @@ def test_p2p_sendq_drops_oldest_gossip_never_consensus():
 
     sess = _Session(b"\xcc" * 8, BlockedSock(), lambda s: None,
                     max_queue=1000)
+    sess.start()  # writer thread is no longer started by __init__
     try:
         # park the writer on a sacrificial frame so everything after
         # stays QUEUED deterministically

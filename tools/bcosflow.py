@@ -1,0 +1,1512 @@
+#!/usr/bin/env python3
+"""bcosflow — whole-program plane-contract analyzer for fisco_bcos_tpu.
+
+bcoslint (tools/bcoslint.py) checks invariants a single function can
+violate *lexically*; this tool checks the ones that live BETWEEN
+functions: a blocking send hidden one call deep under a hot lock, a
+lock-order inversion split across two modules, an fsync edge whose
+failpoint arming lives in the caller, a host↔device sync buried in a
+kernel the crypto-lane dispatcher reaches through four layers.
+
+It builds a whole-repo call graph over `fisco_bcos_tpu/` (AST-based name
+resolution: module defs, methods via self/cls + constructor-site receiver
+typing, `functools.partial` and `threading.Thread(target=...)` edges),
+classifies thread roots into execution planes (analysis/profiler's
+thread-role registry + analysis/planes.py), propagates per-function
+effect summaries transitively, and enforces the plane contracts declared
+in fisco_bcos_tpu/analysis/planes.py.
+
+Passes (rule ids):
+    plane-blocking        blocking effect (lockorder.BLOCKING_KINDS)
+                          reachable from a plane root whose contract
+                          forbids that kind
+    lock-blocking-interproc
+                          blocking effect reachable from UNDER a HOT lock
+                          (lockorder.HOT_LOCKS) across >=1 call boundary
+                          (the lexical depth-0 case is bcoslint's)
+    lock-order-interproc  a ranked lock acquired while a higher-or-equal
+                          ranked lock is held, across call boundaries
+                          (analysis/lockorder.RANK)
+    fsync-path-unarmed    a storage/snapshot durability edge (fsync /
+                          os.replace) where NO function on some root->site
+                          call path crosses a failpoint — the kill -9
+                          matrix cannot reach it (whole-program version of
+                          bcoslint's per-function rule: a caller that arms
+                          the site satisfies this one)
+    lane-host-sync        block_until_ready / np.asarray / .item()
+                          host-sync reachable from the crypto-lane
+                          dispatcher OUTSIDE the sanctioned demux boundary
+                          (planes.LANE_SYNC_BOUNDARY)
+    jit-impure            blocking / host-sync / print effects inside a
+                          jit-decorated function (host syncs break the
+                          trace; effects silently run once at trace time)
+    jit-shape-branch      `if ...shape...` branching inside a jit body —
+                          one compile PER SHAPE; route through the padding
+                          buckets instead
+    hot-loop-alloc        per-item Python object construction in a loop
+                          reachable from the wire->lane->seal hot path
+                          (guard rail for the ROADMAP-1 columnar refactor)
+
+Usage:
+    python tools/bcosflow.py                  # analyze vs baseline
+    python tools/bcosflow.py --json           # findings as JSON
+    python tools/bcosflow.py --graph out.json # dump the call graph
+    python tools/bcosflow.py --no-baseline    # show EVERY finding
+    python tools/bcosflow.py --update-baseline
+    python tools/bcosflow.py --changed-only   # git-diff-scoped report
+    python tools/bcosflow.py --stats          # resolution/timing only
+
+Suppression (same line or the line directly above the effect):
+    something()  # bcosflow: disable=plane-blocking
+    # bcosflow: disable=all
+
+Baseline: tools/bcosflow_baseline.txt, same rule|path|scope|fingerprint|
+justification format as bcoslint's (entries survive line churn; stale
+ones are warned about and pruned by --update-baseline).
+
+The analyzer imports NOTHING from the package (lockorder/planes/profiler
+are loaded by file path) — it must never pay for, or require, a jax
+import, and it must finish inside the CI lint budget (<30 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import importlib.util
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "fisco_bcos_tpu"
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "bcosflow_baseline.txt")
+DEFAULT_CACHE = os.path.join(REPO, "tools", ".bcosflow_cache.json")
+# cache version = hash of this very file: ANY analyzer change invalidates
+# every cached module summary (stale summaries silently change findings)
+try:
+    with open(os.path.abspath(__file__), "rb") as _f:
+        SUMMARY_VERSION = hashlib.sha1(_f.read()).hexdigest()[:16]
+except OSError:
+    SUMMARY_VERSION = "unknown"
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import bcoslint  # noqa: E402 — shared Violation/baseline/file-walk infra
+
+
+def _load_by_path(name: str, rel: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, PKG, rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lockorder = bcoslint.lockorder
+planes = _load_by_path("_bcosflow_planes", "analysis/planes.py")
+profiler = _load_by_path("_bcosflow_profiler", "analysis/profiler.py")
+
+SUPPRESS_RE = re.compile(r"#\s*bcosflow:\s*disable=([a-z\-,\s]+|all)")
+
+# call-site attr -> blocking kind (bcoslint's vocabulary + poseidon)
+BLOCKING_ATTRS = {
+    "fsync": "fsync", "fdatasync": "fsync",
+    "sendall": "socket_send", "send_text": "socket_send",
+    "send_binary": "socket_send",
+    "verify_batch": "suite_batch", "recover_batch": "suite_batch",
+    "hash_batch": "suite_batch", "poseidon_batch": "suite_batch",
+}
+SUBPROCESS_ATTRS = {"run", "check_call", "check_output", "call", "Popen"}
+HOST_SYNC_ATTRS = {"block_until_ready", "device_get", "item"}
+NP_SYNC_FUNCS = {"asarray", "array", "concatenate", "stack", "copy"}
+ALLOC_ATTRS = {"from_bytes", "from_json", "from_dict"}
+FSYNC_FP_SCOPE = ("fisco_bcos_tpu/storage/", "fisco_bcos_tpu/snapshot/")
+
+# CHA-by-name fallback: method names too generic to attribute to a repo
+# class when the receiver is untyped (indistinguishable from stdlib) are
+# excluded from resolution entirely — neither edges nor the stat's
+# denominator. Typed receivers resolve them normally.
+GENERIC_NAMES = frozenset({
+    "get", "put", "set", "add", "pop", "push", "clear", "copy", "update",
+    "start", "stop", "close", "run", "join", "send", "recv", "read",
+    "write", "append", "appendleft", "popleft", "extend", "insert",
+    "remove", "discard", "count", "index", "sort", "reverse", "keys",
+    "values", "items", "encode", "decode", "split", "strip", "replace",
+    "format", "lower", "upper", "hex", "digest", "name", "wait",
+    "notify", "notify_all", "acquire", "release", "submit", "shutdown",
+    "exists", "flush", "fileno", "accept", "connect", "bind", "listen",
+    "setdefault", "render", "load", "loads", "dump", "dumps", "commit",
+    "prepare", "rollback", "begin", "info", "debug", "warning", "error",
+    "exception", "critical", "call", "cancel", "result", "done", "next",
+    "hash", "sign", "verify", "seal", "reset", "match", "search", "group",
+})
+CHA_CAP = 6  # max same-name candidates a nameless receiver may fan to
+
+_GENERIC_SKIPPED = 0  # module-level counter for the stats line
+
+
+# ---------------------------------------------------------------------------
+# per-module extraction (pure-data summaries; JSON-cacheable)
+# ---------------------------------------------------------------------------
+
+def _mod_name(relpath: str) -> str:
+    """fisco_bcos_tpu/rpc/edge.py -> rpc.edge ; .../zk/__init__.py -> zk"""
+    p = relpath
+    if p.startswith(PKG + "/"):
+        p = p[len(PKG) + 1:]
+    if p.endswith("/__init__.py"):
+        return p[:-len("/__init__.py")].replace("/", ".")
+    if p == "__init__.py":
+        return "<root>"
+    return p[:-3].replace("/", ".")
+
+
+def _dotted(expr: ast.expr) -> Optional[str]:
+    """Name / dotted Attribute chain -> 'a.b.c' (None otherwise)."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _name_prefix(expr: Optional[ast.expr]) -> Optional[str]:
+    """Literal (prefix of a) thread name: Constant or leading JoinedStr
+    constant."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.JoinedStr) and expr.values and \
+            isinstance(expr.values[0], ast.Constant):
+        return str(expr.values[0].value)
+    return None
+
+
+class _ModuleExtract:
+    """One file -> a JSON-serializable summary: defs with their calls,
+    effects, lock acquisitions; class layouts; import map."""
+
+    def __init__(self, src: str, relpath: str):
+        self.relpath = relpath
+        self.module = _mod_name(relpath)
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=relpath)
+        self.is_pkg = relpath.endswith("__init__.py")
+        self.imports: dict[str, list] = {}   # name -> ["mod"|"sym"|"ext",..]
+        self.classes: dict[str, dict] = {}   # name -> {bases, methods,
+        #                                      attr_types, lock_attrs}
+        self.funcs: dict[str, dict] = {}     # qual -> summary
+        self.suppress: dict[int, str] = {}   # line -> rules string
+        self._mod_lock_attrs = {}
+        for suffix, attrs in lockorder.MODULE_LOCK_ATTRS.items():
+            if relpath.endswith(suffix):
+                self._mod_lock_attrs = attrs
+                break
+        for i, ln in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(ln)
+            if m:
+                self.suppress[i] = m.group(1).strip()
+        self._collect_imports()
+        self._collect_classes()
+        self._collect_funcs()
+
+    # -- imports -----------------------------------------------------------
+    def _rel_base(self, level: int) -> str:
+        parts = self.module.split(".") if self.module != "<root>" else []
+        if not self.is_pkg:
+            parts = parts[:-1]
+        if level > 1:
+            parts = parts[: len(parts) - (level - 1)]
+        return ".".join(parts)
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    self.imports[name] = ["ext", a.name]
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                if node.level > 0:
+                    base = self._rel_base(node.level)
+                    mod = f"{base}.{node.module}" if base and node.module \
+                        else (node.module or base)
+                elif node.module and (node.module == PKG
+                                      or node.module.startswith(PKG + ".")):
+                    mod = node.module[len(PKG) + 1:] or "<root>"
+                else:
+                    for a in node.names:
+                        self.imports[a.asname or a.name] = \
+                            ["ext", node.module or "?"]
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = ["sym", mod, a.name]
+
+    def _class_ref(self, expr: ast.expr) -> Optional[str]:
+        """Resolve an expression naming a class/function to a dotted repo
+        ref ('module.Sym'), via the import map or same-module defs."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        imp = self.imports.get(head)
+        if imp is None:
+            return f"{self.module}.{d}"  # same-module name
+        if imp[0] == "sym":
+            base = f"{imp[1]}.{imp[2]}"
+            return f"{base}.{rest}" if rest else base
+        if imp[0] == "ext":
+            return None
+        return None
+
+    # -- classes -----------------------------------------------------------
+    def _collect_classes(self) -> None:
+        for node in self.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = {"bases": [], "methods": [], "attr_types": {},
+                    "lock_attrs": dict(self._mod_lock_attrs), "line":
+                    node.lineno}
+            for b in node.bases:
+                ref = self._class_ref(b)
+                if ref:
+                    info["bases"].append(ref)
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    info["methods"].append(stmt.name)
+            self.classes[node.name] = info
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._scan_attr_assigns(node.name, stmt, info)
+
+    def _scan_attr_assigns(self, cls: str, fn: ast.FunctionDef,
+                           info: dict) -> None:
+        ann = {a.arg: self._class_ref(a.annotation)
+               for a in fn.args.args if a.annotation is not None}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            attr, val = t.attr, node.value
+            if isinstance(val, ast.Call):
+                d = _dotted(val.func)
+                if d in ("lc.make_lock", "lc.make_rlock",
+                         "lc.make_condition", "_lc.make_lock",
+                         "_lc.make_rlock", "_lc.make_condition",
+                         "lockcheck.make_lock", "lockcheck.make_rlock",
+                         "lockcheck.make_condition") and val.args and \
+                        isinstance(val.args[0], ast.Constant):
+                    info["lock_attrs"].setdefault(attr, val.args[0].value)
+                    continue
+                if d in ("threading.Lock", "threading.RLock",
+                         "threading.Condition"):
+                    info["lock_attrs"].setdefault(
+                        attr, f"raw:{self.module}.{attr}")
+                    continue
+                ref = self._class_ref(val.func)
+                if ref and ref.rsplit(".", 1)[-1][:1].isupper():
+                    info["attr_types"].setdefault(attr, ref)
+            elif isinstance(val, ast.Name) and val.id in ann and \
+                    fn.name == "__init__" and ann[val.id]:
+                info["attr_types"].setdefault(attr, ann[val.id])
+
+    # -- function bodies ---------------------------------------------------
+    def _collect_funcs(self) -> None:
+        def walk(body, qual_prefix, cls):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = f"{qual_prefix}.{node.name}" if qual_prefix \
+                        else f"{self.module}.{node.name}"
+                    self._extract_func(node, qual, cls)
+                elif isinstance(node, ast.ClassDef):
+                    walk(node.body, f"{self.module}.{node.name}",
+                         node.name)
+        walk(self.tree.body, "", None)
+
+    def _jit_decorated(self, fn: ast.FunctionDef) -> tuple[bool, list]:
+        """-> (is_jit, static arg names/indices)."""
+        for dec in fn.decorator_list:
+            d = _dotted(dec) or ""
+            if d.endswith("jax.jit") or d == "jit":
+                return True, []
+            if isinstance(dec, ast.Call):
+                dc = _dotted(dec.func) or ""
+                if dc.endswith("partial") and dec.args and \
+                        (_dotted(dec.args[0]) or "").endswith("jit"):
+                    static = []
+                    for kw in dec.keywords:
+                        if kw.arg == "static_argnums":
+                            static += [e.value for e in ast.walk(kw.value)
+                                       if isinstance(e, ast.Constant)]
+                        elif kw.arg == "static_argnames":
+                            static += [e.value for e in ast.walk(kw.value)
+                                       if isinstance(e, ast.Constant)]
+                    return True, static
+                if dc.endswith("jax.jit") or dc == "jit":
+                    return True, []
+        return False, []
+
+    def _extract_func(self, fn: ast.FunctionDef, qual: str,
+                      cls: Optional[str]) -> None:
+        is_jit, jit_static = self._jit_decorated(fn)
+        params = [a.arg for a in fn.args.args]
+        static_params = {params[i] for i in jit_static
+                         if isinstance(i, int) and i < len(params)}
+        static_params |= {s for s in jit_static if isinstance(s, str)}
+        rec = {"qual": qual, "module": self.module, "cls": cls,
+               "name": fn.name, "line": fn.lineno, "path": self.relpath,
+               "jit": is_jit, "jit_static": sorted(static_params),
+               "fp_armed": False, "calls": [], "effects": [],
+               "acquires": [], "params": params,
+               "is_ctor": fn.name == "__init__"}
+        self.funcs[qual] = rec
+        cinfo = self.classes.get(cls, {})
+        lock_attrs = cinfo.get("lock_attrs", self._mod_lock_attrs)
+        attr_types = cinfo.get("attr_types", {})
+        local_defs: set[str] = set()
+        for st in fn.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.add(st.name)
+        var_types = {a.arg: self._class_ref(a.annotation)
+                     for a in fn.args.args if a.annotation is not None}
+        var_types = {k: v for k, v in var_types.items() if v}
+
+        def text(line: int) -> str:
+            return self.lines[line - 1].strip() \
+                if 1 <= line <= len(self.lines) else ""
+
+        def lockname_of(expr: ast.expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls"):
+                return lock_attrs.get(expr.attr)
+            if isinstance(expr, ast.Attribute):
+                return lock_attrs.get(expr.attr)
+            return None
+
+        def effect(cat, kind, what, line, locks, loop=0):
+            rec["effects"].append(
+                {"cat": cat, "kind": kind, "what": what, "line": line,
+                 "locks": list(locks), "loop": loop, "text": text(line)})
+
+        def call_desc(node: ast.Call, locks, loop):
+            f = node.func
+            desc = None
+            if isinstance(f, ast.Name):
+                n = f.id
+                if n in local_defs:
+                    desc = {"t": "qual",
+                            "q": f"{qual}.<locals>.{n}", "name": n}
+                else:
+                    imp = self.imports.get(n)
+                    if imp is None:
+                        desc = {"t": "bare", "name": n}
+                    elif imp[0] == "sym":
+                        desc = {"t": "symbol", "mod": imp[1],
+                                "name": imp[2]}
+                    else:
+                        desc = {"t": "ext", "mod": imp[1], "attr": n}
+            elif isinstance(f, ast.Attribute):
+                attr = f.attr
+                base = f.value
+                if isinstance(base, ast.Name):
+                    b = base.id
+                    if b in ("self", "cls"):
+                        desc = {"t": "self", "attr": attr}
+                    elif b in var_types:
+                        desc = {"t": "typed", "cls": var_types[b],
+                                "attr": attr}
+                    elif b in self.imports:
+                        imp = self.imports[b]
+                        if imp[0] == "ext":
+                            desc = {"t": "ext", "mod": imp[1],
+                                    "attr": attr}
+                        elif imp[0] == "sym":
+                            desc = {"t": "typed",
+                                    "cls": f"{imp[1]}.{imp[2]}",
+                                    "attr": attr}
+                        else:
+                            desc = {"t": "modfunc", "mod": imp[1],
+                                    "name": attr}
+                    else:
+                        desc = {"t": "unknown", "attr": attr}
+                elif isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id in ("self", "cls"):
+                    at = attr_types.get(base.attr)
+                    if at:
+                        desc = {"t": "typed", "cls": at, "attr": attr}
+                    else:
+                        lk = lock_attrs.get(base.attr)
+                        desc = {"t": "unknown", "attr": attr,
+                                "recv_lock": lk}
+                elif isinstance(base, ast.Call):
+                    d = _dotted(base.func) or ""
+                    if d.endswith("super"):
+                        desc = {"t": "super", "attr": attr, "cls": cls}
+                    else:
+                        desc = {"t": "unknown", "attr": attr}
+                else:
+                    desc = {"t": "unknown", "attr": attr}
+            else:
+                return  # call of a call / subscript — opaque
+            desc["line"] = node.lineno
+            desc["locks"] = list(locks)
+
+            dd = _dotted(f) or ""
+            # -- effects at the call site ---------------------------------
+            attr = desc.get("attr") or desc.get("name") or ""
+            if attr in BLOCKING_ATTRS:
+                effect("blocking", BLOCKING_ATTRS[attr], dd or attr,
+                       node.lineno, locks)
+            elif dd == "time.sleep":
+                effect("blocking", "sleep", dd, node.lineno, locks)
+            elif dd == "os.replace":
+                effect("blocking", "fsync", dd, node.lineno, locks)
+            elif dd.startswith("subprocess.") and \
+                    dd.split(".")[-1] in SUBPROCESS_ATTRS:
+                effect("blocking", "subprocess", dd, node.lineno, locks)
+            elif attr == "note_blocking" and node.args and \
+                    isinstance(node.args[0], ast.Constant):
+                effect("blocking", node.args[0].value,
+                       "note_blocking marker", node.lineno, locks)
+            if attr in ("fire", "fire_lossy", "_maybe_fail"):
+                rec["fp_armed"] = True
+            if attr in HOST_SYNC_ATTRS:
+                effect("host_sync", attr, dd or attr, node.lineno, locks)
+            elif desc.get("t") == "ext" and \
+                    desc.get("mod") == "numpy" and \
+                    attr in NP_SYNC_FUNCS:
+                # jnp.* is traced, not a sync — only REAL numpy
+                # materialises device buffers on the host
+                effect("host_sync", f"np.{attr}", dd, node.lineno, locks)
+            if loop > 0:
+                ref = self._class_ref(f) if isinstance(f, ast.Name) \
+                    else None
+                leaf = (ref or "").rsplit(".", 1)[-1]
+                if (ref and leaf[:1].isupper()) or attr in ALLOC_ATTRS:
+                    effect("alloc", "per_item", dd or leaf, node.lineno,
+                           locks, loop)
+
+            # -- spawn / deferred refs ------------------------------------
+            refs = []
+            for kw in node.keywords:
+                r = self._func_ref(kw.value, qual, local_defs)
+                if r:
+                    refs.append({"kw": kw.arg, "ref": r})
+            for i, a in enumerate(node.args):
+                r = self._func_ref(a, qual, local_defs)
+                if r:
+                    refs.append({"pos": i, "ref": r})
+            if refs:
+                desc["refs"] = refs
+            if dd in ("threading.Thread", "Thread"):
+                target = next((r["ref"] for r in refs
+                               if r.get("kw") == "target"), None)
+                nkw = next((kw.value for kw in node.keywords
+                            if kw.arg == "name"), None)
+                desc["spawn"] = {"target": target,
+                                 "name": _name_prefix(nkw)}
+            if dd.endswith("functools.partial") or dd == "partial":
+                if node.args:
+                    r = self._func_ref(node.args[0], qual, local_defs)
+                    if r:
+                        desc["partial"] = r
+            rec["calls"].append(desc)
+
+        def walk(node, locks, loop):
+            if isinstance(node, ast.With):
+                entered = list(locks)
+                for item in node.items:
+                    ln = lockname_of(item.context_expr)
+                    if ln:
+                        rec["acquires"].append(
+                            {"lock": ln, "line": node.lineno,
+                             "held": list(entered),
+                             "text": text(node.lineno)})
+                        entered.append(ln)
+                for child in node.body:
+                    walk(child, tuple(entered), loop)
+                return
+            if isinstance(node, ast.Call):
+                call_desc(node, locks, loop)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, locks, loop)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: body runs LATER, not under these locks —
+                # extracted as its own function below
+                nested_qual = f"{qual}.<locals>.{node.name}"
+                self._extract_func(node, nested_qual, cls)
+                return
+            if isinstance(node, ast.Lambda):
+                return
+            if isinstance(node, (ast.For, ast.While, ast.ListComp,
+                                 ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, locks, loop + 1)
+                return
+            if isinstance(node, ast.Raise):
+                # an exception ctor is the loop's EXIT, not a per-item
+                # allocation — drop the loop context for the alloc pass
+                for child in ast.iter_child_nodes(node):
+                    walk(child, locks, 0)
+                return
+            if is_jit and isinstance(node, (ast.If, ast.While)) and \
+                    hasattr(node, "test"):
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Attribute) and \
+                            sub.attr == "shape":
+                        effect("jit_branch", "shape", "shape-dependent "
+                               "branch", node.lineno, locks)
+                        break
+                    if isinstance(sub, ast.Name) and \
+                            sub.id in params and \
+                            sub.id not in static_params and \
+                            isinstance(node.test, ast.Name):
+                        effect("jit_branch", "tracer-bool",
+                               f"branch on traced arg {sub.id!r}",
+                               node.lineno, locks)
+                        break
+            if is_jit and isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                effect("blocking", "print", "print", node.lineno, locks)
+            for child in ast.iter_child_nodes(node):
+                walk(child, locks, loop)
+
+        for st in fn.body:
+            walk(st, (), 0)
+
+        # `jax.jit(run)` wrapping a nested def marks it jit after the fact
+        for c in rec["calls"]:
+            pass  # (handled in linker via JIT_WRAP below)
+
+    def _func_ref(self, expr: ast.expr, encl_qual: str,
+                  local_defs: set) -> Optional[dict]:
+        """A Name/Attribute argument that may be a function value."""
+        if isinstance(expr, ast.Name):
+            if expr.id in local_defs:
+                return {"t": "qual",
+                        "q": f"{encl_qual}.<locals>.{expr.id}"}
+            imp = self.imports.get(expr.id)
+            if imp and imp[0] == "sym":
+                return {"t": "symbol", "mod": imp[1], "name": imp[2]}
+            if imp is None:
+                return {"t": "bare", "name": expr.id}
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            return {"t": "self", "attr": expr.attr}
+        return None
+
+    def summary(self) -> dict:
+        return {"module": self.module, "relpath": self.relpath,
+                "imports": self.imports, "classes": self.classes,
+                "funcs": self.funcs, "suppress": self.suppress}
+
+
+def extract_module(src: str, relpath: str) -> dict:
+    return _ModuleExtract(src, relpath).summary()
+
+
+# ---------------------------------------------------------------------------
+# linking: summaries -> call graph
+# ---------------------------------------------------------------------------
+
+class Graph:
+    def __init__(self, summaries: dict[str, dict]):
+        self.summaries = summaries          # relpath -> module summary
+        self.funcs: dict[str, dict] = {}    # qual -> func record
+        self.classes: dict[str, dict] = {}  # "module.Cls" -> class info
+        self.subclasses: dict[str, list] = {}
+        self.method_index: dict[str, list] = {}
+        self.module_of: dict[str, str] = {}
+        self.edges: dict[str, list] = {}    # qual -> [target quals]
+        self.redges: dict[str, list] = {}   # reverse (call + ref)
+        self.ref_edges: dict[str, list] = {}
+        self.roots: list[tuple[str, str, str]] = []  # (qual, plane, why)
+        self.stats = {"files": 0, "defs": 0, "classes": 0,
+                      "sites": 0, "candidates": 0, "resolved": 0,
+                      "generic_skipped": 0}
+        self._reach: dict[str, frozenset] = {}
+        self._build_indexes()
+        self._link()
+        self._find_roots()
+
+    # -- indexes -----------------------------------------------------------
+    def _build_indexes(self) -> None:
+        for rel, s in self.summaries.items():
+            self.stats["files"] += 1
+            mod = s["module"]
+            for cname, cinfo in s["classes"].items():
+                self.classes[f"{mod}.{cname}"] = cinfo
+            for qual, f in s["funcs"].items():
+                self.funcs[qual] = f
+                self.module_of[qual] = mod
+                if f["cls"] and "<locals>" not in qual:
+                    self.method_index.setdefault(f["name"], []).append(qual)
+        self.stats["defs"] = len(self.funcs)
+        self.stats["classes"] = len(self.classes)
+        for cq, ci in self.classes.items():
+            for b in ci["bases"]:
+                bq = self.resolve_class(b)
+                if bq:
+                    self.subclasses.setdefault(bq, []).append(cq)
+
+    def resolve_class(self, ref: Optional[str]) -> Optional[str]:
+        """Dotted ref -> canonical class qual, chasing one or two levels
+        of package __init__ re-exports ('protocol.Block' ->
+        'protocol.block.Block')."""
+        if not ref:
+            return None
+        for _ in range(3):
+            if ref in self.classes:
+                return ref
+            mod, _, name = ref.rpartition(".")
+            s = self._summary_of_module(mod)
+            if s is None:
+                return None
+            imp = s["imports"].get(name)
+            if imp and imp[0] == "sym":
+                ref = f"{imp[1]}.{imp[2]}"
+            else:
+                return None
+        return ref if ref in self.classes else None
+
+    def _summary_of_module(self, mod: str) -> Optional[dict]:
+        for s in self.summaries.values():
+            if s["module"] == mod:
+                return s
+        return None
+
+    def find_method(self, clsqual: str, name: str,
+                    seen=None) -> Optional[str]:
+        if seen is None:
+            seen = set()
+        if clsqual in seen or clsqual not in self.classes:
+            return None
+        seen.add(clsqual)
+        ci = self.classes[clsqual]
+        if name in ci["methods"]:
+            return f"{clsqual}.{name}"
+        for b in ci["bases"]:
+            bq = self.resolve_class(b)
+            if bq:
+                hit = self.find_method(bq, name, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _overrides(self, clsqual: str, name: str) -> list:
+        """Methods named `name` on transitive subclasses of clsqual."""
+        out, todo, seen = [], [clsqual], set()
+        while todo:
+            c = todo.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            for sub in self.subclasses.get(c, ()):  # CHA dispatch
+                if name in self.classes[sub]["methods"]:
+                    out.append(f"{sub}.{name}")
+                todo.append(sub)
+        return out
+
+    # -- resolution --------------------------------------------------------
+    def _resolve_desc(self, desc: dict, encl: dict) -> tuple[list, bool]:
+        """-> (target quals, counts_in_denominator)."""
+        t = desc["t"]
+        mod = encl["module"]
+        if t == "qual":
+            q = desc["q"]
+            return ([q] if q in self.funcs else [], True)
+        if t == "bare":
+            n = desc["name"]
+            q = f"{mod}.{n}"
+            if q in self.funcs:
+                return [q], True
+            cq = self.resolve_class(f"{mod}.{n}")
+            if cq:
+                ctor = self.find_method(cq, "__init__")
+                return ([ctor] if ctor else []), True
+            return [], False  # builtin / unresolvable bare name
+        if t == "symbol":
+            ref = f"{desc['mod']}.{desc['name']}"
+            q = self._resolve_symbol(ref)
+            if q:
+                return q, True
+            # symbol imported from a repo module but not found: external
+            # re-export or dynamic — count only if it LOOKS like ours
+            return [], desc["mod"] in {s["module"]
+                                       for s in self.summaries.values()}
+        if t == "modfunc":
+            q = self._resolve_symbol(f"{desc['mod']}.{desc['name']}")
+            return (q or []), True
+        if t in ("self", "super"):
+            cls = encl["cls"] if t == "self" else desc.get("cls")
+            if not cls:
+                return [], False
+            cq = f"{mod}.{cls}"
+            if t == "super":
+                ci = self.classes.get(cq)
+                hit = None
+                if ci:
+                    for b in ci["bases"]:
+                        bq = self.resolve_class(b)
+                        if bq:
+                            hit = self.find_method(bq, desc["attr"])
+                            if hit:
+                                break
+                return ([hit] if hit else []), True
+            hit = self.find_method(cq, desc["attr"])
+            targets = [hit] if hit else []
+            targets += self._overrides(cq, desc["attr"])
+            return list(dict.fromkeys(targets)), True
+        if t == "typed":
+            cq = self.resolve_class(desc["cls"])
+            if cq is None:
+                # typed ref didn't resolve to a repo class (external)
+                return [], False
+            attr = desc["attr"]
+            if attr == "__init__" or attr == cq.rsplit(".", 1)[-1]:
+                hit = self.find_method(cq, "__init__")
+                return ([hit] if hit else []), True
+            hit = self.find_method(cq, attr)
+            targets = ([hit] if hit else []) + self._overrides(cq, attr)
+            return list(dict.fromkeys(targets)), True
+        if t == "unknown":
+            attr = desc["attr"]
+            if attr in GENERIC_NAMES:
+                self.stats["generic_skipped"] += 1
+                return [], False
+            cands = self.method_index.get(attr, [])
+            if 1 <= len(cands) <= CHA_CAP:
+                return list(cands), True
+            return [], bool(cands)  # too many same-name: honest miss
+        if t == "ext":
+            return [], False
+        return [], False
+
+    def _resolve_symbol(self, ref: str) -> Optional[list]:
+        """'module.sym' -> [func qual] (function, or class -> its ctor),
+        chasing __init__ re-exports."""
+        for _ in range(3):
+            if ref in self.funcs:
+                return [ref]
+            if ref in self.classes:
+                ctor = self.find_method(ref, "__init__")
+                return [ctor] if ctor else []
+            mod, _, name = ref.rpartition(".")
+            s = self._summary_of_module(mod)
+            if s is None:
+                return None
+            imp = s["imports"].get(name)
+            if imp and imp[0] == "sym":
+                ref = f"{imp[1]}.{imp[2]}"
+            else:
+                return None
+        return None
+
+    def _link(self) -> None:
+        jit_wrapped: set[str] = set()
+        for qual, f in self.funcs.items():
+            targets: list[str] = []
+            refs: list[str] = []
+            for c in f["calls"]:
+                self.stats["sites"] += 1
+                tg, counts = self._resolve_desc(c, f)
+                if counts:
+                    self.stats["candidates"] += 1
+                    if tg:
+                        self.stats["resolved"] += 1
+                c["targets"] = tg
+                if "spawn" in c:
+                    # thread target runs on ITS OWN plane, not as a call
+                    pass
+                else:
+                    targets += tg
+                for r in c.get("refs", []):
+                    rq, _ = self._resolve_desc(
+                        {**r["ref"], "line": c["line"]}, f)
+                    r["targets"] = rq
+                    refs += rq
+                if "partial" in c:
+                    pq, _ = self._resolve_desc(
+                        {**c["partial"], "line": c["line"]}, f)
+                    c["partial_targets"] = pq
+                    refs += pq
+                # `x = jax.jit(run)` / `return jax.jit(run)`
+                dd = c.get("attr") or c.get("name") or ""
+                if c["t"] == "ext" and c.get("mod") == "jax" and \
+                        dd == "jit":
+                    for r in c.get("refs", []):
+                        jit_wrapped.update(r.get("targets", []))
+            self.edges[qual] = list(dict.fromkeys(targets))
+            self.ref_edges[qual] = list(dict.fromkeys(refs))
+        for q in jit_wrapped:
+            if q in self.funcs:
+                self.funcs[q]["jit"] = True
+        for src, ts in self.edges.items():
+            for t in ts:
+                self.redges.setdefault(t, []).append(src)
+        for src, ts in self.ref_edges.items():
+            for t in ts:
+                self.redges.setdefault(t, []).append(src)
+
+    # -- roots / planes ----------------------------------------------------
+    def _classify_name(self, name: str) -> str:
+        for prefix, role in planes.EXTRA_ROLE_PREFIXES:
+            if name.startswith(prefix):
+                return role
+        return profiler.classify(name)
+
+    def _find_roots(self) -> None:
+        seen = set()
+
+        def add(qual, plane, why):
+            if qual in self.funcs and (qual, plane) not in seen:
+                seen.add((qual, plane))
+                self.roots.append((qual, plane, why))
+
+        for qual, plane in planes.ROOT_OVERRIDES.items():
+            add(qual, plane, "override")
+        worker_base = None
+        for cq in self.classes:
+            if cq.endswith("utils.worker.Worker") or cq == "utils.worker.Worker":
+                worker_base = cq
+        for qual, f in self.funcs.items():
+            for c in f["calls"]:
+                sp = c.get("spawn")
+                if sp and sp.get("target"):
+                    tq, _ = self._resolve_desc(
+                        {**sp["target"], "line": c["line"]}, f)
+                    for q in tq:
+                        plane = self._classify_name(sp["name"] or "") \
+                            if sp.get("name") else None
+                        if plane is None or plane == "other":
+                            plane = planes.ROOT_OVERRIDES.get(q, "other")
+                        add(q, plane, f"Thread in {qual}")
+                for r in c.get("refs", []):
+                    plane = None
+                    attr = c.get("attr") or c.get("name") or ""
+                    if attr in planes.CALLBACK_PLANES:
+                        plane = planes.CALLBACK_PLANES[attr]
+                    ckey = (attr, r.get("kw"))
+                    if ckey in planes.CTOR_CALLBACK_KWARGS:
+                        plane = planes.CTOR_CALLBACK_KWARGS[ckey]
+                    if plane:
+                        for q in r.get("targets", []):
+                            add(q, plane, f"callback via {attr} in {qual}")
+        # Worker subclasses: the loop thread's body is execute_worker();
+        # the plane comes from the literal name in super().__init__("...")
+        if worker_base:
+            for sub in self.subclasses.get(worker_base, []):
+                ctor = self.find_method(sub, "__init__")
+                name = None
+                if ctor and ctor in self.funcs:
+                    for c in self.funcs[ctor]["calls"]:
+                        if c["t"] == "super" and c["attr"] == "__init__":
+                            name = c.get("ctor_name")
+                # fall back to scanning the ctor source line via calls'
+                # recorded name literal (stored by extractor below)
+                name = name or self.classes[sub].get("worker_name")
+                plane = self._classify_name(name) if name else "other"
+                ew = self.find_method(sub, "execute_worker")
+                if ew:
+                    add(ew, plane, f"Worker subclass {sub}")
+        # deep subclasses of Worker subclasses inherit via _overrides
+        # already (execute_worker override fan-out at the call site).
+
+    # -- reachability ------------------------------------------------------
+    def reach(self, qual: str) -> frozenset:
+        """All functions transitively callable from qual (call edges)."""
+        hit = self._reach.get(qual)
+        if hit is not None:
+            return hit
+        seen = set()
+        todo = [qual]
+        while todo:
+            q = todo.pop()
+            for t in self.edges.get(q, ()):
+                if t not in seen:
+                    seen.add(t)
+                    todo.append(t)
+        fs = frozenset(seen)
+        self._reach[qual] = fs
+        return fs
+
+    def chain(self, src: str, dst: str, maxlen: int = 10) -> list[str]:
+        """Shortest call path src -> dst (BFS, for finding messages)."""
+        if src == dst:
+            return [src]
+        parent = {src: None}
+        todo = [src]
+        while todo:
+            nxt = []
+            for q in todo:
+                for t in self.edges.get(q, ()):
+                    if t in parent:
+                        continue
+                    parent[t] = q
+                    if t == dst:
+                        out = [t]
+                        while parent[out[-1]] is not None:
+                            out.append(parent[out[-1]])
+                        return list(reversed(out))[:maxlen]
+                    nxt.append(t)
+            todo = nxt
+        return [src, "...", dst]
+
+    def dump(self) -> dict:
+        return {
+            "stats": dict(self.stats,
+                          resolution=self.resolution_rate()),
+            "roots": [{"func": q, "plane": p, "why": w}
+                      for q, p, w in self.roots],
+            "functions": [
+                {"qual": q, "path": f["path"], "line": f["line"],
+                 "jit": f["jit"], "fp_armed": f["fp_armed"],
+                 "effects": [{k: e[k] for k in
+                              ("cat", "kind", "what", "line")}
+                             for e in f["effects"]],
+                 "acquires": [{"lock": a["lock"], "line": a["line"]}
+                              for a in f["acquires"]]}
+                for q, f in sorted(self.funcs.items())],
+            "edges": [[s, t] for s, ts in sorted(self.edges.items())
+                      for t in ts],
+            "ref_edges": [[s, t]
+                          for s, ts in sorted(self.ref_edges.items())
+                          for t in ts],
+        }
+
+    def resolution_rate(self) -> float:
+        c = self.stats["candidates"]
+        return (self.stats["resolved"] / c) if c else 1.0
+
+
+# ---------------------------------------------------------------------------
+# findings + passes
+# ---------------------------------------------------------------------------
+
+class Finding(bcoslint.Violation):
+    """Same key/fingerprint/baseline semantics as a bcoslint Violation;
+    carries the interprocedural witness chain in the message."""
+
+
+def _suppressed(summary: dict, line: int, rule: str) -> bool:
+    for ln in (line, line - 1):
+        rules = summary["suppress"].get(ln)
+        if rules is not None:
+            if rules == "all" or rule in [r.strip()
+                                          for r in rules.split(",")]:
+                return True
+    return False
+
+
+def _scope_of(qual: str, f: dict) -> str:
+    mod = f["module"]
+    return qual[len(mod) + 1:] if qual.startswith(mod + ".") else qual
+
+
+def _fmt_chain(chain: list[str]) -> str:
+    # trim module prefixes for readability; keep first and last full
+    if len(chain) <= 1:
+        return chain[0] if chain else ""
+    tail = [q.rsplit(".", 1)[-1] if q != "..." else q
+            for q in chain[1:-1]]
+    return " -> ".join([chain[0]] + tail + [chain[-1]])
+
+
+class Analyzer:
+    def __init__(self, graph: Graph):
+        self.g = graph
+        self.findings: list[Finding] = []
+
+    def _summary_for(self, f: dict) -> dict:
+        return self.g.summaries[f["relpath"]] \
+            if f["relpath"] in self.g.summaries else \
+            next(s for s in self.g.summaries.values()
+                 if s["module"] == f["module"])
+
+    def _emit(self, rule: str, qual: str, line: int, text: str,
+              message: str) -> None:
+        f = self.g.funcs[qual]
+        s = next(s for s in self.g.summaries.values()
+                 if s["module"] == f["module"]
+                 and qual in s["funcs"])
+        if _suppressed(s, line, rule):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=f["path"], line=line,
+            scope=_scope_of(qual, f), text=text, message=message))
+
+    def run(self) -> list[Finding]:
+        self.pass_plane_blocking()
+        self.pass_lock_blocking()
+        self.pass_lock_order()
+        self.pass_fsync_coverage()
+        self.pass_lane_host_sync()
+        self.pass_jit()
+        self.pass_hot_loop_alloc()
+        return self.findings
+
+    # -- pass: plane contracts --------------------------------------------
+    def pass_plane_blocking(self) -> None:
+        done = set()
+        for root, plane, _why in self.g.roots:
+            forbid = planes.PLANE_CONTRACTS.get(plane)
+            if not forbid:
+                continue
+            for q in [root, *self.g.reach(root)]:
+                f = self.g.funcs.get(q)
+                if f is None:
+                    continue
+                for e in f["effects"]:
+                    if e["cat"] != "blocking" or e["kind"] not in forbid:
+                        continue
+                    key = (plane, q, e["kind"])
+                    if key in done:
+                        continue
+                    done.add(key)
+                    chain = self.g.chain(root, q)
+                    self._emit(
+                        "plane-blocking", q, e["line"], e["text"],
+                        f"{e['what']} ({e['kind']}) reachable from the "
+                        f"'{plane}' plane (root {root}) — forbidden by "
+                        f"the plane contract (analysis/planes.py); "
+                        f"path: {_fmt_chain(chain)}")
+
+    # -- pass: blocking under a hot lock, across call boundaries ----------
+    def pass_lock_blocking(self) -> None:
+        done = set()
+        for qual, f in self.g.funcs.items():
+            for c in f["calls"]:
+                held = [L for L in c.get("locks", ())
+                        if L in lockorder.HOT_LOCKS]
+                if not held:
+                    continue
+                for t in c.get("targets", []):
+                    for q in [t, *self.g.reach(t)]:
+                        g = self.g.funcs.get(q)
+                        if g is None or q == qual:
+                            continue
+                        for e in g["effects"]:
+                            if e["cat"] != "blocking":
+                                continue
+                            for L in held:
+                                allow = lockorder.HOT_LOCKS[L]
+                                if e["kind"] in allow or \
+                                        e["kind"] == "print":
+                                    continue
+                                key = (L, q, e["kind"])
+                                if key in done:
+                                    continue
+                                done.add(key)
+                                chain = self.g.chain(t, q)
+                                self._emit(
+                                    "lock-blocking-interproc", q,
+                                    e["line"], e["text"],
+                                    f"{e['what']} ({e['kind']}) runs "
+                                    f"under hot lock {L} held in {qual} "
+                                    f"(line {c['line']}); path: "
+                                    f"{qual} -> {_fmt_chain(chain)}")
+
+    # -- pass: interprocedural lock ordering -------------------------------
+    def pass_lock_order(self) -> None:
+        done = set()
+        for qual, f in self.g.funcs.items():
+            for c in f["calls"]:
+                ranked = [L for L in c.get("locks", ())
+                          if L in lockorder.RANK]
+                if not ranked:
+                    continue
+                for t in c.get("targets", []):
+                    for q in [t, *self.g.reach(t)]:
+                        g = self.g.funcs.get(q)
+                        if g is None or q == qual:
+                            continue
+                        for a in g["acquires"]:
+                            M = a["lock"]
+                            rb = lockorder.RANK.get(M)
+                            if rb is None:
+                                continue
+                            for L in ranked:
+                                ra = lockorder.RANK[L]
+                                if M == L or ra < rb:
+                                    continue
+                                key = (L, M, q)
+                                if key in done:
+                                    continue
+                                done.add(key)
+                                chain = self.g.chain(t, q)
+                                self._emit(
+                                    "lock-order-interproc", q,
+                                    a["line"], a["text"],
+                                    f"acquires {M} (rank {rb}) while "
+                                    f"{L} (rank {ra}) is held in {qual} "
+                                    f"(line {c['line']}) — canonical "
+                                    f"order inverted across calls; "
+                                    f"path: {qual} -> "
+                                    f"{_fmt_chain(chain)}")
+
+    # -- pass: whole-program failpoint coverage of durability edges --------
+    def pass_fsync_coverage(self) -> None:
+        for qual, f in self.g.funcs.items():
+            if not any(f["path"].startswith(p) for p in FSYNC_FP_SCOPE):
+                continue
+            sites = [e for e in f["effects"]
+                     if e["cat"] == "blocking" and e["kind"] == "fsync"
+                     and e["what"] != "note_blocking marker"]
+            if not sites or f["fp_armed"]:
+                continue
+            # covered iff EVERY path from an entry point down to this
+            # function crosses a failpoint-armed function
+            bare = self._unarmed_entry_chain(qual)
+            if bare is None:
+                continue
+            e = sites[0]
+            self._emit(
+                "fsync-path-unarmed", qual, e["line"], e["text"],
+                f"{e['what']} (durability edge) with no failpoint "
+                f"site on the call path from {bare[0]} "
+                f"({_fmt_chain(bare)}) — the kill -9 matrix cannot "
+                f"exercise this edge (utils/failpoints.py)")
+
+    def _unarmed_entry_chain(self, qual: str) -> Optional[list]:
+        """A caller chain entry->qual crossing NO fp-armed function, or
+        None if every path is armed. DFS over reverse edges."""
+        seen = set()
+        stack = [(qual, [qual])]
+        while stack:
+            q, path = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            callers = self.g.redges.get(q, [])
+            callers = [c for c in callers if c not in path]  # no cycles
+            if not callers:
+                return list(reversed(path))  # reached an entry, unarmed
+            for c in callers:
+                fc = self.g.funcs.get(c)
+                if fc is None or fc["fp_armed"]:
+                    continue  # this path is armed (or leaves the repo)
+                stack.append((c, path + [c]))
+        return None
+
+    # -- pass: host syncs reachable from the lane dispatcher ---------------
+    def pass_lane_host_sync(self) -> None:
+        done = set()
+        for root, plane, _why in self.g.roots:
+            if plane != "lane":
+                continue
+            for q in [root, *self.g.reach(root)]:
+                f = self.g.funcs.get(q)
+                if f is None:
+                    continue
+                if any(f["path"].startswith(p)
+                       for p in planes.LANE_SYNC_BOUNDARY):
+                    continue
+                for e in f["effects"]:
+                    if e["cat"] != "host_sync":
+                        continue
+                    key = (q, e["line"])
+                    if key in done:
+                        continue
+                    done.add(key)
+                    chain = self.g.chain(root, q)
+                    self._emit(
+                        "lane-host-sync", q, e["line"], e["text"],
+                        f"{e['what']} host<->device sync reachable from "
+                        f"the crypto-lane dispatcher OUTSIDE the "
+                        f"sanctioned demux boundary; path: "
+                        f"{_fmt_chain(chain)}")
+
+    # -- pass: jit purity --------------------------------------------------
+    def pass_jit(self) -> None:
+        for qual, f in self.g.funcs.items():
+            if not f["jit"]:
+                continue
+            for e in f["effects"]:
+                if e["cat"] == "blocking":
+                    self._emit(
+                        "jit-impure", qual, e["line"], e["text"],
+                        f"{e['what']} ({e['kind']}) inside a jit-traced "
+                        f"function — side effects run ONCE at trace "
+                        f"time, then never again")
+                elif e["cat"] == "host_sync":
+                    self._emit(
+                        "jit-impure", qual, e["line"], e["text"],
+                        f"{e['what']} inside a jit-traced function — "
+                        f"forces a host sync / breaks the trace")
+                elif e["cat"] == "jit_branch":
+                    self._emit(
+                        "jit-shape-branch", qual, e["line"], e["text"],
+                        f"{e['what']} inside a jit body — one compile "
+                        f"per encountered shape; pad through the bucket "
+                        f"discipline instead")
+
+    # -- pass: per-item allocation on the hot path -------------------------
+    def pass_hot_loop_alloc(self) -> None:
+        done = set()
+        for root, plane, _why in self.g.roots:
+            if plane not in planes.HOT_PATH_PLANES:
+                continue
+            for q in [root, *self.g.reach(root)]:
+                f = self.g.funcs.get(q)
+                if f is None:
+                    continue
+                if not any(f["path"].startswith(p)
+                           for p in planes.HOT_ALLOC_SCOPE):
+                    continue
+                for e in f["effects"]:
+                    if e["cat"] != "alloc":
+                        continue
+                    key = (q, e["line"])
+                    if key in done:
+                        continue
+                    done.add(key)
+                    chain = self.g.chain(root, q)
+                    self._emit(
+                        "hot-loop-alloc", q, e["line"], e["text"],
+                        f"per-item object construction ({e['what']}) in "
+                        f"a loop on the '{plane}' hot path — the "
+                        f"columnar contract (ROADMAP-1) wants batch "
+                        f"arrays, not N Python objects; path: "
+                        f"{_fmt_chain(chain)}")
+
+
+RULES = ("plane-blocking", "lock-blocking-interproc",
+         "lock-order-interproc", "fsync-path-unarmed", "lane-host-sync",
+         "jit-impure", "jit-shape-branch", "hot-loop-alloc")
+
+
+# ---------------------------------------------------------------------------
+# worker-name sidecar: the extractor stores the literal passed to
+# super().__init__ on the class, so the linker can classify Worker planes
+# ---------------------------------------------------------------------------
+
+_orig_extract = _ModuleExtract._extract_func
+
+
+def _extract_func_with_worker_name(self, fn, qual, cls):
+    _orig_extract(self, fn, qual, cls)
+    if fn.name != "__init__" or cls is None:
+        return
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "__init__" and \
+                isinstance(node.func.value, ast.Call) and \
+                (_dotted(node.func.value.func) or "") == "super":
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self.classes[cls]["worker_name"] = node.args[0].value
+
+
+_ModuleExtract._extract_func = _extract_func_with_worker_name
+
+
+# ---------------------------------------------------------------------------
+# driver: files -> summaries (cached) -> graph -> findings
+# ---------------------------------------------------------------------------
+
+def _sha(src: bytes) -> str:
+    return hashlib.sha1(src).hexdigest()
+
+
+def load_summaries(paths: list[str], cache_file: Optional[str] = None
+                   ) -> tuple[dict, dict]:
+    """-> ({relpath: summary}, cache_stats)."""
+    cache = {"version": SUMMARY_VERSION, "files": {}}
+    if cache_file and os.path.exists(cache_file):
+        try:
+            loaded = json.load(open(cache_file, encoding="utf-8"))
+            if loaded.get("version") == SUMMARY_VERSION:
+                cache = loaded
+        except (OSError, ValueError):
+            pass
+    summaries: dict[str, dict] = {}
+    hits = misses = 0
+    new_cache = {"version": SUMMARY_VERSION, "files": {}}
+    for path in bcoslint.iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), REPO).replace(
+            os.sep, "/")
+        try:
+            raw = open(path, "rb").read()
+        except OSError:
+            continue
+        sha = _sha(raw)
+        ent = cache["files"].get(rel)
+        if ent and ent.get("sha") == sha:
+            summary = ent["summary"]
+            # JSON round-trip turns int keys into strings
+            summary["suppress"] = {int(k): v for k, v in
+                                   summary["suppress"].items()}
+            hits += 1
+        else:
+            try:
+                summary = extract_module(raw.decode("utf-8"), rel)
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                print(f"bcosflow: cannot parse {rel}: {exc}",
+                      file=sys.stderr)
+                continue
+            misses += 1
+        summaries[rel] = summary
+        new_cache["files"][rel] = {"sha": sha, "summary": summary}
+    if cache_file:
+        try:
+            with open(cache_file, "w", encoding="utf-8") as f:
+                json.dump(new_cache, f)
+        except OSError:
+            pass
+    return summaries, {"cache_hits": hits, "cache_misses": misses}
+
+
+def analyze_sources(sources: dict[str, str]) -> tuple[list, Graph]:
+    """Fixture entry point: {relpath: src} -> (findings, graph)."""
+    summaries = {rel: extract_module(src, rel)
+                 for rel, src in sources.items()}
+    graph = Graph(summaries)
+    return Analyzer(graph).run(), graph
+
+
+def git_changed_files() -> Optional[set]:
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=REPO, timeout=20,
+            capture_output=True, text=True)
+        if out.returncode != 0:
+            return None
+        changed = set()
+        for ln in out.stdout.splitlines():
+            p = ln[3:].split(" -> ")[-1].strip().strip('"')
+            if p.endswith(".py"):
+                changed.add(p)
+        head = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD~1", "HEAD"], cwd=REPO,
+            timeout=20, capture_output=True, text=True)
+        if head.returncode == 0:
+            for p in head.stdout.splitlines():
+                if p.endswith(".py"):
+                    changed.add(p.strip())
+        return changed
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="findings as a JSON array on stdout")
+    ap.add_argument("--graph", metavar="FILE",
+                    help="dump the resolved call graph as JSON "
+                    "('-' for stdout)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print resolution/timing stats and exit 0")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only for git-changed files "
+                    "(cached module summaries make this fast)")
+    ap.add_argument("--cache-file", default=DEFAULT_CACHE)
+    ap.add_argument("--no-cache", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(r)
+        return 0
+
+    t0 = time.monotonic()
+    paths = args.paths or [os.path.join(REPO, PKG)]
+    cache_file = None if args.no_cache else args.cache_file
+    summaries, cstats = load_summaries(paths, cache_file)
+    graph = Graph(summaries)
+    findings = Analyzer(graph).run()
+    elapsed = time.monotonic() - t0
+
+    if args.graph:
+        payload = json.dumps(graph.dump(), indent=1)
+        if args.graph == "-":
+            print(payload)
+        else:
+            with open(args.graph, "w", encoding="utf-8") as f:
+                f.write(payload)
+            print(f"bcosflow: graph -> {args.graph}")
+
+    if args.update_baseline:
+        old = bcoslint.load_baseline(args.baseline)
+        bcoslint.write_baseline(args.baseline, findings, old)
+        print(f"bcosflow: baseline rewritten with "
+              f"{len({v.key for v in findings})} entr(y/ies) -> "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    scope = None
+    if args.changed_only:
+        scope = git_changed_files()
+        if scope is not None:
+            findings = [v for v in findings if v.path in scope]
+
+    baseline = {} if args.no_baseline else \
+        bcoslint.load_baseline(args.baseline)
+    fresh = [v for v in findings if v.key not in baseline]
+    stale = set(baseline) - {v.key for v in findings}
+    if scope is not None:  # only judge staleness inside the scope
+        stale = {k for k in stale if k[1] in scope}
+
+    if args.json:
+        print(json.dumps([{
+            "rule": v.rule, "path": v.path, "line": v.line,
+            "scope": v.scope, "message": v.message,
+            "baselined": v.key in baseline} for v in findings], indent=1))
+    else:
+        for v in fresh:
+            print(v.render())
+        if stale and not args.changed_only:
+            print(f"bcosflow: {len(stale)} stale baseline entr(y/ies) — "
+                  "run --update-baseline to prune:", file=sys.stderr)
+            for key in sorted(stale):
+                print(f"    {key[0]}|{key[1]}|{key[2]}", file=sys.stderr)
+
+    s = graph.stats
+    print(f"bcosflow: {s['files']} files, {s['defs']} defs, "
+          f"{s['resolved']}/{s['candidates']} intra-repo call edges "
+          f"resolved ({100 * graph.resolution_rate():.1f}%), "
+          f"{len(graph.roots)} plane roots, "
+          f"{len(fresh)} new finding(s), "
+          f"{len(findings) - len(fresh)} grandfathered, "
+          f"{len(stale)} stale, "
+          f"cache {cstats['cache_hits']}h/{cstats['cache_misses']}m, "
+          f"{elapsed:.1f}s",
+          file=sys.stderr if args.json or args.graph == "-" else
+          sys.stdout)
+    if args.stats:
+        return 0
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,11 @@ Rules:
     dict-iter-mutation    `for k in d:` whose body pops/clears d — dict
                           mutated during iteration raises at runtime
     unused-import         import never referenced (hygiene pass)
+    thread-start-in-ctor  a thread started inside __init__ — the new thread
+                          can observe a partially-constructed object (the
+                          p2p _Session writer raced its own registration)
+    log-in-hot-loop       f-string log call inside a loop on the hot path —
+                          formats per item even when the level is disabled
 """
 
 from __future__ import annotations
@@ -570,6 +575,126 @@ def rule_unused_import(ctx: FileCtx) -> Iterator[Violation]:
                 yield v
 
 
+# -- rule: thread-start-in-ctor --------------------------------------------
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread" and \
+            isinstance(fn.value, ast.Name) and fn.value.id == "threading":
+        return True
+    if isinstance(fn, ast.Name) and (fn.id == "Thread"
+                                     or fn.id.endswith("Thread")):
+        return True
+    return False
+
+
+def rule_thread_start_in_ctor(ctx: FileCtx) -> Iterator[Violation]:
+    """A thread started inside __init__ can observe the object before the
+    ctor finished assigning its fields (the p2p _Session writer raced its
+    own session registration this way). Expose start() and have the owner
+    call it after construction completes."""
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        threadlike = any(
+            (isinstance(b, ast.Name)
+             and (b.id in ("Thread", "Worker") or b.id.endswith("Thread")))
+            or (isinstance(b, ast.Attribute) and b.attr == "Thread")
+            for b in cls.bases)
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) or fn.name != "__init__":
+                continue
+            # self-attrs / locals assigned a Thread in THIS ctor
+            thread_names: set[tuple[str, str]] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        _is_thread_ctor(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            thread_names.add(("self", t.attr))
+                        elif isinstance(t, ast.Name):
+                            thread_names.add(("local", t.id))
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "start"):
+                    continue
+                recv = node.func.value
+                hit = (
+                    # Thread(...).start() inline
+                    (isinstance(recv, ast.Call) and _is_thread_ctor(recv))
+                    # self._t = Thread(...); ... self._t.start()
+                    or (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                        and ("self", recv.attr) in thread_names)
+                    # t = Thread(...); t.start()
+                    or (isinstance(recv, ast.Name)
+                        and ("local", recv.id) in thread_names)
+                    # self.start() in a Thread/Worker subclass ctor
+                    or (isinstance(recv, ast.Name) and recv.id == "self"
+                        and threadlike))
+                if hit:
+                    v = _v(ctx, "thread-start-in-ctor", node,
+                           f"thread started inside {cls.name}.__init__ — "
+                           "the new thread can see a partially-constructed "
+                           "object; expose start() and call it after "
+                           "construction")
+                    if v:
+                        yield v
+
+
+# -- rule: log-in-hot-loop -------------------------------------------------
+
+# modules on the wire->lane->seal hot path: a per-item f-string log call
+# formats (and allocates) even when the level is disabled
+HOT_LOG_SCOPE = ("fisco_bcos_tpu/txpool/", "fisco_bcos_tpu/crypto/",
+                 "fisco_bcos_tpu/protocol/", "fisco_bcos_tpu/sealer/")
+LOG_RECEIVERS = ("LOG", "log", "logger", "_LOG")
+LOG_LEVELS = ("debug", "info", "warning", "error", "exception", "critical")
+
+
+def rule_log_in_hot_loop(ctx: FileCtx) -> Iterator[Violation]:
+    if not ctx.relpath.startswith(HOT_LOG_SCOPE):
+        return
+    out: list[Violation] = []
+
+    def eager(arg: ast.expr) -> bool:
+        if isinstance(arg, ast.JoinedStr):
+            return True  # f-string: formatted before the level check
+        return (isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "format"
+                and isinstance(arg.func.value, ast.Constant))
+
+    def walk(node: ast.AST, loop: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            depth = loop
+            if isinstance(child, (ast.For, ast.While)):
+                depth += 1
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                depth = 0  # closure body runs on its own schedule
+            if depth > 0 and isinstance(child, ast.Call) and \
+                    isinstance(child.func, ast.Attribute) and \
+                    child.func.attr in LOG_LEVELS and \
+                    isinstance(child.func.value, ast.Name) and \
+                    child.func.value.id in LOG_RECEIVERS and \
+                    any(eager(a) for a in child.args):
+                v = _v(ctx, "log-in-hot-loop", child,
+                       "f-string log call inside a hot-path loop formats "
+                       "per item even when the level is off — hoist it out "
+                       "of the loop or use lazy %-style args")
+                if v:
+                    out.append(v)
+            walk(child, depth)
+
+    walk(ctx.tree, 0)
+    yield from out
+
+
 RULES = {
     "raw-lock": rule_raw_lock,
     "lock-order": rule_with_locks,       # emits lock-order AND
@@ -582,6 +707,8 @@ RULES = {
     "mutable-default": rule_mutable_default,
     "dict-iter-mutation": rule_dict_iter_mutation,
     "unused-import": rule_unused_import,
+    "thread-start-in-ctor": rule_thread_start_in_ctor,
+    "log-in-hot-loop": rule_log_in_hot_loop,
 }
 
 

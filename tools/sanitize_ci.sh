@@ -6,8 +6,12 @@
 #   tools/sanitize_ci.sh            # full gate: ASan+UBSan, TSan, fuzz
 #   tools/sanitize_ci.sh --fast     # skip the @slow deep differential fuzz
 #   tools/sanitize_ci.sh --lint     # ONLY the concurrency-correctness
-#                                   # plane: bcoslint clean against the
-#                                   # committed baseline, then an ARMED
+#                                   # plane: bcoslint (lexical) AND
+#                                   # bcosflow (whole-program plane
+#                                   # contracts) clean against their
+#                                   # committed baselines — same exit-code
+#                                   # convention: 1 iff a NEW finding —
+#                                   # then an ARMED
 #                                   # (BCOS_LOCKCHECK=1) 4-node smoke
 #                                   # asserting zero lock-order cycles and
 #                                   # zero blocking-while-locked hits with
@@ -106,7 +110,16 @@ FAST=0
 
 run_lint_stage() {
   echo "== [lint] bcoslint: repo invariants vs the committed baseline"
+  local t0 t1
+  t0=$SECONDS
   python tools/bcoslint.py
+  t1=$SECONDS
+  echo "== [lint] bcoslint clean in $((t1 - t0))s"
+  echo "== [lint] bcosflow: whole-program plane contracts vs the baseline"
+  t0=$SECONDS
+  python tools/bcosflow.py
+  t1=$SECONDS
+  echo "== [lint] bcosflow clean in $((t1 - t0))s"
   echo "== [lint] armed lockcheck smoke: 4-node chain under BCOS_LOCKCHECK=1"
   BCOS_LOCKCHECK=1 JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
     timeout -k 10 600 python - <<'EOF'
