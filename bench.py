@@ -735,6 +735,49 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: the game-day plane (testing/gameday.py) — the
+            # ci-smoke fault schedule on a real 4-node cluster: kill -9,
+            # asymmetric partition + heal, armed WAL-crash failpoint and an
+            # aggressor burst under open-loop scenario load, ending in the
+            # post-soak capacity row the perf gate tracks.
+            # BENCH_GAMEDAY_TIMEOUT=0 skips it.
+            import subprocess as sp
+
+            timeout = float(os.environ.get("BENCH_GAMEDAY_TIMEOUT", "900"))
+            if timeout <= 0:
+                raise _SkipStage
+            r = sp.run(
+                [sys.executable, "-u",
+                 os.path.join(_REPO, "tools", "gameday.py"),
+                 "--schedule", "ci-smoke"],
+                timeout=timeout, stdout=sp.PIPE, stderr=sp.DEVNULL,
+                text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu",
+                     "PALLAS_AXON_POOL_IPS": ""})
+            rows = [json.loads(ln) for ln in r.stdout.splitlines()
+                    if ln.startswith("{")]
+            post = next((row for row in rows
+                         if row.get("metric") == "gameday_post_soak_tps"),
+                        None)
+            p99 = next((row for row in rows
+                        if row.get("metric") == "gameday_write_p99_ms"),
+                       None)
+            if r.returncode == 0 and post:
+                line["gameday_post_soak_tps"] = post.get("value")
+                line["gameday_vs_baseline"] = post.get("vs_baseline")
+                if p99:
+                    line["gameday_write_p99_ms"] = p99.get("value")
+            else:
+                print(f"[bench] game day failed (rc={r.returncode}); "
+                      "no gameday_* fields this run",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass
+        except Exception as exc:
+            print(f"[bench] game-day stage failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # host-weather stamp (analysis/hostweather.py): PSI, steal,
             # spin-calibration — the co-tenant context this line was
             # measured under, consumed by tools/perf_gate.py's bands

@@ -43,6 +43,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import statistics
 import sys
 import time
@@ -185,6 +186,11 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
                   trace_sample_rate=0.0, trace_slow_ms=0.0,
                   rpc_port=0 if rpc_on_first and i == 0 else None)
         kw.update(cfg_overrides or {})
+        if kw.get("storage_path"):
+            # a shared override names the chain's base dir; each node
+            # gets its own subdirectory (real deployments never share)
+            kw["storage_path"] = os.path.join(kw["storage_path"],
+                                              f"node{i}")
         node = Node(NodeConfig(**kw), keypair=kp, gateway=gw)
         node.build_genesis(sealers)
         nodes.append(node)
@@ -1961,6 +1967,430 @@ def _emit_overload_mode(args, sm: bool) -> None:
     print(_dumps(fair), flush=True)
 
 
+# -- scenario mode (ISSUE 17: production-shaped load) ------------------------
+
+def _scenario_spec(args, cross_dest: str = ""):
+    from fisco_bcos_tpu.testing.scenario import ScenarioSpec
+    return ScenarioSpec(
+        name=args.scenario, accounts=args.scenario_accounts,
+        hot_share=args.hot_share, cross_share=args.cross_share,
+        value_bytes=args.value_bytes, cross_dest=cross_dest)
+
+
+def _receipt_watcher(ledger, suite, txs, pending, pending_lock, stop):
+    """Resolve sampled submit->commit latencies; returns sorted list."""
+    from fisco_bcos_tpu.protocol import batch_hash
+
+    hashes = batch_hash(txs, suite)
+    resolved: list[float] = []
+
+    def loop():
+        outstanding: dict[int, float] = {}
+        grace_until = None
+        while True:
+            with pending_lock:
+                outstanding.update(pending)
+                pending.clear()
+            done = [k for k, ts in outstanding.items()
+                    if ledger.receipt(hashes[k]) is not None]
+            for k in done:
+                resolved.append(time.perf_counter() - outstanding.pop(k))
+            if stop.is_set():
+                if not outstanding:
+                    return
+                if grace_until is None:
+                    grace_until = time.monotonic() + 15.0
+                elif time.monotonic() > grace_until:
+                    return  # drain grace expired; samples stay partial
+            time.sleep(0.05)
+
+    return resolved, loop
+
+
+def run_scenario(sm: bool, backend: str, tx_count_limit: int,
+                 args) -> dict:
+    """One production-shaped scenario, open-loop Poisson at
+    `--scenario-intensity` times the chain's measured capacity, against
+    a 4-node PBFT chain on the DISK backend (key pages + leveled
+    compaction on their defaults — the deployment shape)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.testing import scenario as sc
+
+    spec = _scenario_spec(args)
+    work = tempfile.mkdtemp(prefix=f"scenario-{spec.name}-")
+    nodes, gateways, _ = _build_chain(
+        sm, backend, tx_count_limit,
+        cfg_overrides={**_overload_cfg(True), "storage_backend": "disk",
+                       "storage_path": work,
+                       "storage_memtable_mb": args.scenario_memtable_mb})
+    ingress = nodes[0]
+    try:
+        # pre-fund the account space by direct injection on EVERY node
+        # (identical rows, changeset-delta state roots: consensus-safe)
+        funded = 0
+        for node in nodes:
+            funded = sc.prefund_storage(node.storage, spec)
+        print(f"scenario {spec.name}: pre-funded {funded} rows/node",
+              file=sys.stderr, flush=True)
+        for node in nodes:
+            node.start()
+
+        # capacity calibration: closed-loop burst of the SAME shape
+        n_cap = max(400, args.n // 2)
+        print(f"scenario {spec.name}: calibrating capacity "
+              f"({n_cap} txs)...", file=sys.stderr, flush=True)
+        cap_wire = sc.sign_workload(spec, sm, n_cap, block_limit=600)
+        t0 = time.perf_counter()
+        admitted = 0
+        for s in range(0, len(cap_wire), 256):
+            results = ingress.txpool.submit_batch(
+                [Transaction.decode(raw) for raw in cap_wire[s:s + 256]])
+            admitted += sum(1 for r in results if int(r.status) == 0)
+        deadline = time.monotonic() + max(120.0, n_cap / 20)
+        while time.monotonic() < deadline:
+            if ingress.ledger.total_tx_count() >= admitted:
+                break
+            time.sleep(0.05)
+        cap_wall = time.perf_counter() - t0
+        committed = ingress.ledger.total_tx_count()
+        if committed < max(1, admitted // 2):
+            raise RuntimeError(
+                f"scenario calibration wedged at {committed}/{admitted}")
+        capacity = committed / cap_wall
+        rate = capacity * args.scenario_intensity
+
+        window_s = args.scenario_window
+        n_w = int(rate * window_s * 1.3) + 64
+        print(f"scenario {spec.name}: capacity ~{capacity:.0f} TPS, "
+              f"window {n_w} txs @ {rate:.0f}/s...",
+              file=sys.stderr, flush=True)
+        wire = sc.sign_workload(spec, sm, n_w, block_limit=600,
+                                start=n_cap)
+        txs = [Transaction.decode(raw) for raw in wire]
+
+        pending: dict[int, float] = {}
+        pending_lock = threading.Lock()
+        stop = threading.Event()
+        resolved, watch_loop = _receipt_watcher(
+            ingress.ledger, ingress.suite, txs, pending, pending_lock,
+            stop)
+        watcher = threading.Thread(target=watch_loop, daemon=True)
+        watcher.start()
+
+        def submit(batch):
+            results = ingress.txpool.submit_batch(batch)
+            return sum(1 for r in results if int(r.status) == 0)
+
+        def on_sample(k, t_sub):
+            with pending_lock:
+                pending[k] = t_sub
+
+        committed0 = ingress.ledger.total_tx_count()
+        t_ep = time.perf_counter()
+        win = sc.open_loop_poisson(submit, txs, rate, window_s,
+                                   seed=spec.seed, on_sample=on_sample)
+        drained = _drain(ingress)
+        stop.set()
+        watcher.join(timeout=30)
+        elapsed = time.perf_counter() - t_ep
+        sustained = (ingress.ledger.total_tx_count() - committed0) \
+            / max(elapsed, 1e-9)
+        lat = sorted(resolved)
+
+        def pct(p):
+            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat \
+                else 0.0
+
+        st_stats = ingress.storage.stats()
+        eng = st_stats.get("backend_stats", st_stats)
+        storage_row = {
+            "compaction_debt_bytes": eng.get("compaction_debt_bytes"),
+            "levels": len(eng.get("levels", [])),
+            "max_merge_secs": eng.get("max_merge_secs"),
+            "key_page_size": st_stats.get("key_page_size"),
+            "backend_reads": st_stats.get("backend_reads"),
+            "cache_hits": st_stats.get("cache_hits"),
+        }
+        return {
+            "metric": "scenario_" + spec.name.replace("-", "_"),
+            "unit": "tx/sec", "suite": "sm" if sm else "ecdsa",
+            "scenario": spec.name, "value": round(sustained, 1),
+            "capacity_tps": round(capacity, 1),
+            "intensity": args.scenario_intensity,
+            "accounts": spec.accounts,
+            "prefunded_rows": funded,
+            "write_p50_ms": round(pct(0.50) * 1000, 1),
+            "write_p99_ms": round(pct(0.99) * 1000, 1),
+            "latency_samples": len(lat),
+            "episode_seconds": round(elapsed, 3),
+            "drained": drained,
+            "storage": storage_row,
+            **win,
+        }
+    finally:
+        for node in nodes:
+            node.stop()
+        for gw in set(gateways):
+            gw.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def run_scenario_xshard(sm: bool, backend: str, tx_count_limit: int,
+                        args) -> dict:
+    """xshard-heavy: two solo groups in one process (GroupManager, the
+    multi-group deployment shape), each fed open-loop Poisson arrivals
+    where `--cross-share` of them are cross-group transferOut legs;
+    reports goodput, write p99, and the settlement drain."""
+    import threading
+
+    from fisco_bcos_tpu.executor import precompiled as pc
+    from fisco_bcos_tpu.init.group import GroupManager
+    from fisco_bcos_tpu.init.node import NodeConfig
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.storage.memory import MemoryStorage
+    from fisco_bcos_tpu.testing import scenario as sc
+
+    gids = ["group0", "group1"]
+    mgr = GroupManager(storage=MemoryStorage())
+    nodes = {gid: mgr.add_group(NodeConfig(
+        group_id=gid, consensus="solo", sm_crypto=sm,
+        crypto_backend=backend, min_seal_time=0.0,
+        tx_count_limit=tx_count_limit, ingest_lane=False))
+        for gid in gids}
+    specs = {gid: _scenario_spec(args, cross_dest=gids[1 - g])
+             for g, gid in enumerate(gids)}
+    mgr.start()
+    try:
+        for gid in gids:
+            sc.prefund_storage(nodes[gid].storage, specs[gid])
+
+        # calibration: closed-loop burst on group0 only (groups are
+        # symmetric; per-group rate = capacity * intensity)
+        n_cap = max(300, args.n // 3)
+        cap_wire = sc.sign_workload(specs["group0"], sm, n_cap,
+                                    block_limit=600, group_id="group0")
+        ing0 = nodes["group0"]
+        t0 = time.perf_counter()
+        admitted = 0
+        for s in range(0, len(cap_wire), 256):
+            results = ing0.txpool.submit_batch(
+                [Transaction.decode(raw) for raw in cap_wire[s:s + 256]])
+            admitted += sum(1 for r in results if int(r.status) == 0)
+        deadline = time.monotonic() + max(120.0, n_cap / 20)
+        while time.monotonic() < deadline:
+            if ing0.ledger.total_tx_count() >= admitted:
+                break
+            time.sleep(0.05)
+        capacity = ing0.ledger.total_tx_count() / (time.perf_counter()
+                                                   - t0)
+        rate = capacity * args.scenario_intensity
+        window_s = args.scenario_window
+        n_w = int(rate * window_s * 1.3) + 64
+        print(f"scenario xshard-heavy: capacity ~{capacity:.0f} TPS/"
+              f"group, {n_w} txs/group @ {rate:.0f}/s...",
+              file=sys.stderr, flush=True)
+
+        workload = {}
+        for gid in gids:
+            wire = sc.sign_workload(specs[gid], sm, n_w, block_limit=600,
+                                    group_id=gid, start=n_cap)
+            workload[gid] = [Transaction.decode(raw) for raw in wire]
+
+        pending: dict[int, float] = {}
+        pending_lock = threading.Lock()
+        stop = threading.Event()
+        resolved, watch_loop = _receipt_watcher(
+            ing0.ledger, ing0.suite, workload["group0"], pending,
+            pending_lock, stop)
+        watcher = threading.Thread(target=watch_loop, daemon=True)
+        watcher.start()
+        wins: dict[str, dict] = {}
+        committed0 = sum(nodes[g].ledger.total_tx_count() for g in gids)
+        barrier = threading.Barrier(len(gids) + 1)
+
+        def feeder(gid):
+            node = nodes[gid]
+
+            def submit(batch):
+                results = node.txpool.submit_batch(batch)
+                return sum(1 for r in results if int(r.status) == 0)
+
+            on_sample = None
+            if gid == "group0":
+                def on_sample(k, t_sub):
+                    with pending_lock:
+                        pending[k] = t_sub
+            barrier.wait()
+            wins[gid] = sc.open_loop_poisson(
+                submit, workload[gid], rate, window_s,
+                seed=specs[gid].seed, on_sample=on_sample)
+
+        threads = [threading.Thread(target=feeder, args=(gid,),
+                                    daemon=True) for gid in gids]
+        for th in threads:
+            th.start()
+        barrier.wait()
+        t_ep = time.perf_counter()
+        for th in threads:
+            th.join(timeout=window_s + 120)
+        drained = all(_drain(nodes[g]) for g in gids)
+        t_clients = time.perf_counter()
+        # settlement drain: every cross-group escrow finished everywhere
+        deadline = time.monotonic() + 120.0
+        settled = True
+        while time.monotonic() < deadline:
+            if sum(len(list(nodes[g].storage.keys(pc.T_XSHARD_PEND)))
+                   for g in gids) == 0:
+                break
+            time.sleep(0.05)
+        else:
+            settled = False
+        stop.set()
+        watcher.join(timeout=30)
+        t_end = time.perf_counter()
+        committed = sum(nodes[g].ledger.total_tx_count()
+                        for g in gids) - committed0
+        lat = sorted(resolved)
+
+        def pct(p):
+            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat \
+                else 0.0
+
+        coord = mgr.coordinator.stats() if mgr.coordinator else {}
+        return {
+            "metric": "scenario_xshard_heavy", "unit": "tx/sec",
+            "suite": "sm" if sm else "ecdsa",
+            "scenario": "xshard-heavy",
+            "value": round(committed / max(t_clients - t_ep, 1e-9), 1),
+            "capacity_tps": round(capacity, 1),
+            "intensity": args.scenario_intensity,
+            "cross_share": args.cross_share,
+            "offered": sum(w["offered"] for w in wins.values()),
+            "admitted": sum(w["admitted"] for w in wins.values()),
+            "shed_rate": round(
+                sum(w["shed"] for w in wins.values())
+                / max(1, sum(w["offered"] for w in wins.values())), 4),
+            "write_p50_ms": round(pct(0.50) * 1000, 1),
+            "write_p99_ms": round(pct(0.99) * 1000, 1),
+            "latency_samples": len(lat),
+            "drained": drained, "settled": settled,
+            "settle_drain_seconds": round(t_end - t_clients, 3),
+            "cross_completed": coord.get("completed_total", 0),
+            "cross_aborted": coord.get("aborted_total", 0),
+        }
+    finally:
+        mgr.stop()
+
+
+def _emit_scenario_mode(args, sm: bool) -> None:
+    if args.scenario == "xshard-heavy":
+        row = run_scenario_xshard(sm, args.backend, args.tx_count_limit,
+                                  args)
+    else:
+        row = run_scenario(sm, args.backend, args.tx_count_limit, args)
+    print(_dumps(row), flush=True)
+
+
+# -- compaction-curve mode (ISSUE 17: GB-scale merge-cost growth) ------------
+
+def run_compaction_curve(target_mb: int, memtable_mb: int,
+                         value_kb: int, seg_mb: int = 8) -> list:
+    """Max single-merge cost vs dataset size, leveled vs the full-merge
+    baseline, measured by DRIVING compaction synchronously (auto_compact
+    off — every merge's seconds/bytes are attributed exactly).
+
+    The leveled engine's claim: a merge reads one source segment plus
+    the overlapping slice of the next level, so max merge cost goes
+    FLAT as the dataset grows. The baseline (an effectively infinite
+    level-1 target, i.e. the old single-level engine: every compaction
+    rewrites everything) grows linearly — both curves land in PERF.md.
+    """
+    import shutil
+    import tempfile
+
+    from fisco_bcos_tpu.storage.engine import DiskStorage
+
+    rng = random.Random(17)
+    value = rng.getrandbits(8 * value_kb * 1024).to_bytes(
+        value_kb * 1024, "big")
+    checkpoints = [mb for mb in (32, 64, 128, 256, 512, 1024, 2048)
+                   if mb <= target_mb]
+    if checkpoints[-1] != target_mb:
+        checkpoints.append(target_mb)
+    rows = []
+    for mode in ("leveled", "full"):
+        work = tempfile.mkdtemp(prefix=f"compact-curve-{mode}-")
+        st = DiskStorage(
+            work, memtable_bytes=memtable_mb << 20, max_segments=4,
+            auto_compact=False,
+            level_base_bytes=(1 << 60) if mode == "full"
+            else 4 * (memtable_mb << 20),
+            seg_target_bytes=seg_mb << 20)
+        try:
+            written = 0
+            ckpt_iter = iter(checkpoints)
+            ckpt = next(ckpt_iter)
+            max_secs = max_in = 0.0
+            merges = 0
+            t_start = time.perf_counter()
+            batch_rows = max(1, (2 << 20) // len(value))
+            while written < target_mb << 20:
+                batch = [(rng.getrandbits(128).to_bytes(16, "big"), value)
+                         for _ in range(batch_rows)]
+                st.set_batch("t_curve", batch)
+                written += batch_rows * (len(value) + 16)
+                while st.needs_compaction():
+                    if not st.compact_once(force=False):
+                        break
+                    last = st.stats()["last_merge"]
+                    merges += 1
+                    max_secs = max(max_secs, last["secs"])
+                    max_in = max(max_in, last["input_bytes"])
+                if written >= ckpt << 20:
+                    rows.append({
+                        "metric": "compaction_curve", "unit": "sec",
+                        "mode": mode, "dataset_mb": ckpt,
+                        "value": round(max_secs, 3),
+                        "max_merge_secs": round(max_secs, 3),
+                        "max_merge_input_mb": round(max_in / (1 << 20),
+                                                    1),
+                        "merges": merges,
+                        "disk_mb": round(st.disk_bytes() / (1 << 20), 1),
+                        "write_wall_s": round(
+                            time.perf_counter() - t_start, 1),
+                    })
+                    print(_dumps(rows[-1]), flush=True)
+                    max_secs = max_in = 0.0  # per-window max
+                    merges = 0
+                    ckpt = next(ckpt_iter, 1 << 30)
+            assert st.audit() == [], st.audit()
+        finally:
+            st.close()
+            shutil.rmtree(work, ignore_errors=True)
+    # growth summary: last-window max merge at full size, per mode
+    by_mode = {m: [r for r in rows if r["mode"] == m]
+               for m in ("leveled", "full")}
+    if all(by_mode.values()):
+        lv, fl = by_mode["leveled"][-1], by_mode["full"][-1]
+        summary = {
+            "metric": "compaction_curve_summary", "unit": "x",
+            "dataset_mb": lv["dataset_mb"],
+            "value": round(fl["max_merge_input_mb"]
+                           / max(lv["max_merge_input_mb"], 0.1), 1),
+            "leveled_max_merge_mb": lv["max_merge_input_mb"],
+            "full_max_merge_mb": fl["max_merge_input_mb"],
+            "leveled_max_merge_secs": lv["max_merge_secs"],
+            "full_max_merge_secs": fl["max_merge_secs"],
+        }
+        print(_dumps(summary), flush=True)
+        rows.append(summary)
+    return rows
+
+
 def run_storage_child(backend: str, n: int, tx_count_limit: int,
                       memtable_mb: int) -> dict:
     """ONE backend's sustained-write run in THIS process (the parent
@@ -2147,6 +2577,38 @@ def main() -> None:
                     help="with --storage-compare: disk-engine memtable cap "
                          "(small by default so the dataset spills to "
                          "segments and RSS boundedness is actually tested)")
+    ap.add_argument("--scenario", default=None,
+                    choices=["mint-storm", "airdrop-sweep", "hot-key",
+                             "wide-table", "xshard-heavy"],
+                    help="production-shaped load mode: pre-funded "
+                         "account space, open-loop Poisson arrivals at "
+                         "--scenario-intensity x measured capacity, on "
+                         "the disk backend (testing/scenario.py)")
+    ap.add_argument("--scenario-accounts", type=int, default=100_000,
+                    help="pre-funded account space (direct injection)")
+    ap.add_argument("--scenario-intensity", type=float, default=1.0,
+                    help="offered load as a multiple of calibrated "
+                         "capacity (2.0 = sustained 2x overload)")
+    ap.add_argument("--scenario-window", type=float, default=8.0,
+                    help="seconds per open-loop scenario window")
+    ap.add_argument("--scenario-memtable-mb", type=int, default=16,
+                    help="disk-engine memtable cap during scenarios")
+    ap.add_argument("--hot-share", type=float, default=0.9,
+                    help="hot-key: fraction of arrivals on the hot set")
+    ap.add_argument("--cross-share", type=float, default=0.5,
+                    help="xshard-heavy: cross-group arrival fraction")
+    ap.add_argument("--value-bytes", type=int, default=2048,
+                    help="wide-table: value width per row")
+    ap.add_argument("--compaction-curve", action="store_true",
+                    help="max single-merge cost vs dataset size, "
+                         "leveled vs full-merge baseline, by direct "
+                         "GB-scale writes into the disk engine")
+    ap.add_argument("--curve-mb", type=int, default=512,
+                    help="with --compaction-curve: dataset size to grow")
+    ap.add_argument("--curve-memtable-mb", type=int, default=8,
+                    help="with --compaction-curve: memtable cap")
+    ap.add_argument("--curve-value-kb", type=int, default=4,
+                    help="with --compaction-curve: row value width")
     ap.add_argument("--overload", action="store_true",
                     help="overload mode: capacity calibration, open-loop "
                          "1x/2x/4x Poisson ladder (goodput, shed rate, "
@@ -2211,6 +2673,14 @@ def main() -> None:
         for sm in suites:
             for row in run_sync_bench(sm, args.sync_blocks):
                 print(_dumps(row), flush=True)
+        return
+    if args.compaction_curve:
+        run_compaction_curve(args.curve_mb, args.curve_memtable_mb,
+                             args.curve_value_kb)
+        return
+    if args.scenario:
+        for sm in suites:
+            _emit_scenario_mode(args, sm)
         return
     if args.overload:
         for sm in suites:

@@ -194,7 +194,9 @@ class NodeDaemon:
             cfg.storage_backend, cfg.storage_path,
             memtable_mb=cfg.storage_memtable_mb,
             compact_segments=cfg.storage_compact_segments,
-            key_page_size=cfg.storage_key_page_size)
+            key_page_size=cfg.storage_key_page_size,
+            level_base_mb=cfg.storage_level_base_mb,
+            level_fanout=cfg.storage_level_fanout)
         # ONE p2p listener for all groups: group tags ride the frames
         # (MuxGateway), sessions authenticate with the single node key
         self.manager = GroupManager(shared_gateway=MuxGateway(self.gateway),

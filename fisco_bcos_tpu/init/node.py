@@ -51,10 +51,17 @@ class NodeConfig:
     # datasets beyond RAM). memory/wal force those backends.
     storage_backend: str = "auto"  # auto | memory | wal | disk
     storage_memtable_mb: int = 64  # disk engine: flush watermark
-    storage_compact_segments: int = 8  # disk engine: merge past this many
-    # > 0 wraps the persistent backend in KeyPageStorage (page-packed rows,
-    # the reference's storage.key_page_size — NodeConfig.cpp:620)
-    storage_key_page_size: int = 0
+    storage_compact_segments: int = 8  # disk engine: L0 merge trigger
+    # leveled compaction geometry (storage/engine.py): L1 byte target and
+    # the per-level growth factor; merges stay O(level slice) regardless
+    # of dataset size, so these bound single-merge latency at GB scale
+    storage_level_base_mb: int = 16
+    storage_level_fanout: int = 8
+    # KeyPageStorage wrap (page-packed rows, the reference's
+    # storage.key_page_size — NodeConfig.cpp:620): > 0 explicit page
+    # bytes, 0 off, -1 = auto (ON at the default page size for the disk
+    # backend, where wide tables dominate; off for wal/memory)
+    storage_key_page_size: int = -1
     tx_count_limit: int = 1000
     txpool_limit: int = 15000
     block_limit_range: int = 600
@@ -78,6 +85,10 @@ class NodeConfig:
     overload_hold_s: float = 0.5   # hysteresis hold on both edges
     overload_commit_backlog: int = 6  # commit depth scoring 1.0
     overload_busy_write_factor: float = 0.25  # write-rate shrink while busy
+    # compaction-debt backpressure: debt bytes (engine levels over target)
+    # scoring 1.0 on the overload plane — a compaction-starved node goes
+    # busy and sheds writes instead of silently drowning in L0 segments
+    overload_compact_debt_mb: int = 256
     client_write_rate: float = 0.0
     client_write_burst: float = 0.0  # 0 -> 2x rate
     client_read_rate: float = 0.0
@@ -247,6 +258,8 @@ class Node:
             memtable_mb=cfg.storage_memtable_mb,
             compact_segments=cfg.storage_compact_segments,
             key_page_size=cfg.storage_key_page_size,
+            level_base_mb=cfg.storage_level_base_mb,
+            level_fanout=cfg.storage_level_fanout,
             registry=self.metrics_view, health=self.health)
         # injected storage (test fixtures, sharded clusters): adopt its
         # ENOSPC/flush health seam if the backend has one and nobody
@@ -290,6 +303,16 @@ class Node:
             if self.ingest is not None:
                 self.overload.add_signal("ingest",
                                          self.ingest.queue_fraction)
+            # compaction-debt backpressure (ISSUE 17): saturation 1.0 when
+            # the disk engine's un-merged debt reaches the configured cap.
+            # Feature-detected so wal/memory (and injected test) backends
+            # simply contribute nothing.
+            debt_fn = getattr(self.storage, "compaction_debt_bytes", None)
+            if debt_fn is not None:
+                debt_norm = max(1, cfg.overload_compact_debt_mb) << 20
+                self.overload.add_signal(
+                    "compaction_debt",
+                    lambda: debt_fn() / debt_norm)
         self.executor = TransactionExecutor(self.suite)
         self.scheduler = Scheduler(self.storage, self.ledger, self.executor,
                                    self.suite, self.txpool,

@@ -29,16 +29,26 @@ def __getattr__(name):  # lazy: engine pulls in sstable/compact machinery
     raise AttributeError(name)
 
 
+DEFAULT_KEY_PAGE_SIZE = 8 << 10  # auto page size for the disk backend
+
+
 def make_storage(backend: str, path: Optional[str],
                  memtable_mb: int = 64, compact_segments: int = 8,
-                 key_page_size: int = 0, registry=None, health=None
+                 key_page_size: int = -1, registry=None, health=None,
+                 level_base_mb: int = 16, level_fanout: int = 8
                  ) -> TransactionalStorage:
     """Build the node's backing store from the `[storage]` config surface.
 
     backend: `auto` keeps the historical selection (WAL-backed when a path
     is configured, in-memory otherwise); `memory`/`wal`/`disk` force one.
-    `key_page_size` > 0 wraps the persistent backend in KeyPageStorage so
-    wide-table rows are page-packed (reference KeyPageStorage layout).
+    `key_page_size` wraps the persistent backend in KeyPageStorage so
+    wide-table rows are page-packed (reference KeyPageStorage layout):
+    > 0 sets an explicit page size, 0 disables paging, and < 0 (the
+    default, ini `key_page_size = auto`) turns paging ON for the disk
+    backend — wide tables are the norm at production scale, and the page
+    layout is what keeps their range scans at O(pages) backend reads.
+    `level_base_mb`/`level_fanout` shape the disk engine's leveled
+    compaction (L1 byte target and per-level growth factor).
     `health` (utils/health.py) receives the persistent backends' ENOSPC /
     flush-failure degradation signals.
     """
@@ -54,9 +64,13 @@ def make_storage(backend: str, path: Optional[str],
         from .engine import DiskStorage
         st = DiskStorage(path, memtable_bytes=memtable_mb << 20,
                          max_segments=compact_segments, registry=registry,
-                         health=health)
+                         health=health,
+                         level_base_bytes=level_base_mb << 20,
+                         level_fanout=level_fanout)
     else:
         raise ValueError(f"unknown [storage] backend {backend!r}")
+    if key_page_size < 0:
+        key_page_size = DEFAULT_KEY_PAGE_SIZE if backend == "disk" else 0
     if key_page_size > 0:
         from .keypage import KeyPageStorage
         st = KeyPageStorage(st, page_size=key_page_size)

@@ -15,15 +15,28 @@ Shape (a small LSM tree):
     it is frozen and flushed to an immutable sorted **segment** on disk
     (storage/sstable.py: block-aligned, prefix-compressed keys, per-segment
     bloom filter + sparse index);
-  * a **manifest** names the live segments and the WAL flush floor; every
-    edge is written to a fresh `MANIFEST-<n>` file and published by an
-    atomic rename of `CURRENT` (the snapshot store's fsync discipline), so
-    kill -9 at ANY point recovers to either the pre- or post-edge state;
+  * segments are organised in **levels** (the leveled-LSM shape production
+    stores use at GB scale): L0 holds raw flush output — segments whose
+    key ranges freely overlap, newest wins — while L1+ each hold
+    NON-overlapping sorted runs with a per-level byte target that grows by
+    `level_fanout` per level. A merge picks ONE source slice (all of L0,
+    or one over-target Ln segment) plus only the next level's
+    RANGE-OVERLAPPING segments, so per-merge cost is O(level slice), not
+    O(dataset) — the full-merge compactor this replaces rewrote the whole
+    store every merge, a guaranteed wedge at multi-GB state;
+  * a **manifest** names the live segments (with their levels) and the WAL
+    flush floor; every edge is written to a fresh `MANIFEST-<n>` file and
+    published by an atomic rename of `CURRENT` (the snapshot store's fsync
+    discipline), so kill -9 at ANY point recovers to either the pre- or
+    post-edge state — including mid-way through a multi-output merge;
   * once a flush is durable in the manifest, the WAL segments it covers
     are retired — the log stays O(memtable), not O(history);
-  * background **compaction** (storage/compact.py) merges segments and
-    drops tombstones/pruned history; reads consult memtable -> newest
-    segment -> oldest.
+  * background **compaction** (storage/compact.py) drains **compaction
+    debt** — bytes sitting above a level's target (or in an over-full L0).
+    Debt is published as `bcos_storage_compaction_debt_bytes` and feeds
+    the overload controller (utils/overload.py): a compaction-starved node
+    goes *busy* and sheds writes instead of silently falling behind.
+    Reads consult memtable -> L0 newest..oldest -> L1 -> L2 ...
 
 Restart cost is flat in chain length: boot reads the manifest, opens the
 segment metadata, and replays only the WAL tail above the flush floor —
@@ -49,7 +62,8 @@ from .interface import ChangeSet, Entry, EntryStatus, TransactionalStorage
 from .sstable import SSTableReader, composite_key, split_key, write_sstable
 from .wal import SegmentedWal, _SpaceHealth, unpack_payload
 
-_MANIFEST_MAGIC = b"FBTPUMAN"
+_MANIFEST_MAGIC_V1 = b"FBTPUMAN"   # pre-leveled: bare segment ids
+_MANIFEST_MAGIC = b"FBTPUMN2"      # v2: (segment id, level) pairs
 _TOMBSTONE = None  # memtable value sentinel
 
 # every durability edge the kill -9 suite exercises is a registered global
@@ -59,6 +73,7 @@ fp.register("storage.engine.flush_before_sstable",
             "storage.engine.flush_before_manifest",
             "storage.engine.manifest_before_current",
             "storage.engine.compact_before_sstable",
+            "storage.engine.compact_mid_outputs",
             "storage.engine.compact_before_manifest",
             "storage.memtable.flush")
 
@@ -67,22 +82,30 @@ class ManifestError(RuntimeError):
     pass
 
 
-def _pack_manifest(next_seg: int, wal_floor: int, seg_ids: list[int]) -> bytes:
-    body = struct.pack("<QQI", next_seg, wal_floor, len(seg_ids))
-    body += b"".join(struct.pack("<Q", s) for s in seg_ids)
+def _pack_manifest(next_seg: int, wal_floor: int,
+                   seg_levels: list[tuple[int, int]]) -> bytes:
+    body = struct.pack("<QQI", next_seg, wal_floor, len(seg_levels))
+    body += b"".join(struct.pack("<QI", s, lvl) for s, lvl in seg_levels)
     return _MANIFEST_MAGIC + struct.pack("<I", zlib.crc32(body)) + body
 
 
-def _unpack_manifest(data: bytes) -> tuple[int, int, list[int]]:
-    if data[:8] != _MANIFEST_MAGIC:
+def _unpack_manifest(data: bytes) -> tuple[int, int, list[tuple[int, int]]]:
+    magic = data[:8]
+    if magic not in (_MANIFEST_MAGIC, _MANIFEST_MAGIC_V1):
         raise ManifestError("bad manifest magic")
     (crc,) = struct.unpack_from("<I", data, 8)
     body = data[12:]
     if zlib.crc32(body) != crc:
         raise ManifestError("manifest crc mismatch")
     next_seg, wal_floor, n = struct.unpack_from("<QQI", body, 0)
-    ids = [struct.unpack_from("<Q", body, 20 + 8 * i)[0] for i in range(n)]
-    return next_seg, wal_floor, ids
+    if magic == _MANIFEST_MAGIC_V1:
+        # pre-leveled manifests carried bare ids; place everything in L0,
+        # where overlap is legal — the first merges re-shape it into levels
+        return next_seg, wal_floor, [
+            (struct.unpack_from("<Q", body, 20 + 8 * i)[0], 0)
+            for i in range(n)]
+    return next_seg, wal_floor, [
+        struct.unpack_from("<QI", body, 20 + 12 * i) for i in range(n)]
 
 
 class DiskStorage(TransactionalStorage, _SpaceHealth):
@@ -91,13 +114,24 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
     def __init__(self, path: str, memtable_bytes: int = 64 << 20,
                  max_segments: int = 8, registry=None,
                  auto_compact: bool = True, block_bytes: int = 4096,
-                 health=None):
+                 health=None, level_base_bytes: int = 16 << 20,
+                 level_fanout: int = 8,
+                 seg_target_bytes: Optional[int] = None):
         from ..utils.metrics import REGISTRY
         self.path = path
         self.health = health
         os.makedirs(path, exist_ok=True)
         self.memtable_bytes = memtable_bytes
+        # leveled-compaction geometry: `max_segments` is the L0 segment
+        # count that triggers an L0->L1 merge; L(n>=1) targets
+        # level_base_bytes * fanout^(n-1) bytes; merge outputs are split
+        # at seg_target_bytes so one over-full segment never grows into a
+        # monolith that re-couples merge cost to dataset size
         self.max_segments = max(2, max_segments)
+        self.level_base_bytes = max(1 << 12, level_base_bytes)
+        self.level_fanout = max(2, level_fanout)
+        self.seg_target_bytes = seg_target_bytes or \
+            max(1 << 12, self.level_base_bytes // 4)
         self.block_bytes = block_bytes
         self._reg = registry if registry is not None else REGISTRY
         self._lock = lc.make_rlock("engine.state")
@@ -107,7 +141,16 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
         self._mem: dict[bytes, Optional[bytes]] = {}
         self._mem_bytes = 0
         self._frozen: list[dict] = []  # being flushed; newest last
-        self._segments: list[SSTableReader] = []  # oldest -> newest
+        # _levels[0] = L0 flush output in arrival order (oldest -> newest,
+        # ranges may overlap); _levels[n>=1] = non-overlapping sorted runs
+        # ordered by first_key. Readers carry `.level` for observability.
+        self._levels: list[list[SSTableReader]] = [[]]
+        # per-level round-robin cursor (last merged key) so repeated
+        # over-target picks sweep the whole key space instead of re-merging
+        # one hot range
+        self._level_cursor: dict[int, bytes] = {}
+        self._last_merge: dict = {}   # secs/input_bytes/outputs of last merge
+        self._max_merge_secs = 0.0
         self._graveyard: list[SSTableReader] = []  # retired, fds kept briefly
         self._manifest_seq = 0
         self._next_seg = 1
@@ -154,6 +197,37 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
             self._wal.append(0, {})
         return True
 
+    # -- level bookkeeping -------------------------------------------------
+    def _flat_locked(self) -> list[SSTableReader]:
+        """Live readers flattened in PRIORITY order, lowest first — deepest
+        level (oldest data) up through L1, then L0 oldest -> newest. This is
+        exactly the order `_merge_sources` expects (higher index = newer),
+        so reads walk it REVERSED: L0 newest first, deepest level last."""
+        flat: list[SSTableReader] = []
+        for level in range(len(self._levels) - 1, 0, -1):
+            flat.extend(self._levels[level])
+        flat.extend(self._levels[0])
+        return flat
+
+    def _level_target(self, level: int) -> int:
+        """Byte budget for L(level>=1): base * fanout^(level-1)."""
+        return self.level_base_bytes * (self.level_fanout ** (level - 1))
+
+    def _ensure_level(self, level: int) -> list[SSTableReader]:
+        while len(self._levels) <= level:
+            self._levels.append([])
+        return self._levels[level]
+
+    def _set_levels_locked(self, level: int, reader: SSTableReader) -> None:
+        """Insert `reader` into a sorted L(level>=1) run by first_key."""
+        reader.level = level
+        run = self._ensure_level(level)
+        lo = reader.first_key
+        idx = 0
+        while idx < len(run) and run[idx].first_key < lo:
+            idx += 1
+        run.insert(idx, reader)
+
     # -- manifest ----------------------------------------------------------
     def _manifest_path(self, seq: int) -> str:
         return os.path.join(self.path, f"MANIFEST-{seq:08d}")
@@ -165,7 +239,9 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
         self._manifest_seq += 1
         mpath = self._manifest_path(self._manifest_seq)
         data = _pack_manifest(self._next_seg, self._wal_floor,
-                              [s.seg_id for s in self._segments])
+                              [(s.seg_id, lvl)
+                               for lvl, run in enumerate(self._levels)
+                               for s in run])
         with open(mpath, "wb") as f:
             f.write(data)
             f.flush()
@@ -194,14 +270,14 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
     # -- recovery ----------------------------------------------------------
     def _recover(self) -> None:
         t0 = time.monotonic()
-        seg_ids: list[int] = []
+        seg_levels: list[tuple[int, int]] = []
         cur = os.path.join(self.path, self.CURRENT)
         if os.path.exists(cur):
             with open(cur) as f:
                 name = f.read().strip()
             try:
                 with open(os.path.join(self.path, name), "rb") as f:
-                    self._next_seg, self._wal_floor, seg_ids = \
+                    self._next_seg, self._wal_floor, seg_levels = \
                         _unpack_manifest(f.read())
                 self._manifest_seq = int(name.rsplit("-", 1)[1])
             except (OSError, ManifestError, ValueError, IndexError) as exc:
@@ -209,13 +285,17 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
                     f"{self.path}: CURRENT points at unreadable manifest "
                     f"{name!r} ({exc}) — refusing to boot on corrupt "
                     "storage") from exc
-        for sid in seg_ids:
+        for sid, lvl in seg_levels:
             reader = SSTableReader(self._seg_path(sid))
             reader.seg_id = sid
-            self._segments.append(reader)
+            if lvl == 0:
+                reader.level = 0
+                self._levels[0].append(reader)  # manifest keeps flush order
+            else:
+                self._set_levels_locked(lvl, reader)
         # orphans: segments written but never referenced (crash between
         # sstable fsync and the manifest edge), superseded manifests
-        live = {os.path.basename(self._seg_path(s)) for s in seg_ids}
+        live = {os.path.basename(self._seg_path(s)) for s, _ in seg_levels}
         live.add(self.CURRENT)
         if self._manifest_seq:
             live.add(os.path.basename(self._manifest_path(self._manifest_seq)))
@@ -249,9 +329,11 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
         # always append to a FRESH segment (never behind a truncated tail)
         self._wal = SegmentedWal(self.path, max(max_seq,
                                                 self._wal_floor) + 1)
+        flat = self._flat_locked()
         LOG.info(badge("ENGINE", "recovered", path=self.path,
-                       segments=len(self._segments),
-                       records=sum(s.nrecords for s in self._segments),
+                       segments=len(flat),
+                       levels=sum(1 for run in self._levels if run),
+                       records=sum(s.nrecords for s in flat),
                        wal_records=wal_records,
                        ms=int((time.monotonic() - t0) * 1000)))
         self._publish_gauges()
@@ -279,7 +361,7 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
                 for frozen in reversed(self._frozen):
                     if ck in frozen:
                         return frozen[ck]
-                segs = list(self._segments)
+                segs = self._flat_locked()
             probes = skips = 0
             try:
                 for r in reversed(segs):
@@ -340,7 +422,7 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
             for m in list(self._frozen) + [self._mem]:
                 md.update(m)
             mem_items = sorted(md.items())
-            segs = list(self._segments)
+            segs = self._flat_locked()
             for r in segs:
                 r.pins += 1
         return mem_items, segs
@@ -397,7 +479,7 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
             for m in [self._mem] + list(self._frozen):
                 for ck in m:
                     names.add(split_key(ck)[0])
-            for r in self._segments:
+            for r in self._flat_locked():
                 names.update(r.tables())
         return sorted(names)
 
@@ -490,8 +572,9 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
                 self._maybe_fail("flush-before-manifest")
                 reader = SSTableReader(self._seg_path(seg_id))
                 reader.seg_id = seg_id
+                reader.level = 0
                 with self._lock:
-                    self._segments.append(reader)
+                    self._levels[0].append(reader)
                     self._frozen.remove(frozen)
                     self._wal_floor = floor
                     self._write_manifest_locked()
@@ -519,76 +602,227 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
     # -- compaction --------------------------------------------------------
     def needs_compaction(self) -> bool:
         with self._lock:
-            return len(self._segments) > self.max_segments
+            return self._pick_compaction_locked() is not None
+
+    def _level_bytes_locked(self, level: int) -> int:
+        if level >= len(self._levels):
+            return 0
+        return sum(s.file_bytes for s in self._levels[level])
 
     def compaction_debt_bytes(self) -> int:
+        """Bytes the compactor still owes: the whole of an over-full L0
+        plus every L(n>=1) byte above its target. This is the saturation
+        signal the overload controller watches — a node whose debt keeps
+        growing is falling behind its own write rate and must go *busy*
+        (shed writes) before reads drown in overlapping L0 segments."""
         with self._lock:
-            if len(self._segments) <= 1:
-                return 0
-            return sum(s.file_bytes for s in self._segments)
+            return self._debt_locked()
 
-    def compact_once(self) -> bool:
-        """Merge the current segments into one, dropping tombstones (the
-        captured set always includes the oldest segment, so nothing older
-        can resurrect a deleted row). Returns True if a merge ran.
+    def _debt_locked(self) -> int:
+        debt = 0
+        if len(self._levels[0]) > self.max_segments:
+            debt += self._level_bytes_locked(0)
+        for lvl in range(1, len(self._levels)):
+            over = self._level_bytes_locked(lvl) - self._level_target(lvl)
+            if over > 0:
+                debt += over
+        return debt
+
+    def _pick_compaction_locked(self, force: bool = False
+                                ) -> Optional[tuple[int, list, list]]:
+        """Choose one bounded merge: (src_level, src_segs, dst_segs) with
+        dst level = src_level + 1, or None when no level is over budget.
+
+        * L0 over its segment-count trigger -> merge ALL of L0 (its ranges
+          overlap, so a partial pick could resurrect old versions) plus
+          only the L1 segments whose ranges intersect it.
+        * L(n>=1) over its byte target -> ONE source segment (round-robin
+          by key range across calls, so hot ranges don't starve cold ones)
+          plus only the overlapping L(n+1) slice.
+
+        `force` (operator catch-up / post-prune compact()) also drains an
+        UNDER-target shallowest run downward so tombstones reach the
+        deepest level and drop."""
+        if len(self._levels[0]) > self.max_segments or \
+                (force and self._levels[0]):
+            src = list(self._levels[0])
+            lo = min(s.first_key for s in src)
+            hi = max(s.last_key for s in src)
+            dst = [s for s in self._ensure_level(1) if s.overlaps(lo, hi)]
+            return 0, src, dst
+        for lvl in range(1, len(self._levels)):
+            run = self._levels[lvl]
+            if not run:
+                continue
+            over = self._level_bytes_locked(lvl) > self._level_target(lvl)
+            deeper = any(self._levels[i]
+                         for i in range(lvl + 1, len(self._levels)))
+            # force drains only runs with data BENEATH them — a lone
+            # deepest run (even multi-segment) is already fully compacted,
+            # and pushing it further down would never terminate
+            if not over and not (force and deeper):
+                continue
+            cursor = self._level_cursor.get(lvl, b"")
+            src_seg = next((s for s in run if s.first_key > cursor), run[0])
+            dst = [s for s in self._ensure_level(lvl + 1)
+                   if s.overlaps(src_seg.first_key, src_seg.last_key)]
+            return lvl, [src_seg], dst
+        return None
+
+    def compact_once(self, force: bool = True) -> bool:
+        """Run ONE bounded leveled merge; True if a merge ran.
+
+        `force=True` (the default — direct operator/test calls keep the
+        old "merge something if anything is mergeable" contract) also
+        drains under-target runs downward; the background Compactor passes
+        force=False so it only works off genuine over-budget debt.
+
+        Inputs are one source slice + the next level's overlapping
+        segments, so the work is O(level slice) regardless of total
+        dataset size — the property the GB-scale acceptance curve pins.
+        The merged stream is split into multiple output segments at
+        `seg_target_bytes`; every output is written and fsynced BEFORE the
+        single manifest edge swaps inputs for outputs, so kill -9 anywhere
+        (including between outputs — the `compact_mid_outputs` site)
+        recovers to either the pre-merge or post-merge state, never a mix.
+        Tombstones drop only when no level deeper than the destination
+        holds data (nothing underneath can resurrect the key).
 
         Runs WITHOUT the flush lock: a commit crossing the memtable
-        watermark must never stall behind an O(dataset) merge, so flushes
-        land freely during it (their segments are newer than the captured
-        set and keep precedence). Only a whole-state swap (install_rows)
-        can invalidate the merge — detected at the manifest edge, where
-        the merged output is abandoned instead of resurrecting old state."""
+        watermark must never stall behind a merge, so flushes land freely
+        during it (their L0 segments are newer than the captured inputs
+        and keep precedence). Only a whole-state swap (install_rows) can
+        invalidate the merge — detected at the manifest edge, where the
+        merged outputs are abandoned instead of resurrecting old state."""
         with self._compact_lock:
-            _, captured = self._pinned_view()  # pinned under the same lock
-            if len(captured) < 2:
-                self._unpin(captured)
-                return False
-            t0 = time.monotonic()
             with self._lock:
-                seg_id = self._next_seg
-                self._next_seg += 1
+                pick = self._pick_compaction_locked(force=force)
+                if pick is None:
+                    return False
+                src_level, src, dst = pick
+                dst_level = src_level + 1
+                # tombstones can drop iff nothing lives below the outputs
+                drop_tombstones = not any(
+                    self._levels[i]
+                    for i in range(dst_level + 1, len(self._levels)))
+                # priority order for the merge, lowest first: dst run is
+                # older than every src segment; within L0 src keeps its
+                # flush order (oldest -> newest)
+                inputs = list(dst) + list(src)
+                for r in inputs:
+                    r.pins += 1
+            t0 = time.monotonic()
+            in_bytes = sum(s.file_bytes for s in inputs)
+            outputs: list[SSTableReader] = []
             try:
                 self._maybe_fail("compact-before-sstable")
-
-                def merged():
-                    empty_mem: list = []
-                    for ck, v in self._iter_merged(
-                            b"", sources=(empty_mem, captured)):
-                        if v is not None:
-                            yield ck, 0, v
-                stats = write_sstable(self._seg_path(seg_id), merged(),
-                                      block_bytes=self.block_bytes)
+                merged = self._iter_merged(b"", sources=([], inputs))
+                done = False
+                while not done:
+                    with self._lock:
+                        seg_id = self._next_seg
+                        self._next_seg += 1
+                    batch: list[tuple[bytes, int, bytes]] = []
+                    batch_bytes = 0
+                    for ck, v in merged:
+                        if v is None:
+                            if drop_tombstones:
+                                continue
+                            batch.append((ck, 1, b""))
+                            batch_bytes += len(ck) + 16
+                        else:
+                            batch.append((ck, 0, v))
+                            batch_bytes += len(ck) + len(v) + 16
+                        if batch_bytes >= self.seg_target_bytes:
+                            break
+                    else:
+                        done = True
+                    if not batch:
+                        break
+                    if outputs:
+                        self._maybe_fail("compact-mid-outputs")
+                    write_sstable(self._seg_path(seg_id), iter(batch),
+                                  block_bytes=self.block_bytes)
+                    reader = SSTableReader(self._seg_path(seg_id))
+                    reader.seg_id = seg_id
+                    outputs.append(reader)
                 self._maybe_fail("compact-before-manifest")
-                reader = SSTableReader(self._seg_path(seg_id))
-                reader.seg_id = seg_id
                 with self._lock:
-                    if any(s not in self._segments for s in captured):
+                    flat = self._flat_locked()
+                    if any(s not in flat for s in inputs):
                         # install_rows swapped the state mid-merge: the
-                        # merged output describes dead state — drop it
-                        reader.close()
-                        try:
-                            os.remove(reader.path)
-                        except OSError:
-                            pass
+                        # merged outputs describe dead state — drop them
+                        for r in outputs:
+                            r.close()
+                            try:
+                                os.remove(r.path)
+                            except OSError:
+                                pass
                         return False
-                    kept = [s for s in self._segments if s not in captured]
-                    self._segments = [reader] + kept
-                    self._write_manifest_locked()
-                    self._graveyard.extend(captured)
+                    if src_level == 0:
+                        # newer flushes may have appended during the merge;
+                        # drop only the captured prefix
+                        self._levels[0] = [s for s in self._levels[0]
+                                           if s not in src]
+                    else:
+                        self._levels[src_level] = [
+                            s for s in self._levels[src_level]
+                            if s not in src]
+                    self._levels[dst_level] = [
+                        s for s in self._ensure_level(dst_level)
+                        if s not in dst]
+                    for r in outputs:
+                        self._set_levels_locked(dst_level, r)
+                    if src_level >= 1 and src:
+                        self._level_cursor[src_level] = src[-1].last_key
+                    try:
+                        self._write_manifest_locked()
+                    except BaseException:
+                        # manifest edge failed (transient fs error, armed
+                        # failpoint): the on-disk truth is still the old
+                        # manifest — restore the in-memory levels to match
+                        # so a retrying Compactor sees pre-merge state and
+                        # the outer handler can delete the orphan outputs
+                        self._levels[dst_level] = [
+                            s for s in self._levels[dst_level]
+                            if s not in outputs]
+                        for s in dst:
+                            self._set_levels_locked(dst_level, s)
+                        if src_level == 0:
+                            self._levels[0] = list(src) + self._levels[0]
+                        else:
+                            for s in src:
+                                self._set_levels_locked(src_level, s)
+                        raise
+                    self._graveyard.extend(inputs)
                     self._sweep_graveyard_locked()
+            except BaseException:
+                for r in outputs:
+                    try:
+                        r.close()
+                        os.remove(r.path)
+                    except OSError:
+                        pass
+                raise
             finally:
-                self._unpin(captured)
-            for r in captured:
+                self._unpin(inputs)
+            for r in inputs:
                 try:
                     os.remove(r.path)
                 except OSError:
                     pass
             secs = time.monotonic() - t0
+            with self._lock:
+                self._last_merge = {
+                    "secs": round(secs, 4), "input_bytes": in_bytes,
+                    "inputs": len(inputs), "outputs": len(outputs),
+                    "src_level": src_level}
+                self._max_merge_secs = max(self._max_merge_secs, secs)
             self._reg.inc("bcos_storage_compactions_total")
             self._reg.observe("bcos_storage_compaction_seconds", secs)
-            LOG.info(badge("ENGINE", "compacted", merged=len(captured),
-                           segment=seg_id, records=stats["records"],
-                           bytes=stats["bytes"], ms=int(secs * 1000)))
+            LOG.info(badge("ENGINE", "compacted", level=src_level,
+                           merged=len(inputs), outputs=len(outputs),
+                           input_bytes=in_bytes, ms=int(secs * 1000)))
             self._publish_gauges()
             return True
 
@@ -605,10 +839,15 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
                 return
 
     def compact(self) -> None:
-        """Full flush+merge (SnapshotService calls this after pruning so
-        tombstoned history leaves the disk, like WalStorage.compact)."""
+        """Full flush+drain (SnapshotService calls this after pruning so
+        tombstoned history leaves the disk, like WalStorage.compact; the
+        storage_tool --compact operator path uses it for catch-up after an
+        outage). Forces merges until every run sits in one deepest level,
+        so the final merges see no data beneath them and drop tombstones."""
         self.flush()
-        self.compact_once()
+        for _ in range(10_000):  # backstop; each merge strictly shrinks
+            if not self.compact_once(force=True):
+                break
 
     # -- snapshot integration ---------------------------------------------
     def capture_rows(self):
@@ -650,21 +889,46 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
             finally:
                 self._unpin(segs)
             items.sort()
+            # split the sorted snapshot into non-overlapping L1 runs at the
+            # segment target, so post-install merges stay bounded instead
+            # of inheriting one monolithic segment
+            readers: list[SSTableReader] = []
+            total_records = total_bytes = 0
+            chunk: list[tuple[bytes, int, bytes]] = []
+            chunk_bytes = 0
+
+            def cut_segment() -> None:
+                nonlocal chunk, chunk_bytes, total_records, total_bytes
+                with self._lock:
+                    seg_id = self._next_seg
+                    self._next_seg += 1
+                st = write_sstable(self._seg_path(seg_id), iter(chunk),
+                                   block_bytes=self.block_bytes)
+                reader = SSTableReader(self._seg_path(seg_id))
+                reader.seg_id = seg_id
+                readers.append(reader)
+                total_records += st["records"]
+                total_bytes += st["bytes"]
+                chunk, chunk_bytes = [], 0
+
+            for ck, flag, v in items:
+                chunk.append((ck, flag, v))
+                chunk_bytes += len(ck) + len(v) + 16
+                if chunk_bytes >= self.seg_target_bytes:
+                    cut_segment()
+            if chunk or not readers:
+                cut_segment()
             with self._lock:
-                seg_id = self._next_seg
-                self._next_seg += 1
-            stats = write_sstable(self._seg_path(seg_id),
-                                  iter(items), block_bytes=self.block_bytes)
-            reader = SSTableReader(self._seg_path(seg_id))
-            reader.seg_id = seg_id
-            with self._lock:
-                old = self._segments
+                old = self._flat_locked()
                 self._mem = {}
                 self._mem_bytes = 0
                 self._frozen = []
                 self._prepared.clear()
                 self._wal_floor = self._wal.rotate()
-                self._segments = [reader]
+                self._levels = [[]]
+                self._level_cursor = {}
+                for r in readers:
+                    self._set_levels_locked(1, r)
                 self._write_manifest_locked()
                 self._wal.retire_below(self._wal_floor)
                 self._graveyard.extend(old)
@@ -675,45 +939,60 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
                 except OSError:
                     pass
             LOG.info(badge("ENGINE", "snapshot-installed",
-                           records=stats["records"], bytes=stats["bytes"]))
+                           segments=len(readers), records=total_records,
+                           bytes=total_bytes))
             self._publish_gauges()
 
     # -- observability -----------------------------------------------------
     def audit(self) -> list[str]:
         """WAL/manifest coherence problems, [] if clean (the invariant
         auditor's storage check, ops/audit.py): CURRENT must name a
-        readable manifest whose segment list matches the live set, every
-        referenced segment file must exist, and the WAL floor must not
-        have passed the active segment."""
+        readable manifest whose (segment, level) list matches the live
+        set, every referenced segment file must exist, the WAL floor must
+        not have passed the active segment, and every L(n>=1) run must be
+        sorted and strictly NON-overlapping — an overlap there silently
+        serves stale versions, the worst storage bug there is."""
         problems: list[str] = []
         with self._lock:
-            seg_ids = [s.seg_id for s in self._segments]
+            seg_levels = sorted((s.seg_id, lvl)
+                                for lvl, run in enumerate(self._levels)
+                                for s in run)
+            level_ranges = [[(s.seg_id, s.first_key, s.last_key)
+                             for s in run]
+                            for run in self._levels]
             wal_floor = self._wal_floor
             active_seq = self._wal.active_seq
         cur = os.path.join(self.path, self.CURRENT)
-        man_ids: list[int] = []
         if not os.path.exists(cur):
-            if seg_ids:
+            if seg_levels:
                 problems.append("CURRENT missing with live segments")
         else:
             try:
                 with open(cur) as f:
                     name = f.read().strip()
                 with open(os.path.join(self.path, name), "rb") as f:
-                    _, man_floor, man_ids = _unpack_manifest(f.read())
-                if sorted(man_ids) != sorted(seg_ids):
+                    _, man_floor, man_sl = _unpack_manifest(f.read())
+                if sorted(man_sl) != seg_levels:
                     problems.append(
-                        f"manifest segments {sorted(man_ids)} != live "
-                        f"{sorted(seg_ids)}")
+                        f"manifest segments {sorted(man_sl)} != live "
+                        f"{seg_levels}")
                 if man_floor > active_seq:
                     problems.append(
                         f"WAL floor {man_floor} beyond active segment "
                         f"{active_seq}")
             except (OSError, ManifestError, ValueError) as exc:
                 problems.append(f"CURRENT/manifest unreadable: {exc}")
-        for sid in seg_ids:
+        for sid, _ in seg_levels:
             if not os.path.exists(self._seg_path(sid)):
                 problems.append(f"segment file seg-{sid:08d}.sst missing")
+        for lvl, run in enumerate(level_ranges):
+            if lvl == 0:
+                continue  # L0 overlap is legal by construction
+            for (a_id, _, a_hi), (b_id, b_lo, _) in zip(run, run[1:]):
+                if a_hi >= b_lo:
+                    problems.append(
+                        f"L{lvl} overlap: seg-{a_id:08d} range reaches "
+                        f"into seg-{b_id:08d}")
         if wal_floor > active_seq:
             problems.append(f"live WAL floor {wal_floor} beyond active "
                             f"segment {active_seq}")
@@ -721,19 +1000,39 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
 
     def disk_bytes(self) -> int:
         with self._lock:
-            seg_bytes = sum(s.file_bytes for s in self._segments)
+            seg_bytes = sum(s.file_bytes for s in self._flat_locked())
         return seg_bytes + self._wal.tail_bytes()
 
     def stats(self) -> dict:
         with self._lock:
-            segs = [{"id": s.seg_id, "records": s.nrecords,
-                     "bytes": s.file_bytes} for s in self._segments]
+            segs = [{"id": s.seg_id, "level": lvl, "records": s.nrecords,
+                     "bytes": s.file_bytes}
+                    for lvl, run in enumerate(self._levels) for s in run]
+            levels = []
+            for lvl, run in enumerate(self._levels):
+                lvl_bytes = sum(s.file_bytes for s in run)
+                target = (self.max_segments if lvl == 0
+                          else self._level_target(lvl))
+                if lvl == 0:
+                    debt = lvl_bytes if len(run) > self.max_segments else 0
+                else:
+                    debt = max(0, lvl_bytes - target)
+                levels.append({"level": lvl, "segments": len(run),
+                               "bytes": lvl_bytes,
+                               "target": target, "debt_bytes": debt})
+            debt_total = self._debt_locked()
             mem_bytes = self._mem_bytes
+            last_merge = dict(self._last_merge)
+            max_merge_secs = round(self._max_merge_secs, 4)
         probes, skips = self._bloom_probes, self._bloom_skips
         return {
             "backend": "disk",
             "segments": segs,
             "segment_count": len(segs),
+            "levels": levels,
+            "compaction_debt_bytes": debt_total,
+            "last_merge": last_merge,
+            "max_merge_secs": max_merge_secs,
             "memtable_bytes": mem_bytes,
             "wal_bytes": self._wal.tail_bytes(),
             "disk_bytes": self.disk_bytes(),
@@ -754,15 +1053,18 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
 
     def _publish_gauges(self) -> None:
         with self._lock:
-            nsegs = len(self._segments)
-            seg_bytes = sum(s.file_bytes for s in self._segments)
+            flat = self._flat_locked()
+            nsegs = len(flat)
+            seg_bytes = sum(s.file_bytes for s in flat)
             mem_bytes = self._mem_bytes
+            debt = self._debt_locked()
+            nlevels = sum(1 for run in self._levels if run)
         self._reg.set_gauge("bcos_storage_segments", nsegs)
+        self._reg.set_gauge("bcos_storage_levels", nlevels)
         self._reg.set_gauge("bcos_storage_disk_bytes",
                             seg_bytes + self._wal.tail_bytes())
         self._reg.set_gauge("bcos_storage_memtable_bytes", mem_bytes)
-        self._reg.set_gauge("bcos_storage_compaction_debt_bytes",
-                            seg_bytes if nsegs > 1 else 0)
+        self._reg.set_gauge("bcos_storage_compaction_debt_bytes", debt)
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
@@ -778,5 +1080,5 @@ class DiskStorage(TransactionalStorage, _SpaceHealth):
             LOG.exception(badge("ENGINE", "close-flush-failed"))
         with self._lock:
             self._wal.close()
-            for r in self._segments + self._graveyard:
+            for r in self._flat_locked() + self._graveyard:
                 r.close()

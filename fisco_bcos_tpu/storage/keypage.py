@@ -187,12 +187,50 @@ class KeyPageStorage(TransactionalStorage):
         return [] if base_tables is None else base_tables()
 
     def stats(self) -> dict:
-        """Read-amplification counters (direct unit-test surface)."""
+        """Read-amplification counters (direct unit-test surface), merged
+        with the wrapped backend's stats under `backend_stats` so the ops
+        surface (getSystemStatus, storage_tool) still sees the engine's
+        level/debt/segment detail when keypage is the default layout."""
         with self._lock:
-            return {"backend_reads": self._backend_reads,
-                    "cache_hits": self._cache_hits,
-                    "cached_pages": len(self._pages),
-                    "tables_cached": len(self._meta)}
+            out = {"backend_reads": self._backend_reads,
+                   "cache_hits": self._cache_hits,
+                   "cached_pages": len(self._pages),
+                   "tables_cached": len(self._meta),
+                   "key_page_size": self.page_size}
+        backend_stats = getattr(self.backend, "stats", None)
+        if backend_stats is not None:
+            out["backend_stats"] = backend_stats()
+        return out
+
+    # -- engine passthroughs ----------------------------------------------
+    # KeyPageStorage is a LAYOUT, not a lifecycle owner: every operational
+    # seam the node discovers by feature detection (ops/audit.py, snapshot
+    # export/install, the overload debt signal, storage_tool) must keep
+    # working when the disk engine sits behind a page layer — these appear
+    # only when the backend provides them, preserving the getattr contract.
+    def __getattr__(self, name):
+        if name in ("audit", "compaction_debt_bytes", "disk_bytes",
+                    "flush", "needs_compaction", "probe_space"):
+            return getattr(self.backend, name)
+        raise AttributeError(name)
+
+    def compact(self) -> None:
+        backend_compact = getattr(self.backend, "compact", None)
+        if backend_compact is not None:
+            backend_compact()
+
+    def capture_rows(self):
+        """Snapshot export passthrough: rows stream in the PAGE layout
+        (meta + `_kp_/` pages are ordinary rows to the backend), which is
+        deterministic for identical logical state — so cross-node
+        `c_balance` byte-comparisons and snapshot install both stay
+        exact."""
+        return self.backend.capture_rows()
+
+    def install_rows(self, by_table: dict) -> None:
+        self.backend.install_rows(by_table)
+        # the swapped-in state invalidates every cached page wholesale
+        self.flush_caches()
 
     # -- changeset translation ---------------------------------------------
     def _translate(self, changes: ChangeSet,

@@ -246,6 +246,29 @@ class SSTableReader:
         self._cache_order: list[int] = []
         self._cache_lock = threading.Lock()
         self.pins = 0  # long scans pin the reader against graveyard close
+        self._last_key: Optional[bytes] = None  # lazily decoded
+
+    @property
+    def first_key(self) -> bytes:
+        """Smallest composite key in the segment (b"" when empty)."""
+        return self._block_keys[0] if self._block_keys else b""
+
+    @property
+    def last_key(self) -> bytes:
+        """Largest composite key — decoded from the final block ONCE and
+        cached; the engine's leveled compaction selects overlapping-range
+        segments by [first_key, last_key] without scanning files."""
+        if self._last_key is None:
+            if not self._block_keys:
+                self._last_key = b""
+            else:
+                self._last_key = self._block(len(self._block_keys) - 1)[-1][0]
+        return self._last_key
+
+    def overlaps(self, lo: bytes, hi: bytes) -> bool:
+        """Key-range intersection test against [lo, hi] (inclusive)."""
+        return bool(self._block_keys) and \
+            self.first_key <= hi and lo <= self.last_key
 
     def _load_meta(self) -> None:
         if self.file_bytes < _FOOTER.size:

@@ -1,0 +1,644 @@
+"""Game-day orchestration: scheduled faults under production-shaped load
+against a REAL multi-node cluster.
+
+A chaos test proves one fault; a game day proves the OPERATION: scenario
+load (testing/scenario.py) runs open-loop against a live cluster of
+daemon processes (testing/chaos.py) while a declarative schedule fires
+faults — kill -9, asymmetric partitions that heal, Byzantine peers,
+armed failpoints at storage durability edges, aggressor clients — and
+after every phase the plane asserts what an operator would page on:
+
+  * every node's `getAuditReport` is clean (manifest/WAL/ledger/state
+    coherent — crash recovery actually recovered);
+  * heads CONVERGE to one hash within the recovery SLO;
+  * `healthz` returns ok on every node within the SLO;
+  * sampled write (submit -> receipt) p99 stays under the schedule's
+    bound — liveness under fault, not just eventual safety;
+
+and at the end of the day: the c_balance table is BYTE-IDENTICAL across
+every node's storage (offline read of each data directory), plus a
+post-soak closed-loop capacity row (`gameday_post_soak_tps`) for the
+perf gate — surviving the day is not enough if the node limps out of it.
+
+Schedules are plain dicts (JSON on disk, or a builtin name):
+
+    {"name": "...", "nodes": 4, "tls": true, "recovery_slo_s": 90,
+     "write_p99_ms": 45000, "scenario_accounts": 400,
+     "phases": [
+       {"name": "kill9-under-mint", "duration_s": 25,
+        "load": {"scenario": "mint-storm", "intensity": 0.7},
+        "events": [
+          {"at_s": 6.0, "action": "sigkill", "node": 3,
+           "restart_after_s": 4.0},
+          {"at_s": 4.0, "action": "partition", "a": 0, "b": 1,
+           "heal_after_s": 6.0, "symmetric": false},
+          {"at_s": 5.0, "action": "failpoint", "node": 2,
+           "site": "storage.engine.flush_before_sstable",
+           "fp_action": "crash", "restart_after_s": 4.0},
+          {"at_s": 3.0, "action": "aggressor", "duration_s": 6.0,
+           "rate_mult": 3.0},
+          {"at_s": 2.0, "action": "byzantine", "node": 1,
+           "duration_s": 5.0}]}]}
+
+Failures raise `GameDayFailure(phase, invariant, detail)`; the CLI
+(tools/gameday.py) turns that into a nonzero exit naming both.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from . import scenario as sc
+from .chaos import ChaosHarness
+
+_ACTIONS = ("sigkill", "partition", "failpoint", "aggressor", "byzantine")
+
+#: ~2-3 min of wall on a shared CI host: one SIGKILL + restart under an
+#: aggressor burst, one asymmetric partition + heal, one armed crash
+#: failpoint at a storage durability edge — each under a different
+#: scenario's open-loop load.
+CI_SMOKE = {
+    "name": "ci-smoke",
+    "nodes": 4,
+    "tls": True,
+    "recovery_slo_s": 120.0,
+    "write_p99_ms": 60_000.0,
+    "scenario_accounts": 300,
+    "phases": [
+        {"name": "kill9-under-mint-storm", "duration_s": 22.0,
+         "load": {"scenario": "mint-storm", "intensity": 0.6},
+         "events": [
+             {"at_s": 3.0, "action": "aggressor", "duration_s": 6.0,
+              "rate_mult": 3.0},
+             {"at_s": 5.0, "action": "sigkill", "node": 3,
+              "restart_after_s": 3.0}]},
+        {"name": "partition-under-hot-key", "duration_s": 20.0,
+         "load": {"scenario": "hot-key", "intensity": 0.6},
+         "events": [
+             {"at_s": 4.0, "action": "partition", "a": 0, "b": 1,
+              "heal_after_s": 7.0, "symmetric": False}]},
+        # append_before_fsync fires on the NEXT committed block's WAL
+        # append — deterministic under load, unlike flush/merge edges
+        # that need the memtable to fill first
+        {"name": "wal-crash-under-airdrop", "duration_s": 22.0,
+         "load": {"scenario": "airdrop-sweep", "intensity": 0.6},
+         "events": [
+             {"at_s": 4.0, "action": "failpoint", "node": 2,
+              "site": "storage.wal.append_before_fsync",
+              "fp_action": "crash", "restart_after_s": 3.0}]},
+    ],
+}
+
+#: The longer day: adds an aggressor burst, a Byzantine peer (tls off),
+#: a leveled-merge crash edge, and a wide-table phase.
+SOAK = {
+    "name": "soak",
+    "nodes": 4,
+    "tls": False,  # byzantine phases need a plaintext p2p edge
+    "recovery_slo_s": 180.0,
+    "write_p99_ms": 90_000.0,
+    "scenario_accounts": 600,
+    "phases": [
+        {"name": "kill9-and-aggressor-under-mint", "duration_s": 30.0,
+         "load": {"scenario": "mint-storm", "intensity": 0.6},
+         "events": [
+             {"at_s": 4.0, "action": "aggressor", "duration_s": 8.0,
+              "rate_mult": 3.0},
+             {"at_s": 8.0, "action": "sigkill", "node": 3,
+              "restart_after_s": 4.0}]},
+        {"name": "byzantine-under-hot-key", "duration_s": 24.0,
+         "load": {"scenario": "hot-key", "intensity": 0.6},
+         "events": [
+             {"at_s": 3.0, "action": "byzantine", "node": 1,
+              "duration_s": 8.0}]},
+        {"name": "partition-and-merge-crash-under-wide-table",
+         "duration_s": 30.0,
+         "load": {"scenario": "wide-table", "intensity": 0.5},
+         "events": [
+             {"at_s": 4.0, "action": "partition", "a": 1, "b": 2,
+              "heal_after_s": 8.0, "symmetric": True},
+             {"at_s": 6.0, "action": "failpoint", "node": 3,
+              "site": "storage.engine.flush_before_sstable",
+              "fp_action": "crash", "restart_after_s": 4.0}]},
+    ],
+}
+
+BUILTIN_SCHEDULES = {"ci-smoke": CI_SMOKE, "soak": SOAK}
+
+
+class GameDayFailure(AssertionError):
+    """An invariant did not hold; names the phase and the invariant."""
+
+    def __init__(self, phase: str, invariant: str, detail: str):
+        super().__init__(f"phase {phase!r}: invariant {invariant!r} "
+                         f"failed: {detail}")
+        self.phase = phase
+        self.invariant = invariant
+        self.detail = detail
+
+
+def validate_schedule(schedule: dict) -> dict:
+    """Fill defaults, check every field the executor will rely on;
+    raises ValueError naming the offending phase/event. Returns a deep
+    copy — the caller's dict is never mutated."""
+    s = copy.deepcopy(schedule)
+    if not isinstance(s, dict) or not s.get("name"):
+        raise ValueError("schedule needs a 'name'")
+    s.setdefault("nodes", 4)
+    s.setdefault("tls", True)
+    s.setdefault("recovery_slo_s", 120.0)
+    s.setdefault("write_p99_ms", 60_000.0)
+    s.setdefault("scenario_accounts", 300)
+    if s["nodes"] < 4:
+        raise ValueError("a game day needs >= 4 nodes (f=1 PBFT)")
+    phases = s.get("phases")
+    if not phases:
+        raise ValueError("schedule has no phases")
+    names = set()
+    for p in phases:
+        pname = p.get("name")
+        if not pname or pname in names:
+            raise ValueError(f"phase needs a unique name: {p!r}")
+        names.add(pname)
+        if not (isinstance(p.get("duration_s"), (int, float))
+                and p["duration_s"] > 0):
+            raise ValueError(f"phase {pname!r}: duration_s must be > 0")
+        load = p.setdefault("load", {})
+        load.setdefault("scenario", "mint-storm")
+        load.setdefault("intensity", 0.6)
+        if load["scenario"] not in sc.SCENARIOS:
+            raise ValueError(f"phase {pname!r}: unknown scenario "
+                             f"{load['scenario']!r}")
+        if load["scenario"] == "xshard-heavy":
+            raise ValueError(f"phase {pname!r}: xshard-heavy needs the "
+                             "multi-group bench runner, not a game day")
+        for ev in p.setdefault("events", []):
+            act = ev.get("action")
+            if act not in _ACTIONS:
+                raise ValueError(f"phase {pname!r}: unknown action "
+                                 f"{act!r} (have {_ACTIONS})")
+            at = ev.setdefault("at_s", 0.0)
+            if not 0 <= at <= p["duration_s"]:
+                raise ValueError(f"phase {pname!r}: {act} at_s={at} "
+                                 "outside the phase window")
+            if act in ("sigkill", "failpoint", "byzantine"):
+                node = ev.get("node")
+                if not isinstance(node, int) or not \
+                        0 <= node < s["nodes"]:
+                    raise ValueError(f"phase {pname!r}: {act} needs a "
+                                     f"valid 'node' (got {node!r})")
+            if act == "sigkill":
+                ev.setdefault("restart_after_s", 3.0)
+            if act == "partition":
+                a, b = ev.get("a"), ev.get("b")
+                if not (isinstance(a, int) and isinstance(b, int)
+                        and a != b and 0 <= a < s["nodes"]
+                        and 0 <= b < s["nodes"]):
+                    raise ValueError(f"phase {pname!r}: partition needs "
+                                     f"distinct nodes a/b (got {a!r},"
+                                     f" {b!r})")
+                ev.setdefault("heal_after_s", 6.0)
+                ev.setdefault("symmetric", False)
+            if act == "failpoint":
+                if not ev.get("site"):
+                    raise ValueError(f"phase {pname!r}: failpoint needs "
+                                     "a 'site'")
+                ev.setdefault("fp_action", "crash")
+                ev.setdefault("restart_after_s", 3.0)
+            if act == "aggressor":
+                ev.setdefault("duration_s", 6.0)
+                ev.setdefault("rate_mult", 3.0)
+            if act == "byzantine":
+                if s["tls"]:
+                    raise ValueError(f"phase {pname!r}: byzantine needs "
+                                     "a tls=false schedule (SM-TLS "
+                                     "rejects strangers at transport)")
+                ev.setdefault("duration_s", 5.0)
+    return s
+
+
+class GameDay:
+    """Execute one validated schedule against a fresh real cluster.
+
+    `emit(row)` receives bench rows (dicts with a `metric` key) as they
+    are produced — the CLI prints them as JSON lines for bench.py /
+    tools/perf_gate.py pickup."""
+
+    def __init__(self, schedule: dict, out_dir: str,
+                 emit: Optional[Callable[[dict], None]] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self.schedule = validate_schedule(schedule)
+        self.out_dir = out_dir
+        self.emit = emit or (lambda row: None)
+        self.log = log or (lambda msg: None)
+        self.harness: Optional[ChaosHarness] = None
+        self.suite = None
+        self._capacity = 0.0
+        self._sign_cursor = 0
+        self._faults: list[str] = []
+
+    # -- cluster ------------------------------------------------------------
+    def _boot(self) -> None:
+        s = self.schedule
+        # leveled compaction live on every daemon: disk backend, a small
+        # memtable and a low L0 trigger so scenario load actually
+        # flushes and merges inside the day's window
+        self.harness = ChaosHarness(
+            self.out_dir, n_nodes=s["nodes"], tls=s["tls"],
+            config_overrides={
+                "storage_backend": "disk", "storage_memtable_mb": 1,
+                "storage_compact_segments": 2,
+                "storage_level_base_mb": 4})
+        # partition proxies interpose on p2p links and must exist before
+        # the first start: collect every (a, b) pair up front
+        self._proxies: dict[tuple[int, int], object] = {}
+        for p in s["phases"]:
+            for ev in p["events"]:
+                if ev["action"] == "partition":
+                    key = tuple(sorted((ev["a"], ev["b"])))
+                    if key not in self._proxies:
+                        self._proxies[key] = self.harness.inject_link(
+                            *key)
+        self.harness.start_all()
+        for i in range(s["nodes"]):
+            self.harness.wait_rpc_up(i)
+        self.suite = self.harness.suite()
+        # one client per node for the whole day: SdkClient re-dials a
+        # dropped connection per request, so restarts need no rebuild
+        self._clients = [self.harness.client(i)
+                         for i in range(s["nodes"])]
+        self.log(f"cluster up: {s['nodes']} nodes, tls={s['tls']}, "
+                 f"{len(self._proxies)} interposed links")
+
+    def _spec(self, scenario_name: str) -> sc.ScenarioSpec:
+        return sc.ScenarioSpec(name=scenario_name,
+                               accounts=self.schedule[
+                                   "scenario_accounts"])
+
+    def _sm(self) -> bool:
+        return bool(self.harness.info["sm_crypto"])
+
+    def _alive(self) -> list[int]:
+        return [i for i, p in enumerate(self.harness.procs)
+                if p is not None and p.poll() is None]
+
+    def _submit_wire(self, raws: list[bytes]) -> int:
+        """Round-robin pre-signed wire txs across ALIVE nodes' RPC;
+        per-tx transport errors count as shed (the cluster is under
+        fault — a dead ingress is load the operator loses, not a bug)."""
+        alive = self._alive()
+        if not alive:
+            return 0
+        ok = 0
+        for k, raw in enumerate(raws):
+            i = alive[k % len(alive)]
+            try:
+                self._clients[i].request(
+                    "sendTransaction",
+                    [self.harness.info["group_id"], "",
+                     "0x" + raw.hex(), False, False])
+                ok += 1
+            except Exception:  # noqa: BLE001 — fault windows drop txs
+                continue
+        return ok
+
+    def _total_txs(self) -> int:
+        for i in self._alive():
+            try:
+                return self._clients[i].get_total_transaction_count()[
+                    "transactionCount"]
+            except Exception:  # noqa: BLE001
+                continue
+        return 0
+
+    # -- prefund + calibration ----------------------------------------------
+    def _prefund(self, specs: list[sc.ScenarioSpec]) -> None:
+        seen: set[str] = set()
+        raws: list[bytes] = []
+        for spec in specs:
+            if spec.name in seen:
+                continue
+            seen.add(spec.name)
+            fields = sc.prefund_fields(spec)
+            if fields:
+                raws += sc.sign_workload(spec, self._sm(), len(fields),
+                                         block_limit=500, prefund=True)
+        if not raws:
+            return
+        self.log(f"pre-funding {len(raws)} txs through the chain...")
+        before = self._total_txs()
+        admitted = self._submit_wire(raws)
+        self.harness.wait_until(
+            lambda: self._total_txs() - before >= admitted,
+            timeout=180.0, what="prefund commit")
+
+    def _calibrate(self, n: int = 150) -> float:
+        spec = self._spec("mint-storm")
+        raws = sc.sign_workload(spec, self._sm(), n, block_limit=600,
+                                start=self._sign_cursor)
+        self._sign_cursor += n
+        before = self._total_txs()
+        t0 = time.perf_counter()
+        admitted = self._submit_wire(raws)
+        self.harness.wait_until(
+            lambda: self._total_txs() - before >= admitted,
+            timeout=180.0, what="calibration commit")
+        cap = admitted / (time.perf_counter() - t0)
+        self.log(f"calibrated capacity ~{cap:.0f} TPS")
+        return max(cap, 1.0)
+
+    # -- fault handlers -----------------------------------------------------
+    def _run_event(self, ev: dict, phase: str,
+                   aggr_wire: list[bytes]) -> None:
+        h = self.harness
+        act = ev["action"]
+        try:
+            if act == "sigkill":
+                self.log(f"[{phase}] kill -9 node{ev['node']}")
+                h.kill(ev["node"])
+                time.sleep(ev["restart_after_s"])
+                h.start(ev["node"])
+                h.wait_rpc_up(ev["node"],
+                              timeout=self.schedule["recovery_slo_s"])
+            elif act == "partition":
+                key = tuple(sorted((ev["a"], ev["b"])))
+                proxy = self._proxies[key]
+                self.log(f"[{phase}] partition {key} "
+                         f"(symmetric={ev['symmetric']})")
+                if ev["symmetric"]:
+                    proxy.blackhole()
+                else:
+                    h.partition_link(proxy, ev["a"], ev["b"])
+                time.sleep(ev["heal_after_s"])
+                proxy.heal()
+                self.log(f"[{phase}] healed {key}")
+            elif act == "failpoint":
+                node, site = ev["node"], ev["site"]
+                self.log(f"[{phase}] arming {site}={ev['fp_action']} "
+                         f"on node{node}")
+                h.arm_failpoint(node, site, ev["fp_action"])
+                if ev["fp_action"] == "crash":
+                    # the site fires on the next crossing under load;
+                    # wait for the process to die, then restart it
+                    deadline = time.monotonic() + 60.0
+                    proc = h.procs[node]
+                    while time.monotonic() < deadline:
+                        if proc is None or proc.poll() is not None:
+                            break
+                        time.sleep(0.25)
+                    else:
+                        raise RuntimeError(
+                            f"armed crash at {site} never fired on "
+                            f"node{node} (site not crossed under load)")
+                    h.procs[node] = None
+                    self.log(f"[{phase}] node{node} crashed at {site}; "
+                             "restarting")
+                    time.sleep(ev["restart_after_s"])
+                    h.start(node)
+                    h.wait_rpc_up(
+                        node, timeout=self.schedule["recovery_slo_s"])
+                else:
+                    h.disarm_failpoints(node)
+            elif act == "aggressor":
+                n = len(aggr_wire)
+                self.log(f"[{phase}] aggressor burst: {n} txs over "
+                         f"{ev['duration_s']}s")
+                sc.open_loop_poisson(
+                    self._submit_wire, aggr_wire,
+                    rate=max(1.0, n / ev["duration_s"]),
+                    window_s=ev["duration_s"], seed=99)
+            elif act == "byzantine":
+                self.log(f"[{phase}] byzantine peer at node{ev['node']}")
+                peer = h.byzantine_peer(ev["node"])
+                victim = h.node_id(ev["node"])
+                t_end = time.monotonic() + ev["duration_s"]
+                while time.monotonic() < t_end:
+                    peer.send_garbage(16)
+                    peer.send_corrupt_frames(victim, 8)
+                    peer.send_module_junk(victim, module=0x03, n=8)
+                    time.sleep(0.2)
+                peer.close()
+        except Exception as exc:  # noqa: BLE001 — surface at phase end
+            self._faults.append(f"{phase}/{act}: "
+                                f"{type(exc).__name__}: {exc}")
+
+    # -- phase --------------------------------------------------------------
+    def _run_phase(self, p: dict) -> dict:
+        h, s = self.harness, self.schedule
+        phase = p["name"]
+        self._faults = []
+        spec = self._spec(p["load"]["scenario"])
+        rate = max(1.0, self._capacity * p["load"]["intensity"])
+        n = int(rate * p["duration_s"] * 1.3) + 32
+        raws = sc.sign_workload(spec, self._sm(), n, block_limit=600,
+                                start=self._sign_cursor)
+        self._sign_cursor += n
+        aggr_wire: list[bytes] = []
+        for ev in p["events"]:
+            if ev["action"] == "aggressor":
+                n_a = int(self._capacity * ev["rate_mult"]
+                          * ev["duration_s"]) + 32
+                aggr_wire = sc.sign_workload(
+                    spec, self._sm(), n_a, block_limit=600,
+                    start=self._sign_cursor)
+                self._sign_cursor += n_a
+
+        from fisco_bcos_tpu.protocol import Transaction, batch_hash
+        hashes = batch_hash([Transaction.decode(r) for r in raws],
+                            self.suite)
+        pending: dict[int, float] = {}
+        lat: list[float] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def watcher():
+            outstanding: dict[int, float] = {}
+            grace = None
+            while True:
+                with lock:
+                    outstanding.update(pending)
+                    pending.clear()
+                for k in list(outstanding):
+                    alive = self._alive()
+                    if not alive:
+                        break
+                    try:
+                        rc = self._clients[
+                            alive[0]].get_transaction_receipt(
+                            "0x" + hashes[k].hex())
+                    except Exception:  # noqa: BLE001
+                        break
+                    if rc is not None:
+                        lat.append(time.perf_counter()
+                                   - outstanding.pop(k))
+                if stop.is_set():
+                    if not outstanding:
+                        return
+                    grace = grace or time.monotonic() + 30.0
+                    if time.monotonic() > grace:
+                        return
+                time.sleep(0.25)
+
+        def on_sample(k, t_sub):
+            with lock:
+                pending[k] = t_sub
+
+        self.log(f"phase {phase}: {p['load']['scenario']} @ "
+                 f"{rate:.0f}/s for {p['duration_s']}s, "
+                 f"{len(p['events'])} event(s)")
+        timers = [threading.Timer(
+            ev["at_s"], self._run_event, (ev, phase, aggr_wire))
+            for ev in p["events"]]
+        watch = threading.Thread(target=watcher, daemon=True)
+        before = self._total_txs()
+        t0 = time.perf_counter()
+        watch.start()
+        for t in timers:
+            t.daemon = True
+            t.start()
+        win = sc.open_loop_poisson(
+            self._submit_wire, raws, rate, p["duration_s"],
+            seed=spec.seed, on_sample=on_sample, sample_every=8)
+        for t in timers:
+            t.join(timeout=max(120.0, s["recovery_slo_s"]))
+        if self._faults:
+            raise GameDayFailure(phase, "fault-injection",
+                                 "; ".join(self._faults))
+
+        # -- invariants, in page order -------------------------------------
+        slo = s["recovery_slo_s"]
+        try:
+            h.wait_until(
+                lambda: all(h.healthz(i)[0] == 200
+                            for i in range(s["nodes"])),
+                timeout=slo, what="healthz ok on every node")
+        except TimeoutError as exc:
+            raise GameDayFailure(phase, "health-within-slo", str(exc))
+        recovery_s = time.perf_counter() - t0 - p["duration_s"]
+        try:
+            height = h.wait_converged(range(s["nodes"]), min_height=1,
+                                      timeout=slo)
+        except TimeoutError as exc:
+            raise GameDayFailure(phase, "heads-converge", str(exc))
+        for i in range(s["nodes"]):
+            report = h.audit_report(i)
+            if not report.get("ok"):
+                bad = [c for c in report.get("checks", [])
+                       if not c.get("ok")]
+                raise GameDayFailure(phase, "audit-clean",
+                                     f"node{i}: {bad}")
+        stop.set()
+        watch.join(timeout=60)
+        lat.sort()
+        p99 = lat[int(0.99 * (len(lat) - 1))] * 1000 if lat else 0.0
+        if lat and p99 > s["write_p99_ms"]:
+            raise GameDayFailure(
+                phase, "write-p99-bounded",
+                f"{p99:.0f}ms > {s['write_p99_ms']:.0f}ms bound "
+                f"({len(lat)} samples)")
+        if not lat:
+            raise GameDayFailure(phase, "write-p99-bounded",
+                                 "no sampled write committed")
+        committed = self._total_txs() - before
+        row = {
+            "metric": "gameday_phase", "unit": "tx/sec",
+            "phase": phase, "scenario": p["load"]["scenario"],
+            "value": round(committed
+                           / max(time.perf_counter() - t0, 1e-9), 1),
+            "committed": committed, "height": height,
+            "write_p50_ms": round(lat[len(lat) // 2] * 1000, 1)
+            if lat else None,
+            "write_p99_ms": round(p99, 1),
+            "latency_samples": len(lat),
+            "recovery_s": round(max(0.0, recovery_s), 1),
+            **{k: win[k] for k in ("offered", "admitted", "shed_rate",
+                                   "submit_errors")},
+        }
+        self.emit(row)
+        return row
+
+    # -- end-of-day checks --------------------------------------------------
+    def _balance_digest(self, node_dir: str) -> str:
+        """sha256 over the sorted c_balance rows of one STOPPED node's
+        data directory, read offline through the same layout stack the
+        node used (disk engine + key pages)."""
+        from fisco_bcos_tpu.storage.engine import DiskStorage
+        from fisco_bcos_tpu.storage.keypage import (META_KEY,
+                                                    KeyPageStorage)
+
+        st = DiskStorage(os.path.join(node_dir, "data"),
+                         auto_compact=False)
+        try:
+            view = st
+            if any(st.get(t, META_KEY) is not None for t in st.tables()):
+                view = KeyPageStorage(st)
+            hasher = hashlib.sha256()
+            keys = sorted(view.keys("c_balance"))
+            for k in keys:
+                hasher.update(k)
+                hasher.update(view.get("c_balance", k) or b"")
+            return f"{len(keys)}:{hasher.hexdigest()}"
+        finally:
+            st.close()
+
+    def run(self) -> dict:
+        s = self.schedule
+        t_day = time.perf_counter()
+        self._boot()
+        try:
+            specs = [self._spec(p["load"]["scenario"])
+                     for p in s["phases"]]
+            self._prefund(specs)
+            self._capacity = self._calibrate()
+            phase_rows = [self._run_phase(p) for p in s["phases"]]
+
+            # post-soak capacity: the day must not leave the node slow
+            post = self._calibrate()
+            self.emit({"metric": "gameday_post_soak_tps",
+                       "unit": "tx/sec", "value": round(post, 1),
+                       "schedule": s["name"],
+                       "baseline_tps": round(self._capacity, 1),
+                       "vs_baseline": round(
+                           post / max(self._capacity, 0.001), 2)})
+            height = self.harness.wait_converged(
+                range(s["nodes"]), min_height=1,
+                timeout=s["recovery_slo_s"])
+            for i in range(s["nodes"]):
+                rc = self.harness.terminate(i)
+                if rc != 0:
+                    raise GameDayFailure("end-of-day", "clean-shutdown",
+                                         f"node{i} exit code {rc}")
+            digests = {i: self._balance_digest(
+                self.harness.info["nodes"][i]["dir"])
+                for i in range(s["nodes"])}
+            if len(set(digests.values())) != 1:
+                raise GameDayFailure(
+                    "end-of-day", "balances-byte-identical",
+                    json.dumps(digests))
+            report = {
+                "schedule": s["name"], "nodes": s["nodes"],
+                "tls": s["tls"], "height": height,
+                "capacity_tps": round(self._capacity, 1),
+                "post_soak_tps": round(post, 1),
+                "balance_digest": next(iter(digests.values())),
+                "phases": phase_rows,
+                "wall_seconds": round(time.perf_counter() - t_day, 1),
+                "ok": True,
+            }
+            self.emit({"metric": "gameday_write_p99_ms", "unit": "ms",
+                       "schedule": s["name"],
+                       "value": max(r["write_p99_ms"]
+                                    for r in phase_rows),
+                       "bound_ms": s["write_p99_ms"]})
+            self.log(f"game day {s['name']!r} complete: height "
+                     f"{height}, balances identical on {s['nodes']} "
+                     f"nodes, {report['wall_seconds']}s")
+            return report
+        finally:
+            self.harness.stop_all()

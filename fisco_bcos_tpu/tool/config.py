@@ -130,8 +130,16 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                      # disk engine knobs (storage/engine.py)
                      "memtable_mb": str(cfg.storage_memtable_mb),
                      "compact_segments": str(cfg.storage_compact_segments),
-                     # reference storage.key_page_size (NodeConfig.cpp:620)
-                     "key_page_size": str(cfg.storage_key_page_size)}
+                     # leveled compaction geometry: L1 byte target +
+                     # per-level growth factor (merge cost stays
+                     # O(level slice) at any dataset size)
+                     "level_base_mb": str(cfg.storage_level_base_mb),
+                     "level_fanout": str(cfg.storage_level_fanout),
+                     # reference storage.key_page_size (NodeConfig.cpp:620);
+                     # auto = ON for the disk backend, off otherwise
+                     "key_page_size": "auto"
+                     if cfg.storage_key_page_size < 0
+                     else str(cfg.storage_key_page_size)}
     cp["snapshot"] = {"interval": str(cfg.snapshot_interval),
                       "retention": str(cfg.snapshot_retention),
                       "prune": str(cfg.snapshot_prune).lower(),
@@ -190,6 +198,10 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
     # legacy configs carry `type = wal|memory` instead of `backend`
     backend = cp.get("storage", "backend", fallback="") or \
         cp.get("storage", "type", fallback="auto") or "auto"
+    # key_page_size: `auto` (or empty/absent) = backend-appropriate
+    # default (-1 sentinel -> make_storage turns paging on for disk)
+    kps_raw = cp.get("storage", "key_page_size", fallback="auto").strip()
+    key_page_size = -1 if kps_raw in ("", "auto") else int(kps_raw)
     port_s = cp.get("rpc", "listen_port", fallback="")
     metrics_s = cp.get("monitor", "metrics_port", fallback="")
     p2p_port_s = cp.get("p2p", "listen_port", fallback="")
@@ -217,8 +229,11 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
                                       fallback=64),
         storage_compact_segments=cp.getint("storage", "compact_segments",
                                            fallback=8),
-        storage_key_page_size=cp.getint("storage", "key_page_size",
-                                        fallback=0),
+        storage_level_base_mb=cp.getint("storage", "level_base_mb",
+                                        fallback=16),
+        storage_level_fanout=cp.getint("storage", "level_fanout",
+                                       fallback=8),
+        storage_key_page_size=key_page_size,
         txpool_limit=cp.getint("txpool", "limit", fallback=15000),
         block_limit_range=cp.getint("txpool", "block_limit_range",
                                     fallback=600),

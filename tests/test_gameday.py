@@ -1,0 +1,204 @@
+"""Scenario workload shapes + game-day schedule plane (fast tier).
+
+The real-cluster game day itself is exercised by tests/test_gameday_e2e.py
+(slow tier) and tools/sanitize_ci.sh --gameday; this file pins the parts
+that must hold BEFORE a cluster ever boots: deterministic workload
+generation, open-loop admission accounting, and schedule validation."""
+
+import copy
+import threading
+
+import pytest
+
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.storage.memory import MemoryStorage
+from fisco_bcos_tpu.testing import scenario as sc
+from fisco_bcos_tpu.testing.gameday import (BUILTIN_SCHEDULES,
+                                            GameDayFailure,
+                                            validate_schedule)
+
+
+# -- scenario shapes ---------------------------------------------------------
+
+def test_scenario_spec_rejects_unknown_name():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        sc.ScenarioSpec(name="tsunami")
+
+
+def test_prefund_rows_cover_each_scenarios_sources():
+    hot = sc.prefund_rows(sc.ScenarioSpec("hot-key", accounts=100))
+    assert len(hot[pc.T_BALANCE]) == 100
+    bal = sc.ACCOUNT_BALANCE.to_bytes(16, "big")
+    assert all(v == bal for _, v in hot[pc.T_BALANCE])
+
+    air = sc.prefund_rows(sc.ScenarioSpec("airdrop-sweep", funders=5))
+    assert [k for k, _ in air[pc.T_BALANCE]] == [
+        b"funder-%d" % i for i in range(5)]
+
+    wide = sc.prefund_rows(sc.ScenarioSpec("wide-table"))
+    assert wide[pc.T_USER_PREFIX + "gd"] == [(b"\x00__meta__", b"kv")]
+
+    # mint-storm needs nothing pre-funded: registers are self-contained
+    assert sc.prefund_rows(sc.ScenarioSpec("mint-storm")) == {}
+
+
+def test_prefund_storage_injects_rows():
+    st = MemoryStorage()
+    spec = sc.ScenarioSpec("hot-key", accounts=64)
+    n = sc.prefund_storage(st, spec)
+    assert n == 64
+    assert st.get(pc.T_BALANCE, b"acct-0000063") == \
+        sc.ACCOUNT_BALANCE.to_bytes(16, "big")
+
+
+def test_prefund_fields_fund_through_the_chain():
+    fields = sc.prefund_fields(sc.ScenarioSpec("hot-key", accounts=7))
+    assert len(fields) == 7
+    assert all(to == pc.BALANCE_ADDRESS for to, _, _ in fields)
+    nonces = [nonce for _, _, nonce in fields]
+    assert len(set(nonces)) == 7 and nonces[0] == "gda-0"
+    # wide-table prefund is the table DDL
+    (to, _, nonce), = sc.prefund_fields(sc.ScenarioSpec("wide-table"))
+    assert to == pc.KV_TABLE_ADDRESS and nonce == "gdt-0"
+
+
+def test_tx_fields_deterministic_and_shaped():
+    spec = sc.ScenarioSpec("hot-key", accounts=1000, hot_keys=4,
+                           hot_share=1.0)
+    assert sc.tx_fields(spec, 42) == sc.tx_fields(spec, 42)
+    assert sc.tx_fields(spec, 42) != sc.tx_fields(spec, 43)
+    # hot_share=1.0: every arrival lands in the hot set
+    for i in range(50):
+        to, data, nonce = sc.tx_fields(spec, i)
+        assert to == pc.BALANCE_ADDRESS and nonce == f"gdh-{i}"
+        assert b"hot-" in data
+
+    wide = sc.ScenarioSpec("wide-table", value_bytes=32, wide_rows=10)
+    _, data, _ = sc.tx_fields(wide, 3)
+    assert b"row-" in data
+
+    # different seed -> different stream (chunk determinism is per-seed)
+    other = sc.ScenarioSpec("hot-key", accounts=1000, seed=99)
+    assert sc.tx_fields(spec, 7) != sc.tx_fields(other, 7)
+
+
+def test_sign_workload_produces_decodable_wire_txs():
+    from fisco_bcos_tpu.protocol import Transaction
+
+    spec = sc.ScenarioSpec("mint-storm")
+    raws = sc.sign_workload(spec, sm=False, n=5, block_limit=77,
+                            start=3)
+    assert len(raws) == 5
+    txs = [Transaction.decode(r) for r in raws]
+    assert [t.nonce for t in txs] == [f"gdm-{i}" for i in range(3, 8)]
+    assert all(t.block_limit == 77 and t.group_id == "group0"
+               for t in txs)
+
+
+# -- open-loop driver --------------------------------------------------------
+
+def test_open_loop_poisson_counts_admission_shed_and_errors():
+    calls = []
+
+    def submit(batch):
+        calls.append(len(batch))
+        if len(calls) == 1:
+            raise ConnectionError("node died mid-window")
+        return max(0, len(batch) - 1)  # shed one per batch
+
+    counts = sc.open_loop_poisson(submit, list(range(400)), rate=5000.0,
+                                  window_s=2.0)
+    assert counts["offered"] == 400
+    assert counts["submit_errors"] >= 1
+    assert counts["shed"] >= counts["submit_errors"]
+    assert counts["admitted"] + counts["shed"] == counts["offered"]
+    assert 0 < counts["shed_rate"] <= 1
+
+
+def test_open_loop_poisson_samples_admitted_indexes():
+    seen = []
+    counts = sc.open_loop_poisson(
+        lambda b: len(b), list(range(300)), rate=5000.0, window_s=2.0,
+        on_sample=lambda k, t: seen.append(k), sample_every=8)
+    assert counts["admitted"] == 300 and counts["shed"] == 0
+    assert seen and seen == sorted(seen) and len(set(seen)) == len(seen)
+    assert all(0 <= k < 300 for k in seen)
+
+
+def test_open_loop_poisson_stop_predicate_halts_early():
+    stop = threading.Event()
+
+    def submit(batch):
+        stop.set()
+        return len(batch)
+
+    counts = sc.open_loop_poisson(submit, list(range(10_000)),
+                                  rate=100_000.0, window_s=5.0,
+                                  stop=stop.is_set)
+    assert counts["offered"] < 10_000
+    assert counts["wall_seconds"] < 5.0
+
+
+# -- schedule validation -----------------------------------------------------
+
+def test_builtin_schedules_validate_and_fill_defaults():
+    for name, schedule in BUILTIN_SCHEDULES.items():
+        v = validate_schedule(schedule)
+        assert v["name"] == name and v["phases"]
+        for p in v["phases"]:
+            assert p["load"]["scenario"] in sc.SCENARIOS
+            for ev in p["events"]:
+                assert 0 <= ev["at_s"] <= p["duration_s"]
+
+
+def test_validate_schedule_does_not_mutate_input():
+    raw = {"name": "d", "tls": False,
+           "phases": [{"name": "p", "duration_s": 5}]}
+    snapshot = copy.deepcopy(raw)
+    v = validate_schedule(raw)
+    assert raw == snapshot
+    assert v["phases"][0]["load"]["scenario"] == "mint-storm"
+    assert v["nodes"] == 4 and v["recovery_slo_s"] > 0
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda s: s.pop("phases"), "no phases"),
+    (lambda s: s.__setitem__("nodes", 3), ">= 4 nodes"),
+    (lambda s: s["phases"][0].__setitem__("duration_s", 0), "duration_s"),
+    (lambda s: s["phases"][0]["load"].__setitem__(
+        "scenario", "xshard-heavy"), "multi-group"),
+    (lambda s: s["phases"][0]["events"].append(
+        {"action": "meteor"}), "unknown action"),
+    (lambda s: s["phases"][0]["events"].append(
+        {"action": "sigkill", "node": 11}), "valid 'node'"),
+    (lambda s: s["phases"][0]["events"].append(
+        {"action": "sigkill", "node": 0, "at_s": 99.0}),
+     "outside the phase"),
+    (lambda s: s["phases"][0]["events"].append(
+        {"action": "partition", "a": 1, "b": 1}), "distinct nodes"),
+    (lambda s: s["phases"][0]["events"].append(
+        {"action": "failpoint", "node": 0}), "needs a 'site'"),
+])
+def test_validate_schedule_rejects_bad_shapes(mutate, msg):
+    s = {"name": "d", "tls": False,
+         "phases": [{"name": "p", "duration_s": 10.0,
+                     "load": {"scenario": "hot-key", "intensity": 0.5},
+                     "events": []}]}
+    mutate(s)
+    with pytest.raises(ValueError, match=msg):
+        validate_schedule(s)
+
+
+def test_byzantine_requires_plaintext_p2p():
+    s = {"name": "d", "tls": True,
+         "phases": [{"name": "p", "duration_s": 10.0,
+                     "events": [{"action": "byzantine", "node": 1}]}]}
+    with pytest.raises(ValueError, match="tls=false"):
+        validate_schedule(s)
+
+
+def test_gameday_failure_names_phase_and_invariant():
+    exc = GameDayFailure("kill9-under-mint", "heads-converge", "stuck")
+    assert exc.phase == "kill9-under-mint"
+    assert exc.invariant == "heads-converge"
+    assert "kill9-under-mint" in str(exc) and "heads-converge" in str(exc)

@@ -775,3 +775,60 @@ def test_overload_config_ini_roundtrip():
                   "client_write_burst", "client_read_rate",
                   "client_read_burst"):
         assert getattr(back, field) == getattr(cfg, field), field
+
+
+# -- compaction-debt backpressure (ISSUE 17) --------------------------------
+
+def test_compaction_debt_backpressure_ok_busy_ok(tmp_path):
+    """A compaction-starved node under write load must transition
+    ok -> busy on debt (the overload plane's `compaction_debt` signal),
+    KEEP serving reads while busy, and drain back to ok once the
+    compactor catches up — the contract that keeps a node from silently
+    falling behind its own write rate at GB scale."""
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.utils.metrics import REGISTRY
+
+    node = Node(NodeConfig(
+        consensus="solo", crypto_backend="host",
+        storage_backend="disk", storage_path=str(tmp_path / "data"),
+        storage_memtable_mb=0,           # flush on every write batch
+        storage_compact_segments=2,
+        overload_hold_s=0.0,             # deterministic: no hold window
+        overload_compact_debt_mb=1))     # 1 MB of debt saturates the signal
+    try:
+        engine = node.storage.backend    # key_page_size=auto wraps disk
+        assert type(engine).__name__ == "DiskStorage"
+        node.overload.sample_once()
+        assert "compaction_debt" in node.overload.stats()["signals"]
+        assert not node.overload.busy()
+
+        engine._compactor.pause()        # starve compaction deliberately
+        rows = [(b"bp%04d-%02d" % (i, j), b"x" * 2048)
+                for i in range(24) for j in range(32)]
+        for i in range(0, len(rows), 32):
+            engine.set_batch("t", rows[i:i + 32])  # one flush per batch
+        assert engine.compaction_debt_bytes() > (1 << 20)
+        for _ in range(8):               # EWMA convergence over enter=0.85
+            node.overload.sample_once()
+        assert node.overload.busy()
+        status = node.system_status()
+        assert status["health"]["state"] == "busy"
+        # reads keep serving while writes are being shed
+        assert engine.get("t", b"bp0000-00") == b"x" * 2048
+        assert engine.get("t", b"bp0023-31") == b"x" * 2048
+        assert REGISTRY.snapshot()["gauges"][
+            "bcos_storage_compaction_debt_bytes"] > 0
+
+        engine._compactor.resume()       # catch-up drains the backlog
+        deadline = time.monotonic() + 60
+        while engine.compaction_debt_bytes() > 0:
+            assert time.monotonic() < deadline, "debt never drained"
+            time.sleep(0.05)
+        for _ in range(16):              # EWMA decay below exit=0.5
+            node.overload.sample_once()
+        assert not node.overload.busy()
+        assert node.system_status()["health"]["state"] == "ok"
+        assert engine.get("t", b"bp0000-00") == b"x" * 2048
+    finally:
+        node.stop()
+        node.storage.close()

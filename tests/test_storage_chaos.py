@@ -29,12 +29,18 @@ DISK_OVERRIDES = {"storage_backend": "disk", "storage_memtable_mb": 0,
 
 
 def _read_balance_rows(node_dir: str) -> dict:
-    """Open a STOPPED node's engine offline and dump c_balance raw."""
+    """Open a STOPPED node's engine offline and dump c_balance raw. With
+    key_page_size on by default for the disk backend, the raw rows are
+    pages — read through the page layer when the meta row is present so
+    the cross-node comparison stays at the logical row level."""
     from fisco_bcos_tpu.storage.engine import DiskStorage
+    from fisco_bcos_tpu.storage.keypage import META_KEY, KeyPageStorage
 
     st = DiskStorage(os.path.join(node_dir, "data"), auto_compact=False)
     try:
-        return {k: st.get("c_balance", k) for k in st.keys("c_balance")}
+        view = KeyPageStorage(st) \
+            if st.get("c_balance", META_KEY) is not None else st
+        return {k: view.get("c_balance", k) for k in view.keys("c_balance")}
     finally:
         st.close()
 

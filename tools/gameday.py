@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""game-day — run a fault schedule against a real local cluster.
+
+    tools/gameday.py --schedule ci-smoke  -o /tmp/gd
+    tools/gameday.py --schedule soak
+    tools/gameday.py --schedule my-day.json --report report.json
+
+Builds a fresh multi-node chain under `-o`, drives production-shaped
+scenario load (open-loop Poisson at a calibrated fraction of capacity)
+while the schedule fires faults — kill -9, asymmetric partitions,
+Byzantine peers, armed failpoints, aggressor clients — and asserts the
+operator-facing invariants after every phase (clean getAuditReport,
+converged heads, healthz ok within the recovery SLO, bounded write p99)
+plus end-of-day byte-identical c_balance across every node's storage.
+
+Emits bench rows (gameday_phase / gameday_post_soak_tps /
+gameday_write_p99_ms) as JSON lines on stdout for benchmark/bench.py
+pickup; exits nonzero naming the failed phase AND invariant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fisco_bcos_tpu.testing.gameday import (  # noqa: E402
+    BUILTIN_SCHEDULES, GameDay, GameDayFailure)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="fault-schedule orchestrator over a real cluster")
+    ap.add_argument("--schedule", required=True,
+                    help="builtin name (%s) or a JSON schedule file"
+                         % ", ".join(sorted(BUILTIN_SCHEDULES)))
+    ap.add_argument("-o", "--out-dir", default="",
+                    help="cluster directory (default: a temp dir, "
+                         "removed on success, kept on failure)")
+    ap.add_argument("--report", default="",
+                    help="write the full day report JSON here")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the cluster directory even on success")
+    args = ap.parse_args()
+
+    if args.schedule in BUILTIN_SCHEDULES:
+        schedule = BUILTIN_SCHEDULES[args.schedule]
+    else:
+        with open(args.schedule) as f:
+            schedule = json.load(f)
+
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="gameday-")
+    day = GameDay(schedule, out_dir,
+                  emit=lambda row: print(json.dumps(row), flush=True),
+                  log=lambda msg: print(f"# {msg}", file=sys.stderr,
+                                        flush=True))
+    try:
+        report = day.run()
+    except GameDayFailure as exc:
+        print(f"GAME DAY FAILED — phase {exc.phase!r}, invariant "
+              f"{exc.invariant!r}: {exc.detail}", file=sys.stderr)
+        print(f"cluster kept for inspection: {out_dir}", file=sys.stderr)
+        return 1
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1)
+    print(f"# game day ok: {json.dumps(report)[:400]}", file=sys.stderr)
+    if not args.keep and not args.out_dir:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,14 @@
 #                                   # 4 OS-process TLS chain, kill -9 a node
 #                                   # mid-stream, assert it rejoins to the
 #                                   # same state root (tests/test_chaos_e2e)
+#   tools/sanitize_ci.sh --gameday  # ONLY the game-day orchestration gate:
+#                                   # the ci-smoke fault schedule
+#                                   # (tools/gameday.py) against a real
+#                                   # 4-node cluster under scenario load —
+#                                   # clean audit + converged heads +
+#                                   # health SLO + bounded write p99 +
+#                                   # byte-identical c_balance, with the
+#                                   # gameday_* rows under the perf gate
 #   tools/sanitize_ci.sh --faults   # ONLY the failpoint/health smoke: boot
 #                                   # a 4-node chain, arm one storage and
 #                                   # one consensus failpoint at runtime
@@ -821,6 +829,28 @@ EOF
     python benchmark/chain_bench.py --storage-compare -n 400 \
     --tx-count-limit 100 --storage-memtable-mb 1 2>/dev/null \
     | grep '"metric": "storage_compare"'
+  echo "== [storage] wide-table scenario: key pages default-on," \
+       "read-amp counters live"
+  WT_ROW="$(JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python benchmark/chain_bench.py --scenario wide-table -n 400 \
+    --scenario-accounts 2000 --scenario-window 4 \
+    --tx-count-limit 100 2>/dev/null \
+    | grep '"metric": "scenario_wide_table"')"
+  WT_ROW="$WT_ROW" python - <<'EOF'
+import json, os
+row = json.loads(os.environ["WT_ROW"])
+st = row["storage"]
+assert st["key_page_size"] and st["key_page_size"] > 0, \
+    f"key pages not on by default for disk: {st}"
+assert st["backend_reads"] and st["backend_reads"] > 0, \
+    f"read-amp counter backend_reads dead: {st}"
+assert st["cache_hits"] and st["cache_hits"] > 0, \
+    f"read-amp counter cache_hits dead: {st}"
+print("sanitize_ci: STORAGE STAGE read-amp live "
+      f"(key_page={st['key_page_size']}B, "
+      f"backend_reads={st['backend_reads']}, "
+      f"cache_hits={st['cache_hits']})")
+EOF
   exit 0
 fi
 
@@ -1231,6 +1261,24 @@ EOF
     python benchmark/chain_bench.py --proof-bench --proof-txs 60 \
     --backend host 2>/dev/null | grep -E \
     '"metric": "(poseidon_hashes|proofs_(rendered|served|verified))_per_sec"'
+  exit 0
+fi
+
+if [ "${1:-}" = "--gameday" ]; then
+  echo "== [gameday] ci-smoke fault schedule on a real 4-node cluster:" \
+       "kill -9 + asymmetric partition/heal + armed WAL-crash failpoint" \
+       "under scenario load; clean audit, converged heads, health SLO," \
+       "bounded write p99, byte-identical c_balance"
+  GD_OUT="$(mktemp -d)"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 15 1200 \
+    python tools/gameday.py --schedule ci-smoke \
+    -o "$GD_OUT/cluster" --report "$GD_OUT/report.json" \
+    | tee "$GD_OUT/rows.jsonl"
+  grep -q '"metric": "gameday_post_soak_tps"' "$GD_OUT/rows.jsonl"
+  echo "== [gameday] perf gate, report-only, gameday_* rows vs trajectory"
+  python tools/perf_gate.py --candidate "$GD_OUT/rows.jsonl" --report-only
+  rm -rf "$GD_OUT"
+  echo "sanitize_ci: GAMEDAY STAGE CLEAN"
   exit 0
 fi
 

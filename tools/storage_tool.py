@@ -7,13 +7,24 @@ WAL-backed or the disk engine (auto-detected by its CURRENT manifest
 pointer; `stats` then also reports segments/memtable/bloom counters).
 
 Commands:
-  stats  <path>                      table/row/byte counts
+  stats  <path>                      table/row/byte counts; for the disk
+                                     engine also per-level segment/byte/
+                                     debt stats (leveled compaction)
   tables <path>                      list tables
   scan   <path> <table> [prefix-hex] list keys (values with --values)
   get    <path> <table> <key-hex>    print one value (hex)
   set    <path> <table> <key-hex> <value-hex>   write one value (repair)
   remove <path> <table> <key-hex>    delete one key
-  compact <path>                     rewrite snapshot, truncate the WAL
+  compact <path>                     offline catch-up: drain ALL
+                                     compaction debt (leveled engine —
+                                     e.g. after a long outage left the
+                                     node behind), or rewrite snapshot +
+                                     truncate WAL (wal backend)
+
+Disk-engine directories written with `key_page_size` (the default) are
+detected by their `_kp_/meta` rows and read through the page layer, so
+scan/get/set/remove operate on LOGICAL rows; stats reports both the page
+layer and the underlying engine (levels, debt, bloom counters).
 """
 
 from __future__ import annotations
@@ -52,8 +63,14 @@ def _open(path: str):
             or (n.startswith("seg-") and n.endswith(".sst"))
             for n in names):
         from fisco_bcos_tpu.storage.engine import DiskStorage
+        from fisco_bcos_tpu.storage.keypage import META_KEY, KeyPageStorage
 
-        return DiskStorage(path, auto_compact=False)
+        st = DiskStorage(path, auto_compact=False)
+        # page-packed layout (key_page_size, on by default for disk):
+        # wrap so the operator addresses logical rows, not raw pages
+        if any(st.get(t, META_KEY) is not None for t in st.tables()):
+            return KeyPageStorage(st)
+        return st
     return WalStorage(path)
 
 
@@ -116,7 +133,12 @@ def main() -> None:
         elif args.cmd == "compact":
             if not hasattr(st, "compact"):
                 raise SystemExit("compact: local WAL storage only")
+            debt_fn = getattr(st, "compaction_debt_bytes", None)
+            before = debt_fn() if debt_fn is not None else None
             st.compact()
+            if debt_fn is not None:
+                print(json.dumps({"debt_bytes_before": before,
+                                  "debt_bytes_after": debt_fn()}))
             print("ok")
     finally:
         st.close()
