@@ -706,6 +706,55 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: columnar transaction substrate (protocol/
+            # columnar.py + txpool.submit_columns) — object-path vs
+            # columnar wire ingest, interleaved fresh-chain runs, the
+            # adjacent-pair-ratio headline. BENCH_COLUMNAR_TIMEOUT=0
+            # skips it.
+            rows, rc = _chain_bench_rows(
+                ["--columnar-compare", "-n", "1000", "--columnar-runs",
+                 "3", "--backend", "host"],
+                "BENCH_COLUMNAR_TIMEOUT", 600)
+            col = next((r for r in rows
+                        if r.get("metric") == "columnar_tps"), None)
+            if col and not col.get("timed_out"):
+                line["columnar_tps"] = col.get("value")
+                line["columnar_vs_object"] = col.get("columnar_vs_object")
+            else:
+                print(f"[bench] columnar A/B incomplete (rc={rc})",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] columnar A/B failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
+            # supplementary: out-of-process execution workers (scheduler/
+            # workers.py) — the 4-node chain with [scheduler] workers=1;
+            # pool occupancy over the timed window plus the fallback
+            # count (0 = the seam never had to bail to in-process).
+            # BENCH_WORKERS_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--workers", "1", "-n", "1000", "--backend", "host"],
+                "BENCH_WORKERS_TIMEOUT", 300)
+            occ = next((r for r in rows
+                        if r.get("metric") == "exec_worker_occupancy"),
+                       None)
+            if occ:
+                line["exec_worker_occupancy"] = occ.get("value")
+                line["exec_worker_pool_blocks"] = occ.get("pool_blocks")
+                line["exec_worker_fallbacks"] = occ.get("exec_fallbacks")
+            else:
+                print(f"[bench] workers bench produced no occupancy row "
+                      f"(rc={rc})", file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] workers bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: persistent storage engine A/B (storage/
             # engine.py) — sustained-write TPS, cold-restart seconds, and
             # peak RSS for memory vs WAL vs disk backends, each in a fresh

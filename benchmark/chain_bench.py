@@ -199,11 +199,13 @@ def _build_chain(sm: bool, backend: str, tx_count_limit: int,
 
 def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
               transport: str = "fake", tls: bool = False,
-              pipeline: bool = True, profile: bool = False) -> dict:
+              pipeline: bool = True, profile: bool = False,
+              workers: int = 0) -> dict:
     from fisco_bcos_tpu.protocol import Transaction
 
-    nodes, gateways, tls = _build_chain(sm, backend, tx_count_limit,
-                                        transport, tls, pipeline=pipeline)
+    nodes, gateways, tls = _build_chain(
+        sm, backend, tx_count_limit, transport, tls, pipeline=pipeline,
+        cfg_overrides={"scheduler_workers": workers} if workers else None)
     gateway = gateways[0]
 
     # instrument proposal verification latency on every node
@@ -272,6 +274,10 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
         # consensus_wait/commit seconds) — collected before stop so the
         # numbers cover exactly the timed window's blocks
         pstats = nodes[0].scheduler.pipeline_stats() if profile else None
+        # out-of-process execution pools: per-node stats collected before
+        # stop so occupancy covers exactly the timed window
+        wstats = ([nd.exec_pool.stats() for nd in nodes]
+                  if workers and nodes[0].exec_pool is not None else None)
     finally:
         for node in nodes:
             node.stop()
@@ -303,6 +309,8 @@ def run_chain(sm: bool, n: int, backend: str, tx_count_limit: int,
     }
     if pstats is not None:
         row["pipeline_stats"] = pstats
+    if wstats is not None:
+        row["exec_worker_stats"] = wstats
     return row
 
 
@@ -1606,6 +1614,102 @@ def run_lockcheck_ab(sm: bool, n: int, backend: str, tx_count_limit: int,
     }
 
 
+def run_columnar_compare(sm: bool, n: int, backend: str,
+                         tx_count_limit: int, reps: int = 3) -> dict:
+    """Object-path vs columnar wire ingest, interleaved in ONE session.
+
+    Both arms start from the same pre-signed wire frames and drive a
+    fresh solo chain through the txpool's batch door; the ONLY variable
+    is the substrate the door runs on:
+
+      object:   `Transaction.decode` each frame, `submit_batch` — the
+                per-tx marshalling the PR-16 attribution blamed for the
+                ~0.19 ms-GIL-per-tx ceiling (per-field bytes copies,
+                per-tx hash/encode, list-of-int limb packing);
+      columnar: `decode_columns` + `submit_columns` — one arena, offset
+                arrays, ONE `hash_batch`/`recover_addresses` over arena
+                slices, `TxView`s only for rows that admit.
+
+    Decode cost sits INSIDE the timed window for both arms — wire bytes
+    in, committed txs out is the contract being compared. Run-to-run
+    drift on the 2-core CI host dwarfs the effect, so the honest
+    statistic is the median of adjacent-pair ratios (same discipline as
+    profiler_overhead_ab), alternating which arm goes first."""
+    import gc
+
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.protocol.columnar import decode_columns
+
+    blocks_needed = -(-n // max(1, tx_count_limit))
+    block_limit = min(600, max(100, 2 * blocks_needed + 20))
+    print(f"signing {n} txs (excluded from every timed window)...",
+          file=sys.stderr, flush=True)
+    wire_txs = _build_workload(sm, n, block_limit=block_limit,
+                               prefix="cc")
+
+    def solo_run(columnar: bool) -> tuple[float, int]:
+        node = Node(NodeConfig(
+            consensus="solo", sm_crypto=sm, crypto_backend=backend,
+            min_seal_time=0.0, tx_count_limit=tx_count_limit,
+            trace_sample_rate=0.0, trace_slow_ms=0.0))
+        node.start()
+        try:
+            t0 = time.perf_counter()
+            for s in range(0, len(wire_txs), 512):
+                chunk = wire_txs[s:s + 512]
+                if columnar:
+                    node.txpool.submit_columns(decode_columns(chunk))
+                else:
+                    node.txpool.submit_batch(
+                        [Transaction.decode(raw) for raw in chunk])
+            deadline = time.monotonic() + max(120.0, n / 25)
+            while time.monotonic() < deadline:
+                if node.ledger.total_tx_count() >= n:
+                    break
+                time.sleep(0.02)
+            t1 = time.perf_counter()
+            committed = node.ledger.total_tx_count()
+        finally:
+            node.stop()
+        return committed / max(1e-9, t1 - t0), committed
+
+    results: dict[str, list[float]] = {"object": [], "columnar": []}
+    ratios: list[float] = []
+    committed_min = n
+    solo_run(False)  # warm-up, discarded (compile/alloc noise lands on
+    #                  neither side)
+    for rep in range(reps):
+        order = ("object", "columnar") if rep % 2 == 0 \
+            else ("columnar", "object")
+        pair = {}
+        for mode in order:
+            gc.collect()
+            tps, committed = solo_run(mode == "columnar")
+            results[mode].append(tps)
+            pair[mode] = tps
+            committed_min = min(committed_min, committed)
+        ratios.append(pair["columnar"] / max(pair["object"], 0.001))
+
+    obj = statistics.median(results["object"])
+    col = statistics.median(results["columnar"])
+    return {
+        "metric": "columnar_tps", "unit": "tx/sec",
+        "suite": "sm" if sm else "ecdsa",
+        "value": round(col, 1),
+        "tps_columnar_median": round(col, 1),
+        "tps_object_median": round(obj, 1),
+        # headline ratio: median of adjacent-pair ratios, NOT the ratio
+        # of cross-run medians (drift-honest, same as the profiler A/B)
+        "columnar_vs_object": round(statistics.median(ratios), 3),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "tps_columnar_runs": [round(v, 1) for v in results["columnar"]],
+        "tps_object_runs": [round(v, 1) for v in results["object"]],
+        "n": n, "runs": reps,
+        "timed_out": committed_min < n,
+    }
+
+
 def run_profile_attrib(sm: bool, backend: str, n: int = 1500,
                        tx_count_limit: int = 1000, reps: int = 2) -> list:
     """GIL-holder attribution + profiler self-cost on the direct solo
@@ -1614,11 +1718,16 @@ def run_profile_attrib(sm: bool, backend: str, n: int = 1500,
 
     Two measurements, one invocation:
 
-      1. attribution run: solo chain, profiler armed at a high-resolution
-         hz, `n` txs submitted direct (txpool.submit_batch). Process CPU
-         is measured independently via getrusage; the profiler must
-         attribute >= 80% of it to named functions/stages or the summary
-         row says so. Emits the top-GIL-holders table per stage.
+      1. attribution A/B, same session: solo chain, profiler armed at a
+         high-resolution hz, `n` txs submitted direct — ONCE through the
+         object door (Transaction.decode + submit_batch) and once
+         through the columnar door (decode_columns + submit_columns).
+         Process CPU is measured independently via getrusage; the
+         profiler must attribute >= 80% of it to named functions/stages
+         or the summary row says so. Emits the top-GIL-holders table per
+         stage for both paths and the recover_share_ab row — the
+         "recover call-site share collapses under the columnar
+         substrate" acceptance number.
       2. interleaved A/B: the ALWAYS-ON default hz vs disarmed (no
          sampler thread), `reps` runs each, fresh chain per run, medians
          — the < 3% self-overhead acceptance row.
@@ -1628,6 +1737,7 @@ def run_profile_attrib(sm: bool, backend: str, n: int = 1500,
     from fisco_bcos_tpu.analysis import profiler as prof
     from fisco_bcos_tpu.init.node import Node, NodeConfig
     from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.protocol.columnar import decode_columns
 
     blocks_needed = -(-n // max(1, tx_count_limit))
     block_limit = min(600, max(100, 2 * blocks_needed + 20))
@@ -1665,62 +1775,120 @@ def run_profile_attrib(sm: bool, backend: str, n: int = 1500,
     rows = []
     suite_name = "sm" if sm else "ecdsa"
 
-    # -- 1) attribution run (high-res sampling + independent CPU meter) ----
-    node = Node(NodeConfig(
-        consensus="solo", sm_crypto=sm, crypto_backend=backend,
-        min_seal_time=0.0, tx_count_limit=tx_count_limit,
-        trace_sample_rate=0.0, trace_slow_ms=0.0,
-        profile_hz=53.0, profile_ring=4096, profile_burst_hz=0.0))
-    txs = [Transaction.decode(raw) for raw in wire_txs]
-    node.start()
-    try:
-        prof.PROFILER.reset()
-        ru0 = resource.getrusage(resource.RUSAGE_SELF)
-        t0 = time.perf_counter()
-        for s in range(0, len(txs), 512):
-            node.txpool.submit_batch(txs[s:s + 512])
-        deadline = time.monotonic() + max(120.0, n / 25)
-        while time.monotonic() < deadline:
-            if node.ledger.total_tx_count() >= n:
-                break
-            time.sleep(0.02)
-        t1 = time.perf_counter()
-        ru1 = resource.getrusage(resource.RUSAGE_SELF)
-        committed = node.ledger.total_tx_count()
-        attrib = prof.PROFILER.attribution()
-    finally:
-        node.stop()
-    # measured GIL-held CPU: whole-process rusage over the window, minus
-    # the sampler's own measured burn (it is overhead, not workload)
-    cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
-    workload_cpu = max(1e-9, cpu_s - attrib["profiler_cpu_seconds"])
-    attributed = attrib["attributed_cpu_seconds"]
-    for r in attrib["rows"][:12]:
+    # -- 1) attribution A/B (high-res sampling + independent CPU meter),
+    #       object door then columnar door, same session -----------------
+    def attrib_run(columnar: bool) -> dict:
+        node = Node(NodeConfig(
+            consensus="solo", sm_crypto=sm, crypto_backend=backend,
+            min_seal_time=0.0, tx_count_limit=tx_count_limit,
+            trace_sample_rate=0.0, trace_slow_ms=0.0,
+            profile_hz=53.0, profile_ring=4096, profile_burst_hz=0.0))
+        node.start()
+        try:
+            prof.PROFILER.reset()
+            ru0 = resource.getrusage(resource.RUSAGE_SELF)
+            t0 = time.perf_counter()
+            for s in range(0, len(wire_txs), 512):
+                chunk = wire_txs[s:s + 512]
+                if columnar:
+                    node.txpool.submit_columns(decode_columns(chunk))
+                else:
+                    node.txpool.submit_batch(
+                        [Transaction.decode(raw) for raw in chunk])
+            deadline = time.monotonic() + max(120.0, n / 25)
+            while time.monotonic() < deadline:
+                if node.ledger.total_tx_count() >= n:
+                    break
+                time.sleep(0.02)
+            t1 = time.perf_counter()
+            ru1 = resource.getrusage(resource.RUSAGE_SELF)
+            committed = node.ledger.total_tx_count()
+            attrib = prof.PROFILER.attribution()
+        finally:
+            node.stop()
+        # measured GIL-held CPU: whole-process rusage over the window,
+        # minus the sampler's own measured burn (overhead, not workload)
+        cpu_s = (ru1.ru_utime - ru0.ru_utime) + \
+            (ru1.ru_stime - ru0.ru_stime)
+        workload_cpu = max(1e-9, cpu_s - attrib["profiler_cpu_seconds"])
+        return {
+            "attrib": attrib, "committed": committed,
+            "tps": committed / max(1e-9, t1 - t0),
+            "workload_cpu": workload_cpu,
+            # the recover call-site share: every attributed leaf that is
+            # a recover entry point (nativeec/suite, ecdsa or sm2) — the
+            # per-tx marshalling PR 16 measured at ~58% on the object
+            # path, which the columnar door exists to collapse
+            "recover": sum(r["cpu_seconds"] for r in attrib["rows"]
+                           if "recover" in r["func"]),
+            # the event-driven-sealer acceptance number: attributed CPU
+            # with the sealer thread sitting in threading-wait — PR 16's
+            # table put 15.4% of the GIL budget here with the 0.02 s
+            # idle poll; wakeup-driven sealing collapses this row
+            "seal_wait": sum(r["cpu_seconds"] for r in attrib["rows"]
+                             if r["role"] == "seal"
+                             and r["func"] == "threading.py:wait"),
+        }
+
+    runs = {"object": attrib_run(False), "columnar": attrib_run(True)}
+    for path, a in runs.items():
+        committed, workload_cpu = a["committed"], a["workload_cpu"]
+        attrib = a["attrib"]
+        attributed = attrib["attributed_cpu_seconds"]
+        for r in attrib["rows"][:12]:
+            rows.append({
+                "metric": "profile_attrib", "unit": "ms/tx",
+                "suite": suite_name, "path": path,
+                "role": r["role"], "stage": r["stage"], "func": r["func"],
+                "cpu_ms_per_tx": round(1000.0 * r["cpu_seconds"]
+                                       / max(1, committed), 4),
+                "cpu_share_pct": round(100.0 * r["cpu_seconds"]
+                                       / workload_cpu, 1),
+            })
         rows.append({
-            "metric": "profile_attrib", "unit": "ms/tx",
-            "suite": suite_name,
-            "role": r["role"], "stage": r["stage"], "func": r["func"],
-            "cpu_ms_per_tx": round(1000.0 * r["cpu_seconds"]
+            "metric": "profile_attrib_summary", "unit": "ms/tx",
+            "suite": suite_name, "path": path, "txs": int(committed),
+            "tps": round(a["tps"], 1),
+            "gil_ms_per_tx": round(1000.0 * workload_cpu
                                    / max(1, committed), 4),
-            "cpu_share_pct": round(100.0 * r["cpu_seconds"]
-                                   / workload_cpu, 1),
+            "attributed_ms_per_tx": round(1000.0 * attributed
+                                          / max(1, committed), 4),
+            # the >= 80% acceptance number: named-function coverage of
+            # the measured per-tx CPU (independent meters — rusage vs
+            # /proc scan)
+            "attributed_pct": round(100.0 * attributed / workload_cpu, 1),
+            "seal_wait_share_pct": round(100.0 * a["seal_wait"]
+                                         / workload_cpu, 1),
+            "profiler_cpu_seconds": attrib["profiler_cpu_seconds"],
+            "samples": attrib["samples"],
+            "by_stage_ms_per_tx": {
+                k: round(1000.0 * v / max(1, committed), 4)
+                for k, v in list(attrib["by_stage"].items())[:8]},
         })
+    obj, col = runs["object"], runs["columnar"]
     rows.append({
-        "metric": "profile_attrib_summary", "unit": "ms/tx",
-        "suite": suite_name, "txs": int(committed),
-        "tps": round(committed / max(1e-9, t1 - t0), 1),
-        "gil_ms_per_tx": round(1000.0 * workload_cpu
-                               / max(1, committed), 4),
-        "attributed_ms_per_tx": round(1000.0 * attributed
-                                      / max(1, committed), 4),
-        # the >= 80% acceptance number: named-function coverage of the
-        # measured per-tx CPU (independent meters — rusage vs /proc scan)
-        "attributed_pct": round(100.0 * attributed / workload_cpu, 1),
-        "profiler_cpu_seconds": attrib["profiler_cpu_seconds"],
-        "samples": attrib["samples"],
-        "by_stage_ms_per_tx": {
-            k: round(1000.0 * v / max(1, committed), 4)
-            for k, v in list(attrib["by_stage"].items())[:8]},
+        # the tentpole acceptance row: what happened to the per-tx GIL
+        # budget and the recover call-site share when the SAME wire
+        # frames went through the columnar door instead — one process,
+        # back-to-back, same profiler, same CPU meter
+        "metric": "recover_share_ab", "unit": "pct",
+        "suite": suite_name,
+        "object_recover_share_pct": round(
+            100.0 * obj["recover"] / obj["workload_cpu"], 1),
+        "columnar_recover_share_pct": round(
+            100.0 * col["recover"] / col["workload_cpu"], 1),
+        "object_gil_ms_per_tx": round(
+            1000.0 * obj["workload_cpu"] / max(1, obj["committed"]), 4),
+        "columnar_gil_ms_per_tx": round(
+            1000.0 * col["workload_cpu"] / max(1, col["committed"]), 4),
+        # 1 / (GIL ms per tx): the solo per-process ceiling each
+        # substrate implies, independent of this run's wall-clock noise
+        "object_implied_ceiling_tps": round(
+            obj["committed"] / max(1e-9, obj["workload_cpu"]), 0),
+        "columnar_implied_ceiling_tps": round(
+            col["committed"] / max(1e-9, col["workload_cpu"]), 0),
+        "object_tps": round(obj["tps"], 1),
+        "columnar_tps_run": round(col["tps"], 1),
     })
 
     # -- 2) interleaved A/B: always-on default hz vs no sampler thread -----
@@ -2650,6 +2818,22 @@ def main() -> None:
                          "disarmed-overhead acceptance row)")
     ap.add_argument("--lockcheck-runs", type=int, default=3, metavar="R",
                     help="with --lockcheck-ab: interleaved reps per side")
+    ap.add_argument("--columnar-compare", action="store_true",
+                    help="columnar-substrate A/B: object-path "
+                         "(Transaction.decode + submit_batch) vs columnar "
+                         "wire ingest (decode_columns + submit_columns) "
+                         "on a fresh solo chain per run, INTERLEAVED; "
+                         "emits the columnar_tps row with both medians "
+                         "and the adjacent-pair ratio")
+    ap.add_argument("--columnar-runs", type=int, default=3, metavar="R",
+                    help="with --columnar-compare: interleaved reps per "
+                         "side (default 3; the CI host is noisy)")
+    ap.add_argument("--workers", type=int, default=0, metavar="W",
+                    help="out-of-process execution workers per node "
+                         "([scheduler] workers): the 4-node run executes "
+                         "blocks in W subprocesses behind the scheduler "
+                         "seam and emits an exec_worker_occupancy row "
+                         "from the pools' timed-window stats")
     ap.add_argument("--pipeline-profile", action="store_true",
                     help="direct mode: also emit pipeline_tps and a per-"
                          "stage (fill/execute/roots/consensus_wait/commit) "
@@ -2709,6 +2893,12 @@ def main() -> None:
                 sm, args.n, args.backend, args.tx_count_limit,
                 args.lockcheck_runs)), flush=True)
         return
+    if args.columnar_compare:
+        for sm in suites:
+            print(_dumps(run_columnar_compare(
+                sm, args.n, args.backend, args.tx_count_limit,
+                args.columnar_runs)), flush=True)
+        return
     if args.groups > 0:
         for sm in suites:
             _emit_groups_mode(args, sm)
@@ -2725,14 +2915,38 @@ def main() -> None:
         res = run_chain(sm, args.n, args.backend, args.tx_count_limit,
                         transport=args.transport, tls=args.tls,
                         pipeline=not args.no_pipeline,
-                        profile=args.pipeline_profile)
+                        profile=args.pipeline_profile,
+                        workers=args.workers)
         suffix = ""
         if args.transport == "p2p":
             suffix = "_tls" if res["tls"] else "_tcp"
         pstats = res.pop("pipeline_stats", None)
+        wstats = res.pop("exec_worker_stats", None)
         res.update({"metric": f"chain_tps_4node_{res['suite']}" + suffix,
                     "value": res["tps"], "unit": "tx/sec"})
         print(_dumps(res), flush=True)
+        if wstats is not None:
+            # pool engagement over the timed window, whole chain: blocks
+            # the subprocesses executed, fallbacks taken, and per-worker
+            # busy-fraction (value = mean occupancy across every worker
+            # on every node — the "did the pool actually absorb
+            # execution" number the perf gate tracks)
+            occ = [w["occupancy"] for st in wstats
+                   for w in st["per_worker"]]
+            print(_dumps({
+                "metric": "exec_worker_occupancy", "unit": "occupancy",
+                "suite": res["suite"], "workers": args.workers,
+                "value": round(statistics.mean(occ), 3) if occ else 0.0,
+                "pool_blocks": sum(w["blocks"] for st in wstats
+                                   for w in st["per_worker"]),
+                "exec_fallbacks": sum(st["fallbacks"] for st in wstats),
+                "per_node": [{
+                    "fallbacks": st["fallbacks"],
+                    "occupancy": [round(w["occupancy"], 3)
+                                  for w in st["per_worker"]],
+                    "blocks": [w["blocks"] for w in st["per_worker"]],
+                } for st in wstats],
+            }), flush=True)
         if args.pipeline_profile:
             print(_dumps({
                 "metric": "pipeline_tps", "value": res["tps"],

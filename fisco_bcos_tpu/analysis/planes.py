@@ -146,3 +146,14 @@ HOT_ALLOC_SCOPE: tuple[str, ...] = (
     "fisco_bcos_tpu/protocol/",
     "fisco_bcos_tpu/sealer/",
 )
+
+# Columnar substrate entry points (ROADMAP-1, landed): these ARE the hot
+# path now — wire frames enter as batches here from the ingest lane, the
+# gossip receiver and the RPC edge, so the per-item-allocation guard rail
+# must cover everything they reach even when a caller sits outside the
+# thread-root planes above (e.g. submit_columns called straight off the
+# p2p reader). Keyed by bcosflow qualname, value = plane label.
+HOT_PATH_EXTRA_ROOTS: dict[str, str] = {
+    "protocol.columnar.decode_columns": "ingest",
+    "txpool.txpool.TxPool.submit_columns": "ingest",
+}

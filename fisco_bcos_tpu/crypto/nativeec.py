@@ -182,6 +182,36 @@ def sm2_sign(secret: int, digest: bytes) -> Optional[tuple]:
             int.from_bytes(bytes(s), "big"))
 
 
+def ecdsa_recover_batch_rows(e_rows: bytes, r_rows: bytes, s_rows: bytes,
+                             vs: bytes) -> Optional[tuple]:
+    """Pre-packed row buffers -> ([pub64 | None], [bool]); None when the
+    library is unavailable.
+
+    The zero-marshalling recover door: digests and signature halves
+    arrive as the exact count x 32 big-endian rows the C side reads —
+    wire signature bytes and 32-byte tx hashes ARE this shape already
+    (the columnar arena hands out slices of it), so no per-row
+    int.from_bytes/to_bytes round trip happens on either side of the
+    FFI. Digests must be exactly 32 bytes: callers holding longer
+    digests take `ecdsa_recover_batch`, whose `_e_rows` pre-reduces
+    them mod the group order (a 32-byte value is always below 2^256,
+    so for this door the reduction is the identity)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    n = len(vs)
+    if (len(e_rows) != 32 * n or len(r_rows) != 32 * n
+            or len(s_rows) != 32 * n):
+        raise ValueError("row buffer length mismatch")
+    ok = (ctypes.c_uint8 * n)()
+    pubs = (ctypes.c_uint8 * (64 * n))()
+    lib.ncrypto_ecdsa_recover_batch(
+        _CURVE_SECP, n, e_rows, r_rows, s_rows, vs, pubs, ok)
+    raw = bytes(pubs)
+    out = [raw[64 * i:64 * i + 64] if ok[i] else None for i in range(n)]
+    return out, [bool(v) for v in ok]
+
+
 def ecdsa_recover_batch(es, rs, ss, vs) -> Optional[tuple]:
     """ints + v bytes -> ([pub64 | None], [bool]); None when unavailable."""
     from . import refimpl

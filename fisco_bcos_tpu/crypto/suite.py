@@ -44,6 +44,10 @@ BUCKETS = (8, 64, 512, 4096, 16384, 65536)
 # padding waste for sizes between buckets
 CHUNK = 16384
 
+# substitute row for malformed (short) signatures on the rows fast path:
+# r=s=0 is rejected by every verify/recover backend, same as _split_sigs
+_ZERO32 = b"\x00" * 32
+
 
 def _bucket(n: int) -> int:
     for b in BUCKETS:
@@ -333,6 +337,28 @@ class CryptoSuite:
             pubs = [g[64:128] if len(g) >= 128 else b"\x00" * 64 for g in sigs]
             ok = self.verify_batch(digests, sigs, pubs)
             return [p if o else None for p, o in zip(pubs, ok)], ok
+        if not self._use_device(n):
+            from . import nativeec
+
+            if (nativeec.available()
+                    and all(len(d) == 32 for d in digests)):
+                # rows fast path: wire signature bytes and 32-byte tx
+                # hashes ARE the count x 32 BE rows the C side reads, so
+                # the r16 call-site residue (per-sig int round trips on
+                # both sides of the FFI) disappears — slices of the
+                # columnar arena feed the join directly. Malformed rows
+                # degrade to r=s=0 / v=255, rejected by the C side the
+                # same way _split_sigs' zeros are.
+                ssz = self.signature_size
+                native = nativeec.ecdsa_recover_batch_rows(
+                    b"".join(digests),
+                    b"".join(g[:32] if len(g) >= ssz else _ZERO32
+                             for g in sigs),
+                    b"".join(g[32:64] if len(g) >= ssz else _ZERO32
+                             for g in sigs),
+                    bytes(g[64] if len(g) >= 65 else 255 for g in sigs))
+                if native is not None:
+                    return native[0], np.array(native[1])
         rs, ss = self._split_sigs(sigs)
         vs = [g[64] if len(g) >= 65 else 255 for g in sigs]
         es = [int.from_bytes(d, "big") for d in digests]

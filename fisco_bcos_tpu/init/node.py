@@ -113,6 +113,13 @@ class NodeConfig:
     # state view; state_root stays per-changeset). False restores the
     # serial execute-then-commit path (comparison benches, odd embeddings).
     pipeline_commit: bool = True
+    # out-of-process execution workers ([scheduler] workers): N spawned
+    # worker interpreters run the execute stage behind the Scheduler seam
+    # (scheduler/workers.py) so block execution stops taxing this
+    # process's GIL. 0 = in-process execute (the default). The pool is a
+    # pure offload: a dead/slow worker falls back in-process and the
+    # health plane respawns it.
+    scheduler_workers: int = 0
     consensus: str = "solo"  # solo | pbft
     crypto_backend: str = "auto"  # device | host | auto
     device_min_batch: int = 512
@@ -320,6 +327,20 @@ class Node:
                                    trace_label=self.trace_label,
                                    health=self.health,
                                    state_index=cfg.zk_proofs)
+        # out-of-process execution workers ([scheduler] workers > 0):
+        # the execute stage runs in spawned worker interpreters with
+        # their own GILs; roots/prewrite/2PC stay here (see
+        # scheduler/workers.py). Started lazily in start() — spawning
+        # processes from a ctor complicates embedders that only build
+        # nodes to inspect them.
+        self.exec_pool = None
+        if cfg.scheduler_workers > 0:
+            from ..scheduler.workers import ExecPool
+            self.exec_pool = ExecPool(sm_crypto=cfg.sm_crypto,
+                                      workers=cfg.scheduler_workers,
+                                      health=self.health,
+                                      registry=self.metrics_view)
+            self.scheduler.attach_exec_pool(self.exec_pool)
         # ZK proof plane bookkeeping (zk/proof.py): commit-time render
         # counts, proof cache hit rate, batched-verify volume — behind
         # bcos_zk_* and the getSystemStatus "zk" section
@@ -500,6 +521,8 @@ class Node:
                        "unsealed": self.txpool.pending_count()},
             "ingest": self.ingest.stats() if self.ingest else None,
             "pipeline": self.scheduler.pipeline_stats(),
+            "execWorkers": self.exec_pool.stats()
+            if self.exec_pool is not None else None,
             "storage": storage_stats() if callable(storage_stats)
             else {"backend": type(self.storage).__name__},
             "cache": self.query_cache.stats() if self.query_cache else None,
@@ -532,6 +555,8 @@ class Node:
         if self.ledger.current_number() < 0:
             self.build_genesis()
         self._started = True
+        if self.exec_pool is not None:
+            self.exec_pool.start()
         if self.config.consensus == "solo":
             self.sealer.set_should_seal(True, self.ledger.current_number() + 1)
             # commits landing OUTSIDE the proposal path (the health
@@ -641,6 +666,8 @@ class Node:
         if self.front is not None:
             self.front.stop()
         self.scheduler.shutdown()
+        if self.exec_pool is not None:
+            self.exec_pool.stop()
         self.health.stop()
         self._started = False
 

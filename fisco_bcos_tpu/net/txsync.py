@@ -182,18 +182,16 @@ class TransactionSync(Worker):
         with self._lock:
             known = self._known_by_peer.setdefault(src, set())
             known.update(h for h, _raw in pairs)
-        # decode only txs this pool does not already hold (flood gossip
-        # re-delivers most txs through every mesh edge)
+        # filter by claimed hash only — txs this pool does not already
+        # hold stay RAW WIRE BYTES all the way to columnar admission
+        # (protocol.columnar): the p2p reader never pays a per-tx
+        # Transaction decode for flood-gossip re-deliveries OR for fresh
+        # frames (the columnar substrate parses the whole packet into one
+        # arena + offset columns at dispatch)
         unknown = self.txpool.unknown_hashes([h for h, _raw in pairs])
-        txs = [Transaction.decode(raw) for h, raw in pairs if h in unknown]
-        if not txs:
+        wires = [raw for h, raw in pairs if h in unknown]
+        if not wires:
             return
-        ctx = otrace.current()  # gossip frame's envelope context
-        if ctx is not None and ctx.sampled:
-            # re-pin onto the lead tx (decode strips in-process attrs):
-            # admission + seal adoption on THIS node stay in the
-            # originating trace
-            txs[0]._otrace = ctx
         if self.ingest is not None:
             # continuous-batching lane: this packet coalesces with other
             # peers' packets and concurrent RPC submissions into one
@@ -201,7 +199,8 @@ class TransactionSync(Worker):
             # (bounded queue) and the anti-entropy sweep re-delivers;
             # blocking the p2p reader here would wedge the network plane
             # behind the verify engine.
-            self.ingest.submit_many_nowait(txs)
+            self.ingest.submit_many_wire_nowait(wires)
             return
         # one TPU batch-recover for the whole gossip packet
-        self.txpool.submit_batch(txs, broadcast=True)
+        from ..protocol.columnar import decode_columns
+        self.txpool.submit_columns(decode_columns(wires), broadcast=True)

@@ -307,12 +307,16 @@ class JsonRpcImpl:
             raise JsonRpcError(int(TransactionStatus.NODE_DEGRADED),
                                "node degraded: writes shed "
                                f"({health.state()})")
-        tx = Transaction.decode(_unhex(tx_hex))
+        raw = _unhex(tx_hex)
         ctx = otrace.current()
+        tx = None
         if ctx is not None:
-            # the span context follows the TX OBJECT from here: ingest
-            # lane entry -> pool admission -> sealer adoption -> (via the
-            # p2p envelope) every node's consensus/execute/commit spans
+            # traced request: decode eagerly — the span context follows
+            # the TX OBJECT from here (ingest lane entry -> pool admission
+            # -> sealer adoption -> every node's consensus spans via the
+            # p2p envelope). Tracing is sampled, so the object-path cost
+            # is paid on a fraction of requests.
+            tx = Transaction.decode(raw)
             tx._otrace = ctx
         from ..protocol import TransactionStatus
         # the wait budget is CLIENT-supplied: clamp it, or a crafted
@@ -324,11 +328,17 @@ class JsonRpcImpl:
             # continuous-batching lane: this request's tx coalesces with
             # every other in-flight sendTransaction (and gossip arrivals)
             # into ONE batch recover; the future resolves with this tx's
-            # own admission result
+            # own admission result. Untraced requests ride the COLUMNAR
+            # door: the raw frame is never decoded into a Transaction on
+            # this thread — the dispatcher folds the cohort's frames into
+            # one arena-backed column batch (protocol.columnar)
             from ..txpool.ingest import TxPoolIsFull
             from ..utils.task import TaskTimeout
             try:
-                res = lane.submit(tx, timeout=timeout)
+                if tx is None:
+                    res = lane.submit_wire(raw, timeout=timeout)
+                else:
+                    res = lane.submit(tx, timeout=timeout)
             except TxPoolIsFull as exc:
                 raise JsonRpcError(int(TransactionStatus.TXPOOL_FULL),
                                    str(exc))
@@ -342,9 +352,11 @@ class JsonRpcImpl:
                 # dispatch exception means this tx was NOT admitted —
                 # retrying alone on the direct path is safe and isolates
                 # this request from a bad cohort member
-                res = self.node.txpool.submit(tx)
+                res = self.node.txpool.submit(
+                    tx if tx is not None else Transaction.decode(raw))
         else:
-            res = self.node.txpool.submit(tx)
+            res = self.node.txpool.submit(
+                tx if tx is not None else Transaction.decode(raw))
         if res.status not in (TransactionStatus.OK,
                               TransactionStatus.ALREADY_IN_TXPOOL,
                               TransactionStatus.ALREADY_KNOWN):

@@ -103,6 +103,9 @@ class Scheduler:
         # (with a self-healing retry probe) instead of being swallowed
         self.health = health
         self._commit_faulted = False
+        # out-of-process execution pool (scheduler/workers.py) — None =
+        # in-process execute (the default); wired via attach_exec_pool
+        self.exec_pool = None
         # per-node label for the block-trace registry + span attribution
         self.trace_label = trace_label
         self._lock = lc.make_rlock("scheduler.state")    # bookkeeping dicts
@@ -290,8 +293,7 @@ class Scheduler:
         self._stage("fill", t_fill - t0)
 
         state = StateStorage(backend)
-        receipts = self.executor.execute_block_dag(
-            txs, state, header.number, header.timestamp)
+        receipts = self._execute_stage(txs, state, backend, header)
         trace.stage("execute")
         t_exec = time.monotonic()
         self._stage("execute", t_exec - t_fill)
@@ -353,6 +355,38 @@ class Scheduler:
                speculative=bool(spec),
                ms=int((time.monotonic() - t0) * 1000))
         return result
+
+    def _execute_stage(self, txs, state: StateStorage, backend,
+                       header: BlockHeader) -> list[Receipt]:
+        """The execute cut point. With an attached ExecPool
+        (scheduler/workers.py) the block runs OUT OF PROCESS — encoded
+        txs ship to a worker interpreter with its own GIL, receipts and
+        the changeset come back, and the changeset is replayed into this
+        block's StateStorage overlay so everything downstream (prewrite,
+        roots, 2PC staging) is byte-identical to the in-process path.
+        The pool is a pure offload: any worker trouble returns None and
+        the block executes in-process — chain liveness never depends on
+        a worker process."""
+        if self.exec_pool is not None:
+            out = self.exec_pool.execute(txs, backend, header.number,
+                                         header.timestamp, self.suite,
+                                         self.executor)
+            if out is not None:
+                receipts, changes = out
+                for (table, key), e in changes.items():
+                    if e.deleted:
+                        state.remove(table, key)
+                    else:
+                        state.set(table, key, e.value)
+                return receipts
+            metric("scheduler.exec_pool_fallback", number=header.number)
+        return self.executor.execute_block_dag(
+            txs, state, header.number, header.timestamp)
+
+    def attach_exec_pool(self, pool) -> None:
+        """Adopt an out-of-process execution pool (node init; also used
+        by benches). Call before the first execute_block."""
+        self.exec_pool = pool
 
     # -- bookkeeping helpers (all under self._lock) ------------------------
     def _forget_locked(self, result: ExecutionResult) -> None:

@@ -55,7 +55,16 @@ class Sealer(Worker):
                  trace_label: str = "",
                  gate: Callable[[], bool] | None = None,
                  current_height: Callable[[], int] | None = None):
-        super().__init__("sealer", idle_wait=0.05)
+        # EVENT-DRIVEN wait (idle_wait=None): the sealer used to poll at
+        # 50 ms and that `threading.py:wait` row was 15.4% of the node's
+        # attributed GIL budget (PR 16 `chain_bench --profile-attrib`).
+        # Every state change it reacts to already signals `wakeup()` —
+        # grants (grant/set_should_seal), tx admission/unseal/removal
+        # (TxPool._notify_ready via register_unseal_notifier) — so between
+        # events the thread now sleeps without touching the GIL, and
+        # execute_worker returns precise deadlines (fill-window expiry)
+        # when it does need a timed re-run.
+        super().__init__("sealer", idle_wait=None)
         # health-plane gate (utils/health.py sealing_allowed): a degraded
         # node stops producing proposals (they would queue behind a sick
         # pipeline or split votes) while grants stay armed, so sealing
@@ -125,28 +134,34 @@ class Sealer(Worker):
             self.wakeup()
 
     # -- worker loop --------------------------------------------------------
-    def execute_worker(self) -> None:
+    def execute_worker(self) -> Optional[float]:
+        """Returns the next wait: None = sleep until a wakeup event, a
+        float = timed re-run (fill-window expiry, health re-probe)."""
         if self.gate is not None and not self.gate():
-            return  # degraded: hold proposals until the node heals
+            # degraded: the health plane has no "healed" event hook, so
+            # this one state is still polled — but only WHILE degraded
+            return 0.05
         if self.current_height is not None:
             self.revoke(self.current_height())
         with self._lock:
             if not self._grants:
                 self._first_pending_at = None
-                return
+                return None  # grant() wakes us
             number = min(self._grants)
             view, limit = self._grants[number]
         pending = self.txpool.pending_count()
         if pending == 0:
             self._first_pending_at = None
-            return
+            return None  # _notify_ready (admission/unseal) wakes us
         now = time.monotonic()
         if self._first_pending_at is None:
             self._first_pending_at = now
         waited = now - self._first_pending_at
         if pending < limit:
             if waited < self.min_seal_time:
-                return  # wait to fill the block
+                # wait to fill the block: wake exactly when the window
+                # expires (earlier admissions re-run this and recompute)
+                return self.min_seal_time - waited
             if (pending < limit // 2
                     and self.pipeline_busy is not None
                     and waited < self.max_seal_time
@@ -156,7 +171,9 @@ class Sealer(Worker):
                 # filling. A half-full block already amortizes the
                 # per-block overhead, so it ships at min_seal_time (a
                 # burst's tail block must not idle out the window).
-                return
+                # pipeline_busy has no completion event, so poll the
+                # remaining fill window at 50 ms.
+                return min(self.max_seal_time - waited, 0.05)
         # seal against the height this proposal will OCCUPY: with
         # pipelining, `number` can run ahead of the committed height, and
         # a tx expiring between them would burn its seal slot for nothing
@@ -164,7 +181,10 @@ class Sealer(Worker):
         with _prof_stage("seal"):
             txs, hashes = self.txpool.seal(limit, for_number=number)
         if not txs:
-            return
+            # pending txs exist but none sealable right now (inflight in
+            # another proposal / expired at this height) — unseal, commit
+            # removal and fresh admission all fire _notify_ready
+            return None
         t_seal = time.monotonic()
         queue_wait = (t_seal - self._first_pending_at
                       if self._first_pending_at is not None else 0.0)
@@ -209,3 +229,6 @@ class Sealer(Worker):
                     self._grants[number] = (view, limit)
         else:
             metric("sealer.proposal", number=number, n_tx=len(txs))
+        # re-run immediately: another grant may already be armed (PBFT
+        # pipelines proposals) or the refused round was just re-opened
+        return 0.0

@@ -124,7 +124,10 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                        "waterline": str(cfg.waterline)}
     # pipelined block production (scheduler/scheduler.py): off-thread
     # ordered commit + speculative next-height execution
-    cp["scheduler"] = {"pipeline": str(cfg.pipeline_commit).lower()}
+    cp["scheduler"] = {"pipeline": str(cfg.pipeline_commit).lower(),
+                       # out-of-process execution workers (scheduler/
+                       # workers.py): 0 = in-process execution
+                       "workers": str(cfg.scheduler_workers)}
     cp["storage"] = {"backend": cfg.storage_backend,
                      "path": cfg.storage_path or "",
                      # disk engine knobs (storage/engine.py)
@@ -272,6 +275,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         waterline=cp.getint("consensus", "waterline", fallback=8),
         pipeline_commit=cp.getboolean("scheduler", "pipeline",
                                       fallback=True),
+        scheduler_workers=cp.getint("scheduler", "workers", fallback=0),
         snapshot_interval=cp.getint("snapshot", "interval", fallback=0),
         snapshot_retention=cp.getint("snapshot", "retention", fallback=2),
         snapshot_prune=cp.getboolean("snapshot", "prune", fallback=False),

@@ -68,11 +68,12 @@ class LaneStopped(RuntimeError):
 
 
 class _Entry:
-    __slots__ = ("tx", "task", "t_enq", "ctx")
+    __slots__ = ("tx", "task", "t_enq", "ctx", "wire")
 
-    def __init__(self, tx: Transaction, task: Optional[Task],
-                 ctx=None):
-        self.tx = tx
+    def __init__(self, tx: Optional[Transaction], task: Optional[Task],
+                 ctx=None, wire: Optional[bytes] = None):
+        self.tx = tx  # None: columnar entry — raw frame in `wire`, never
+        #               decoded into a Transaction (protocol.columnar)
         self.task = task  # None: fire-and-forget (gossip), nobody awaits
         self.t_enq = time.monotonic()
         # otrace span context of the submitting trace (None when the
@@ -80,6 +81,7 @@ class _Entry:
         # queue-to-admission span under it, and one batch span LINKS all
         # coalesced traces
         self.ctx = ctx
+        self.wire = wire
 
 
 class IngestLane:
@@ -182,6 +184,59 @@ class IngestLane:
                ) -> TxSubmitResult:
         """Blocking single-tx submission through the batching lane."""
         return self.submit_async(tx).result(timeout)
+
+    def submit_wire_async(self, raw: bytes) -> Task:
+        """Enqueue one RAW wire frame; -> Task[TxSubmitResult].
+
+        The columnar front door (ROADMAP item 1): the frame is never
+        decoded into a `Transaction` — the dispatcher folds all queued
+        wire entries into one `protocol.columnar.decode_columns` +
+        `TxPool.submit_columns` call, so per-tx Python marshalling
+        disappears from the hot path. Raises TxPoolIsFull at capacity."""
+        entry = _Entry(None, Task(), ctx=otrace.current(), wire=raw)
+        with self._cv:
+            if self._stop:
+                raise LaneStopped("ingest lane stopped")
+            if len(self._q) >= self.queue_cap:
+                self._rejected_total += 1
+                self._reg.inc("bcos_ingest_rejected_total")
+                raise TxPoolIsFull(
+                    f"ingest queue at capacity ({self.queue_cap})")
+            self._q.append(entry)
+            depth = len(self._q)
+            self._cv.notify_all()
+        self._reg.set_gauge("bcos_ingest_queue_depth", depth)
+        return entry.task
+
+    def submit_wire(self, raw: bytes, timeout: float = 30.0
+                    ) -> TxSubmitResult:
+        """Blocking single-frame submission through the columnar lane."""
+        return self.submit_wire_async(raw).result(timeout)
+
+    def submit_many_wire_nowait(self, wires: Sequence[bytes]) -> int:
+        """Fire-and-forget bulk enqueue of RAW wire frames (the gossip
+        decode path): same drop-don't-block contract as
+        submit_many_nowait, but frames ride to admission undecoded."""
+        if not wires:
+            return 0
+        accepted = 0
+        with self._cv:
+            if self._stop:
+                return 0
+            room = self.queue_cap - len(self._q)
+            for w in wires[:max(0, room)]:
+                self._q.append(_Entry(None, None, wire=w))
+                accepted += 1
+            depth = len(self._q)
+            dropped = len(wires) - accepted
+            self._dropped_total += dropped
+            if accepted:
+                self._cv.notify_all()
+        if dropped:
+            self._reg.inc("bcos_ingest_dropped_total", dropped)
+            metric("ingest.drop", n=dropped)
+        self._reg.set_gauge("bcos_ingest_queue_depth", depth)
+        return accepted
 
     def submit_many_nowait(self, txs: Sequence[Transaction]) -> int:
         """Fire-and-forget bulk enqueue (gossip ingestion): accepts what
@@ -295,15 +350,25 @@ class IngestLane:
 
     def _dispatch(self, batch: list[_Entry]) -> None:
         now = time.monotonic()
+        # columnar entries (raw wire frames) and object entries dispatch
+        # through their own pool doors; a mixed drain pays two recover
+        # calls, but producers are homogeneous per deployment (wire RPC +
+        # wire gossip, or legacy object submitters), so the mix is a
+        # transition artifact, not the steady state
+        wire_entries = [e for e in batch if e.tx is None]
+        obj_entries = [e for e in batch if e.tx is not None]
         # deadline shed BEFORE any admission/crypto work: entries whose
         # block_limit already passed while they sat in the queue can never
         # commit — settle them with the typed expiry status instead of
         # spending lane verify + pool slots on work that would be dropped
         # anyway (they would be rejected by the pool's precheck, but under
-        # overload even carrying them through the batch costs real time)
+        # overload even carrying them through the batch costs real time).
+        # Wire entries skip this: reading block_limit would mean decoding,
+        # and submit_columns' precheck rejects expired rows BEFORE the
+        # recover anyway (they pay one batched hash slot, nothing more).
         ledger = getattr(self.txpool, "ledger", None)  # test doubles may
         current = ledger.current_number() if ledger is not None else None
-        shed = [e for e in batch
+        shed = [e for e in obj_entries
                 if current is not None and e.tx.block_limit <= current]
         if shed:
             from ..protocol import TransactionStatus, batch_hash
@@ -313,19 +378,30 @@ class IngestLane:
                     e.task.resolve(TxSubmitResult(
                         h, TransactionStatus.BLOCK_LIMIT_CHECK_FAIL))
             self._reg.inc("bcos_ingest_deadline_shed_total", len(shed))
-            batch = [e for e in batch if e.tx.block_limit > current]
+            obj_entries = [e for e in obj_entries
+                           if e.tx.block_limit > current]
+            batch = obj_entries + wire_entries
             if not batch:
                 return
-        # one submit_batch == one device recover for the whole drained set
+        # one pool call per path == one device recover for the drained set
         from ..analysis.profiler import stage as _prof_stage
         t0 = time.perf_counter()
         with _prof_stage("ingest.admit"):
-            results = self.txpool.submit_batch([e.tx for e in batch],
-                                               broadcast=self.broadcast)
+            if obj_entries:
+                results = self.txpool.submit_batch(
+                    [e.tx for e in obj_entries], broadcast=self.broadcast)
+                for e, res in zip(obj_entries, results):
+                    if e.task is not None:
+                        e.task.resolve(res)
+            if wire_entries:
+                from ..protocol.columnar import decode_columns
+                cols = decode_columns([e.wire for e in wire_entries])
+                results = self.txpool.submit_columns(
+                    cols, broadcast=self.broadcast)
+                for e, res in zip(wire_entries, results):
+                    if e.task is not None:
+                        e.task.resolve(res)
         dt = time.perf_counter() - t0
-        for e, res in zip(batch, results):
-            if e.task is not None:
-                e.task.resolve(res)
         # latency attribution: per-batch coalesce time into the stage
         # histogram; traced submissions additionally get their own
         # enqueue-to-admitted span (one per traced entry, linked to the

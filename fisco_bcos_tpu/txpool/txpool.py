@@ -256,7 +256,8 @@ class TxPool:
                             self._high_mark, self.pool_limit):
                         victims = self._victims_locked()
                     st, _victim, vi, occupancy = self._plan_admission_locked(
-                        occupancy, tx, current, victims, vi)
+                        occupancy, self._band(tx), tx.block_limit, current,
+                        victims, vi)
                 if st is not None:
                     results[i] = TxSubmitResult(h, st)
                 else:
@@ -298,9 +299,9 @@ class TxPool:
                                 self._high_mark, self.pool_limit):
                             victims = self._victims_locked()
                         st, victim, vi, occupancy = \
-                            self._plan_admission_locked(occupancy, tx,
-                                                        current, victims,
-                                                        vi)
+                            self._plan_admission_locked(
+                                occupancy, self._band(tx), tx.block_limit,
+                                current, victims, vi)
                         if st is not None:
                             results[i] = TxSubmitResult(h, st)
                             continue
@@ -362,6 +363,129 @@ class TxPool:
                                             n=len(accepted)))
         return [r for r in results]
 
+    def submit_columns(self, cols, broadcast: bool = True
+                       ) -> list[TxSubmitResult]:
+        """Columnar admission: the wire-ingest hot path (ROADMAP item 1).
+
+        Mirrors `submit_batch`'s two phases — pre-crypto prechecks +
+        watermark planning under the lock, ONE batched recover off it,
+        insert phase re-validating against live state — but every check
+        reads straight off the column arrays (`protocol.columnar`): no
+        `Transaction` construction, no per-field bytes copies, no Reader
+        walks. Hashing is one `hash_batch` over arena slices and recovery
+        is one `recover_addresses` over the batch; the only per-row
+        Python object the path allocates is the lazy `TxView` for rows
+        that actually ADMIT (rejected rows never materialise anything).
+
+        Per-slice failure isolation: rows whose frames failed decode
+        reject as REQUEST_NOT_BELIEVABLE (tx_hash left empty — there is
+        no trustworthy identity to report), rows with bad signatures
+        reject INVALID_SIGNATURE, and neither poisons its batchmates."""
+        t0 = time.monotonic()
+        n = len(cols)
+        results: list[Optional[TxSubmitResult]] = [None] * n
+        rows: list[int] = []
+        for i in range(n):
+            if cols.decode_ok[i]:
+                rows.append(i)
+            else:
+                results[i] = TxSubmitResult(
+                    b"", TransactionStatus.REQUEST_NOT_BELIEVABLE)
+        hashes = cols.ensure_hashes(self.suite)
+        from ..utils.trace import observe_stage
+        # ledger reads OUTSIDE txpool.state (same rationale as
+        # submit_batch: GIL-held / possibly-RPC work off the hot lock)
+        current = self.ledger.current_number()
+        on_chain = {i: self.ledger.receipt(hashes[i]) is not None
+                    for i in rows}
+        need_verify: list[int] = []
+        with self._lock:
+            seen_batch: set[bytes] = set()
+            occupancy = len(self._pending)
+            victims: Optional[list] = None
+            vi = 0
+            for i in rows:
+                h = hashes[i]
+                st = self._precheck_fields(
+                    h, cols.chain_id[i], cols.group_id[i],
+                    int(cols.block_limit[i]), cols.nonce[i], current,
+                    on_chain[i])
+                if st is None and h in seen_batch:
+                    st = TransactionStatus.ALREADY_IN_TXPOOL
+                if st is None:
+                    if victims is None and occupancy >= min(
+                            self._high_mark, self.pool_limit):
+                        victims = self._victims_locked()
+                    st, _victim, vi, occupancy = self._plan_admission_locked(
+                        occupancy, self._band_attr(int(cols.attribute[i])),
+                        int(cols.block_limit[i]), current, victims, vi)
+                if st is not None:
+                    results[i] = TxSubmitResult(h, st)
+                else:
+                    seen_batch.add(h)
+                    need_verify.append(i)
+        drops: list[tuple[bytes, TransactionStatus, object]] = []
+        accepted: list = []
+        if need_verify:
+            t_rec = time.monotonic()
+            ok_mask = cols.ensure_senders(self.suite, rows=need_verify)
+            observe_stage("crypto", time.monotonic() - t_rec)
+            current = self.ledger.current_number()  # off-lock, as above
+            with self._lock:
+                occupancy = len(self._pending)
+                vi = 0  # stale-list carryover: see submit_batch
+                for i in need_verify:
+                    h = hashes[i]
+                    if not ok_mask[i]:
+                        results[i] = TxSubmitResult(
+                            h, TransactionStatus.INVALID_SIGNATURE)
+                        continue
+                    if victims is None and occupancy >= min(
+                            self._high_mark, self.pool_limit):
+                        victims = self._victims_locked()
+                    st, victim, vi, occupancy = self._plan_admission_locked(
+                        occupancy, self._band_attr(int(cols.attribute[i])),
+                        int(cols.block_limit[i]), current, victims, vi)
+                    if st is not None:
+                        results[i] = TxSubmitResult(h, st)
+                        continue
+                    if victim is not None:
+                        task = self._drop_locked(
+                            victim, TransactionStatus.TXPOOL_EVICTED)
+                        drops.append((victim,
+                                      TransactionStatus.TXPOOL_EVICTED,
+                                      task))
+                    # the FIRST (and only) per-row object on this path:
+                    # the pool's pending map holds tx-shaped things, and
+                    # everything downstream of admission (seal, execute,
+                    # prewrite, gossip re-encode) runs on the lazy view
+                    v = cols.view(i)
+                    self._pending[h] = v
+                    self._dropped.pop(h, None)
+                    if h in self._presealed:
+                        self._presealed.discard(h)
+                        self._sealed.add(h)
+                    if cols.nonce[i]:
+                        self._known_nonces.add(cols.nonce[i])
+                    accepted.append(v)
+                    results[i] = TxSubmitResult(h, TransactionStatus.OK,
+                                                cols.senders[i])
+        self._settle_dropped(drops)
+        metric("txpool.submit_columns", n=n, ok=len(accepted),
+               ms=int((time.monotonic() - t0) * 1000))
+        self._update_pending_gauge()
+        if need_verify:
+            self._notify_ready()
+        if broadcast and accepted and self._broadcast_hooks:
+            for fn in self._broadcast_hooks:
+                try:
+                    fn(accepted)
+                except Exception:  # noqa: BLE001 — same contract as
+                    # submit_batch: admitted txs must not read as rejected
+                    LOG.exception(badge("TXPOOL", "broadcast-hook-failed",
+                                        n=len(accepted)))
+        return [r for r in results]
+
     def _precheck(self, tx: Transaction, h: bytes, current: int,
                   on_chain: bool) -> Optional[TransactionStatus]:
         """Cheap host-side validation (TxValidator.cpp:33-51 semantics).
@@ -370,18 +494,28 @@ class TxPool:
         caller BEFORE acquiring txpool.state: the ledger read may be a
         storage lookup (or, split-service, an RPC) and must not run
         under the pool's hot lock."""
+        return self._precheck_fields(h, tx.chain_id, tx.group_id,
+                                     tx.block_limit, tx.nonce, current,
+                                     on_chain)
+
+    def _precheck_fields(self, h: bytes, chain_id: str, group_id: str,
+                         block_limit: int, nonce: str, current: int,
+                         on_chain: bool) -> Optional[TransactionStatus]:
+        """Scalar-argument core of `_precheck`: the columnar path calls
+        this straight off the column arrays, so a rejected row never
+        materialises a tx object at all."""
         if h in self._pending or h in self._sealed:
             return TransactionStatus.ALREADY_IN_TXPOOL
         if on_chain:
             return TransactionStatus.ALREADY_KNOWN
-        if tx.chain_id != self.chain_id:
+        if chain_id != self.chain_id:
             return TransactionStatus.INVALID_CHAINID
-        if tx.group_id != self.group_id:
+        if group_id != self.group_id:
             return TransactionStatus.INVALID_GROUPID
-        if tx.block_limit <= current or \
-                tx.block_limit > current + self.block_limit_range:
+        if block_limit <= current or \
+                block_limit > current + self.block_limit_range:
             return TransactionStatus.BLOCK_LIMIT_CHECK_FAIL
-        if tx.nonce and tx.nonce in self._known_nonces:
+        if nonce and nonce in self._known_nonces:
             return TransactionStatus.NONCE_CHECK_FAIL
         return None
 
@@ -400,9 +534,14 @@ class TxPool:
         `[txpool] priority_bands = false` (bands ignored, eviction by
         deadline/age only), because a forged band-255 flood could
         otherwise evict other clients' pending txs for free."""
+        return self._band_attr(tx.attribute)
+
+    def _band_attr(self, attribute: int) -> int:
+        """`_band` off the raw attribute word — the columnar path reads
+        it straight from the attribute column."""
         if not self.priority_bands:
             return 0
-        return (tx.attribute >> 24) & 0xFF
+        return (attribute >> 24) & 0xFF
 
     def _victims_locked(self) -> list:
         """Unsealed pending txs in eviction order — ascending
@@ -415,10 +554,11 @@ class TxPool:
                        if h not in self._sealed),
                       key=lambda v: (v[0], v[1]))
 
-    def _plan_admission_locked(self, occupancy: int, tx: Transaction,
-                               current: int, victims: Optional[list],
-                               vi: int):
-        """One candidate's watermark verdict.
+    def _plan_admission_locked(self, occupancy: int, band: int,
+                               block_limit: int, current: int,
+                               victims: Optional[list], vi: int):
+        """One candidate's watermark verdict, off scalar (band,
+        block_limit) so the columnar path feeds it straight from columns.
         -> (status|None, victim_hash|None, vi, occupancy).
 
         `victims` is the lazily built eviction-ordered list (None while
@@ -428,7 +568,6 @@ class TxPool:
         insert phase the returned victim is actually evicted. Freshly
         inserted batch members are not candidates — the scan predates
         them, which only errs toward keeping the newest txs."""
-        band = self._band(tx)
         high = min(self._high_mark, self.pool_limit)
         if occupancy >= high:
             if victims is not None:
@@ -437,7 +576,7 @@ class TxPool:
                         or victims[vi][2] in self._sealed):
                     vi += 1  # went stale since the scan (committed/sealed)
                 if vi < len(victims) \
-                        and victims[vi][:2] < (band, tx.block_limit):
+                        and victims[vi][:2] < (band, block_limit):
                     # strictly lower priority pending: exchange slots
                     return None, victims[vi][2], vi + 1, occupancy
             return TransactionStatus.TXPOOL_FULL, None, vi, occupancy
@@ -448,7 +587,7 @@ class TxPool:
             frac = (occupancy - self._low_mark) / max(
                 1, high - self._low_mark)
             required = 1 + int(self.DEADLINE_SLACK_BLOCKS * frac)
-            if tx.block_limit - current < required:
+            if block_limit - current < required:
                 return (TransactionStatus.DEADLINE_UNMEETABLE, None, vi,
                         occupancy)
         return None, None, vi, occupancy + 1

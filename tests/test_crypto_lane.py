@@ -99,6 +99,11 @@ def _gated_concurrent(counting, lane, calls, probe_op="hash"):
     gated-dispatch idiom, lifted to the crypto plane)."""
     counting.gate = threading.Event()
     gate = counting.gate
+    # `entered` is sticky from any earlier gated stage on this fixture;
+    # without the clear, wait(10) below is a no-op and the callers race
+    # the dispatcher's coalesce window (their requests get swept into the
+    # PROBE's round and _q never refills -> "requests never queued")
+    counting.entered.clear()
     # occupy the dispatcher: a tiny probe that parks inside the base call
     # (pick an op DIFFERENT from the one under count so the probe never
     # pollutes the assertion's counter)

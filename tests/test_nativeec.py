@@ -153,3 +153,62 @@ def test_mismatched_batch_lengths_rejected():
         nativeec.ecdsa_verify_batch([1, 2], [1], [1, 2], [1, 2], [1, 2])
     with pytest.raises(ValueError):
         nativeec.ecdsa_recover_batch([1, 2], [1, 2], [1, 2], [0])
+
+
+def test_ecdsa_recover_rows_door_matches_int_door():
+    """The zero-marshalling rows entry (pre-packed 32-byte rows, no int
+    round trip) returns bit-identical pubs/ok to the int-marshalling
+    door for the same batch, including rejected rows."""
+    params = refimpl.SECP256K1
+    rows = _sigs(params, 5)
+    es = [r[0] for r in rows]
+    rs = [r[1] for r in rows]
+    ss = [r[2] for r in rows]
+    vs = [r[3] for r in rows]
+    # edge rows the C side must classify, not crash on
+    es += [es[0], es[1]]
+    rs += [0, rs[1]]
+    ss += [ss[0], ss[1]]
+    vs += [vs[0], 255]
+    want_pubs, want_ok = nativeec.ecdsa_recover_batch(es, rs, ss, vs)
+    got_pubs, got_ok = nativeec.ecdsa_recover_batch_rows(
+        b"".join(int(e).to_bytes(32, "big") for e in es),
+        b"".join(int(r).to_bytes(32, "big") for r in rs),
+        b"".join(int(s).to_bytes(32, "big") for s in ss),
+        bytes(vs))
+    assert got_ok == want_ok
+    assert got_pubs == want_pubs
+    with pytest.raises(ValueError):
+        nativeec.ecdsa_recover_batch_rows(b"\x00" * 32, b"\x00" * 32,
+                                          b"\x00" * 32, bytes([0, 0]))
+
+
+def test_suite_recover_rows_fast_path_parity(monkeypatch):
+    """suite.recover_batch answers identically with the rows fast path
+    live vs forced off (int door), across valid / tampered / malformed-
+    short signatures; oversized digests take the int door (which
+    pre-reduces mod n) without error."""
+    suite = make_suite(False, backend="host")
+    kps = [suite.generate_keypair(bytes([i + 41]) * 20) for i in range(4)]
+    digests = [suite.hash(b"rows-%d" % i) for i in range(4)]
+    sigs = [suite.sign(kp, d) for kp, d in zip(kps, digests)]
+    sigs[1] = b"\x00" * 32 + sigs[1][32:]  # r=0: unrecoverable
+    sigs[2] = sigs[2][:17]                 # malformed: short
+    live = suite.recover_batch(digests, sigs)
+    monkeypatch.setattr(nativeec, "ecdsa_recover_batch_rows",
+                        lambda *a: None)
+    forced = suite.recover_batch(digests, sigs)
+    assert live[0] == forced[0]
+    assert live[1].tolist() == forced[1].tolist() == [
+        True, False, False, True]
+    monkeypatch.undo()
+    # oversized digest: the rows door declines (not 32 bytes), the int
+    # door classifies it like the oracle
+    params = refimpl.SECP256K1
+    sk, pub = refimpl.keygen(params, b"\x23" * 24)
+    digest = b"\x8c" * 40
+    r, s, v = refimpl.ecdsa_sign(params, sk, digest)
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+    pubs, ok = suite.recover_batch([digest], [sig])
+    assert ok.tolist() == [True]
+    assert pubs[0] == pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")

@@ -889,6 +889,10 @@ class Graph:
 
         for qual, plane in planes.ROOT_OVERRIDES.items():
             add(qual, plane, "override")
+        # columnar hot-path entry points: roots regardless of which
+        # thread reaches them (hot-loop-alloc guard rail, ROADMAP-1)
+        for qual, plane in planes.HOT_PATH_EXTRA_ROOTS.items():
+            add(qual, plane, "columnar hot-path entry")
         worker_base = None
         for cq in self.classes:
             if cq.endswith("utils.worker.Worker") or cq == "utils.worker.Worker":

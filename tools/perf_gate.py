@@ -61,8 +61,17 @@ _HIGHER_EXACT = {"value", "vs_baseline", "recover_vs_baseline",
                  "rpc_read_qps", "groups_scaling_2x", "groups_tps_median",
                  "recover_sigs_per_sec", "native_host_floor_sigs_per_sec",
                  "replay_blocks_per_sec", "poseidon_hashes_per_sec",
-                 "rpc_read_cache_hit_rate"}
+                 "rpc_read_cache_hit_rate",
+                 # columnar wire ingest vs the object path (adjacent-pair
+                 # ratio median) and the exec pools' busy fraction over
+                 # the timed window — both shrink when the substrate or
+                 # the worker seam regresses
+                 "columnar_vs_object", "exec_worker_occupancy"}
 _LOWER_SUFFIXES = ("_ms", "_seconds", "_mb", "_cost_pct", "_ns")
+# lower-is-better fields whose names don't carry a _LOWER suffix: the
+# sealer's idle threading-wait share of attributed CPU (the event-driven
+# sealer's acceptance number — PR 16 measured 15.4% under the 0.02 s poll)
+_LOWER_EXACT = {"seal_wait_share_pct"}
 _SKIP = {"cpu_cores", "rpc_ingest_clients", "rpc_read_clients",
          "poseidon_batch", "overload_rate_limited", "live_value",
          "cpu_baseline_sigs_per_sec", "spin_score", "sampled_at",
@@ -77,7 +86,13 @@ _SKIP = {"cpu_cores", "rpc_ingest_clients", "rpc_read_clients",
          "dataset_mb", "disk_dataset_mb", "memtable_mb",
          "peak_rss_mb", "peak_rss_disk_mb", "peak_rss_memory_mb",
          "storage_peak_rss_disk_mb",
-         "cpu_seconds", "attributed_cpu_seconds", "profiler_cpu_seconds"}
+         "cpu_seconds", "attributed_cpu_seconds", "profiler_cpu_seconds",
+         # counts that scale with the run's -n / worker config, and the
+         # fallback counter whose healthy median is exactly 0 (ratio
+         # banding around zero is meaningless; workers_smoke asserts the
+         # fallback/respawn contract directly)
+         "exec_worker_pool_blocks", "exec_worker_fallbacks", "workers",
+         "pool_blocks", "exec_fallbacks"}
 
 
 def direction(metric: str):
@@ -88,7 +103,7 @@ def direction(metric: str):
         return None
     if base in _HIGHER_EXACT or base.endswith(_HIGHER_SUFFIXES):
         return "higher"
-    if base.endswith(_LOWER_SUFFIXES):
+    if base in _LOWER_EXACT or base.endswith(_LOWER_SUFFIXES):
         return "lower"
     return None
 

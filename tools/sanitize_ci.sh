@@ -96,6 +96,17 @@
 #                                   # chain_bench --profile-attrib row,
 #                                   # then tools/perf_gate.py report-only
 #                                   # against the recorded trajectory
+#   tools/sanitize_ci.sh --workers  # ONLY the out-of-process execution
+#                                   # smoke: 4 real daemons with
+#                                   # [scheduler] workers = 1, RPC writes,
+#                                   # SIGKILL one node's execution worker
+#                                   # mid-stream — the scheduler falls
+#                                   # back in-process, the health plane
+#                                   # respawns the worker, the respawned
+#                                   # worker executes blocks, the chain
+#                                   # converges to identical heads +
+#                                   # byte-identical c_balance with a
+#                                   # clean getAuditReport everywhere
 #   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
 #                                   # daemon hosting two groups ([groups]
 #                                   # ini), disjoint writes routed by the
@@ -602,6 +613,20 @@ EOF
   JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 600 \
     python benchmark/chain_bench.py -n 1000 --backend host \
     --pipeline-profile 2>/dev/null | grep '"metric": "pipeline_'
+  exit 0
+fi
+
+if [ "${1:-}" = "--workers" ]; then
+  echo "== [workers] out-of-process execution smoke: 4 daemons with" \
+       "[scheduler] workers = 1, SIGKILL a worker mid-stream, scheduler" \
+       "falls back + health plane respawns, chain converges, clean audit"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python tools/workers_smoke.py
+  echo "== [workers] columnar A/B bench row (object vs columnar ingest)"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python benchmark/chain_bench.py --columnar-compare -n 1000 \
+    --backend host 2>/dev/null | grep '"metric": "columnar_tps"'
+  echo "sanitize_ci: WORKERS STAGE CLEAN"
   exit 0
 fi
 

@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""Out-of-process execution workers smoke (sanitize_ci.sh --workers).
+
+Boots a REAL 4-node PBFT cluster (OS processes, JSON-RPC) with
+`[scheduler] workers = 1`, streams RPC writes, SIGKILLs one node's
+execution worker MID-STREAM, and asserts the production contract:
+
+  - the worker pool engaged (execWorkers in getSystemStatus, blocks > 0);
+  - the kill is observed (bcos_exec_worker_deaths_total >= 1) and the
+    scheduler restarts the worker via the health plane's respawn probe
+    (new pid, alive, node health back to ok);
+  - the chain never wedges: all writes commit, every node converges to
+    the identical head hash, the c_balance table is byte-identical on
+    every node (read back over RPC), and getAuditReport is clean.
+
+Run directly (`python tools/workers_smoke.py`) or via the CI gate.
+"""
+
+import os
+import signal
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from fisco_bcos_tpu.executor import precompiled as pc  # noqa: E402
+from fisco_bcos_tpu.sdk.client import TransactionBuilder  # noqa: E402
+from fisco_bcos_tpu.testing.chaos import ChaosHarness  # noqa: E402
+
+N_PRE = 8     # writes committed before the kill
+N_POST = 12   # writes streamed across/after the kill
+
+
+def _exec_workers(h: ChaosHarness, i: int) -> dict:
+    st = h.client(i).request("getSystemStatus", [h.info["group_id"], ""])
+    ew = st.get("execWorkers")
+    assert ew is not None, f"node {i} booted without an exec pool: {st}"
+    return ew
+
+
+def _deaths(h: ChaosHarness, i: int) -> float:
+    for ln in h.metrics_text(i).splitlines():
+        if ln.startswith("bcos_exec_worker_deaths_total"):
+            return float(ln.split()[-1])
+    return 0.0
+
+
+def main() -> None:
+    out = tempfile.mkdtemp(prefix="workers-smoke-")
+    with ChaosHarness(out, tls=False,
+                      config_overrides={"scheduler_workers": 1}) as h:
+        h.start_all()
+        for i in range(h.n):
+            h.wait_rpc_up(i)
+
+        suite = h.suite()
+        kp = suite.generate_keypair(b"workers-smoke")
+        builder = TransactionBuilder(suite, None,
+                                     chain_id=h.info["chain_id"],
+                                     group_id=h.info["group_id"])
+        sent = 0
+
+        def burst(n):
+            nonlocal sent
+            for _ in range(n):
+                tx = builder.build(
+                    kp, pc.BALANCE_ADDRESS,
+                    pc.encode_call("register",
+                                   lambda w, s=sent: w.blob(b"wk%d" % s)
+                                   .u64(100 + s)),
+                    nonce=f"wk-{sent}", block_limit=500)
+                h.client(sent % h.n).send_transaction(tx, wait=False)
+                sent += 1
+
+        # phase 1: the pool engages on every node
+        burst(N_PRE)
+        h.wait_until(lambda: min(h.total_txs(i) for i in range(h.n))
+                     >= N_PRE, timeout=240, what="pre-kill commits")
+        ew0 = _exec_workers(h, 0)
+        victim = ew0["per_worker"][0]["pid"]
+        assert victim and ew0["per_worker"][0]["alive"], ew0
+        assert sum(w["blocks"] for w in ew0["per_worker"]) >= 1, \
+            f"pool never executed a block: {ew0}"
+
+        # phase 2: SIGKILL node 0's worker MID-STREAM
+        os.kill(victim, signal.SIGKILL)
+        burst(N_POST)
+        h.wait_until(lambda: min(h.total_txs(i) for i in range(h.n))
+                     >= N_PRE + N_POST, timeout=300,
+                     what="commits through the worker kill")
+
+        # the kill was OBSERVED and the health plane respawned the worker
+        h.wait_until(lambda: _deaths(h, 0) >= 1, timeout=60,
+                     what="worker death observed in metrics")
+        h.wait_until(
+            lambda: (lambda ew: ew["per_worker"][0]["alive"]
+                     and ew["per_worker"][0]["pid"] != victim)
+            (_exec_workers(h, 0)),
+            timeout=120, what="health-plane respawn (new live pid)")
+        h.wait_until(lambda: all(h.healthz(i)[0] == 200
+                                 and h.healthz(i)[1]["state"] == "ok"
+                                 for i in range(h.n)),
+                     timeout=120, what="health back to ok on every node")
+
+        # phase 3: the RESPAWNED worker executes real blocks
+        burst(4)
+        h.wait_until(lambda: min(h.total_txs(i) for i in range(h.n))
+                     >= sent, timeout=240, what="post-respawn commits")
+        h.wait_until(
+            lambda: sum(w["blocks"]
+                        for w in _exec_workers(h, 0)["per_worker"]) >= 1,
+            timeout=60, what="respawned worker executed a block")
+
+        # phase 4: convergence — identical heads + byte-identical balances
+        height = h.wait_converged(range(h.n), min_height=1, timeout=240)
+        cli0 = h.client(0)
+        heads = [h.client(i).request("getBlockHashByNumber",
+                                     [h.info["group_id"], "", height])
+                 for i in range(h.n)]
+        assert len(set(heads)) == 1, heads
+
+        def balances(i):
+            cli = h.client(i)
+            out = []
+            for s in range(sent):
+                call = pc.encode_call("balanceOf",
+                                      lambda w, s=s: w.blob(b"wk%d" % s))
+                r = cli.request("call", [h.info["group_id"], "",
+                                         "0x" + pc.BALANCE_ADDRESS.hex(),
+                                         "0x" + call.hex()])
+                out.append(r["output"])
+            return out
+
+        want = balances(0)
+        assert all(int(o[2:], 16) == 100 + s for s, o in enumerate(want)), \
+            want
+        for i in range(1, h.n):
+            assert balances(i) == want, f"node {i} balance divergence"
+        for i in range(h.n):
+            rep = h.audit_report(i)
+            assert rep["ok"], (i, rep)
+
+        ew = _exec_workers(h, 0)
+        print("workers_smoke: WORKERS STAGE CLEAN "
+              f"(height={height}, txs={sent}, "
+              f"deaths={_deaths(h, 0):.0f}, "
+              f"fallbacks={ew['fallbacks']}, "
+              f"pool_blocks={sum(w['blocks'] for w in ew['per_worker'])})")
+
+
+if __name__ == "__main__":
+    main()
