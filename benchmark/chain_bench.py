@@ -1009,22 +1009,28 @@ def _emit_read_mode(args, sm: bool) -> None:
         }), flush=True)
 
 
-def run_trace_profile(sm: bool, backend: str, n_txs: int = 24) -> list:
+def run_trace_profile(sm: bool, backend: str, n_txs: int = 24,
+                      seal_mode: str = "multi") -> list:
     """End-to-end latency decomposition from the tracing plane
     (utils/otrace.py): a 4-node chain at sample_rate=1, `n_txs` closed-loop
     transactions each carrying its own trace root, stages aggregated from
     the INGRESS node's spans. Emits one row per stage plus a summary whose
     `coverage` reconciles the stage sum against the independently measured
     submit->receipt p50 — the check that the stages account for the
-    transaction's wall-clock rather than a subset of it."""
+    transaction's wall-clock rather than a subset of it. `seal_mode`
+    selects the commit-seal carriage (consensus/qc.py) so multi-vs-cert
+    consensus stages can be A/B'd in one session; the summary row carries
+    the consensus stage means and the measured per-block seal bytes as
+    named fields for perf_gate banding."""
     import statistics as _stats
 
     from fisco_bcos_tpu.executor import precompiled as pc
     from fisco_bcos_tpu.protocol import Transaction
     from fisco_bcos_tpu.utils import otrace
 
-    nodes, gateways, _tls = _build_chain(sm, backend, 1000,
-                                         min_seal_time=0.0)
+    nodes, gateways, _tls = _build_chain(
+        sm, backend, 1000, min_seal_time=0.0,
+        cfg_overrides={"seal_mode": seal_mode})
     otrace.TRACER.configure(sample_rate=1.0, ring_size=16384, slow_ms=0.0)
     otrace.TRACER.reset()
     ingress = nodes[0]
@@ -1107,10 +1113,26 @@ def run_trace_profile(sm: bool, backend: str, n_txs: int = 24) -> list:
                      "mean_ms": round(mean, 3),
                      "count": len(per_stage[name])})
     p50 = _stats.median(e2e_ms) if e2e_ms else 0.0
+    # per-block commit-seal wire bytes actually committed in this run
+    # (consensus/qc.py seal_wire_bytes: encode() minus encode_core())
+    from fisco_bcos_tpu.consensus import qc as _qc
+    head = ingress.ledger.current_number()
+    seal_bytes = [_qc.seal_wire_bytes(ingress.ledger.header_by_number(nn))
+                  for nn in range(1, head + 1)]
     rows.append({
         "metric": "trace_profile_summary", "unit": "ms",
         "suite": "sm" if sm else "ecdsa",
         "txs": len(e2e_ms),
+        "seal_mode": seal_mode,
+        "seal_bytes_per_block": round(_stats.mean(seal_bytes), 1)
+        if seal_bytes else 0,
+        # the two consensus stages as named fields (the generic per-stage
+        # rows pool under one `mean_ms` name, which would gate ALL stages
+        # as one population; perf_gate's `_ms` suffix bands these)
+        "consensus_pre_ms": round(_stats.mean(
+            per_stage.get("stage.consensus_pre", [0.0])), 3),
+        "consensus_wait_ms": round(_stats.mean(
+            per_stage.get("stage.consensus_wait", [0.0])), 3),
         "stage_sum_ms": round(stage_sum, 3),
         "e2e_p50_ms": round(p50, 3),
         "e2e_mean_ms": round(_stats.mean(e2e_ms), 3) if e2e_ms else 0.0,
@@ -1120,6 +1142,75 @@ def run_trace_profile(sm: bool, backend: str, n_txs: int = 24) -> list:
         "nodes_stitched": len({n for n in stitched_nodes
                                if n not in (None, "")}),
     })
+    return rows
+
+
+def run_seal_bench(sm: bool, backend: str, rosters=(4, 16, 64)) -> list:
+    """Commit-seal carriage bytes + verify cost per `seal_mode`
+    (consensus/qc.py), deterministic and offline: for each roster size,
+    mint a real quorum of seals over one header in every mode and measure
+    (a) the exact wire bytes each hop ships (encode() minus encode_core())
+    and (b) one span-verify call's wall time through `qc.verify_spans`.
+    Honesty notes: `aggregate` verify is the pure-Python BN254 pairing
+    (~1 s — correctness-first wire format, not a live-path speedup), and
+    at tiny rosters `cert` saves only the per-seal index framing, so
+    `vs_multi` is reported per mode rather than a blended headline."""
+    from fisco_bcos_tpu.consensus import qc as _qc
+    from fisco_bcos_tpu.crypto import agg as _agg
+    from fisco_bcos_tpu.crypto.suite import make_suite
+    from fisco_bcos_tpu.protocol import BlockHeader
+
+    suite = make_suite(sm, backend=backend)
+    rows = []
+    for n in rosters:
+        kps = [suite.generate_keypair(bytes([i + 1]) * 8 + b"seal-bench")
+               for i in range(n)]
+        sealers = sorted(kp.pub_bytes for kp in kps)
+        by_pub = {kp.pub_bytes: kp for kp in kps}
+        quorum = 2 * ((n - 1) // 3) + 1
+        reg = _agg.AggKeyRegistry.from_seeds(
+            [(pk, pk + b"bench-seed") for pk in sealers])
+        secrets = {pk: _agg.derive_secret(pk + b"bench-seed")
+                   for pk in sealers}
+
+        def header_for(mode):
+            h = BlockHeader(number=1, sealer_list=list(sealers))
+            hh = h.hash(suite)
+            if mode == "aggregate":
+                sigs = [_agg.sign(secrets[sealers[i]], hh)
+                        for i in range(quorum)]
+                _qc.attach(h, _qc.mint_aggregate(
+                    list(range(quorum)), _agg.aggregate_sigs(sigs), n))
+                return h
+            seals = [(i, suite.sign(by_pub[sealers[i]], hh))
+                     for i in range(quorum)]
+            if mode == "cert":
+                _qc.attach(h, _qc.mint_cert(seals, n))
+            else:
+                h.signature_list = seals
+            return h
+
+        multi_bytes = None
+        for mode in ("multi", "cert", "aggregate"):
+            if mode == "aggregate" and n > 16:
+                continue  # pairing cost is roster-independent; 2 rows pin it
+            h = header_for(mode)
+            nbytes = _qc.seal_wire_bytes(h)
+            if mode == "multi":
+                multi_bytes = nbytes
+            t0 = time.perf_counter()
+            ok = _qc.verify_spans([h], sealers, suite, agg_registry=reg)
+            verify_ms = (time.perf_counter() - t0) * 1000.0
+            if not bool(ok[0]):
+                raise RuntimeError(f"seal bench self-check failed: {mode}")
+            rows.append({
+                "metric": "seal_bytes", "unit": "bytes",
+                "suite": "sm" if sm else "ecdsa",
+                "mode": mode, "sealers": n, "quorum": quorum,
+                "seal_bytes_per_block": nbytes,
+                "vs_multi": round(nbytes / multi_bytes, 3),
+                "span_verify_ms": round(verify_ms, 2),
+            })
     return rows
 
 
@@ -2802,6 +2893,15 @@ def main() -> None:
                          "reconciliation against measured e2e p50")
     ap.add_argument("--trace-txs", type=int, default=24,
                     help="with --trace-profile: closed-loop tx count")
+    ap.add_argument("--seal-mode", default="multi",
+                    choices=["multi", "cert", "aggregate"],
+                    help="with --trace-profile: commit-seal carriage the "
+                         "cluster mints (consensus/qc.py) — A/B the "
+                         "consensus stages across modes")
+    ap.add_argument("--seal-bench", action="store_true",
+                    help="commit-seal carriage bytes + span-verify cost "
+                         "per seal_mode across roster sizes (offline, "
+                         "deterministic)")
     ap.add_argument("--profile-attrib", action="store_true",
                     help="GIL-holder attribution on the direct solo "
                          "ingest path (top functions per stage vs an "
@@ -2872,7 +2972,13 @@ def main() -> None:
         return
     if args.trace_profile:
         for sm in suites:
-            for row in run_trace_profile(sm, args.backend, args.trace_txs):
+            for row in run_trace_profile(sm, args.backend, args.trace_txs,
+                                         seal_mode=args.seal_mode):
+                print(_dumps(row), flush=True)
+        return
+    if args.seal_bench:
+        for sm in suites:
+            for row in run_seal_bench(sm, args.backend):
                 print(_dumps(row), flush=True)
         return
     if args.profile_attrib:

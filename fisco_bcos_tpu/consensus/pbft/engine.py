@@ -40,13 +40,16 @@ from typing import Optional
 
 import numpy as np
 
+from ...crypto import agg
 from ...net.front import FrontService
 from ...net.moduleid import ModuleID
 from ...protocol import Block
 from ...utils import otrace
 from ...utils.log import LOG, badge, metric
+from ...utils.metrics import REGISTRY
 from ...utils.trace import block_trace
 from ...utils.worker import Worker
+from .. import qc
 from .messages import (
     PacketType,
     PBFTMessage,
@@ -97,9 +100,32 @@ class PBFTEngine(Worker):
                  scheduler, ledger, leader_period: int = 1,
                  view_timeout: float = 3.0, txsync=None,
                  full_proposals: bool = False, persist: bool = True,
-                 clock_ms=None, waterline: int = 8):
+                 clock_ms=None, waterline: int = 8,
+                 seal_mode: str = "multi", agg_registry=None,
+                 agg_secret: Optional[int] = None):
         super().__init__("pbft", idle_wait=0.02)
         self.suite = suite
+        # commit-seal carriage (consensus/qc.py): multi = legacy loose
+        # 2f+1 seals; cert = one bitmap+ECDSA certificate; aggregate = one
+        # bitmap+BLS point. The knob only controls what THIS node mints —
+        # verification accepts every form everywhere, so a mixed-mode
+        # cluster converges on whichever form each block's committer chose
+        if seal_mode not in ("multi", "cert", "aggregate"):
+            raise ValueError(f"unknown seal_mode: {seal_mode}")
+        if seal_mode == "aggregate" and agg_registry is None:
+            # aggregate needs the PoP'd key registry (the roster's trust
+            # root); without one this node could mint certs nobody can
+            # check — downgrade to the cert form, which needs no new keys
+            LOG.warning(badge("PBFT", "no-agg-registry-cert-fallback"))
+            seal_mode = "cert"
+        self.seal_mode = seal_mode
+        self.agg_registry = agg_registry
+        self.agg_secret = agg_secret
+        if seal_mode == "aggregate" and agg_secret is None:
+            # deterministic BLS secret from the node's existing ECDSA key
+            # (crypto/agg.py derive_secret) — no second key file to manage
+            self.agg_secret = agg.derive_secret(
+                keypair.secret.to_bytes(32, "big"))
         # aligned clock source (tool/timesync.py median); raw UTC fallback
         self.clock_ms = clock_ms or (lambda: int(time.time() * 1000))
         self.keypair = keypair
@@ -158,6 +184,14 @@ class PBFTEngine(Worker):
         self._deadline = 0.0
         self._timeout = view_timeout
         self._committed_waiters: list = []
+        # heights whose checkpoint quorum landed this drain — their seals
+        # are judged TOGETHER at the end of the worker pass (one lane call
+        # across every in-flight height, the sync-range coalescing shape)
+        self._pending_commits: set[int] = set()
+        self._seal_batches = 0       # lane calls spent on checkpoint seals
+        self._seals_verified = 0     # seals judged in those calls
+        self._seal_bytes_last = 0    # wire bytes of the last minted carriage
+        self._seal_signers_last = 0  # signers in the last minted carriage
 
         front.register_module(ModuleID.PBFT, self._on_network)
 
@@ -374,6 +408,8 @@ class PBFTEngine(Worker):
         for block in local:
             with otrace.ctx_scope(getattr(block, "_otrace", None)):
                 self._broadcast_preprepare(block)
+        if self._pending_commits:
+            self._flush_checkpoint_commits()
         if time.monotonic() > self._deadline:
             self._on_timeout()
 
@@ -753,7 +789,13 @@ class PBFTEngine(Worker):
         cache.executed_header = result.header
         if self.index >= 0:
             # the checkpoint seal IS the commit seal for signature_list
-            seal = self.suite.sign(self.keypair, cache.executed_hash)
+            # (aggregate mode signs the BLS lane so the quorum's seals sum
+            # into one G1 point; peers in other modes simply judge it as a
+            # bad ECDSA seal and count the remaining quorum)
+            if self.seal_mode == "aggregate":
+                seal = agg.sign(self.agg_secret, cache.executed_hash)
+            else:
+                seal = self.suite.sign(self.keypair, cache.executed_hash)
             cache.checkpoints[self.index] = seal
             ck = self._signed(make_packet(PacketType.CHECKPOINT, self.view,
                                           number, self.index,
@@ -770,21 +812,107 @@ class PBFTEngine(Worker):
         self._try_advance(number + 1)
 
     def _try_commit_ledger(self, number: int, cache: _ProposalCache) -> None:
-        if len(cache.checkpoints) < self.quorum or cache.committed_phase:
+        """Checkpoint quorum reached: queue the height for this drain's
+        seal-judging flush. Verification is deferred to the END of the
+        worker pass so every height that quorums in one drain shares ONE
+        `verify_batch` call (execute_worker -> _flush_checkpoint_commits)
+        — live consensus coalesces across heights exactly like the sync
+        range path."""
+        if len(cache.checkpoints) < self.quorum or cache.committed_phase \
+                or not cache.executed:
             return
-        # batch-verify every collected seal over the executed header hash in
-        # one call (BlockValidator.cpp:141 checkSignatureList shape)
+        self._pending_commits.add(number)
+
+    def _flush_checkpoint_commits(self) -> None:
+        """Judge every pending height's checkpoint seals in one lane call
+        (BlockValidator.cpp:141 checkSignatureList shape, widened across
+        heights), mint the commit-seal carriage per `seal_mode`, and hand
+        decided blocks to the commit stage in height order."""
+        jobs: list[tuple[int, _ProposalCache]] = []
+        for number in sorted(self._pending_commits):
+            cache = self._caches.get(number)
+            if cache is not None and cache.executed \
+                    and not cache.committed_phase \
+                    and len(cache.checkpoints) >= self.quorum:
+                jobs.append((number, cache))
+        self._pending_commits.clear()
+        if not jobs:
+            return
+        if self.seal_mode == "aggregate":
+            # BLS seals: one pairing-product check per height (there is no
+            # sound cross-height merge of pairing checks without blinding)
+            for number, cache in jobs:
+                self._judge_aggregate(number, cache)
+            return
+        spans: list[tuple[int, _ProposalCache, list[int], int]] = []
+        digests: list[bytes] = []
+        seals: list[bytes] = []
+        pubs: list[bytes] = []
+        for number, cache in jobs:
+            idxs = sorted(cache.checkpoints)
+            spans.append((number, cache, idxs, len(digests)))
+            digests.extend([cache.executed_hash] * len(idxs))
+            seals.extend(cache.checkpoints[i] for i in idxs)
+            pubs.extend(self.nodes[i] for i in idxs)
+        ok = np.asarray(self.suite.verify_batch(digests, seals, pubs))
+        self._seal_batches += 1
+        self._seals_verified += len(digests)
+        for number, cache, idxs, start in spans:
+            verdict = ok[start:start + len(idxs)]
+            good = [(i, cache.checkpoints[i])
+                    for i, g in zip(idxs, verdict) if g]
+            if len(good) < self.quorum:
+                for i, g in zip(idxs, verdict):
+                    if not g:
+                        cache.checkpoints.pop(i, None)
+                continue
+            if self.seal_mode == "cert":
+                carriage = [(qc.QC_SENTINEL,
+                             qc.mint_cert(good, self.n).encode())]
+            else:
+                carriage = good
+            self._commit_decided(number, cache, carriage)
+
+    def _judge_aggregate(self, number: int, cache: _ProposalCache) -> None:
+        """Aggregate-mode checkpoint quorum: optimistic ONE pairing check
+        over the summed seals; on failure fall back to per-seal checks to
+        evict the Byzantine contribution(s) and retry on the next packet."""
         idxs = sorted(cache.checkpoints)
-        seals = [cache.checkpoints[i] for i in idxs]
-        ok = np.asarray(self.suite.verify_batch(
-            [cache.executed_hash] * len(idxs), seals,
-            [self.nodes[i] for i in idxs]))
-        good = [(i, s) for i, s, g in zip(idxs, seals, ok) if g]
-        if len(good) < self.quorum:
-            for i, g in zip(idxs, ok):
-                if not g:
-                    cache.checkpoints.pop(i, None)
+        keep: list[int] = []
+        for i in idxs:
+            pub = self.agg_registry.pub_for(self.nodes[i])
+            try:
+                admissible = pub is not None and \
+                    agg.g1_from_bytes(cache.checkpoints[i]) is not None
+            except ValueError:
+                admissible = False
+            if admissible:
+                keep.append(i)
+            else:  # unregistered key or not even a curve point
+                cache.checkpoints.pop(i, None)
+        if len(keep) < self.quorum:
             return
+        sigs = [cache.checkpoints[i] for i in keep]
+        apubs = [self.agg_registry.pub_for(self.nodes[i]) for i in keep]
+        self._seal_batches += 1
+        self._seals_verified += len(keep)
+        if not agg.verify_aggregate(apubs, cache.executed_hash,
+                                    agg.aggregate_sigs(sigs)):
+            good = [i for i, s, p in zip(keep, sigs, apubs)
+                    if agg.verify(p, cache.executed_hash, s)]
+            for i in keep:
+                if i not in good:
+                    cache.checkpoints.pop(i, None)
+            if len(good) < self.quorum:
+                return
+            keep = good
+            sigs = [cache.checkpoints[i] for i in keep]
+        cert = qc.mint_aggregate(keep, agg.aggregate_sigs(sigs), self.n)
+        self._commit_decided(number, cache,
+                             [(qc.QC_SENTINEL, cert.encode())])
+
+    def _commit_decided(self, number: int, cache: _ProposalCache,
+                        carriage: list) -> None:
         cache.committed_phase = True
         if cache.trace_ctx is not None and cache.t_accept:
             # one consensus span per node per block: proposal accept ->
@@ -800,7 +928,14 @@ class PBFTEngine(Worker):
         # place) but differ behind a scheduler-service proxy, where the
         # proposal header never learns its roots
         header = cache.executed_header
-        header.signature_list = good
+        header.signature_list = carriage
+        self._seal_bytes_last = qc.seal_wire_bytes(header)
+        cert = qc.extract(header)
+        self._seal_signers_last = (cert.signer_count() if cert is not None
+                                   else len(carriage))
+        REGISTRY.set_gauge("bcos_consensus_seal_bytes_per_block",
+                           self._seal_bytes_last,
+                           labels={"mode": self.seal_mode})
         commit_async = getattr(self.scheduler, "commit_async", None)
         if commit_async is not None:
             # pipelined commit: hand the decided block to the scheduler's
@@ -1095,4 +1230,9 @@ class PBFTEngine(Worker):
             "consensusNodeNum": self.n,
             "maxFaultyQuorum": self.f,
             "committedNumber": self.ledger.current_number(),
+            "sealMode": self.seal_mode,
+            "sealBytesPerBlock": self._seal_bytes_last,
+            "sealSignersPerBlock": self._seal_signers_last,
+            "sealBatches": self._seal_batches,
+            "sealsVerified": self._seals_verified,
         }

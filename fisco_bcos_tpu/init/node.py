@@ -29,7 +29,9 @@ from ..sealer.sealer import Sealer
 from ..txpool.ingest import IngestLane
 from ..txpool.txpool import TxPool
 from ..utils.log import LOG, badge
+from ..consensus import qc
 from ..consensus.pbft.engine import PBFTEngine
+from ..crypto import agg
 from ..net.front import FrontService
 from ..net.gateway import Gateway
 from ..net.txsync import TransactionSync
@@ -135,6 +137,16 @@ class NodeConfig:
     # runs this many heights ahead of the committed block while execution
     # stays strictly ordered
     waterline: int = 8
+    # commit-seal carriage this node MINTS at checkpoint quorum
+    # (consensus/qc.py): multi = legacy loose 2f+1 seals, cert = one
+    # bitmap+ECDSA certificate, aggregate = one bitmap+BLS point.
+    # Verification accepts every form regardless, so mixed-mode clusters
+    # and legacy-chain replay keep working during a rollout
+    seal_mode: str = "multi"  # multi | cert | aggregate
+    # PoP-checked BLS key roster (crypto/agg.py AggKeyRegistry) — required
+    # to mint OR accept aggregate certificates; distributed like the
+    # sealer list itself (not an ini knob: tests/tooling inject it)
+    agg_registry: object = None
     # snapshot/checkpoint subsystem (fisco_bcos_tpu/snapshot/): every
     # `snapshot_interval` committed blocks export a chunked Merkle-committed
     # state snapshot; keep `snapshot_retention` of them; when
@@ -396,7 +408,7 @@ class Node:
                 self.front, self.ledger, self.scheduler, self.suite,
                 timesync=self.timesync, snapshot=self.snapshot,
                 snap_sync_threshold=cfg.snap_sync_threshold,
-                registry=self.metrics_view)
+                registry=self.metrics_view, agg_registry=cfg.agg_registry)
             from ..net.amop import AMOPService
             self.amop = AMOPService(self.front)
             from ..lightnode import LightNodeServer
@@ -612,7 +624,9 @@ class Node:
                 view_timeout=self.config.view_timeout,
                 txsync=self.txsync,
                 clock_ms=self.timesync.aligned_time_ms,
-                waterline=self.config.waterline)
+                waterline=self.config.waterline,
+                seal_mode=self.config.seal_mode,
+                agg_registry=self.config.agg_registry)
         self.consensus.start()
         self.sealer.start()
 
@@ -681,10 +695,22 @@ class Node:
             result = self.scheduler.execute_block(block)
             if result is None:
                 return False
-            # solo: self-sign the header as its own commit seal
-            seal = self.suite.sign(self.keypair,
-                                   result.header.hash(self.suite))
-            result.header.signature_list = [(0, seal)]
+            # solo: self-sign the header as its own commit seal, carried
+            # in whatever form seal_mode dictates (the solo chain must
+            # exercise the same certificate plane replicas will judge)
+            hh = result.header.hash(self.suite)
+            n_sealers = len(result.header.sealer_list)
+            if self.config.seal_mode == "cert":
+                qc.attach(result.header, qc.mint_cert(
+                    [(0, self.suite.sign(self.keypair, hh))], n_sealers))
+            elif self.config.seal_mode == "aggregate":
+                secret = agg.derive_secret(
+                    self.keypair.secret.to_bytes(32, "big"))
+                qc.attach(result.header, qc.mint_aggregate(
+                    [0], agg.sign(secret, hh), n_sealers))
+            else:
+                result.header.signature_list = [
+                    (0, self.suite.sign(self.keypair, hh))]
             try:
                 ok = self.scheduler.commit_block(result.header)
             except Exception as exc:  # noqa: BLE001 — deliberate catch

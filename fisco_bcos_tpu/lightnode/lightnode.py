@@ -44,6 +44,7 @@ from typing import Optional, Sequence, Union
 import numpy as np
 
 from ..codec.wire import Reader, Writer
+from ..consensus import qc
 from ..net.front import FrontService
 from ..net.moduleid import ModuleID
 from ..protocol import Block, BlockHeader, Receipt, Transaction, \
@@ -257,12 +258,15 @@ class LightNodeClient:
     """Stateless verifying client over the P2P front."""
 
     def __init__(self, front: FrontService, suite,
-                 consensus_nodes: Sequence[bytes]):
+                 consensus_nodes: Sequence[bytes], agg_registry=None):
         self.front = front
         self.suite = suite
         self.sealers = sorted(consensus_nodes)
         f = (len(self.sealers) - 1) // 3
         self.quorum = 2 * f + 1
+        # PoP'd BLS roster (crypto/agg.py) for aggregate-mode certificates;
+        # None = such headers are rejected (cert/multi still verify)
+        self.agg_registry = agg_registry
         self._lock = threading.Lock()
 
     # -- plumbing ----------------------------------------------------------
@@ -277,34 +281,17 @@ class LightNodeClient:
     # -- header verification ----------------------------------------------
     def verify_headers(self, headers: Sequence[BlockHeader]) -> np.ndarray:
         """-> bool[len(headers)]: each header carries a 2f+1 commit-seal
-        quorum from the configured consensus set. EVERY seal of EVERY
-        header rides ONE `verify_batch` — the span path costs one lane
-        call whether it checks one header or a thousand."""
-        prefill_hashes(headers, lambda h: h.encode_core(), self.suite)
-        digests: list[bytes] = []
-        sigs: list[bytes] = []
-        pubs: list[bytes] = []
-        spans: list[tuple[int, int]] = []
-        for header in headers:
-            hh = header.hash(self.suite)
-            start = len(sigs)
-            seen: set[int] = set()
-            for idx, seal in header.signature_list:
-                # dedup by sealer index: quorum counts DISTINCT sealers —
-                # one compromised sealer's seal repeated 2f+1 times must
-                # never authenticate a header
-                if 0 <= idx < len(self.sealers) and idx not in seen:
-                    seen.add(idx)
-                    digests.append(hh)
-                    sigs.append(seal)
-                    pubs.append(self.sealers[idx])
-            spans.append((start, len(sigs)))
-        ok = np.asarray(self.suite.verify_batch(digests, sigs, pubs)) \
-            if sigs else np.zeros(0, bool)
-        out = np.zeros(len(headers), bool)
-        for i, (lo, hi) in enumerate(spans):
-            out[i] = int(ok[lo:hi].sum()) >= self.quorum
-        return out
+        quorum from the configured consensus set — either the legacy loose
+        multi-seal list (dedup by sealer index: quorum counts DISTINCT
+        sealers) or a quorum certificate (consensus/qc.py). The whole span
+        rides ONE `verify_batch` whether it checks one header or a
+        thousand, and a certificate collapses a header's contribution to
+        that batch to its bitmap's signatures (aggregate mode: one pairing
+        check, zero lane rows). The light client configures its own sealer
+        roster, so header.sealer_list is not consulted."""
+        return qc.verify_spans(headers, self.sealers, self.suite,
+                               self.quorum, agg_registry=self.agg_registry,
+                               check_sealer_list=False)
 
     def verify_header(self, header: BlockHeader) -> bool:
         return bool(self.verify_headers([header])[0])

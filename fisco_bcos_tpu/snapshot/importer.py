@@ -3,9 +3,12 @@
 Verification chain (everything a joining node checks before trusting the
 bytes, reusing the block-sync seal verifier):
 
-  1. the checkpoint header's commit seals carry a 2f+1 quorum of the
-     importer's OWN sealer set (genesis-rooted — `verify_seals` is
-     BlockSync._verify_seals, never peer-supplied data);
+  1. the checkpoint header's commit-seal carriage — the legacy 2f+1
+     multi-seal list OR one quorum certificate (consensus/qc.py), which
+     the manifest binds by carrying the full header bytes — verifies as
+     ONE check against the importer's OWN sealer set (genesis-rooted —
+     `verify_seals` is BlockSync._verify_seals, never peer-supplied
+     data);
   2. every chunk hash (ONE batched `suite.hash_batch` call) matches the
      manifest, and the Merkle root over them matches `manifest.root`;
   3. the installed rows must contain exactly the seal-verified header at H

@@ -43,9 +43,8 @@ import threading
 import time
 from typing import Optional
 
-import numpy as np
-
 from ..codec.wire import Reader, Writer
+from ..consensus import qc
 from ..net.front import FrontService
 from ..net.moduleid import ModuleID
 from ..protocol import Block, BlockHeader
@@ -84,10 +83,13 @@ class BlockSync(Worker):
     def __init__(self, front: FrontService, ledger, scheduler, suite,
                  status_interval: float = 1.0, timesync=None,
                  snapshot=None, snap_sync_threshold: int = 0,
-                 registry=None):
+                 registry=None, agg_registry=None):
         super().__init__("block-sync", idle_wait=0.1)
         # metrics sink: multi-group nodes pass a group-labeled view
         self._reg = registry if registry is not None else REGISTRY
+        # PoP'd BLS key roster (crypto/agg.py) — needed only to accept
+        # aggregate-mode certificates; without it those blocks are rejected
+        self.agg_registry = agg_registry
         self.front = front
         self.ledger = ledger
         self.scheduler = scheduler
@@ -250,24 +252,14 @@ class BlockSync(Worker):
 
     # -- verification + replay --------------------------------------------
     def _verify_seals(self, header: BlockHeader) -> bool:
-        """Verify one block's commit seals against the LOCAL ledger's sealer
-        set (never the peer-supplied header.sealer_list — a malicious peer
-        could fabricate that), deduplicated by sealer index, quorum 2f+1.
-        All seals go through one batch verify (BlockValidator.cpp:141);
-        admission rules are shared with the range-wide batched pre-pass
-        via `_collect_seals`."""
-        sealer_set = self._sealer_set()
-        collected = self._collect_seals(header, sealer_set)
-        if collected is None:
-            LOG.warning(badge("SYNC", "sealer-list-or-quorum-mismatch",
-                              number=header.number))
-            return False
-        idxs, seals = collected
-        hh = header.hash(self.suite)
-        quorum = 2 * ((len(sealer_set) - 1) // 3) + 1
-        ok = np.asarray(self.suite.verify_batch(
-            [hh] * len(idxs), seals, [sealer_set[i] for i in idxs]))
-        if int(ok.sum()) < quorum:
+        """Verify one block's commit-seal carriage — legacy 2f+1 multi-seal
+        OR a quorum certificate (consensus/qc.py), both judged against the
+        LOCAL ledger's sealer set (never the peer-supplied
+        header.sealer_list — a malicious peer could fabricate that).
+        Admission rules are shared with the range-wide batched pre-pass
+        because both are the same `qc.verify_spans` call."""
+        if not qc.verify_spans([header], self._sealer_set(), self.suite,
+                               agg_registry=self.agg_registry)[0]:
             LOG.warning(badge("SYNC", "seal-quorum-failed",
                               number=header.number))
             return False
@@ -280,29 +272,21 @@ class BlockSync(Worker):
     @staticmethod
     def _collect_seals(header: BlockHeader, sealer_set: list[bytes]
                        ) -> Optional[tuple[list[int], list[bytes]]]:
-        """Deduplicated (index, seal) pairs for quorum judging, or None if
-        the header can't reach quorum structurally (sealer-list mismatch /
-        too few distinct signers). Shared by the batched range pre-pass
-        and the per-block fallback so the two paths can never apply
-        different admission rules."""
-        if list(header.sealer_list) != sealer_set:
-            return None
-        n = len(sealer_set)
-        quorum = 2 * ((n - 1) // 3) + 1
-        by_idx: dict[int, bytes] = {}
-        for idx, seal in header.signature_list:
-            if 0 <= idx < n:
-                by_idx.setdefault(idx, seal)
-        if len(by_idx) < quorum:
-            return None
-        idxs = sorted(by_idx)
-        return idxs, [by_idx[i] for i in idxs]
+        """Legacy multi-seal admission (kept for callers/tests that judge
+        structure without crypto) — now a thin wrapper over the shared
+        rule set in consensus/qc.py."""
+        quorum = 2 * ((len(sealer_set) - 1) // 3) + 1
+        return qc.collect_legacy(header, sealer_set, quorum,
+                                 check_sealer_list=True)
 
     def _batch_verify_seals(self, headers: list[BlockHeader]
                             ) -> tuple[dict[bytes, bool], list[bytes]]:
         """ONE `suite.verify_batch` across every header's commit seals (the
         PBFT drain-loop trick, engine._batch_checked) instead of a device
-        round trip per block. Returns ({header hash: quorum-ok}, the
+        round trip per block — a range response may mix legacy multi-seal
+        blocks and certificate blocks (a chain that lived through a
+        seal_mode rollout) and `qc.verify_spans` merges both forms into
+        the same lane call. Returns ({header hash: quorum-ok}, the
         sealer set the batch was judged against). Verdicts are keyed by
         HEADER HASH, never height: a response may carry two different
         blocks at one height, and a by-number verdict would let a forged
@@ -311,27 +295,9 @@ class BlockSync(Worker):
         rejected or whenever a replayed block changes the on-chain
         sealer set."""
         sealer_set = self._sealer_set()
-        quorum = 2 * ((len(sealer_set) - 1) // 3) + 1
-        digests: list[bytes] = []
-        seals: list[bytes] = []
-        pubs: list[bytes] = []
-        spans: list[tuple[bytes, int, int]] = []  # (hash, start, count)
-        out: dict[bytes, bool] = {}
-        for header in headers:
-            hh = header.hash(self.suite)
-            collected = self._collect_seals(header, sealer_set)
-            if collected is None:
-                out[hh] = False
-                continue
-            idxs, hseals = collected
-            spans.append((hh, len(digests), len(idxs)))
-            digests.extend([hh] * len(idxs))
-            seals.extend(hseals)
-            pubs.extend(sealer_set[i] for i in idxs)
-        if digests:
-            ok = np.asarray(self.suite.verify_batch(digests, seals, pubs))
-            for hh, start, count in spans:
-                out[hh] = int(ok[start:start + count].sum()) >= quorum
+        ok = qc.verify_spans(headers, sealer_set, self.suite,
+                             agg_registry=self.agg_registry)
+        out = {h.hash(self.suite): bool(v) for h, v in zip(headers, ok)}
         return out, sealer_set
 
     def _apply_blocks(self, blocks: list[Block]) -> None:

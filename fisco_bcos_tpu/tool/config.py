@@ -121,7 +121,10 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                        "leader_period": str(cfg.leader_period),
                        "tx_count_limit": str(cfg.tx_count_limit),
                        # proposal pipeline depth (PBFT water size)
-                       "waterline": str(cfg.waterline)}
+                       "waterline": str(cfg.waterline),
+                       # commit-seal carriage minted at checkpoint quorum
+                       # (consensus/qc.py): multi | cert | aggregate
+                       "seal_mode": cfg.seal_mode}
     # pipelined block production (scheduler/scheduler.py): off-thread
     # ordered commit + speculative next-height execution
     cp["scheduler"] = {"pipeline": str(cfg.pipeline_commit).lower(),
@@ -273,6 +276,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         tx_count_limit=cp.getint("consensus", "tx_count_limit",
                                  fallback=1000),
         waterline=cp.getint("consensus", "waterline", fallback=8),
+        seal_mode=cp.get("consensus", "seal_mode", fallback="multi"),
         pipeline_commit=cp.getboolean("scheduler", "pipeline",
                                       fallback=True),
         scheduler_workers=cp.getint("scheduler", "workers", fallback=0),

@@ -300,3 +300,109 @@ def test_lightnode_rejects_garbage_responses():
     finally:
         node.stop()
         gw.stop()
+
+
+# -- quorum-certificate spans -----------------------------------------------
+
+def _setup_sealmode(seal_mode, **client_kw):
+    gw = FakeGateway()
+    node = Node(NodeConfig(crypto_backend="host", min_seal_time=0.0,
+                           seal_mode=seal_mode), gateway=gw)
+    node.start()
+    lfront = FrontService(b"L" * 32, gw)
+    sealers = [n.node_id
+               for n in node.ledger.ledger_config().consensus_nodes]
+    client = LightNodeClient(lfront, node.suite, sealers, **client_kw)
+    return gw, node, client
+
+
+def test_lightnode_cert_span_is_one_lane_call():
+    """Cert-mode chain: a whole header span collapses into ONE
+    verify_batch — certificates and any legacy multi-seal headers in the
+    same span merge into the same lane call (the 2f+1 fallback is the
+    SAME code path, not a second loop)."""
+    from fisco_bcos_tpu.consensus import qc
+
+    gw, node, _ = _setup_sealmode("cert")
+    try:
+        kp = node.suite.generate_keypair(b"light-cert")
+        _commit_block(node, kp, b"lc", n=2)
+        for i in range(2):
+            tx = Transaction(to=pc.BALANCE_ADDRESS,
+                             input=pc.encode_call(
+                                 "register",
+                                 lambda w, i=i: w.blob(b"lc%d" % i).u64(1)),
+                             nonce=f"lcr-{i}",
+                             block_limit=node.ledger.current_number() + 100
+                             ).sign(node.suite, kp)
+            node.send_transaction(tx)
+            assert node.txpool.wait_for_receipt(
+                tx.hash(node.suite), 20) is not None
+        head = node.ledger.current_number()
+        counting = _CountingSuite(node.suite)
+        lfront = FrontService(b"C" * 32, gw)
+        sealers = [n.node_id
+                   for n in node.ledger.ledger_config().consensus_nodes]
+        client = LightNodeClient(lfront, counting, sealers)
+
+        headers = client.header_range(1, head)
+        assert all(h is not None for h in headers)
+        assert all(qc.extract(h) is not None for h in headers)
+        assert counting.verify_calls == 1, counting.verify_calls
+
+        # mixed span through the same judge: re-carry header 1's cert as
+        # legacy loose seals (signature_list is outside the header hash)
+        legacy = node.ledger.header_by_number(1)
+        cert = qc.extract(legacy)
+        idxs = qc.idxs_from_bitmap(cert.bitmap, len(sealers))
+        ssz = node.suite.signature_size
+        legacy.signature_list = [
+            (j, cert.payload[k * ssz:(k + 1) * ssz])
+            for k, j in enumerate(idxs)]
+        counting.verify_calls = 0
+        ok = client.verify_headers(
+            [legacy] + [node.ledger.header_by_number(b)
+                        for b in range(2, head + 1)])
+        assert all(ok)
+        assert counting.verify_calls == 1, counting.verify_calls
+    finally:
+        node.stop()
+        gw.stop()
+
+
+def test_lightnode_aggregate_span_skips_the_lane():
+    """Aggregate-mode chain: the span judge runs zero verify_batch rows
+    (one pairing check per header instead), and a client WITHOUT the PoP
+    registry refuses every aggregate header."""
+    from fisco_bcos_tpu.crypto import agg
+
+    gw, node, _ = _setup_sealmode("aggregate")
+    try:
+        registry = agg.AggKeyRegistry.from_seeds(
+            [(node.keypair.pub_bytes,
+              node.keypair.secret.to_bytes(32, "big"))])
+        kp = node.suite.generate_keypair(b"light-agg")
+        tx = Transaction(to=pc.BALANCE_ADDRESS,
+                         input=pc.encode_call(
+                             "register", lambda w: w.blob(b"ag").u64(1)),
+                         nonce="ag-1",
+                         block_limit=node.ledger.current_number() + 100
+                         ).sign(node.suite, kp)
+        node.send_transaction(tx)
+        assert node.txpool.wait_for_receipt(tx.hash(node.suite), 20)
+        counting = _CountingSuite(node.suite)
+        lfront = FrontService(b"C" * 32, gw)
+        sealers = [n.node_id
+                   for n in node.ledger.ledger_config().consensus_nodes]
+        with_reg = LightNodeClient(lfront, counting, sealers,
+                                   agg_registry=registry)
+        h = with_reg.header(1)
+        assert h is not None
+        assert counting.verify_calls == 0, counting.verify_calls
+
+        without = LightNodeClient(FrontService(b"D" * 32, gw), node.suite,
+                                  sealers)
+        assert without.header(1) is None
+    finally:
+        node.stop()
+        gw.stop()

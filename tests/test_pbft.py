@@ -36,7 +36,7 @@ def make_tx(suite, kp, nonce, name=b"acct", amount=10):
                        nonce=nonce, block_limit=100).sign(suite, kp)
 
 
-def build_cluster(n=4, view_timeout=2.0, tx_count_limit=1000):
+def build_cluster(n=4, view_timeout=2.0, tx_count_limit=1000, **cfg_kw):
     suite = make_suite(backend="host")
     gateway = FakeGateway()
     keypairs = [suite.generate_keypair(bytes([i + 1]) * 16) for i in range(n)]
@@ -45,7 +45,7 @@ def build_cluster(n=4, view_timeout=2.0, tx_count_limit=1000):
     for kp in keypairs:
         node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
                                min_seal_time=0.0, view_timeout=view_timeout,
-                               tx_count_limit=tx_count_limit),
+                               tx_count_limit=tx_count_limit, **cfg_kw),
                     keypair=kp, gateway=gateway)
         node.build_genesis(sealers)
         nodes.append(node)
@@ -613,4 +613,116 @@ def test_view_change_carries_multiple_pipelined_heights():
     finally:
         for ev in gates:
             ev.set()
+        stop_cluster(gateway, nodes)
+
+
+# -- quorum-certificate seal modes ------------------------------------------
+
+def test_cert_mode_cluster_commits_one_certificate_per_block():
+    """seal_mode=cert: the committed header carries ONE sentinel entry (a
+    QuorumCert), never 2f+1 loose seals, it re-verifies through the shared
+    span judge, and it ships fewer wire bytes than the multi-seal form."""
+    from fisco_bcos_tpu.consensus import qc
+    suite, gateway, nodes, _ = build_cluster(4, seal_mode="cert")
+    try:
+        kp = suite.generate_keypair(b"cert-user")
+        for i in range(2):
+            res = nodes[0].send_transaction(
+                make_tx(suite, kp, nonce=f"c{i}", name=f"ca{i}".encode()))
+            assert res.status == TransactionStatus.OK
+            assert wait_until(
+                lambda i=i: all(n.ledger.current_number() >= i + 1
+                                for n in nodes)), \
+                [n.ledger.current_number() for n in nodes]
+        import copy
+        sealer_set = sorted(n.keypair.pub_bytes for n in nodes)
+        for number in (1, 2):
+            headers = [n.ledger.header_by_number(number) for n in nodes]
+            assert len({h.hash(suite) for h in headers}) == 1
+            for h in headers:
+                assert len(h.signature_list) == 1
+                cert = qc.extract(h)
+                assert cert is not None and cert.mode == qc.MODE_CERT
+                assert cert.signer_count() >= 3
+                assert qc.verify_spans([h], sealer_set, suite)[0]
+            # the EXACT same quorum as loose multi-seals costs more wire
+            cert = qc.extract(headers[0])
+            idxs = qc.idxs_from_bitmap(cert.bitmap, 4)
+            ssz = suite.signature_size
+            h_multi = copy.copy(headers[0])
+            h_multi.signature_list = [
+                (i, cert.payload[k * ssz:(k + 1) * ssz])
+                for k, i in enumerate(idxs)]
+            assert (qc.seal_wire_bytes(headers[0])
+                    < qc.seal_wire_bytes(h_multi))
+        for n in nodes:
+            st = n.consensus.status()
+            assert st["sealMode"] == "cert"
+            assert st["sealBytesPerBlock"] > 0
+    finally:
+        stop_cluster(gateway, nodes)
+
+
+def test_checkpoint_seal_judging_is_one_batch_per_flush():
+    """The ONE-lane-call pin at the PBFT checkpoint hop: every committed
+    height's quorum rode a flush batch, and flushes never outnumber
+    commits (cross-height coalescing can only make them fewer)."""
+    suite, gateway, nodes, _ = build_cluster(4, seal_mode="cert")
+    try:
+        kp = suite.generate_keypair(b"batch-user")
+        for i in range(3):
+            nodes[i % 4].send_transaction(
+                make_tx(suite, kp, nonce=f"b{i}", name=f"ba{i}".encode()))
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 3 for n in nodes),
+            timeout=30), [n.ledger.current_number() for n in nodes]
+        for n in nodes:
+            st = n.consensus.status()
+            committed = n.ledger.current_number()
+            assert 1 <= st["sealBatches"] <= committed
+            # every height judged at least a 2f+1 quorum of seals
+            assert st["sealsVerified"] >= 3 * committed
+    finally:
+        stop_cluster(gateway, nodes)
+
+
+def test_aggregate_mode_cluster_commits_bls_certificate():
+    """seal_mode=aggregate end-to-end: four live nodes mint and accept a
+    64-byte BLS aggregate seal (PoP-registered keys), and the committed
+    carriage is dramatically smaller than the multi-seal form."""
+    from fisco_bcos_tpu.consensus import qc
+    from fisco_bcos_tpu.crypto import agg
+    suite = make_suite(backend="host")
+    keypairs = [suite.generate_keypair(bytes([i + 1]) * 16) for i in range(4)]
+    registry = agg.AggKeyRegistry.from_seeds(
+        [(kp.pub_bytes, kp.secret.to_bytes(32, "big")) for kp in keypairs])
+    gateway = FakeGateway()
+    sealers = [ConsensusNode(kp.pub_bytes) for kp in keypairs]
+    nodes = []
+    for kp in keypairs:
+        node = Node(NodeConfig(consensus="pbft", crypto_backend="host",
+                               min_seal_time=0.0, view_timeout=30.0,
+                               seal_mode="aggregate", agg_registry=registry),
+                    keypair=kp, gateway=gateway)
+        node.build_genesis(sealers)
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    try:
+        kp = suite.generate_keypair(b"agg-user")
+        res = nodes[0].send_transaction(make_tx(suite, kp, nonce="a1"))
+        assert res.status == TransactionStatus.OK
+        assert wait_until(
+            lambda: all(n.ledger.current_number() >= 1 for n in nodes),
+            timeout=60), [n.ledger.current_number() for n in nodes]
+        sealer_set = sorted(kp.pub_bytes for kp in keypairs)
+        h = nodes[0].ledger.header_by_number(1)
+        cert = qc.extract(h)
+        assert cert is not None and cert.mode == qc.MODE_AGGREGATE
+        assert len(cert.payload) == agg.G1_BYTES
+        assert qc.verify_spans([h], sealer_set, suite,
+                               agg_registry=registry)[0]
+        # the aggregate carriage beats even ONE loose ECDSA seal entry
+        assert qc.seal_wire_bytes(h) < 3 * (8 + 4 + suite.signature_size)
+    finally:
         stop_cluster(gateway, nodes)

@@ -1,0 +1,310 @@
+"""Quorum-certificate plane: wire codec, BLS aggregation primitives, and
+the adversarial matrix against `qc.verify_spans` — the ONE seal judge that
+sync, snapshot and the light client all ride.
+
+The matrix is the point: every forgery shape the certificate design claims
+to kill (rogue keys without proof of possession, sub-quorum bitmaps,
+bitmap/payload mismatches, tampered aggregates, stale sealer sets,
+sentinel-mixing ambiguity) must be REJECTED here, and the happy paths must
+cost exactly one `verify_batch` lane call per span.
+
+BLS pairing checks cost ~0.5 s each on the pure-Python BN254 substrate, so
+the aggregate fixtures are module-cached and the test count is budgeted.
+"""
+
+import numpy as np
+import pytest
+
+from fisco_bcos_tpu.consensus import qc
+from fisco_bcos_tpu.crypto import agg
+from fisco_bcos_tpu.crypto.suite import make_suite
+from fisco_bcos_tpu.protocol import BlockHeader
+
+
+class CountingSuite:
+    """Delegating wrapper counting batch lane entry points (the lightnode
+    test idiom) — the instrument behind the one-call-per-span contract."""
+
+    def __init__(self, suite):
+        self._suite = suite
+        self.verify_calls = 0
+        self.verify_sizes = []
+
+    def __getattr__(self, name):
+        return getattr(self._suite, name)
+
+    def verify_batch(self, digests, sigs, pubs):
+        self.verify_calls += 1
+        self.verify_sizes.append(len(digests))
+        return self._suite.verify_batch(digests, sigs, pubs)
+
+
+_CTX = None
+
+
+def ctx():
+    """Module-cached roster: 4 ECDSA sealers + their PoP-admitted BLS keys
+    (each admission is a pairing check, so build once)."""
+    global _CTX
+    if _CTX is None:
+        suite = make_suite(backend="host")
+        kps = [suite.generate_keypair(bytes([i + 1]) * 8) for i in range(4)]
+        kps.sort(key=lambda kp: kp.pub_bytes)
+        sealer_set = [kp.pub_bytes for kp in kps]
+        secrets = [agg.derive_secret(kp.secret.to_bytes(32, "big"))
+                   for kp in kps]
+        registry = agg.AggKeyRegistry.from_seeds(
+            [(kp.pub_bytes, kp.secret.to_bytes(32, "big")) for kp in kps])
+        _CTX = (suite, kps, sealer_set, secrets, registry)
+    return _CTX
+
+
+def make_header(number=1, sealer_set=None):
+    suite, _, roster, _, _ = ctx()
+    h = BlockHeader(number=number, sealer_list=list(sealer_set or roster))
+    return h, h.hash(suite)
+
+
+def seal_with(idxs, hh):
+    suite, kps, _, _, _ = ctx()
+    return [(i, suite.sign(kps[i], hh)) for i in idxs]
+
+
+def cert_header(idxs, tamper_seal=None, sealer_set=None):
+    """Header carrying a cert-mode certificate signed by `idxs`."""
+    h, hh = make_header(sealer_set=sealer_set)
+    seals = seal_with(idxs, hh)
+    if tamper_seal is not None:
+        i, s = seals[tamper_seal]
+        seals[tamper_seal] = (i, bytes([s[0] ^ 1]) + s[1:])
+    qc.attach(h, qc.mint_cert(seals, 4))
+    return h
+
+
+def agg_header(idxs, tamper=False):
+    """Header carrying an aggregate certificate signed by `idxs`."""
+    _, _, _, secrets, _ = ctx()
+    h, hh = make_header()
+    sig = agg.aggregate_sigs([agg.sign(secrets[i], hh) for i in idxs])
+    if tamper:
+        # a DIFFERENT valid curve point (hash output), not bit-flipped junk
+        sig = agg.g1_to_bytes(agg.hash_to_g1(b"tampered"))
+    qc.attach(h, qc.mint_aggregate(idxs, sig, 4))
+    return h
+
+
+def judge(headers, suite=None, registry=None, check_sealer_list=True):
+    s, _, roster, _, reg = ctx()
+    return qc.verify_spans(list(headers), roster, suite or s,
+                           agg_registry=registry if registry is not None
+                           else reg,
+                           check_sealer_list=check_sealer_list)
+
+
+# -- wire codec -------------------------------------------------------------
+
+def test_cert_wire_roundtrip():
+    cert = qc.mint_cert(seal_with([0, 2, 3], make_header()[1]), 4)
+    back = qc.QuorumCert.decode(cert.encode())
+    assert back == cert
+    assert back.signer_count() == 3
+
+
+def test_unknown_wire_version_and_mode_rejected():
+    raw = qc.QuorumCert(qc.MODE_CERT, b"\x07", b"x").encode()
+    with pytest.raises(qc.QCFormatError):
+        qc.QuorumCert.decode(bytes([qc.QC_WIRE_VERSION + 1]) + raw[1:])
+    with pytest.raises(qc.QCFormatError):
+        qc.QuorumCert.decode(raw[:1] + bytes([99]) + raw[2:])
+    with pytest.raises(qc.QCFormatError):
+        qc.QuorumCert.decode(raw + b"\x00")  # trailing bytes
+    with pytest.raises(qc.QCFormatError):
+        qc.QuorumCert.decode(raw[:3])  # truncated
+
+
+def test_bitmap_helpers():
+    bm = qc.bitmap_from_idxs([0, 3, 8], 9)
+    assert qc.idxs_from_bitmap(bm, 9) == [0, 3, 8]
+    assert qc.idxs_from_bitmap(bm, 4) is None          # wrong width
+    assert qc.idxs_from_bitmap(b"\xff", 4) is None     # claims idx >= n
+    with pytest.raises(ValueError):
+        qc.bitmap_from_idxs([4], 4)
+
+
+def test_extract_legacy_cert_and_mixed():
+    h, hh = make_header()
+    h.signature_list = seal_with([0, 1, 2], hh)
+    assert qc.extract(h) is None                       # legacy
+    cert = qc.mint_cert(seal_with([0, 1, 2], hh), 4)
+    qc.attach(h, cert)
+    assert qc.extract(h) == cert
+    h.signature_list.append((0, seal_with([0], hh)[0][1]))
+    with pytest.raises(qc.QCFormatError):              # sentinel + loose
+        qc.extract(h)
+
+
+# -- verify_spans: happy paths + one-lane-call pin --------------------------
+
+def test_mixed_span_one_lane_call():
+    """Legacy and cert headers, valid and forged, in ONE range span: the
+    whole judgment is exactly one verify_batch call."""
+    h_leg, hh = make_header()
+    h_leg.signature_list = seal_with([0, 1, 2], hh)
+    h_cert = cert_header([1, 2, 3])
+    h_sub = cert_header([0, 1])                        # sub-quorum bitmap
+    h_bad, hh2 = make_header()
+    h_bad.signature_list = seal_with([0, 1], hh2)      # legacy sub-quorum
+    h_forged = cert_header([0, 1, 2], tamper_seal=1)
+    counting = CountingSuite(ctx()[0])
+    ok = judge([h_leg, h_cert, h_sub, h_bad, h_forged], suite=counting)
+    assert list(ok) == [True, True, False, False, False]
+    assert counting.verify_calls == 1
+
+
+def test_cert_requires_every_claimed_signer():
+    """need = count for certs: 3 genuine seals + 1 forged under a 4-signer
+    bitmap is a forgery even though 3 >= quorum."""
+    assert not judge([cert_header([0, 1, 2, 3], tamper_seal=0)])[0]
+
+
+def test_aggregate_happy_and_tampered():
+    ok = judge([agg_header([0, 1, 2]), agg_header([1, 2, 3], tamper=True)])
+    assert list(ok) == [True, False]
+
+
+def test_seal_wire_bytes_ordering():
+    """The whole point of the plane: aggregate < cert < legacy multi-seal
+    on the wire, at the header encode() level every hop actually ships."""
+    h_multi, hh = make_header()
+    h_multi.signature_list = seal_with([0, 1, 2], hh)
+    sizes = [qc.seal_wire_bytes(h) for h in
+             (h_multi, cert_header([0, 1, 2]), agg_header([0, 1, 2]))]
+    assert sizes[2] < sizes[1] < sizes[0], sizes
+
+
+# -- adversarial matrix -----------------------------------------------------
+
+def test_sub_quorum_bitmap_rejected():
+    assert not judge([cert_header([0, 1])])[0]
+
+
+def test_duplicated_signer_mint_cannot_inflate_quorum():
+    """Duplicating a signer index at mint time collapses to one bitmap bit
+    with an oversized payload — structurally rejected, never double-counted
+    toward quorum."""
+    h, hh = make_header()
+    seals = seal_with([0, 0, 0, 1], hh)
+    qc.attach(h, qc.mint_cert(seals, 4))
+    assert qc.extract(h).signer_count() == 2
+    assert not judge([h])[0]
+
+
+def test_bitmap_claiming_foreign_signer_rejected():
+    h, hh = make_header()
+    cert = qc.mint_cert(seal_with([1, 2, 3], hh), 4)
+    cert.bitmap = b"\xff"  # claims 8 signers in a roster of 4
+    qc.attach(h, cert)
+    assert not judge([h])[0]
+
+
+def test_payload_size_mismatch_rejected():
+    h, hh = make_header()
+    cert = qc.mint_cert(seal_with([1, 2, 3], hh), 4)
+    cert.payload = cert.payload[:-1]
+    qc.attach(h, cert)
+    assert not judge([h])[0]
+
+
+def test_stale_sealer_set_cert_rejected():
+    """A certificate minted under yesterday's roster must not authenticate
+    against today's — admission is against the LOCAL sealer set."""
+    _, _, roster, _, _ = ctx()
+    h = cert_header([0, 1, 2], sealer_set=list(reversed(roster)))
+    assert not judge([h])[0]
+    # the light client configures its own roster and skips the header's
+    # sealer_list claim, but signatures still bind to local roster keys
+    assert judge([h], check_sealer_list=False)[0]
+
+
+def test_cert_blob_under_legacy_index_is_not_a_cert():
+    """A Byzantine peer re-flagging a cert blob as a legacy seal (index 0)
+    gets a header judged by legacy rules — one bad seal, no quorum, and
+    the blob is never parsed as a certificate."""
+    h, hh = make_header()
+    cert = qc.mint_cert(seal_with([0, 1, 2], hh), 4)
+    h.signature_list = [(0, cert.encode())]
+    counting = CountingSuite(ctx()[0])
+    assert not judge([h], suite=counting)[0]
+
+
+def test_sentinel_mixed_with_loose_seals_rejected():
+    """Quorum of genuine loose seals + a sentinel entry: the ambiguity is
+    refused outright, NOT salvaged by the legacy path."""
+    h, hh = make_header()
+    qc.attach(h, qc.mint_cert(seal_with([0, 1, 2], hh), 4))
+    h.signature_list = seal_with([0, 1, 2], hh) + h.signature_list
+    assert not judge([h])[0]
+
+
+def test_aggregate_without_registry_rejected():
+    s, _, roster, _, _ = ctx()
+    ok = qc.verify_spans([agg_header([0, 1, 2])], roster, s,
+                         agg_registry=None)
+    assert not ok[0]
+
+
+def test_unregistered_key_never_aggregates():
+    """Registry admission is the rogue-key gate: a signer the registry has
+    never PoP-admitted poisons the whole certificate."""
+    s, _, roster, _, _ = ctx()
+    partial = agg.AggKeyRegistry.from_seeds(
+        [(pk, sk.secret.to_bytes(32, "big"))
+         for pk, sk in zip(roster[:2], ctx()[1][:2])])
+    assert not qc.verify_spans([agg_header([0, 1, 2])], roster, s,
+                               agg_registry=partial)[0]
+
+
+# -- BLS primitives + rogue-key attack --------------------------------------
+
+def test_agg_sign_verify_roundtrip():
+    sk = agg.derive_secret(b"roundtrip")
+    pub = agg.pub_from_secret(sk)
+    sig = agg.sign(sk, b"\xab" * 32)
+    assert agg.verify(pub, b"\xab" * 32, sig)
+    assert not agg.verify(pub, b"\xcd" * 32, sig)
+
+
+def test_g2_from_bytes_rejects_junk():
+    with pytest.raises(ValueError):
+        agg.g2_from_bytes(b"\x01" * agg.G2_BYTES)      # not on curve
+    with pytest.raises(ValueError):
+        agg.g2_from_bytes(b"\x01" * 16)                # wrong length
+    with pytest.raises(ValueError):
+        agg.g1_from_bytes(b"\x02" * agg.G1_BYTES)
+
+
+def test_rogue_key_without_pop_cannot_register():
+    """The classic same-message rogue-key shape: X_evil = Y - X_target lets
+    an attacker forge an 'aggregate' for {target, evil} — but evil has no
+    known discrete log, so the attacker cannot produce a proof of
+    possession and the registry refuses the key."""
+    target_sk = agg.derive_secret(b"victim")
+    target_pub = agg.pub_from_secret(target_sk)
+    y_sk = agg.derive_secret(b"attacker")
+    x_evil = agg.g2_add(agg.pub_from_secret(y_sk),
+                        agg.g2_neg(target_pub))
+    reg = agg.AggKeyRegistry()
+    # attacker's best effort: a PoP signed with a secret it DOES know
+    forged_pop = agg.g1_to_bytes(
+        agg.g1_mul(agg.hash_to_g1(agg.g2_to_bytes(x_evil), agg.DST_POP),
+                   y_sk))
+    assert not reg.register(b"evil", agg.g2_to_bytes(x_evil), forged_pop)
+    assert len(reg) == 0
+    # while a genuine key with a genuine PoP is admitted
+    assert reg.register(b"honest", agg.g2_to_bytes(target_pub),
+                        agg.pop_prove(target_sk))
+
+
+def test_aggregate_sigs_rejects_malformed_point():
+    with pytest.raises(ValueError):
+        agg.aggregate_sigs([b"\x03" * agg.G1_BYTES])

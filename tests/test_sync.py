@@ -493,3 +493,102 @@ def test_range_batch_duplicate_height_cannot_ride_sibling_verdict():
     # the legit blocks alone still replay fine afterwards
     bs._apply_blocks(blocks)
     assert target.ledger.current_number() == 2
+
+
+# -- quorum-certificate blocks in range replay ------------------------------
+
+def _certify(block, n=1):
+    """Re-carry a sealed block's loose seals as a cert-mode QuorumCert —
+    exactly what a seal_mode=cert source ships (signature_list is outside
+    the header hash, so the header identity is untouched)."""
+    from fisco_bcos_tpu.consensus import qc
+    qc.attach(block.header, qc.mint_cert(
+        [(i, s) for i, s in block.header.signature_list], n))
+    return block
+
+
+def test_mixed_legacy_and_cert_range_replays_in_one_call():
+    """One range response holding legacy multi-seal blocks THEN cert-mode
+    blocks (a mid-chain seal_mode rollout) replays end-to-end, and the
+    whole mixed span still costs exactly ONE verify_batch call."""
+    src, blocks = build_source_chain(4)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    counting = _VerifyCountingSuite(src.suite)
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, counting)
+    blocks = blocks[:2] + [_certify(b) for b in blocks[2:]]
+    bs._apply_blocks(blocks)
+    assert target.ledger.current_number() == 4
+    assert counting.calls == 1, (
+        f"{counting.calls} verify_batch calls for a mixed 4-block response")
+
+
+def test_cert_block_with_stale_sealer_set_stops_replay(monkeypatch):
+    """Mid-span governance change under a cert rollout: once the live
+    sealer set diverges from the batch-time snapshot, a cert block must
+    re-verify per block against the LIVE set — and fail its sealer-set
+    admission (a certificate minted under a stale roster is dead)."""
+    src, blocks = build_source_chain(3)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    counting = _VerifyCountingSuite(src.suite)
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, counting)
+    blocks = [blocks[0]] + [_certify(b) for b in blocks[1:]]
+    real_set = bs._sealer_set
+    state = {"mutated": False}
+    monkeypatch.setattr(
+        bs, "_sealer_set",
+        lambda: [b"\xee" * 64] if state["mutated"] else real_set())
+    orig_commit = target.scheduler.commit_block
+
+    def commit_and_mutate(header):
+        ok = orig_commit(header)
+        if ok and header.number == 1:
+            state["mutated"] = True
+        return ok
+
+    monkeypatch.setattr(target.scheduler, "commit_block", commit_and_mutate)
+    bs._apply_blocks(blocks)
+    # block 1 rode the batch; cert block 2's fallback judges against the
+    # changed live set and rejects structurally (no extra lane call)
+    assert target.ledger.current_number() == 1
+    assert counting.calls == 1, counting.calls
+
+
+def test_byzantine_legacy_flagged_cert_blob_rejected():
+    """A Byzantine peer re-flags a cert blob under a legacy seal index:
+    the blob must never parse as a certificate, the header fails legacy
+    quorum, and nothing from the response commits."""
+    src, blocks = build_source_chain(2)
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, src.suite)
+    evil = _certify(blocks[0])
+    evil.header.signature_list = [(0, evil.header.signature_list[0][1])]
+    bs._apply_blocks([evil, blocks[1]])
+    assert target.ledger.current_number() == 0
+
+
+def test_aggregate_block_replays_through_sync():
+    """A seal_mode=aggregate block (64-byte BLS point) replays through the
+    range path when the target holds the PoP registry, and is refused when
+    it does not."""
+    from fisco_bcos_tpu.consensus import qc
+    from fisco_bcos_tpu.crypto import agg
+
+    src, blocks = build_source_chain(1)
+    seed = src.keypair.secret.to_bytes(32, "big")
+    registry = agg.AggKeyRegistry.from_seeds([(src.keypair.pub_bytes, seed)])
+    hh = blocks[0].header.hash(src.suite)
+    qc.attach(blocks[0].header,
+              qc.mint_aggregate([0], agg.sign(agg.derive_secret(seed), hh),
+                                1))
+    target = Node(NodeConfig(crypto_backend="host"), suite=src.suite)
+    target.build_genesis([ConsensusNode(src.keypair.pub_bytes)])
+    bare = BlockSync(StubFront(), target.ledger, target.scheduler, src.suite)
+    bare._apply_blocks(blocks)
+    assert target.ledger.current_number() == 0  # no registry -> refused
+    bs = BlockSync(StubFront(), target.ledger, target.scheduler, src.suite,
+                   agg_registry=registry)
+    bs._apply_blocks(blocks)
+    assert target.ledger.current_number() == 1

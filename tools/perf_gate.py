@@ -92,7 +92,16 @@ _SKIP = {"cpu_cores", "rpc_ingest_clients", "rpc_read_clients",
          # banding around zero is meaningless; workers_smoke asserts the
          # fallback/respawn contract directly)
          "exec_worker_pool_blocks", "exec_worker_fallbacks", "workers",
-         "pool_blocks", "exec_fallbacks"}
+         "pool_blocks", "exec_fallbacks",
+         # commit-seal carriage observability (--seal-bench /
+         # --trace-profile summary): these pool across seal_mode and
+         # roster size under one name, so a cert-mode run would gate
+         # against an aggregate-mode median (239 vs 95 bytes is config,
+         # not code). tests/test_qc.py pins the cert<multi<aggregate byte
+         # ordering deterministically; the gated consensus numbers are
+         # consensus_pre_ms / consensus_wait_ms on the summary row
+         "seal_bytes_per_block", "vs_multi", "span_verify_ms",
+         "sealers", "quorum"}
 
 
 def direction(metric: str):

@@ -107,6 +107,16 @@
 #                                   # converges to identical heads +
 #                                   # byte-identical c_balance with a
 #                                   # clean getAuditReport everywhere
+#   tools/sanitize_ci.sh --seals    # ONLY the quorum-certificate smoke:
+#                                   # 4 real TLS daemons with [consensus]
+#                                   # seal_mode = cert, RPC writes,
+#                                   # converged heads + clean audit on
+#                                   # every node, every committed header
+#                                   # carries ONE certificate whose wire
+#                                   # bytes undercut the same quorum as
+#                                   # 2f+1 loose seals, and the seal-bytes
+#                                   # gauge + cert-verify counters are
+#                                   # live on getSystemStatus.consensus
 #   tools/sanitize_ci.sh --groups   # ONLY the multi-group smoke: ONE
 #                                   # daemon hosting two groups ([groups]
 #                                   # ini), disjoint writes routed by the
@@ -627,6 +637,62 @@ if [ "${1:-}" = "--workers" ]; then
     python benchmark/chain_bench.py --columnar-compare -n 1000 \
     --backend host 2>/dev/null | grep '"metric": "columnar_tps"'
   echo "sanitize_ci: WORKERS STAGE CLEAN"
+  exit 0
+fi
+
+if [ "${1:-}" = "--seals" ]; then
+  echo "== [seals] quorum-certificate smoke: 4 TLS daemons in" \
+       "seal_mode=cert, converged heads, clean audit, ONE cert per" \
+       "block with fewer wire bytes than its own quorum as loose seals"
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" timeout -k 10 900 \
+    python - <<'EOF'
+import tempfile
+from fisco_bcos_tpu.executor import precompiled as pc
+from fisco_bcos_tpu.sdk.client import TransactionBuilder
+from fisco_bcos_tpu.testing.chaos import ChaosHarness
+
+out = tempfile.mkdtemp(prefix="seals-smoke-")
+with ChaosHarness(out, tls=True,
+                  config_overrides={"seal_mode": "cert"}) as h:
+    h.start_all()
+    for i in range(h.n):
+        h.wait_rpc_up(i)
+    suite = h.suite()
+    kp = suite.generate_keypair(b"seals-smoke")
+    builder = TransactionBuilder(suite, None, chain_id=h.info["chain_id"],
+                                 group_id=h.info["group_id"])
+    for s in range(8):
+        tx = builder.build(kp, pc.BALANCE_ADDRESS,
+                           pc.encode_call("register",
+                                          lambda w: w.blob(b"s%d" % s)
+                                          .u64(1)),
+                           nonce=f"s-{s}", block_limit=500)
+        h.client(s % h.n).send_transaction(tx, wait=False)
+    h.wait_until(lambda: min(h.total_txs(i) for i in range(h.n)) >= 8,
+                 timeout=240, what="commits in cert mode")
+    height = h.wait_converged(range(h.n), min_height=1, timeout=240)
+    ssz = suite.signature_size
+    ratios = []
+    for i in range(h.n):
+        rep = h.audit_report(i)
+        assert rep["ok"], (i, rep)
+        cons = h.client(i).request("getSystemStatus",
+                                   [h.info["group_id"], ""])["consensus"]
+        assert cons["sealMode"] == "cert", cons
+        signers, cert_bytes = (cons["sealSignersPerBlock"],
+                               cons["sealBytesPerBlock"])
+        assert signers >= 3 and cert_bytes > 0, cons
+        # the SAME quorum as legacy loose seals: i64 idx + blob frame +
+        # signature per entry, plus the list length word
+        loose = signers * (8 + 4 + ssz) + 8
+        assert cert_bytes < loose, (i, cert_bytes, loose)
+        ratios.append(round(cert_bytes / loose, 3))
+    gauge = [ln for ln in h.metrics_text(0).splitlines()
+             if ln.startswith("bcos_consensus_seal_bytes_per_block")]
+    assert gauge, "seal-bytes gauge missing from /metrics"
+    print(f"sanitize_ci: SEALS STAGE CLEAN (height={height}, "
+          f"cert_vs_loose={ratios})")
+EOF
   exit 0
 fi
 
