@@ -573,6 +573,32 @@ def main() -> None:
                   f"{type(exc).__name__}: {exc}"[:200],
                   file=sys.stderr, flush=True)
         try:
+            # supplementary: push-plane fan-out — WS newBlockHeaders
+            # subscribers fed from the commit-time fragment prime
+            # (rpc/eventsub.py, rpc/ws_server.py FanoutWriter).
+            # BENCH_SUBS_TIMEOUT=0 skips it.
+            rows, rc = _chain_bench_rows(
+                ["--subscribers", "200", "--sub-blocks", "10",
+                 "--backend", "host"],
+                "BENCH_SUBS_TIMEOUT", 300)
+            sb = next((row for row in rows
+                       if row.get("metric") == "sub_notify_p99_ms"), None)
+            if sb:
+                line["sub_notify_p99_ms"] = sb.get("value")
+                line["sub_notify_p50_ms"] = sb.get("notify_p50_ms")
+                line["sub_subscribers"] = sb.get("subscribers")
+                line["sub_events_per_sec"] = sb.get("events_per_sec")
+                line["sub_cpu_us_per_notify"] = sb.get("cpu_us_per_notify")
+            else:
+                print(f"[bench] sub bench produced no row (rc={rc})",
+                      file=sys.stderr, flush=True)
+        except _SkipStage:
+            pass  # explicit opt-out, stay quiet
+        except Exception as exc:
+            print(f"[bench] sub bench failed: "
+                  f"{type(exc).__name__}: {exc}"[:200],
+                  file=sys.stderr, flush=True)
+        try:
             # supplementary: multi-group sharding — G ledgers behind one
             # edge over the shared crypto lane (init/group.py,
             # crypto/lane.py), same-session interleaved 1-vs-G medians +

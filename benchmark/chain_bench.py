@@ -577,6 +577,222 @@ def run_rpc_read(sm: bool, backend: str, clients: int, n_requests: int,
     }
 
 
+class _WsFrameReader:
+    """Bench-local incremental parser for SERVER WebSocket frames (the
+    server never masks): feed raw socket bytes, yields text payloads.
+    The selector-driven subscriber harness needs this because the real
+    WsConnection reader is blocking — 10k blocking readers would need
+    10k client threads just to count notifications."""
+
+    def __init__(self):
+        self.buf = b""
+
+    def feed(self, data: bytes):
+        self.buf += data
+        out = []
+        while True:
+            b = self.buf
+            if len(b) < 2:
+                break
+            ln = b[1] & 0x7F
+            off = 2
+            if ln == 126:
+                if len(b) < 4:
+                    break
+                ln = int.from_bytes(b[2:4], "big")
+                off = 4
+            elif ln == 127:
+                if len(b) < 10:
+                    break
+                ln = int.from_bytes(b[2:10], "big")
+                off = 10
+            if len(b) < off + ln:
+                break
+            if b[0] & 0x0F == 0x1:  # text frame
+                out.append(b[off:off + ln])
+            self.buf = b[off + ln:]
+        return out
+
+
+def run_sub_bench(sm: bool, backend: str, subscribers: int,
+                  blocks: int = 12, txs_per_block: int = 50,
+                  compare: bool = False) -> list:
+    """Push-plane fan-out at subscriber scale: N WS subscribers on
+    `newBlockHeaders` (through the admission plane), then `blocks`
+    committed blocks. Measures commit->client-receipt notify latency
+    (server stamps each commit; a single selector reader stamps every
+    arriving frame), fan-out events/s, and the per-notification CPU
+    cost. With `compare`, adds the poll-vs-push A/B at equal information
+    freshness: what read QPS N pollers would need to learn each head
+    within the push plane's p99, against the node's measured polling
+    capacity."""
+    import selectors as _selectors
+    import threading
+
+    from fisco_bcos_tpu.init.node import Node, NodeConfig
+    from fisco_bcos_tpu.net.websocket import ws_connect
+    from fisco_bcos_tpu.protocol import Transaction
+    from fisco_bcos_tpu.sdk.client import SdkClient
+
+    node = Node(NodeConfig(consensus="solo", sm_crypto=sm,
+                           crypto_backend=backend, min_seal_time=0.05,
+                           tx_count_limit=txs_per_block, rpc_port=0,
+                           ws_port=0, sub_max_sessions=subscribers + 64))
+    node.build_genesis()
+    n_txs = blocks * txs_per_block
+    wire_txs = _build_workload(sm, n_txs, block_limit=min(
+        600, 2 * blocks + 50))
+    node.start()
+    conns = []
+    try:
+        print(f"sub-bench: connecting {subscribers} WS subscribers...",
+              file=sys.stderr, flush=True)
+        sel = _selectors.DefaultSelector()
+        for i in range(subscribers):
+            conn = ws_connect(node.ws.host, node.ws.port, timeout=30)
+            conn.send_text(json.dumps({
+                "jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                "params": ["newBlockHeaders"]}))
+            conns.append(conn)
+        # every subscribe answered (admission + hub registration done)
+        for conn in conns:
+            msg = conn.recv()
+            assert msg is not None, "subscribe dropped"
+            resp = json.loads(msg[1])
+            assert "result" in resp, f"subscribe rejected: {resp}"
+        for conn in conns:
+            conn.sock.setblocking(False)
+            rdr = _WsFrameReader()
+            rdr.buf = conn._rbuf  # bytes that rode in with the response
+            conn._rbuf = b""
+            sel.register(conn.sock, _selectors.EVENT_READ, rdr)
+
+        # stamp FIRST in the observer list: commit->client latency then
+        # honestly includes the cache prime and the hub fan-out cost
+        t_commit: dict = {}
+        node.scheduler.on_commit.insert(
+            0, lambda n: t_commit.setdefault(n, time.perf_counter()))
+
+        lats: list = []
+        received = [0]
+        done = threading.Event()
+
+        def reader():
+            while True:
+                events = sel.select(timeout=0.2)
+                now = time.perf_counter()
+                for key, _m in events:
+                    try:
+                        data = key.fileobj.recv(1 << 16)
+                    # spurious readiness — poll again
+                    except (BlockingIOError, InterruptedError):  # bcoslint: disable=swallowed-worker-exception
+                        continue
+                    except OSError:
+                        sel.unregister(key.fileobj)
+                        continue
+                    if not data:
+                        sel.unregister(key.fileobj)
+                        continue
+                    for payload in key.data.feed(data):
+                        try:
+                            num = json.loads(payload)["params"][
+                                "result"]["number"]
+                        except Exception:  # non-push frame  # bcoslint: disable=swallowed-worker-exception
+                            continue
+                        received[0] += 1
+                        t0 = t_commit.get(num)
+                        if t0 is not None:
+                            lats.append(now - t0)
+                if done.is_set() and not events:
+                    return
+
+        rt = threading.Thread(target=reader, daemon=True)
+        cpu0 = time.process_time()
+        wall0 = time.perf_counter()
+        rt.start()
+        for s in range(0, n_txs, 256):
+            node.txpool.submit_batch(
+                [Transaction.decode(raw) for raw in wire_txs[s:s + 256]])
+        deadline = time.monotonic() + max(120.0, n_txs / 10)
+        while time.monotonic() < deadline:
+            if node.ledger.total_tx_count() >= n_txs:
+                break
+            time.sleep(0.05)
+        head = node.ledger.current_number()
+        # every block 1..head fans out to every subscriber (the commit
+        # notifier is async — t_commit may still be filling here)
+        expect = subscribers * head
+        settle = time.monotonic() + 60
+        while time.monotonic() < settle and received[0] < expect:
+            time.sleep(0.05)
+        done.set()
+        rt.join(timeout=5)
+        wall = time.perf_counter() - wall0
+        cpu = time.process_time() - cpu0
+        lats.sort()
+
+        def pct(p):
+            return lats[min(len(lats) - 1, int(p * len(lats)))] \
+                if lats else 0.0
+
+        hub = node.subhub.stats()
+        drops = node.ws.push_drop_stats()
+        rows = [{
+            "metric": f"sub_notify_p99_ms{'_sm' if sm else ''}",
+            "unit": "ms", "value": round(pct(0.99) * 1000, 2),
+            "suite": "sm" if sm else "ecdsa",
+            "subscribers": subscribers,
+            "blocks": head, "events": received[0],
+            "events_expected": expect,
+            "events_per_sec": round(received[0] / wall, 1) if wall else 0.0,
+            "notify_p50_ms": round(pct(0.50) * 1000, 2),
+            "cpu_us_per_notify": round(cpu / max(received[0], 1) * 1e6, 2),
+            "outbox_drops": drops,
+            "hub_p99_ms": hub["notifyP99Ms"],  # commit-dequeue -> wire
+        }]
+        if compare:
+            # poll capacity: 8 keep-alive pollers, header-only getBlock,
+            # closed loop for a short window on the SAME primed node
+            url = f"http://{node.rpc.host}:{node.rpc.port}"
+            stop = time.monotonic() + 3.0
+            counts = [0] * 8
+
+            def poller(c):
+                sdk = SdkClient(url, keepalive=True)
+                while time.monotonic() < stop:
+                    sdk.get_block_by_number(head, only_header=True)
+                    counts[c] += 1
+
+            ths = [threading.Thread(target=poller, args=(c,), daemon=True)
+                   for c in range(8)]
+            p0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join(30)
+            poll_qps = sum(counts) / (time.perf_counter() - p0)
+            p99s = max(pct(0.99), 1e-4)
+            needed = subscribers / p99s  # each poller must poll ~1/p99
+            rows.append({
+                "metric": f"sub_poll_vs_push{'_sm' if sm else ''}",
+                "unit": "x",
+                "value": round(needed / max(poll_qps, 0.001), 1),
+                "suite": "sm" if sm else "ecdsa",
+                "subscribers": subscribers,
+                "poll_qps_capacity": round(poll_qps, 1),
+                "poll_qps_needed_for_p99_freshness": round(needed, 1),
+                "push_p99_ms": round(p99s * 1000, 2),
+            })
+        return rows
+    finally:
+        for conn in conns:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        node.stop()
+
+
 def run_sync_bench(sm: bool, n_blocks: int, txs_per_block: int = 10) -> list:
     """Join-time comparison on one source chain: replay vs snap-sync.
 
@@ -1007,6 +1223,13 @@ def _emit_read_mode(args, sm: bool) -> None:
             "recover_calls": res["recover_calls"],
             "cache_hit_rate": res["cache_hit_rate"],
         }), flush=True)
+
+
+def _emit_sub_mode(args, sm: bool) -> None:
+    for row in run_sub_bench(sm, args.backend, args.subscribers,
+                             blocks=args.sub_blocks,
+                             compare=args.sub_compare):
+        print(_dumps(row), flush=True)
 
 
 def run_trace_profile(sm: bool, backend: str, n_txs: int = 24,
@@ -2803,6 +3026,18 @@ def main() -> None:
     ap.add_argument("--read-compare", action="store_true",
                     help="with --read-clients: also run the per-request/"
                          "no-cache baseline (fresh connection, cache off)")
+    ap.add_argument("--subscribers", type=int, default=0, metavar="N",
+                    help="push-plane mode: N WS newBlockHeaders "
+                         "subscribers, commit-to-client notify p50/p99 "
+                         "and fan-out events/s")
+    ap.add_argument("--sub-blocks", type=int, default=12,
+                    help="with --subscribers: blocks committed while the "
+                         "subscribers listen")
+    ap.add_argument("--sub-compare", action="store_true",
+                    help="with --subscribers: also report the poll-vs-"
+                         "push A/B — read QPS N pollers would need for "
+                         "the push plane's p99 freshness vs measured "
+                         "polling capacity")
     ap.add_argument("--groups", type=int, default=0, metavar="G",
                     help="multi-group mode: G solo groups in one process "
                          "(shared crypto lane, per-group storage "
@@ -3008,6 +3243,10 @@ def main() -> None:
     if args.groups > 0:
         for sm in suites:
             _emit_groups_mode(args, sm)
+        return
+    if args.subscribers > 0:
+        for sm in suites:
+            _emit_sub_mode(args, sm)
         return
     if args.read_clients > 0:
         for sm in suites:

@@ -205,6 +205,12 @@ class NodeConfig:
     rpc_cache_entries: int = 4096  # 0 disables the query cache
     rpc_cache_mb: int = 64      # approximate rendered-bytes bound
     rpc_keepalive_s: float = 60.0  # idle keep-alive connection reap
+    # push-based subscription plane (rpc/eventsub.SubHub): distinct WS
+    # sessions allowed to hold subscriptions, and the per-session push
+    # outbox byte bound (beyond it, droppable frames evict oldest-first
+    # and a lossless overflow kills the session)
+    sub_max_sessions: int = 16384
+    sub_outbox_kb: int = 1024
     ws_port: Optional[int] = None  # None = no WS server; 0 = ephemeral
     metrics_port: Optional[int] = None  # None = no Prometheus endpoint
     # p2p transport (the reference's [p2p] listen_ip/listen_port +
@@ -418,6 +424,7 @@ class Node:
         self.rpc = None
         self.ws = None
         self.query_cache = None
+        self.subhub = None
         self.rpc_pool = None
         self.admission = None
         if cfg.rpc_port is not None or cfg.ws_port is not None:
@@ -453,7 +460,9 @@ class Node:
                 from ..rpc.ws_server import WsRpcServer
                 self.ws = WsRpcServer(impl, host=cfg.rpc_host,
                                       port=cfg.ws_port, pool=self.rpc_pool,
-                                      admission=self.admission)
+                                      admission=self.admission,
+                                      subhub=self.subhub,
+                                      outbox_kb=cfg.sub_outbox_kb)
         self.metrics = None
         if cfg.metrics_port is not None:
             from ..utils.metrics import MetricsServer
@@ -505,8 +514,21 @@ class Node:
             impl = JsonRpcImpl(self)  # reads query_cache: order matters
             self.scheduler.on_commit.append(impl.prime_block)
             self.scheduler.on_invalidate.append(self.query_cache.invalidate)
-            return impl
-        return JsonRpcImpl(self)
+        else:
+            impl = JsonRpcImpl(self)
+        if self.subhub is None:
+            # push-based subscription fan-out, bound to the FIRST impl
+            # (the one whose prime_block runs): on_commit is appended
+            # AFTER prime_block so the fan-out worker always finds the
+            # block's fragments already rendered in the query cache
+            from ..rpc.eventsub import SubHub
+            self.subhub = SubHub(self, impl,
+                                 max_sessions=cfg.sub_max_sessions,
+                                 registry=self.metrics_view)
+            self.scheduler.on_commit.append(self.subhub.on_commit)
+            self.scheduler.on_invalidate.append(self.subhub.on_invalidate)
+            self.txpool.register_broadcast_hook(self.subhub.on_pending)
+        return impl
 
     # -- aggregated operational state (getSystemStatus RPC + /status) ------
     def system_status(self) -> dict:
@@ -550,7 +572,16 @@ class Node:
             if self.overload is not None else None,
             "admission": self.admission.stats()
             if self.admission is not None else None,
+            "subscriptions": self._subscriptions_status(),
         }
+        return out
+
+    def _subscriptions_status(self) -> Optional[dict]:
+        if self.subhub is None:
+            return None
+        out = self.subhub.stats()
+        if self.ws is not None:
+            out["outboxDrops"] = self.ws.push_drop_stats()
         return out
 
     # -- genesis -----------------------------------------------------------
@@ -663,6 +694,8 @@ class Node:
             self.rpc.stop()
         if self.ws is not None:
             self.ws.stop()
+        if self.subhub is not None:
+            self.subhub.stop()  # after the WS edge: no new fan-outs
         if self.rpc_pool is not None:
             self.rpc_pool.stop()  # after the edges: no new submitters
         if self.ingest is not None:

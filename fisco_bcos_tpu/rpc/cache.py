@@ -41,6 +41,29 @@ from typing import Any, Hashable, Optional
 from ..utils.metrics import REGISTRY
 
 
+class RawResult(dict):
+    """A rendered JSON fragment that carries its own serialized bytes.
+
+    Still a dict (direct `impl` callers, the in-process SDK and the
+    proof-annotating copy path keep working unchanged), but transports
+    that know about it — the HTTP envelope writer and the WS push
+    fan-out — splice `.raw` into the response with a buffer join instead
+    of re-`dumps`-ing the dict on every hit. The bytes are encoded ONCE,
+    at render time (commit prime or first touch), which is the read-plane
+    lever PERF r08 named: cached hits stop paying serialization.
+
+    `.raw` is the compact-separator encoding of the dict at construction
+    time; callers must never mutate a RawResult afterwards (the cache
+    already demands frozen values — annotate a plain `dict(out)` copy)."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, obj: dict, raw: Optional[bytes] = None):
+        super().__init__(obj)
+        self.raw = raw if raw is not None else json.dumps(
+            obj, separators=(",", ":"), default=str).encode()
+
+
 class QueryCache:
     def __init__(self, max_entries: int = 4096,
                  max_bytes: int = 64 << 20, registry=None):
@@ -89,14 +112,22 @@ class QueryCache:
         self._reg.inc("bcos_rpc_cache_hits_total")
         return item[0]
 
-    def put(self, key: Hashable, value: Any, gen: int) -> None:
+    def put(self, key: Hashable, value: Any, gen: int,
+            size: Optional[int] = None) -> None:
         # size ONCE at render time (renders are per-commit / first-touch,
-        # hits are free) — the JSON length is the honest footprint proxy
-        try:
-            size = len(json.dumps(value, separators=(",", ":"),
-                                  default=str))
-        except (TypeError, ValueError):
-            size = 1024
+        # hits are free) — the JSON length is the honest footprint proxy.
+        # RawResult values already carry their encoding; callers that
+        # hold the bytes pass `size=` so the sizing dumps is never paid.
+        if size is None:
+            raw = getattr(value, "raw", None)
+            if raw is not None:
+                size = len(raw)
+        if size is None:
+            try:
+                size = len(json.dumps(value, separators=(",", ":"),
+                                      default=str))
+            except (TypeError, ValueError):
+                size = 1024
         with self._lock:
             if gen != self._gen:
                 return  # render raced an invalidation: stale data, drop

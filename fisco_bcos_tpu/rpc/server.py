@@ -34,6 +34,7 @@ from typing import Optional
 from ..protocol import Block, BlockHeader, Receipt, Transaction
 from ..utils import otrace
 from ..utils.log import LOG, badge
+from .cache import RawResult
 from .edge import EventLoopHttpServer, WorkerPool
 
 JSONRPC_PARSE_ERROR = -32700
@@ -129,6 +130,36 @@ class JsonRpcError(Exception):
         super().__init__(message)
         self.code = code
         self.message = message
+
+
+# -- serialized-fragment envelope splice ------------------------------------
+# Cached fragments (RawResult) carry the bytes their render already paid
+# for; the envelope writer joins buffers around them instead of walking
+# the whole dict through json.dumps again on every hit. The head matches
+# what `json.dumps` produces for handle()'s literal response dict, so
+# spliced and non-spliced envelopes look alike on the wire.
+_SPLICE_HEAD = b'{"jsonrpc": "2.0", "id": '
+
+
+def _encode_one(resp) -> bytes:
+    if isinstance(resp, dict) and len(resp) == 3 and "error" not in resp:
+        raw = getattr(resp.get("result"), "raw", None)
+        if raw is not None:
+            return (_SPLICE_HEAD + json.dumps(resp.get("id")).encode()
+                    + b', "result": ' + raw + b"}")
+    return json.dumps(resp).encode()
+
+
+def encode_jsonrpc(resp) -> bytes:
+    """JSON-RPC response (dict / batch list / None) -> body bytes, with
+    cached RawResult fragments spliced in by buffer join. Both transports
+    (HTTP edge handler, WS dispatch) render through here — a cached read
+    hit performs ZERO `json.dumps` of the fragment."""
+    if resp is None:
+        return b""
+    if isinstance(resp, list):
+        return b"[" + b", ".join(_encode_one(r) for r in resp) + b"]"
+    return _encode_one(resp)
 
 
 def handle_payload_with(impl, payload, max_batch: int = 256):
@@ -437,9 +468,9 @@ class JsonRpcImpl:
         tx = self.node.ledger.transaction(h)
         if tx is None:
             return None
-        out = _tx_json(tx, h, sender=tx.sender(self.node.suite))
+        out = RawResult(_tx_json(tx, h, sender=tx.sender(self.node.suite)))
         if cache is not None:
-            cache.put(("tx", h), out, gen)
+            cache.put(("tx", h), out, gen, size=len(out.raw))
         return out
 
     def get_transaction_receipt(self, group: str, node_name: str = "",
@@ -466,9 +497,9 @@ class JsonRpcImpl:
         rc = self.node.ledger.receipt(h)
         if rc is None:
             return None
-        out = _receipt_json(rc, h)
+        out = RawResult(_receipt_json(rc, h))
         if cache is not None:
-            cache.put(("rc", h), out, gen)
+            cache.put(("rc", h), out, gen, size=len(out.raw))
         return out
 
     def get_block_by_number(self, group: str, node_name: str = "",
@@ -487,7 +518,8 @@ class JsonRpcImpl:
             number, with_txs=not only_header), only_header, only_tx_hash,
             gen=gen)
         if cache is not None and out is not None:
-            cache.put(key, out, gen)
+            out = RawResult(out)  # encode once; hits splice the bytes
+            cache.put(key, out, gen, size=len(out.raw))
         return out
 
     def get_block_by_hash(self, group: str, node_name: str = "",
@@ -535,7 +567,9 @@ class JsonRpcImpl:
         senders, _ = batch_recover_senders(block.transactions,
                                            self.node.suite)
         if cache is not None and gen is not None:
-            cache.put(("senders", n), senders, gen)
+            # bytes rows are not JSON: size them directly (no dumps)
+            cache.put(("senders", n), senders, gen,
+                      size=sum(len(s) if s else 1 for s in senders) + 48)
         return senders
 
     # -- commit-time cache priming (Scheduler.on_commit observer) ----------
@@ -543,7 +577,11 @@ class JsonRpcImpl:
         """Render the just-committed block's hot responses once, off the
         consensus path (runs on the scheduler's notifier thread): block
         JSON with txs / tx-hash-only / header-only, per-tx transaction +
-        receipt JSON, and the recovered-senders row."""
+        receipt JSON, per-log push fragments, and the recovered-senders
+        row. Every fragment is a RawResult — its bytes are encoded HERE,
+        exactly once; polled hits splice them (encode_jsonrpc) and the
+        subscription fan-out (rpc/eventsub.SubHub) pushes the same bytes,
+        so a notification costs zero extra render."""
         cache = self.cache
         if cache is None:
             return
@@ -561,19 +599,45 @@ class JsonRpcImpl:
                             {}).get(number)
             if stash is not None and len(stash) == len(block.transactions):
                 block.transactions = list(stash)
-            full = self._block_json(block, False, False, gen=gen)
-            cache.put(("block", number, False, False), full, gen)
-            cache.put(("block", number, False, True),
-                      self._block_json(block, False, True), gen)
-            cache.put(("block", number, True, False),
-                      self._block_json(block, True, False), gen)
+            full = RawResult(self._block_json(block, False, False, gen=gen))
+            cache.put(("block", number, False, False), full, gen,
+                      size=len(full.raw))
+            hashes_only = RawResult(self._block_json(block, False, True))
+            cache.put(("block", number, False, True), hashes_only, gen,
+                      size=len(hashes_only.raw))
+            header = RawResult(self._block_json(block, True, False))
+            cache.put(("block", number, True, False), header, gen,
+                      size=len(header.raw))
             suite = self.node.suite
             for tx, tj in zip(block.transactions, full["transactions"]):
                 h = tx.hash(suite)
-                cache.put(("tx", h), tj, gen)
-            for rc, tx in zip(block.receipts, block.transactions):
+                rtj = RawResult(tj)
+                cache.put(("tx", h), rtj, gen, size=len(rtj.raw))
+            # receipts + the per-log push fragments: the logs row carries
+            # (LogEntry, rendered bytes) pairs so the subscription fan-out
+            # does filter matching + buffer joins only — no dumps, no
+            # ledger reads on the hot path
+            log_rows: list[tuple] = []
+            log_bytes = 0
+            for ti, (rc, tx) in enumerate(zip(block.receipts,
+                                              block.transactions)):
                 h = tx.hash(suite)
-                cache.put(("rc", h), _receipt_json(rc, h), gen)
+                rrc = RawResult(_receipt_json(rc, h))
+                cache.put(("rc", h), rrc, gen, size=len(rrc.raw))
+                for idx, log in enumerate(rc.logs):
+                    frag = RawResult({
+                        "address": _hex(log.address),
+                        "topics": [_hex(t) for t in log.topics],
+                        "data": _hex(log.data),
+                        "blockNumber": number,
+                        "transactionHash": _hex(h),
+                        "transactionIndex": ti,
+                        "logIndex": idx,
+                    })
+                    log_rows.append((log, frag.raw))
+                    log_bytes += len(frag.raw)
+            cache.put(("logs", number), log_rows, gen,
+                      size=log_bytes + 64)
             # ZK proof plane: render every tx's getProof bundle (both
             # trees' levels built once) so proof hits cost zero walks
             zk = getattr(self.node, "zk", None)
@@ -880,7 +944,7 @@ def http_body_handler(impl, max_batch: int = 256):
                 resp = handle_payload_with(impl, payload, max_batch)
             if resp is None:
                 return b""  # notification-only payload: nothing to send
-        body = json.dumps(resp).encode()
+        body = encode_jsonrpc(resp)
         if ctx is not None:
             return body, {"traceparent": ctx.traceparent()}
         return body

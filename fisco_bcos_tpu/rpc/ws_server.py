@@ -13,6 +13,8 @@ Message protocol (JSON text frames):
     only methods:
       subscribeEvent   [group, {fromBlock,toBlock,addresses,topics}] -> task
       unsubscribeEvent [group, taskId]
+      subscribe        [kind, options?] -> subId   (push plane: SubHub)
+      unsubscribe      [subId]
       subscribeTopic   [topic, ...]        (AMOP; this session serves them)
       unsubscribeTopic [topic, ...]
       publishTopic     [topic, hexData]    -> responder's hex reply
@@ -20,25 +22,232 @@ Message protocol (JSON text frames):
   * Server pushes (no id):
       {"type": "eventPush", "taskId", "blockNumber", "txHash", "logIndex",
        "log": {address, topics, data}}
+      {"jsonrpc": "2.0", "method": "subscription",
+       "params": {"subscription": subId, "kind", "result": fragment}}
       {"type": "amopPush", "seq", "topic", "data": hex}
   * Client reply to an amopPush (the publish round trip):
       {"type": "amopResp", "seq", "data": hex}
+
+Delivery substrate: every server push rides the bounded per-session
+outbox (droppable/lossless classes, O(1) eviction — the PR-13
+blocking-while-locked fix). At subscriber scale the per-session writer
+threads are replaced by ONE selectors-based `FanoutWriter`: non-blocking
+`MSG_DONTWAIT` sends, `EVENT_WRITE` parking on full TCP windows, so 10k
+subscribers cost 0 extra threads on the push side and one stuck window
+never delays another session's drain.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import selectors
+import socket
 import threading
+import time
 from collections import deque
 from typing import Optional
 
 from ..net.websocket import OP_TEXT, WsConnection, WsServer
-from ..rpc.eventsub import EventFilter
+from ..rpc.eventsub import (EventFilter, JSONRPC_SUB_LIMIT, SUB_KINDS,
+                            SubLimitError)
 from ..utils.log import LOG, badge
-from .server import JsonRpcImpl, JsonRpcError, JSONRPC_INVALID_PARAMS
+from .server import (JsonRpcImpl, JsonRpcError, JSONRPC_INVALID_PARAMS,
+                     encode_jsonrpc)
 
 _AMOP_REPLY_TIMEOUT = 5.0
+
+
+def _parse_event_filter(f: dict) -> EventFilter:
+    """{fromBlock,toBlock,addresses,topics} wire dict -> EventFilter
+    (shared by subscribeEvent and the push plane's logs options)."""
+    addresses = ({bytes.fromhex(a.removeprefix("0x"))
+                  for a in f["addresses"]}
+                 if f.get("addresses") else None)
+    topics = [None if t is None
+              else {bytes.fromhex(x.removeprefix("0x")) for x in t}
+              for t in f.get("topics", [])]
+    return EventFilter(from_block=int(f.get("fromBlock", 0)),
+                       to_block=int(f.get("toBlock", -1)),
+                       addresses=addresses, topics=topics)
+
+
+class FanoutWriter:
+    """ONE selectors-based writer thread draining every session's push
+    outbox: `sock.send(..., MSG_DONTWAIT)` under a non-blocking grab of
+    the connection's `_wlock`; a full TCP window parks THAT socket on
+    `EVENT_WRITE` (partial frame kept in `sess._wip`) while every other
+    session keeps draining. Replaces the thread-per-session push writers
+    so 10k subscribers cost zero extra threads on the push side.
+
+    Lock order: conn._wlock -> sess._push_cv (same as _Session.send_now);
+    `push()` takes only the cv, so enqueue never waits on a socket."""
+
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+        self._lock = threading.Lock()
+        self._pending: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        # by-class drop accounting for getSystemStatus (the unlabeled
+        # bcos_ws_push_dropped_total counter is kept by _Session.push)
+        self.drops = {"droppable": 0, "lossless_kill": 0}
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stopped = False
+            self._thread = threading.Thread(target=self._loop,
+                                            name="ws-fanout", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._wakeup()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _wakeup(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    def kick(self, sess) -> None:
+        """A session's outbox went (or may have gone) non-empty."""
+        with self._lock:
+            if sess in self._pending:
+                return  # already queued for service: no wake needed
+            self._pending.add(sess)
+        self._wakeup()
+
+    def forget(self, sess) -> None:
+        with self._lock:
+            self._pending.discard(sess)
+
+    # -- writer loop -------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stopped:
+            with self._lock:
+                busy = bool(self._pending)
+            try:
+                # short poll while wlock-contended sessions wait for a
+                # retry; long poll when everything is drained or parked
+                events = self._sel.select(timeout=0.002 if busy else 0.5)
+            except OSError:
+                events = []
+            for key, _mask in events:
+                if key.data is None:  # wake pipe
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    # wake-pipe drained dry (EAGAIN) — the expected exit
+                    except (BlockingIOError, OSError):  # bcoslint: disable=swallowed-worker-exception
+                        pass
+                    continue
+                try:  # writable again: back into the service batch
+                    self._sel.unregister(key.fileobj)
+                # raced _kill/forget: already unregistered or closed
+                except (KeyError, ValueError, OSError):  # bcoslint: disable=swallowed-worker-exception
+                    pass
+                with self._lock:
+                    self._pending.add(key.data)
+            if self._stopped:
+                return
+            with self._lock:
+                batch, self._pending = self._pending, set()
+            retry = set()
+            for sess in batch:
+                try:
+                    state = self._service(sess)
+                except Exception:  # noqa: BLE001 — one session never
+                    self._kill(sess)  # takes down the whole fan-out
+                    state = "idle"
+                if state == "retry":
+                    retry.add(sess)
+            if retry:
+                with self._lock:
+                    self._pending |= retry
+
+    def _service(self, sess) -> str:
+        """Drain one session as far as the socket allows. -> 'idle'
+        (nothing left), 'retry' (_wlock contended — a send_now response
+        is in flight), 'wait' (TCP window full: parked on EVENT_WRITE)."""
+        conn = sess.conn
+        wl = getattr(conn, "_wlock", None)
+        if wl is None:  # fake/legacy conn: writer-less sessions drain
+            return "idle"  # via their own thread, never land here
+        if not wl.acquire(blocking=False):
+            return "retry"
+        try:
+            while True:
+                if sess._push_dead:
+                    return "idle"
+                wip = sess._wip
+                if wip is not None:
+                    try:
+                        n = conn.sock.send(wip, socket.MSG_DONTWAIT)
+                    except (BlockingIOError, InterruptedError):
+                        return self._wait_writable(sess)
+                    except OSError:
+                        self._kill(sess)
+                        return "idle"
+                    if n < len(wip):
+                        sess._wip = wip[n:]
+                        return self._wait_writable(sess)
+                    t0 = sess._wip_t0
+                    sess._wip = None
+                    sess._wip_t0 = None
+                    if t0 is not None and sess.latency_cb is not None:
+                        try:  # commit-dequeue -> last byte accepted
+                            sess.latency_cb(time.perf_counter() - t0)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    continue
+                with sess._push_cv:
+                    cell = None
+                    while sess._outbox:
+                        c = sess._outbox.popleft()
+                        if c[2]:
+                            continue  # evicted while queued
+                        c[2] = True  # consumed: eviction must skip it
+                        sess._live -= 1
+                        sess._bytes -= len(c[0])
+                        cell = c
+                        break
+                    if cell is None:
+                        return "idle"
+                payload = cell[0]
+                if not isinstance(payload, bytes):
+                    payload = payload.encode()
+                sess._wip = memoryview(conn._frame(OP_TEXT, payload))
+                sess._wip_t0 = cell[3]
+        finally:
+            wl.release()
+
+    def _wait_writable(self, sess) -> str:
+        try:
+            self._sel.register(sess.conn.sock, selectors.EVENT_WRITE, sess)
+        except KeyError:
+            pass  # already registered
+        except Exception:  # noqa: BLE001 — closed/bogus fd
+            self._kill(sess)
+        return "wait"
+
+    def _kill(self, sess) -> None:
+        sess.close_push()
+        try:
+            self._sel.unregister(sess.conn.sock)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            sess.conn.sock.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class _Session:
@@ -58,25 +267,37 @@ class _Session:
 
     MAX_OUTBOX = 4096  # queued push frames per session
 
-    def __init__(self, conn: WsConnection):
+    def __init__(self, conn: WsConnection, writer: Optional[FanoutWriter]
+                 = None, outbox_bytes: int = 1 << 20):
         self.conn = conn
+        # shared fan-out writer (one thread for all sessions). None keeps
+        # the per-session lazy writer thread — tests and embedded use.
+        self.fanout = writer
+        self.outbox_bytes = max(1, int(outbox_bytes))
         self.event_tasks: set[str] = set()
+        self.sub_ids: set[str] = set()  # push-plane (SubHub) streams
         self.topics: set[str] = set()
         self.pending: dict[int, tuple[threading.Event, list]] = {}
-        # outbox entries are shared mutable [text, lossless, dead] cells
-        # held by BOTH deques (the p2p _Session lazy-deletion discipline):
-        # eviction marks a cell dead in O(1) and the writer skips it, so
-        # overflow handling never does deque surgery under the cv on the
-        # commit-notifier thread. _live counts cells not yet consumed or
-        # evicted (len(_outbox) would overcount dead cells).
+        # outbox entries are shared mutable [payload, lossless, dead, t0]
+        # cells held by BOTH deques (the p2p _Session lazy-deletion
+        # discipline): eviction marks a cell dead in O(1) and the writer
+        # skips it, so overflow handling never does deque surgery under
+        # the cv on the commit-notifier thread. _live counts cells not
+        # yet consumed or evicted (len(_outbox) would overcount dead
+        # cells); _bytes bounds queued payload bytes ([rpc] sub_outbox_kb).
         self._outbox: "deque[list]" = deque()
         self._droppable: "deque[list]" = deque()  # live-push cells only
         self._live = 0
+        self._bytes = 0
         self._push_cv = threading.Condition()
         self._push_dead = False
         self._writer: Optional[threading.Thread] = None
+        # FanoutWriter partial-frame state (guarded by conn._wlock)
+        self._wip: Optional[memoryview] = None
+        self._wip_t0: Optional[float] = None
+        self.latency_cb = None  # SubHub.note_latency when subs exist
 
-    def send_now(self, obj: dict) -> bool:
+    def send_now(self, obj) -> bool:
         """SYNCHRONOUS, lossless send — JSON-RPC responses and AMOP
         round-trip frames. These are admitted work a client is waiting
         on: they must never ride the drop-oldest outbox (a dropped
@@ -84,82 +305,160 @@ class _Session:
         immediate False on a dead socket is what lets the AMOP publisher
         fail over to the next responder instead of burning its 5 s
         timeout. Callers run on worker-pool/dispatch threads (bounded),
-        exactly as before the outbox existed."""
+        exactly as before the outbox existed.
+
+        Encodes via `encode_jsonrpc`: a RawResult result splices its
+        cached fragment bytes (buffer join) instead of re-dumps-ing.
+        When the session has a push backlog or a partial frame in flight
+        the response is ENQUEUED lossless instead (checked under
+        conn._wlock then _push_cv — the FanoutWriter's lock order), so
+        frames never interleave and ordering against queued pushes
+        holds."""
+        payload = encode_jsonrpc(obj)
+        conn = self.conn
+        wl = getattr(conn, "_wlock", None)
+        frame = getattr(conn, "_frame", None)
+        if wl is None or frame is None:
+            # fake/legacy conns (tests): the old direct path
+            try:
+                conn.send_text(payload.decode())
+                return True
+            except Exception:
+                return False
+        kill = False
+        enqueued = False
         try:
-            self.conn.send_text(json.dumps(obj))
+            with wl:
+                with self._push_cv:
+                    if self._push_dead:
+                        return False
+                    if self._live > 0 or self._wip is not None:
+                        # backlogged: ride the outbox (lossless — a
+                        # response must never be gapped) to keep frame
+                        # atomicity against the fan-out writer
+                        _, kill = self._enqueue_locked(payload, True, None)
+                        enqueued = not kill
+                        if enqueued:
+                            self._push_cv.notify()
+                if kill:
+                    self._die()
+                    return False
+                if not enqueued:
+                    if getattr(conn, "_closed", False):
+                        return False
+                    conn.sock.sendall(frame(OP_TEXT, payload))
+            if enqueued and self.fanout is not None:
+                self.fanout.kick(self)
             return True
         except Exception:
             return False
 
-    def push(self, obj: dict, lossless: bool = False) -> bool:
-        """Queue a server push. Never blocks on the subscriber's socket —
+    def push(self, obj, lossless: bool = False, t0=None) -> bool:
+        """Queue a server push (dict, or pre-rendered frame bytes from
+        the SubHub fan-out). Never blocks on the subscriber's socket —
         event pushes are emitted on the scheduler's commit-NOTIFIER
         thread under the eventsub task lock, the blocking-while-locked
         finding this outbox exists to fix.
 
-        LIVE pushes (default) are best-effort: overflow drops the OLDEST
-        droppable frame (a reader this far behind has already lost the
-        stream; counted in bcos_ws_push_dropped_total). `lossless=True`
-        marks frames that carry a contract — the subscribeEvent history
-        replay a client EXPLICITLY requested — which are never silently
-        gapped: if overflow finds nothing droppable (the whole backlog
-        is lossless), the session is closed instead, so the client sees
-        a disconnect it can retry rather than an invisible hole in the
-        range it asked for. One FIFO queue keeps replay/live ordering.
+        LIVE pushes (default) are best-effort: overflow (frame count OR
+        queued bytes) drops the OLDEST droppable frame (a reader this
+        far behind has already lost the stream; counted in
+        bcos_ws_push_dropped_total). `lossless=True` marks frames that
+        carry a contract — the subscribeEvent history replay a client
+        EXPLICITLY requested, per-hash receipt completions, queued RPC
+        responses — which are never silently gapped: if overflow finds
+        nothing droppable, the session is closed instead, so the client
+        sees a disconnect it can retry rather than an invisible hole.
+        One FIFO queue keeps replay/live/response ordering. `t0` is the
+        commit-dequeue stamp the writer turns into notify latency.
         Returns False once the session is dead."""
-        text = json.dumps(obj)
-        dropped = 0
-        kill = False
+        payload = obj if isinstance(obj, (bytes, bytearray)) \
+            else json.dumps(obj)
         with self._push_cv:
             if self._push_dead:
                 return False
-            if self._writer is None:  # lazy: request-only sessions never
-                self._writer = threading.Thread(  # pay a thread
-                    target=self._push_loop, name="ws-push", daemon=True)
+            if self.fanout is None and self._writer is None:
+                self._writer = threading.Thread(  # lazy: request-only
+                    target=self._push_loop, name="ws-push",  # sessions
+                    daemon=True)  # never pay a thread
                 self._writer.start()
-            # drain dead heads (consumed/evicted cells) — amortized O(1)
-            while self._droppable and self._droppable[0][2]:
-                self._droppable.popleft()
-            if self._live >= self.MAX_OUTBOX:
-                if self._droppable:
-                    cell = self._droppable.popleft()
-                    cell[2] = True  # writer skips it; O(1), no surgery
-                    cell[0] = ""
-                    self._live -= 1
-                    dropped = 1
-                else:
-                    kill = True  # a client too slow for its own replay
+            dropped, kill = self._enqueue_locked(payload, lossless, t0)
             if not kill:
-                cell = [text, lossless, False]
-                self._outbox.append(cell)
-                if not lossless:
-                    self._droppable.append(cell)
-                self._live += 1
                 self._push_cv.notify()
-            else:
+        if dropped:  # metrics outside the cv: REGISTRY has its own lock
+            from ..utils.metrics import REGISTRY
+            REGISTRY.inc("bcos_ws_push_dropped_total", dropped)
+            REGISTRY.inc("bcos_sub_outbox_drop_total", dropped,
+                         labels={"class": "droppable"})
+            if self.fanout is not None:
+                self.fanout.drops["droppable"] += dropped
+        if kill:
+            self._die()
+            return False
+        if self.fanout is not None:
+            self.fanout.kick(self)
+        return True
+
+    def _enqueue_locked(self, payload, lossless: bool, t0):
+        """_push_cv held. Applies the overflow policy and enqueues.
+        -> (dropped_count, kill)."""
+        size = len(payload)
+        if not lossless and size > self.outbox_bytes:
+            # a single droppable frame larger than the whole outbox can
+            # never fit: shed IT, don't kill the session
+            return 1, False
+        # drain dead heads (consumed/evicted cells) — amortized O(1)
+        while self._droppable and self._droppable[0][2]:
+            self._droppable.popleft()
+        dropped = 0
+        while (self._live >= self.MAX_OUTBOX
+               or self._bytes + size > self.outbox_bytes):
+            if not self._droppable:
+                # nothing droppable left: a client too slow for frames
+                # it was promised
                 self._push_dead = True
                 self._outbox.clear()
                 self._droppable.clear()
                 self._live = 0
+                self._bytes = 0
                 self._push_cv.notify_all()
-        if dropped:  # metrics outside the cv: REGISTRY has its own lock
-            from ..utils.metrics import REGISTRY
-            REGISTRY.inc("bcos_ws_push_dropped_total", dropped)
-        if kill:
-            LOG.warning(badge("WSRPC", "push-backlog-overflow",
-                              peer=self.conn.peer))
-            try:
-                # RAW socket close, NOT the graceful CLOSE-frame handshake:
-                # conn.close() sends a frame under _wlock, which the parked
-                # writer may hold — a blocking close here would put the
-                # commit-notifier thread right back in the stall this
-                # outbox exists to prevent. The reader thread sees EOF and
-                # drives _on_close cleanup.
-                self.conn.sock.close()
-            except Exception:
-                pass
-            return False
-        return True
+                return dropped, True
+            cell = self._droppable.popleft()
+            if cell[2]:
+                continue
+            cell[2] = True  # writer skips it; O(1), no surgery
+            self._bytes -= len(cell[0])
+            cell[0] = b""
+            self._live -= 1
+            dropped += 1
+        cell = [payload, lossless, False, t0]
+        self._outbox.append(cell)
+        if not lossless:
+            self._droppable.append(cell)
+        self._live += 1
+        self._bytes += size
+        return dropped, False
+
+    def _die(self) -> None:
+        """Lossless overflow: kill the session so the client sees a
+        disconnect it can retry rather than a silent gap."""
+        from ..utils.metrics import REGISTRY
+        REGISTRY.inc("bcos_sub_outbox_drop_total",
+                     labels={"class": "lossless_kill"})
+        if self.fanout is not None:
+            self.fanout.drops["lossless_kill"] += 1
+        LOG.warning(badge("WSRPC", "push-backlog-overflow",
+                          peer=self.conn.peer))
+        try:
+            # RAW socket close, NOT the graceful CLOSE-frame handshake:
+            # conn.close() sends a frame under _wlock, which the parked
+            # writer may hold — a blocking close here would put the
+            # commit-notifier thread right back in the stall this
+            # outbox exists to prevent. The reader thread sees EOF and
+            # drives _on_close cleanup.
+            self.conn.sock.close()
+        except Exception:
+            pass
 
     def _push_loop(self) -> None:
         while True:
@@ -172,16 +471,20 @@ class _Session:
                 if cell[2]:
                     continue  # evicted while queued: nothing to send
                 cell[2] = True  # consumed: eviction must skip it now
-                text = cell[0]
+                payload = cell[0]
                 self._live -= 1
+                self._bytes -= len(payload)
             try:
-                self.conn.send_text(text)
+                self.conn.send_text(
+                    payload.decode() if isinstance(payload, bytes)
+                    else payload)
             except Exception:
                 with self._push_cv:
                     self._push_dead = True
                     self._outbox.clear()
                     self._droppable.clear()
                     self._live = 0
+                    self._bytes = 0
                 return
 
     def close_push(self) -> None:
@@ -190,6 +493,7 @@ class _Session:
             self._outbox.clear()
             self._droppable.clear()
             self._live = 0
+            self._bytes = 0
             self._push_cv.notify_all()
 
 
@@ -201,9 +505,16 @@ class WsRpcServer:
     group in multi-group mode)."""
 
     def __init__(self, impl: JsonRpcImpl, host: str = "127.0.0.1",
-                 port: int = 0, pool=None, admission=None):
+                 port: int = 0, pool=None, admission=None, subhub=None,
+                 outbox_kb: int = 1024):
         self.impl = impl
         self.node = impl.node
+        # push-based subscription plane (rpc/eventsub.SubHub): commit-time
+        # fan-out of primed fragment bytes; None = plane disabled
+        self.subhub = subhub if subhub is not None \
+            else getattr(impl.node, "subhub", None)
+        self._outbox_bytes = max(1, int(outbox_kb)) << 10
+        self._fanout = FanoutWriter()
         # per-client admission (rpc/admission.ClientAdmission), shared
         # with the HTTP edge: WS traffic must not be the unmetered side
         # door around the token buckets/fair share. Keyed by peer address
@@ -233,15 +544,27 @@ class WsRpcServer:
         self.host, self.port = self._ws.host, self._ws.port
 
     def start(self) -> None:
+        self._fanout.start()  # before sessions: pushes need the drain
         self._ws.start()
 
     def stop(self) -> None:
         self._ws.stop()
+        self._fanout.stop()
+
+    def push_drop_stats(self) -> dict:
+        """Outbox drops by class (getSystemStatus `subscriptions`)."""
+        d = self._fanout.drops
+        return {"droppable": d["droppable"],
+                "losslessKills": d["lossless_kill"]}
 
     # -- session lifecycle -------------------------------------------------
     def _on_open(self, conn: WsConnection) -> None:
+        sess = _Session(conn, writer=self._fanout,
+                        outbox_bytes=self._outbox_bytes)
+        if self.subhub is not None:
+            sess.latency_cb = self.subhub.note_latency
         with self._lock:
-            self._sessions[conn] = _Session(conn)
+            self._sessions[conn] = sess
 
     def _on_close(self, conn: WsConnection) -> None:
         with self._lock:
@@ -249,6 +572,9 @@ class WsRpcServer:
         if sess is None:
             return
         sess.close_push()
+        self._fanout.forget(sess)
+        if self.subhub is not None:
+            self.subhub.unsubscribe_owner(sess)
         # copies: a concurrent subscribe dispatch may still add entries (it
         # re-checks session liveness afterwards and cleans up its own)
         for task_id in list(sess.event_tasks):
@@ -416,27 +742,74 @@ class WsRpcServer:
         return {
             "subscribeEvent": self._m_subscribe_event,
             "unsubscribeEvent": self._m_unsubscribe_event,
+            "subscribe": self._m_subscribe,
+            "unsubscribe": self._m_unsubscribe,
             "subscribeTopic": self._m_subscribe_topic,
             "unsubscribeTopic": self._m_unsubscribe_topic,
             "publishTopic": self._m_publish_topic,
             "broadcastTopic": self._m_broadcast_topic,
         }
 
+    # -- push-plane subscriptions (SubHub) ---------------------------------
+    def _m_subscribe(self, sess: _Session, params: list) -> str:
+        """subscribe [kind, options?] -> subId. Kinds: newBlockHeaders,
+        logs ({addresses, topics} filter), pendingTransactions, receipt
+        ({txHash} — lossless one-shot). Admission already metered the
+        request (reader thread); the hub's session/per-owner caps answer
+        a subscription STORM with the typed -32006."""
+        hub = self.subhub
+        if hub is None:
+            raise JsonRpcError(-32000, "node has no subscription plane")
+        if not params or not isinstance(params[0], str):
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS,
+                               "need [kind, options?]")
+        kind = params[0]
+        if kind not in SUB_KINDS:
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS,
+                               f"unknown subscription kind {kind!r}")
+        opts = params[1] if len(params) > 1 and isinstance(params[1], dict) \
+            else {}
+        flt = None
+        tx_hash = None
+        if kind == "logs" and (opts.get("addresses") or opts.get("topics")):
+            flt = _parse_event_filter(opts)
+        if kind == "receipt":
+            h = opts.get("txHash")
+            if not h:
+                raise JsonRpcError(JSONRPC_INVALID_PARAMS,
+                                   "receipt subscription needs {txHash}")
+            tx_hash = bytes.fromhex(str(h).removeprefix("0x"))
+        try:
+            sub_id = hub.subscribe(kind, sess.push, owner=sess, flt=flt,
+                                   tx_hash=tx_hash)
+        except SubLimitError as exc:
+            raise JsonRpcError(JSONRPC_SUB_LIMIT, str(exc)) from exc
+        sess.sub_ids.add(sub_id)
+        if not self._session_alive(sess):
+            # disconnect raced the subscribe: _on_close already swept the
+            # hub by owner, but this sub may have registered after —
+            # clean up here instead of leaking it forever
+            hub.unsubscribe(sub_id)
+            raise JsonRpcError(-32000, "session closed")
+        return sub_id
+
+    def _m_unsubscribe(self, sess: _Session, params: list) -> bool:
+        if not params:
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS, "need [subId]")
+        sub_id = params[-1]
+        if sub_id not in sess.sub_ids:  # only a session's own streams
+            raise JsonRpcError(JSONRPC_INVALID_PARAMS,
+                               "unknown subscription id")
+        sess.sub_ids.discard(sub_id)
+        hub = self.subhub
+        return hub.unsubscribe(sub_id) if hub is not None else False
+
     # -- event subscription push ------------------------------------------
     def _m_subscribe_event(self, sess: _Session, params: list) -> str:
         if len(params) < 2 or not isinstance(params[1], dict):
             raise JsonRpcError(JSONRPC_INVALID_PARAMS,
                                "need [group, filter]")
-        f = params[1]
-        addresses = ({bytes.fromhex(a.removeprefix("0x"))
-                      for a in f["addresses"]}
-                     if f.get("addresses") else None)
-        topics = [None if t is None
-                  else {bytes.fromhex(x.removeprefix("0x")) for x in t}
-                  for t in f.get("topics", [])]
-        flt = EventFilter(from_block=int(f.get("fromBlock", 0)),
-                          to_block=int(f.get("toBlock", -1)),
-                          addresses=addresses, topics=topics)
+        flt = _parse_event_filter(params[1])
         # eventsub.subscribe replays history synchronously BEFORE returning
         # the task id, and the commit thread may pump concurrently; buffer
         # pushes under a lock until the id exists so every push carries a

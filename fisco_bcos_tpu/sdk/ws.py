@@ -11,7 +11,9 @@ channels a stateless HTTP client cannot have.
 from __future__ import annotations
 
 import json
+import queue
 import threading
+import time
 from typing import Callable, Optional
 
 from ..net.websocket import OP_TEXT, WsError, ws_connect
@@ -28,12 +30,20 @@ class WsSdkClient(SdkClient):
         # note: no HTTP url — we bypass SdkClient's transport entirely
         super().__init__(url=f"ws://{host}:{port}", group=group)
         self.timeout = timeout
+        self._host, self._port = host, port
         self.conn = ws_connect(host, port, timeout=timeout)
         self._lock = threading.Lock()
         self._waiting: dict[int, tuple[threading.Event, list]] = {}
         self._event_handlers: dict[str, Callable] = {}
         self._orphan_pushes: dict[str, list] = {}  # pushes preceding the id
         self._topic_handlers: dict[str, Callable] = {}
+        # push-plane subscription state (SubHub): sub_id -> (kind, options)
+        # so a socket reset can resubscribe; _sub_alias maps the id the
+        # CALLER holds to the live id after a reconnect re-registered it
+        self._subs: dict[str, tuple] = {}
+        self._sub_alias: dict[str, str] = {}
+        self._events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
+        self._down = False  # socket lost, reconnect in progress
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop,
                                         name="sdk-ws-reader", daemon=True)
@@ -50,7 +60,7 @@ class WsSdkClient(SdkClient):
         ev = threading.Event()
         out: list = []
         with self._lock:
-            if self._closed:
+            if self._closed or self._down:
                 raise RpcCallError(-32000, "ws connection closed")
             self._waiting[rid] = (ev, out)
         self.conn.send_text(json.dumps({
@@ -67,37 +77,86 @@ class WsSdkClient(SdkClient):
         return resp.get("result")
 
     def _read_loop(self) -> None:
-        try:
-            while not self._closed:
-                try:
-                    msg = self.conn.recv()
-                except (WsError, OSError):
-                    break
-                if msg is None:
-                    break
-                op, payload = msg
-                if op != OP_TEXT:
-                    continue
-                try:
-                    obj = json.loads(payload)
-                    self._route(obj)
-                except Exception:
-                    # one bad message must not kill the client, but a
-                    # push-callback bug repeating on every frame must not
-                    # be invisible either (bcoslint
-                    # swallowed-worker-exception finding)
-                    LOG.exception(badge("SDKWS", "message-dropped"))
-                    continue
-        finally:
-            # fail every in-flight waiter instead of letting it time out
+        while True:
+            self._pump()
+            # socket is gone: fail in-flight waiters NOW (they must not
+            # burn their timeout), then — unless close() was deliberate —
+            # reconnect and resubscribe the push-plane streams
             with self._lock:
-                self._closed = True
+                self._down = True
                 waiting = list(self._waiting.values())
                 self._waiting.clear()
             for ev, out in waiting:
                 out.append({"error": {"code": -32000,
                                       "message": "ws connection closed"}})
                 ev.set()
+            if self._closed or not self._reconnect():
+                with self._lock:
+                    self._closed = True
+                return
+
+    def _pump(self) -> None:
+        while not self._closed:
+            try:
+                msg = self.conn.recv()
+            except (WsError, OSError):
+                return
+            if msg is None:
+                return
+            op, payload = msg
+            if op != OP_TEXT:
+                continue
+            try:
+                obj = json.loads(payload)
+                self._route(obj)
+            except Exception:
+                # one bad message must not kill the client, but a
+                # push-callback bug repeating on every frame must not
+                # be invisible either (bcoslint
+                # swallowed-worker-exception finding)
+                LOG.exception(badge("SDKWS", "message-dropped"))
+                continue
+
+    def _reconnect(self) -> bool:
+        for delay in (0.05, 0.2, 0.5, 1.0, 2.0):
+            if self._closed:
+                return False
+            try:
+                self.conn = ws_connect(self._host, self._port,
+                                       timeout=self.timeout)
+            except Exception:
+                time.sleep(delay)
+                continue
+            with self._lock:
+                self._down = False
+                subs = list(self._subs.items())
+            if subs:
+                # NOT inline: resubscribing uses request(), whose
+                # responses only the reader (this thread) can deliver —
+                # it must be back in _pump before they arrive
+                threading.Thread(target=self._resubscribe, args=(subs,),
+                                 name="sdk-ws-resub", daemon=True).start()
+            LOG.info(badge("SDKWS", "reconnected", resubs=len(subs)))
+            return True
+        return False
+
+    def _resubscribe(self, subs: list) -> None:
+        for old_id, (kind, options) in subs:
+            try:
+                new_id = self.request(
+                    "subscribe", [kind, options] if options else [kind])
+            except Exception:
+                LOG.warning(badge("SDKWS", "resubscribe-failed",
+                                  kind=kind, sub=old_id))
+                continue
+            with self._lock:
+                self._subs.pop(old_id, None)
+                self._subs[new_id] = (kind, options)
+                # the caller still holds old_id: route unsubscribes
+                for held, live in list(self._sub_alias.items()):
+                    if live == old_id:
+                        self._sub_alias[held] = new_id
+                self._sub_alias[old_id] = new_id
 
     def _route(self, obj: dict) -> None:
         if "id" in obj and obj.get("type") is None:
@@ -119,6 +178,13 @@ class WsSdkClient(SdkClient):
                 cb(obj)
             except Exception:
                 pass
+        elif obj.get("method") == "subscription":
+            # push-plane notification (SubHub fan-out): params =
+            # {"subscription", "kind", "result"} — queue for next_event()
+            try:
+                self._events.put_nowait(obj.get("params") or {})
+            except queue.Full:
+                pass  # local consumer too slow: shed (live stream)
         elif obj.get("type") == "amopPush":
             # off the reader thread: a topic handler may itself issue
             # request()s, whose responses only this reader can deliver
@@ -144,6 +210,38 @@ class WsSdkClient(SdkClient):
                 "data": "0x" + (reply or b"").hex()}))
         except Exception:
             pass  # connection raced shut; the publisher times out
+
+    # -- push-plane subscriptions (SubHub) ---------------------------------
+    def subscribe(self, kind: str, options: Optional[dict] = None) -> str:
+        """Open a push stream: kind is one of newBlockHeaders / logs
+        ({addresses, topics} filter) / pendingTransactions / receipt
+        ({txHash} — one-shot). Events arrive via `next_event()`. The
+        stream survives a socket reset: the client reconnects and
+        resubscribes, and the returned id keeps working for
+        `unsubscribe()` (a receipt stream may replay its completion
+        after a reset — consumers should treat events as at-least-once)."""
+        sub_id = self.request("subscribe",
+                              [kind, options] if options else [kind])
+        with self._lock:
+            self._subs[sub_id] = (kind, options)
+        return sub_id
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self._lock:
+            live = self._sub_alias.pop(sub_id, sub_id)
+            self._subs.pop(live, None)
+        try:
+            return bool(self.request("unsubscribe", [live]))
+        except RpcCallError:
+            return False  # already completed (one-shot) or session reset
+
+    def next_event(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Next queued push notification ({"subscription", "kind",
+        "result"}), or None after `timeout` seconds (None = block)."""
+        try:
+            return self._events.get(timeout=timeout)
+        except queue.Empty:
+            return None
 
     # -- push channels -----------------------------------------------------
     def subscribe_event(self, flt: dict, cb: Callable) -> str:

@@ -159,7 +159,12 @@ def node_config_to_ini(cfg: NodeConfig) -> str:
                  "max_batch": str(cfg.rpc_max_batch),
                  "cache_entries": str(cfg.rpc_cache_entries),
                  "cache_mb": str(cfg.rpc_cache_mb),
-                 "keepalive_s": str(cfg.rpc_keepalive_s)}
+                 "keepalive_s": str(cfg.rpc_keepalive_s),
+                 # push-based subscription plane (rpc/eventsub.SubHub);
+                 # ws_port empty = no WS server, 0 = ephemeral
+                 "ws_port": "" if cfg.ws_port is None else str(cfg.ws_port),
+                 "sub_max_sessions": str(cfg.sub_max_sessions),
+                 "sub_outbox_kb": str(cfg.sub_outbox_kb)}
     cp["p2p"] = {"listen_ip": cfg.p2p_host,
                  "listen_port": "" if cfg.p2p_port is None else str(cfg.p2p_port),
                  # NodeConfig.cpp's nodes.json connected_nodes, inlined
@@ -209,6 +214,7 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
     kps_raw = cp.get("storage", "key_page_size", fallback="auto").strip()
     key_page_size = -1 if kps_raw in ("", "auto") else int(kps_raw)
     port_s = cp.get("rpc", "listen_port", fallback="")
+    ws_s = cp.get("rpc", "ws_port", fallback="")
     metrics_s = cp.get("monitor", "metrics_port", fallback="")
     p2p_port_s = cp.get("p2p", "listen_port", fallback="")
     peers = []
@@ -301,6 +307,10 @@ def node_config_from_ini(text: str, base_dir: str = "") -> NodeConfig:
         rpc_cache_entries=cp.getint("rpc", "cache_entries", fallback=4096),
         rpc_cache_mb=cp.getint("rpc", "cache_mb", fallback=64),
         rpc_keepalive_s=cp.getfloat("rpc", "keepalive_s", fallback=60.0),
+        ws_port=int(ws_s) if ws_s else None,
+        sub_max_sessions=cp.getint("rpc", "sub_max_sessions",
+                                   fallback=16384),
+        sub_outbox_kb=cp.getint("rpc", "sub_outbox_kb", fallback=1024),
         metrics_port=int(metrics_s) if metrics_s else None,
         trace_sample_rate=cp.getfloat("trace", "sample_rate",
                                       fallback=0.02),

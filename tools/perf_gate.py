@@ -71,8 +71,15 @@ _LOWER_SUFFIXES = ("_ms", "_seconds", "_mb", "_cost_pct", "_ns")
 # lower-is-better fields whose names don't carry a _LOWER suffix: the
 # sealer's idle threading-wait share of attributed CPU (the event-driven
 # sealer's acceptance number — PR 16 measured 15.4% under the 0.02 s poll)
-_LOWER_EXACT = {"seal_wait_share_pct"}
+_LOWER_EXACT = {"seal_wait_share_pct",
+                # push-plane acceptance numbers (PR 20): commit->client
+                # notify tail (also caught by the _ms suffix — pinned
+                # here so a rename can't silently un-gate it) and the
+                # fan-out CPU burned per delivered notification (the
+                # zero-extra-render contract: flat as subscribers grow)
+                "sub_notify_p99_ms", "sub_cpu_us_per_notify"}
 _SKIP = {"cpu_cores", "rpc_ingest_clients", "rpc_read_clients",
+         "sub_subscribers",
          "poseidon_batch", "overload_rate_limited", "live_value",
          "cpu_baseline_sigs_per_sec", "spin_score", "sampled_at",
          "measured_at",
